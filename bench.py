@@ -1,0 +1,77 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Flagship workload: VGG-11/CIFAR-10 jitted train step (the reference's
+part1 measurement: 39 timed iterations at batch 256, iteration 0 excluded
+— ``part1/main.py:32-58``; 2.39 s/iter on its CPU node, group25.pdf p.2).
+
+Metric: images/sec through the train step on the available device.
+``vs_baseline`` compares against the reference's measured part1 rate
+(256 / 2.39 s ≈ 107.1 imgs/sec — BASELINE.md).
+
+The trunk runs in bfloat16 (MXU-native; master weights and loss stay
+fp32).  Uses the synthetic CIFAR stand-in when the real dataset is not on
+disk — identical shapes/dtypes, so the throughput number is unaffected.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_machine_learning_tpu.cli.common import init_model_and_state
+from distributed_machine_learning_tpu.data.cifar10 import load_cifar10
+from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.train.step import make_train_step
+
+BATCH = 256  # part1/main.py:18
+TIMED_ITERS = 39  # part1 protocol: 40 iters, iteration 0 excluded
+BASELINE_IMGS_PER_SEC = 256 / 2.39  # group25.pdf p.2 → 107.1
+
+
+def main() -> None:
+    model = VGG11(compute_dtype=jnp.bfloat16)
+    state = init_model_and_state(model)
+    step = make_train_step(model, mesh=None, augment=True)
+
+    train = load_cifar10("./data", train=True)
+    images = train.images[: BATCH * 8]
+    labels = train.labels[: BATCH * 8]
+
+    def batch(i):
+        s = (i * BATCH) % (len(labels) - BATCH + 1)
+        return (
+            jnp.asarray(images[s : s + BATCH]),
+            jnp.asarray(labels[s : s + BATCH]),
+        )
+
+    # Warm-up / compile (the reference's excluded iteration 0).
+    x, y = batch(0)
+    state, loss = step(state, x, y)
+    jax.block_until_ready(loss)
+
+    start = time.perf_counter()
+    for i in range(1, TIMED_ITERS + 1):
+        x, y = batch(i)
+        state, loss = step(state, x, y)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+
+    imgs_per_sec = BATCH * TIMED_ITERS / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "vgg11_cifar10_train_imgs_per_sec",
+                "value": round(imgs_per_sec, 2),
+                "unit": "imgs/sec",
+                "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

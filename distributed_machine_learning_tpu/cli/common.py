@@ -1,0 +1,133 @@
+"""Shared runner behind the four entrypoints.
+
+The reference's four parts are copy-pasted clones varying only in the
+gradient-sync layer (SURVEY.md §1); here one runner takes the strategy
+(and each part's constants) as parameters.  The reference CLI flags are
+kept verbatim (north-star): ``--master-ip`` (default ``127.0.1.1:8000``),
+``--rank`` (0), ``--num-nodes`` (1) — ``part2/2a/main.py:210-218``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from distributed_machine_learning_tpu.data.cifar10 import load_cifar10
+from distributed_machine_learning_tpu.data.distributed_loader import (
+    DistributedBatchLoader,
+)
+from distributed_machine_learning_tpu.data.loader import BatchLoader
+from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.parallel.strategies import get_strategy
+from distributed_machine_learning_tpu.runtime.distributed import (
+    DEFAULT_MASTER_IP,
+    initialize_from_flags,
+)
+from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+from distributed_machine_learning_tpu.train.loop import evaluate, train_epoch
+from distributed_machine_learning_tpu.train.sgd import SGDConfig
+from distributed_machine_learning_tpu.train.state import TrainState
+from distributed_machine_learning_tpu.train.step import (
+    make_eval_step,
+    make_train_step,
+    shard_batch,
+)
+from distributed_machine_learning_tpu.utils.logging import rank0_print
+
+SEED = 69143  # part1/main.py:17
+EVAL_BATCH = 256
+
+
+def make_flag_parser(description: str) -> argparse.ArgumentParser:
+    """The reference's exact flag surface (part2/2a/main.py:210-218)."""
+    parser = argparse.ArgumentParser(description=description)
+    parser.add_argument("--master-ip", dest="master_ip", default=DEFAULT_MASTER_IP,
+                        type=str, help="coordinator address host:port")
+    parser.add_argument("--rank", default=0, type=int, help="process rank")
+    parser.add_argument("--num-nodes", dest="num_nodes", default=1, type=int,
+                        help="number of processes")
+    parser.add_argument("--data-root", default="./data", type=str)
+    parser.add_argument("--epochs", default=1, type=int)  # range(1): part1/main.py:123
+    parser.add_argument("--compute-dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="trunk compute dtype (bfloat16 targets the MXU)")
+    # Extensions beyond the reference surface (defaults reproduce it).
+    parser.add_argument("--max-iters", default=40, type=int,
+                        help="training iteration cap (reference: 40)")
+    parser.add_argument("--batch-size", default=None, type=int,
+                        help="override the part's per-worker batch size")
+    parser.add_argument("--eval-batches", default=None, type=int,
+                        help="cap eval batches (default: full test set)")
+    return parser
+
+
+def init_model_and_state(model, seed: int = SEED, config: SGDConfig | None = None):
+    """Initialize once from the shared seed → identical weights everywhere,
+    the property the reference gets by seeding every rank before building
+    the model (``part2/2a/main.py:199``, SURVEY.md §2.5)."""
+    rng = jax.random.PRNGKey(seed)
+    init_rng, state_rng = jax.random.split(rng)
+    variables = model.init(init_rng, jax.numpy.zeros((1, 32, 32, 3)), train=False)
+    return TrainState.create(
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats"),
+        rng=state_rng,
+        config=config,
+    )
+
+
+def run_part(
+    strategy_name: str,
+    per_rank_batch: int,
+    use_bn: bool,
+    args,
+    strategy_kwargs: dict | None = None,
+) -> None:
+    """Train VGG-11/CIFAR-10 for `args.epochs` under one sync strategy."""
+    import jax.numpy as jnp
+
+    ctx = initialize_from_flags(args.master_ip, args.rank, args.num_nodes)
+    try:
+        distributed = strategy_name != "none"
+        mesh = make_mesh() if distributed else None
+        world = mesh.shape["batch"] if mesh is not None else 1
+        # Reference banner (part2/2a/main.py:200-203).
+        rank0_print(
+            f"strategy={strategy_name} world_size={world} "
+            f"devices={jax.device_count()} processes={jax.process_count()}"
+        )
+
+        compute_dtype = jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
+        model = VGG11(use_bn=use_bn, compute_dtype=compute_dtype)
+        state = init_model_and_state(model)
+        strategy = get_strategy(strategy_name, **(strategy_kwargs or {}))
+        train_step = make_train_step(model, strategy, mesh=mesh)
+        eval_step = make_eval_step(model)
+
+        train_set = load_cifar10(args.data_root, train=True)
+        test_set = load_cifar10(args.data_root, train=False)
+        if train_set.synthetic:
+            rank0_print("WARNING: CIFAR-10 not found on disk — using the "
+                        "deterministic synthetic stand-in dataset.")
+
+        if args.batch_size is not None:
+            per_rank_batch = args.batch_size
+        place = (lambda i, l: shard_batch(mesh, i, l)) if mesh is not None else None
+        for _ in range(args.epochs):
+            if distributed:
+                batches = DistributedBatchLoader(train_set, per_rank_batch, world)
+            else:
+                batches = BatchLoader(train_set, per_rank_batch)
+            state, _ = train_epoch(
+                train_step, state, batches, place_batch=place,
+                max_iters=args.max_iters,
+            )
+            eval_batches = BatchLoader(test_set, EVAL_BATCH)
+            if args.eval_batches is not None:
+                import itertools
+
+                eval_batches = itertools.islice(iter(eval_batches), args.eval_batches)
+            evaluate(eval_step, state, eval_batches)
+    finally:
+        ctx.shutdown()  # dist.destroy_process_group parity (part2/2a/main.py:207)

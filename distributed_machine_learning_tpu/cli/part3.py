@@ -1,0 +1,33 @@
+"""part3 — bucketed ring all-reduce (reference ``part3/main.py``).
+
+The reference wraps the model in DDP with 25 MB buckets
+(``part3/main.py:137``) — bucketed ring all-reduce with averaging, BN
+enabled (``part3/model.py:24``).  Here: the hand-rolled explicit
+``lax.ppermute`` ring (the north-star), 25 MB buckets, mean semantics,
+VGG-11 with BatchNorm.
+"""
+
+from __future__ import annotations
+
+from distributed_machine_learning_tpu.cli.common import make_flag_parser, run_part
+from distributed_machine_learning_tpu.ops.ring import DEFAULT_BUCKET_BYTES
+
+BATCH_SIZE = 64  # per worker — part3/main.py:31
+
+
+def main(argv=None) -> None:
+    parser = make_flag_parser(__doc__)
+    parser.add_argument("--bucket-mb", default=25, type=int,
+                        help="ring all-reduce bucket size (part3/main.py:137)")
+    args = parser.parse_args(argv)
+    run_part(
+        "ring",
+        per_rank_batch=BATCH_SIZE,
+        use_bn=True,
+        args=args,
+        strategy_kwargs={"bucket_bytes": args.bucket_mb * 2**20},
+    )
+
+
+if __name__ == "__main__":
+    main()

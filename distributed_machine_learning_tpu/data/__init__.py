@@ -1,0 +1,18 @@
+from distributed_machine_learning_tpu.data.cifar10 import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    load_cifar10,
+)
+from distributed_machine_learning_tpu.data.sharding import shard_indices
+from distributed_machine_learning_tpu.data.loader import BatchLoader
+from distributed_machine_learning_tpu.data.augment import augment_batch, normalize
+
+__all__ = [
+    "CIFAR10_MEAN",
+    "CIFAR10_STD",
+    "load_cifar10",
+    "shard_indices",
+    "BatchLoader",
+    "augment_batch",
+    "normalize",
+]

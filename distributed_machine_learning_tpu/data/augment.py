@@ -1,0 +1,58 @@
+"""Device-side normalization and augmentation.
+
+The reference augments on the host per-sample through torchvision
+transforms: RandomCrop(32, padding=4) + RandomHorizontalFlip, then
+normalizes with fixed CIFAR statistics (``part1/main.py:82-89``).
+
+TPU-first redesign: the batch crosses host→device as uint8 NHWC and both
+normalization and augmentation run **inside the jitted train step** —
+they're elementwise/gather ops XLA fuses into the first conv's input, so
+augmentation is effectively free and the host pipeline has nothing to do
+but slice contiguous uint8.  Randomness is stateless `jax.random` keyed
+from the train-state PRNG (seed 69143 — ``part1/main.py:17``), which keeps
+every rank's augmentation stream deterministic and reproducible, the
+property the reference gets from per-rank torch seeding.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_machine_learning_tpu.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+
+
+def normalize(images_u8: jax.Array) -> jax.Array:
+    """uint8 NHWC → normalized fp32 (ToTensor + Normalize, part1/main.py:82-83)."""
+    x = images_u8.astype(jnp.float32) / 255.0
+    mean = jnp.asarray(CIFAR10_MEAN)
+    std = jnp.asarray(CIFAR10_STD)
+    return (x - mean) / std
+
+
+def _random_crop_one(key: jax.Array, img: jax.Array, padding: int = 4) -> jax.Array:
+    """RandomCrop(32, padding=4): zero-pad to 40×40, take a random 32×32 window."""
+    h, w, _ = img.shape
+    padded = jnp.pad(img, ((padding, padding), (padding, padding), (0, 0)))
+    kx, ky = jax.random.split(key)
+    top = jax.random.randint(kx, (), 0, 2 * padding + 1)
+    left = jax.random.randint(ky, (), 0, 2 * padding + 1)
+    return jax.lax.dynamic_slice(padded, (top, left, 0), (h, w, img.shape[2]))
+
+
+def augment_batch(key: jax.Array, images_u8: jax.Array) -> jax.Array:
+    """RandomCrop(32, pad=4) + RandomHorizontalFlip + normalize, whole batch.
+
+    vmapped per-image so each sample draws its own crop offset / flip coin,
+    like torchvision's per-sample transforms; everything stays static-shaped
+    so XLA tiles it without host round-trips.
+    """
+    n = images_u8.shape[0]
+    crop_keys, flip_key = (
+        jax.random.split(jax.random.fold_in(key, 0), n),
+        jax.random.fold_in(key, 1),
+    )
+    cropped = jax.vmap(_random_crop_one)(crop_keys, images_u8)
+    flip = jax.random.bernoulli(flip_key, 0.5, (n,))
+    flipped = jnp.where(flip[:, None, None, None], cropped[:, :, ::-1, :], cropped)
+    return normalize(flipped)

@@ -1,0 +1,120 @@
+"""CIFAR-10 without torchvision.
+
+The reference loads CIFAR-10 through ``torchvision.datasets.CIFAR10``
+(``part1/main.py:96-97``).  Torchvision's loader just unpickles the
+standard "cifar-10-batches-py" payload (five 10k-image training batches +
+one test batch of dicts with ``b'data'`` (N,3072) uint8 row-major CHW and
+``b'labels'``).  We parse that layout directly.
+
+Sources tried, in order:
+1. a local copy under ``root`` (``cifar-10-batches-py/`` or the .tar.gz);
+2. download (the reference passes ``download=True``) — gated, since this
+   environment has no egress;
+3. a deterministic synthetic stand-in (seeded, same shapes/dtype/label
+   distribution) so every part of the framework — and the benchmark — runs
+   without the dataset on disk.  Synthetic data is clearly labeled in the
+   returned metadata.
+
+Images are returned NHWC uint8 — normalization/augmentation happen on
+device (see ``augment.py``), so host→device transfer ships 3 KB/image
+instead of 12 KB of fp32.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from dataclasses import dataclass
+
+import numpy as np
+
+# Reference normalization constants (part1/main.py:82-83).
+CIFAR10_MEAN = np.array([125.3, 123.0, 113.9], dtype=np.float32) / 255.0
+CIFAR10_STD = np.array([63.0, 62.1, 66.7], dtype=np.float32) / 255.0
+
+_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+_DIRNAME = "cifar-10-batches-py"
+_TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
+_TEST_FILES = ["test_batch"]
+
+
+@dataclass
+class Dataset:
+    images: np.ndarray  # (N, 32, 32, 3) uint8, NHWC
+    labels: np.ndarray  # (N,) int32
+    synthetic: bool = False
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+
+def _unpickle(path: str) -> dict:
+    with open(path, "rb") as f:
+        return pickle.load(f, encoding="bytes")
+
+
+def _load_batches(batch_dir: str, files: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    images, labels = [], []
+    for name in files:
+        d = _unpickle(os.path.join(batch_dir, name))
+        # (N, 3072) uint8, row-major CHW → NHWC
+        imgs = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        images.append(imgs)
+        labels.append(np.asarray(d[b"labels"], dtype=np.int32))
+    return np.concatenate(images), np.concatenate(labels)
+
+
+def _maybe_extract(root: str) -> str | None:
+    batch_dir = os.path.join(root, _DIRNAME)
+    if os.path.isdir(batch_dir):
+        return batch_dir
+    tar_path = os.path.join(root, "cifar-10-python.tar.gz")
+    if os.path.isfile(tar_path):
+        with tarfile.open(tar_path, "r:gz") as tar:
+            tar.extractall(root)
+        return batch_dir if os.path.isdir(batch_dir) else None
+    return None
+
+
+def _synthetic(train: bool, seed: int = 69143) -> Dataset:
+    """Deterministic stand-in with CIFAR shapes and plausible statistics."""
+    n = 50_000 if train else 10_000
+    rng = np.random.default_rng(seed + (0 if train else 1))
+    # Class-conditional means so a model can actually learn from it in tests.
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    base = rng.integers(0, 256, size=(10, 32, 32, 3), dtype=np.int64)
+    noise = rng.integers(-40, 41, size=(n, 32, 32, 3), dtype=np.int64)
+    images = np.clip(base[labels] + noise, 0, 255).astype(np.uint8)
+    return Dataset(images=images, labels=labels, synthetic=True)
+
+
+def load_cifar10(
+    root: str = "./data",
+    train: bool = True,
+    download: bool = True,
+    allow_synthetic: bool = True,
+) -> Dataset:
+    """Load CIFAR-10, mirroring ``datasets.CIFAR10(root, train, download)``."""
+    batch_dir = _maybe_extract(root) if os.path.isdir(root) else None
+    if batch_dir is None and download:
+        try:
+            import urllib.request
+
+            os.makedirs(root, exist_ok=True)
+            tar_path = os.path.join(root, "cifar-10-python.tar.gz")
+            urllib.request.urlretrieve(_URL, tar_path)  # no egress here → raises
+            batch_dir = _maybe_extract(root)
+        except Exception:
+            batch_dir = None
+    if batch_dir is not None:
+        images, labels = _load_batches(
+            batch_dir, _TRAIN_FILES if train else _TEST_FILES
+        )
+        return Dataset(images=images, labels=labels, synthetic=False)
+    if allow_synthetic:
+        return _synthetic(train)
+    raise FileNotFoundError(
+        f"CIFAR-10 not found under {root!r} and download failed; "
+        "pass allow_synthetic=True for the deterministic stand-in."
+    )

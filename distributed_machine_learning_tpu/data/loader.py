@@ -1,0 +1,96 @@
+"""Minimal batched loader.
+
+Replaces the reference's ``DataLoader(batch_size, shuffle=False,
+pin_memory=True)`` (``part2/2a/main.py:162-167``).  Because augmentation
+and normalization moved on-device (``augment.py``), the host side reduces
+to contiguous uint8 slicing — there is nothing left for worker processes
+to do, so no multiprocessing machinery is needed (pin_memory has no TPU
+equivalent; transfers stage through the runtime).  A background-thread
+prefetcher overlaps the (tiny) host slicing + H2D with device compute.
+A C++ fast path for parsing/slicing lives in ``native/`` (see
+``native_loader.py``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import numpy as np
+
+from distributed_machine_learning_tpu.data.cifar10 import Dataset
+
+
+class BatchLoader:
+    """Iterates (images_u8, labels) batches over given indices.
+
+    drop_last=False like the reference's DataLoader: the final short batch
+    is yielded as-is (the reference's 40-iteration cap makes this moot for
+    training, but eval consumes the full test set — part1/main.py:67).
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        batch_size: int,
+        indices: np.ndarray | None = None,
+        prefetch: int = 2,
+    ):
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.indices = (
+            np.arange(len(dataset)) if indices is None else np.asarray(indices)
+        )
+        self.prefetch = prefetch
+
+    def __len__(self) -> int:
+        return (len(self.indices) + self.batch_size - 1) // self.batch_size
+
+    def _batches(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        imgs, labels = self.dataset.images, self.dataset.labels
+        for start in range(0, len(self.indices), self.batch_size):
+            idx = self.indices[start : start + self.batch_size]
+            yield imgs[idx], labels[idx]
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        if self.prefetch <= 0:
+            yield from self._batches()
+            return
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        sentinel = object()
+
+        def producer():
+            for batch in self._batches():
+                # Bounded put that aborts if the consumer goes away (the
+                # training loop breaks at its 40-iteration cap mid-epoch —
+                # part1/main.py:32-33 — so early abandonment is the norm).
+                while not stop.is_set():
+                    try:
+                        q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+        finally:
+            stop.set()
+            t.join()

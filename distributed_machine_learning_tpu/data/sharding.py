@@ -1,0 +1,44 @@
+"""Deterministic data sharding with DistributedSampler semantics.
+
+The reference shards with
+``DistributedSampler(training_set, rank=rank, num_replicas=nodes,
+shuffle=False, seed=69143)`` (``part2/2a/main.py:158-159``).  torch's
+sampler with shuffle off does:
+
+    indices = [0, 1, ..., N-1]
+    pad with the head of the list until len % num_replicas == 0
+    take indices[rank::num_replicas]          # rank-strided
+
+so rank r sees samples r, r+W, r+2W, ...  We reproduce exactly that, so a
+step's global batch across W ranks is the same set of samples the
+reference's W gloo workers consumed — the precondition for the
+numerical-equivalence tests (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def shard_indices(
+    num_samples: int,
+    rank: int,
+    num_replicas: int,
+    shuffle: bool = False,
+    seed: int = 69143,
+    epoch: int = 0,
+) -> np.ndarray:
+    """Indices this rank consumes, DistributedSampler-compatible."""
+    if not 0 <= rank < num_replicas:
+        raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+    if shuffle:
+        # torch shuffles with a generator seeded seed+epoch.
+        rng = np.random.default_rng(seed + epoch)
+        indices = rng.permutation(num_samples)
+    else:
+        indices = np.arange(num_samples)
+    # Pad by wrapping from the head so every rank gets the same count.
+    total = ((num_samples + num_replicas - 1) // num_replicas) * num_replicas
+    if total > num_samples:
+        indices = np.concatenate([indices, indices[: total - num_samples]])
+    return indices[rank::num_replicas]

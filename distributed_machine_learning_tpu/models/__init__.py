@@ -1,0 +1,3 @@
+from distributed_machine_learning_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19
+
+__all__ = ["VGG", "VGG11", "VGG13", "VGG16", "VGG19"]

@@ -1,0 +1,44 @@
+"""Parameter initializers matching torch's default distributions.
+
+The reference never sets custom inits, so its weights come from torch's
+defaults (``nn.Conv2d``/``nn.Linear``): Kaiming-uniform with a=sqrt(5) on
+the weight — which works out to U(-1/sqrt(fan_in), 1/sqrt(fan_in)) — and
+U(-1/sqrt(fan_in), 1/sqrt(fan_in)) on the bias.  Flax's defaults
+(lecun_normal / zeros-bias) have different variance; since the reference's
+seed-69143 determinism story depends on every rank drawing identical
+initial weights (``part2/2a/main.py:199``, SURVEY.md §2.5), we match the
+*distribution* (bitwise identity across frameworks is impossible — RNGs
+differ) and keep cross-rank identity by construction: params are initialized
+once from a shared PRNGKey and replicated.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _fan_in(shape, is_conv: bool) -> int:
+    if is_conv:
+        # Flax conv kernel shape: (H, W, in_ch, out_ch)
+        receptive = int(np.prod(shape[:-2]))
+        return receptive * shape[-2]
+    # Dense kernel shape: (in, out)
+    return shape[0]
+
+
+def torch_kernel_init(key, shape, dtype=jnp.float32):
+    """torch's kaiming_uniform_(a=sqrt(5)) == U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    bound = 1.0 / np.sqrt(_fan_in(shape, is_conv=len(shape) > 2))
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def make_torch_bias_init(fan_in: int):
+    """torch bias init: U(-1/sqrt(fan_in), 1/sqrt(fan_in)) with the *weight's* fan-in."""
+    bound = 1.0 / np.sqrt(fan_in)
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+    return init

@@ -1,0 +1,126 @@
+"""VGG family for 32×32 CIFAR-10, cfg-driven, TPU-native (Flax linen).
+
+Capability parity with the reference's ``part1/model.py`` (cloned into
+part2/2a, part2/2b, part3):
+
+- cfg table exposing VGG11/13/16/19 (``part1/model.py:3-8``; the reference
+  only wires up ``VGG11()`` at ``:49-50`` — we expose all four).
+- 3×3 stride-1 pad-1 convs with bias, ReLU, 2×2 max-pools
+  (``part1/model.py:11-27``).
+- optional BatchNorm: commented out in part1/2a/2b (``part1/model.py:24``;
+  the report removed it because unsynced running stats caused cross-node
+  accuracy drift), **enabled** in part3 (``part3/model.py:24``).  Here it is
+  a constructor flag — `use_bn=True` reproduces part3's model; the
+  running stats live in the `batch_stats` collection and are axis-synced
+  by the distributed train step (the reference's per-node unsynced stats
+  were a quirk its report flagged as causing accuracy drift).
+- single Linear(512→10) head on the flattened 1×1×512 feature map
+  (``part1/model.py:38-46``).
+
+TPU-first notes: NHWC layout (XLA:TPU's native conv layout), optional
+bfloat16 compute (params stay fp32; casts fuse into the convs so the MXU
+runs bf16 while the optimizer sees fp32), no Python control flow dependent
+on data — the whole forward traces to one fusable XLA graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_machine_learning_tpu.models.initializers import (
+    make_torch_bias_init,
+    torch_kernel_init,
+)
+
+# Reference cfg table (part1/model.py:3-8): ints are conv output channels,
+# 'M' is a 2×2 max-pool.
+_cfg: dict[str, Sequence] = {
+    "VGG11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "VGG13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "VGG16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+              512, 512, 512, "M"],
+    "VGG19": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    """VGG for NHWC 3-channel 32×32 input, `num_classes` logits.
+
+    Attributes:
+      name_cfg: one of VGG11/VGG13/VGG16/VGG19.
+      use_bn: part3 parity flag (BatchNorm2d after each conv,
+        ``part3/model.py:24``); off reproduces part1/2a/2b.
+      num_classes: classifier width (reference: 10).
+      compute_dtype: activations/matmul dtype; bfloat16 targets the MXU,
+        float32 reproduces the reference numerics.
+    """
+
+    name_cfg: str = "VGG11"
+    use_bn: bool = False
+    num_classes: int = 10
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.compute_dtype)
+        in_channels = 3
+        for layer_cfg in _cfg[self.name_cfg]:
+            if layer_cfg == "M":
+                x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(
+                    features=layer_cfg,
+                    kernel_size=(3, 3),
+                    strides=(1, 1),
+                    padding=1,
+                    use_bias=True,
+                    kernel_init=torch_kernel_init,
+                    bias_init=make_torch_bias_init(9 * in_channels),
+                    dtype=self.compute_dtype,
+                )(x)
+                if self.use_bn:
+                    # part3/model.py:24 — torch BatchNorm2d defaults:
+                    # eps=1e-5, momentum=0.1 (torch's "momentum" is the
+                    # update fraction for running stats; flax's `momentum`
+                    # is the retain fraction, hence 0.9).
+                    x = nn.BatchNorm(
+                        use_running_average=not train,
+                        momentum=0.9,
+                        epsilon=1e-5,
+                        dtype=self.compute_dtype,
+                    )(x)
+                x = nn.relu(x)
+                in_channels = layer_cfg
+        # part1/model.py:43-45: flatten (1×1×512 after five pools) + fc1.
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(
+            features=self.num_classes,
+            kernel_init=torch_kernel_init,
+            bias_init=make_torch_bias_init(512),
+            dtype=self.compute_dtype,
+            name="fc1",
+        )(x)
+        # Logits in fp32: the loss's logsumexp wants full precision even
+        # when the trunk ran in bf16.
+        return x.astype(jnp.float32)
+
+
+def VGG11(**kw) -> VGG:
+    """Factory matching the reference's only exposed model (part1/model.py:49-50)."""
+    return VGG(name_cfg="VGG11", **kw)
+
+
+def VGG13(**kw) -> VGG:
+    return VGG(name_cfg="VGG13", **kw)
+
+
+def VGG16(**kw) -> VGG:
+    return VGG(name_cfg="VGG16", **kw)
+
+
+def VGG19(**kw) -> VGG:
+    return VGG(name_cfg="VGG19", **kw)

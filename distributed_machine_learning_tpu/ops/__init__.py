@@ -1,0 +1,17 @@
+from distributed_machine_learning_tpu.ops.collectives import (
+    all_reduce_sum,
+    all_reduce_mean,
+    gather_scatter_sum,
+)
+from distributed_machine_learning_tpu.ops.ring import (
+    ring_all_reduce,
+    ring_all_reduce_flat,
+)
+
+__all__ = [
+    "all_reduce_sum",
+    "all_reduce_mean",
+    "gather_scatter_sum",
+    "ring_all_reduce",
+    "ring_all_reduce_flat",
+]

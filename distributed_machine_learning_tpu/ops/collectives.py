@@ -1,0 +1,53 @@
+"""Gradient-sync collectives over a named mesh axis.
+
+TPU-native replacements for the reference's gloo primitives (SURVEY.md
+§2.2): these run inside ``shard_map`` over a ``jax.sharding.Mesh`` axis,
+so XLA lowers them to ICI collectives (intra-slice) or DCN (cross-slice)
+— there is no hand-written transport layer to maintain, unlike gloo/TCP.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def all_reduce_sum(grads, axis_name: str):
+    """``dist.all_reduce(SUM)`` per parameter (part2/2b/main.py:101-106).
+
+    The reference deliberately SUMs and never divides by world size
+    (SURVEY.md §2.4) — ``lax.psum`` reproduces that exactly.  One psum per
+    leaf, like the reference's one all_reduce per parameter tensor; XLA
+    fuses/schedules these (and can overlap them with surrounding compute),
+    which is the job DDP's bucketing C++ does by hand.
+    """
+    return jax.tree_util.tree_map(lambda g: lax.psum(g, axis_name), grads)
+
+
+def all_reduce_mean(grads, axis_name: str):
+    """DDP averaging semantics (part3: grads arrive averaged — SURVEY.md §2.4)."""
+    return jax.tree_util.tree_map(lambda g: lax.pmean(g, axis_name), grads)
+
+
+def gather_scatter_sum(grads, axis_name: str):
+    """The part2a centralized pattern, SPMD-honestly (part2/2a/main.py:89-116).
+
+    The reference gathers every rank's gradient to rank 0, sums there in
+    rank order, and scatters the sum back — a centralized pattern alien to
+    SPMD (SURVEY.md §7.3).  The honest TPU equivalent: ``all_gather`` every
+    rank's contribution to every rank, then let each rank perform the same
+    rank-0-ordered summation locally.  Every rank ends with bit-identical
+    results — the same postcondition as gather+scatter, with the fp32
+    reduction happening in the same rank order (0,1,...,N-1) the
+    reference's in-place loop at ``part2/2a/main.py:104-107`` uses.  The
+    rank-0 traffic concentration (report: ~3× — group25.pdf p.4) is a gloo
+    artifact with no ICI analogue.
+    """
+
+    def _sync(g):
+        gathered = lax.all_gather(g, axis_name)  # leading axis = rank order
+        # jnp.sum over a leading axis reduces in index order, matching the
+        # reference's sequential rank-0 accumulation.
+        return gathered.sum(axis=0)
+
+    return jax.tree_util.tree_map(_sync, grads)

@@ -1,0 +1,19 @@
+from distributed_machine_learning_tpu.parallel.strategies import (
+    SyncStrategy,
+    NoSync,
+    AllReduce,
+    GatherScatter,
+    RingAllReduce,
+    get_strategy,
+    STRATEGIES,
+)
+
+__all__ = [
+    "SyncStrategy",
+    "NoSync",
+    "AllReduce",
+    "GatherScatter",
+    "RingAllReduce",
+    "get_strategy",
+    "STRATEGIES",
+]

@@ -1,0 +1,119 @@
+"""The pluggable gradient-synchronization layer.
+
+This is the reference's one *varying* layer (SURVEY.md §1): its four parts
+are copy-pasted clones differing only in what happens between
+``loss.backward()`` and ``optimizer.step()``.  Here that seam is an
+explicit interface — a strategy is a pure function on the gradient pytree,
+executed inside the shard_mapped train step over the mesh's data axis:
+
+  =============  ======================================  =================
+  strategy       reference                               reduction
+  =============  ======================================  =================
+  none           part1 (single process, no sync)         —
+  gather_scatter part2/2a ``gatherAndScatter``            SUM (§2.4)
+                 (``part2/2a/main.py:89-116``)
+  all_reduce     part2/2b ``allReduce``                   SUM (§2.4)
+                 (``part2/2b/main.py:101-106``)
+  ring           part3 DDP bucketed ring                  MEAN (DDP avgs)
+                 (``part3/main.py:137``), rebuilt as an
+                 explicit lax.ppermute ring (north-star)
+  =============  ======================================  =================
+
+SUM-vs-MEAN is a real semantic difference the reference's report glossed
+over (SURVEY.md §2.4): 2a/2b sum gradients and never divide by world size
+(an effective world_size× learning-rate), part3's DDP averages.  Each
+strategy reproduces its part's exact semantics; the ``mean`` flag lets a
+user override.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from distributed_machine_learning_tpu.ops.collectives import (
+    all_reduce_mean,
+    all_reduce_sum,
+    gather_scatter_sum,
+)
+from distributed_machine_learning_tpu.ops.ring import (
+    DEFAULT_BUCKET_BYTES,
+    ring_all_reduce,
+)
+
+
+@dataclass(frozen=True)
+class SyncStrategy:
+    """Base: a pure transform grads → synced grads over `axis_name`."""
+
+    name = "base"
+
+    def __call__(self, grads, axis_name: str, axis_size: int):
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class NoSync(SyncStrategy):
+    """part1: single-process, no gradient exchange."""
+
+    name = "none"
+
+    def __call__(self, grads, axis_name: str, axis_size: int):
+        return grads
+
+
+@dataclass(frozen=True)
+class AllReduce(SyncStrategy):
+    """part2b: one all-reduce per parameter; SUM by default (§2.4)."""
+
+    name = "all_reduce"
+    mean: bool = False
+
+    def __call__(self, grads, axis_name: str, axis_size: int):
+        if self.mean:
+            return all_reduce_mean(grads, axis_name)
+        return all_reduce_sum(grads, axis_name)
+
+
+@dataclass(frozen=True)
+class GatherScatter(SyncStrategy):
+    """part2a: centralized gather→sum→scatter, as all-gather + rank-order sum."""
+
+    name = "gather_scatter"
+
+    def __call__(self, grads, axis_name: str, axis_size: int):
+        return gather_scatter_sum(grads, axis_name)
+
+
+@dataclass(frozen=True)
+class RingAllReduce(SyncStrategy):
+    """part3 north-star: bucketed explicit ppermute ring, DDP mean semantics."""
+
+    name = "ring"
+    mean: bool = True
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES
+
+    def __call__(self, grads, axis_name: str, axis_size: int):
+        return ring_all_reduce(
+            grads,
+            axis_name,
+            axis_size,
+            mean=self.mean,
+            bucket_bytes=self.bucket_bytes,
+        )
+
+
+STRATEGIES = {
+    "none": NoSync,
+    "gather_scatter": GatherScatter,
+    "all_reduce": AllReduce,
+    "ring": RingAllReduce,
+}
+
+
+def get_strategy(name: str, **kwargs) -> SyncStrategy:
+    try:
+        return STRATEGIES[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown sync strategy {name!r}; choose from {sorted(STRATEGIES)}"
+        ) from None

@@ -1,0 +1,7 @@
+from distributed_machine_learning_tpu.runtime.mesh import make_mesh, BATCH_AXIS
+from distributed_machine_learning_tpu.runtime.distributed import (
+    initialize_from_flags,
+    DistributedContext,
+)
+
+__all__ = ["make_mesh", "BATCH_AXIS", "initialize_from_flags", "DistributedContext"]

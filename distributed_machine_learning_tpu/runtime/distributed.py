@@ -1,0 +1,59 @@
+"""Multi-host bootstrap: the reference's CLI flags → JAX's coordination service.
+
+The reference rendezvouses over raw TCP:
+``dist.init_process_group("gloo", init_method="tcp://"+master_ip,
+world_size=num_nodes, rank=rank)`` (``part2/2a/main.py:197``), with flags
+``--master-ip`` (default ``127.0.1.1:8000``), ``--rank``, ``--num-nodes``
+(``part2/2a/main.py:210-218``).  The north-star requires keeping those
+flags verbatim; they map 1:1 onto ``jax.distributed.initialize``:
+
+    --master-ip  → coordinator_address
+    --num-nodes  → num_processes
+    --rank       → process_id
+
+Single-host multi-chip runs need none of this — the local mesh covers all
+chips — so ``num_nodes == 1`` skips initialization entirely (exactly as
+the reference's part1 never calls init_process_group).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+
+# Reference defaults (part2/2a/main.py:213-215).
+DEFAULT_MASTER_IP = "127.0.1.1:8000"
+
+
+@dataclass
+class DistributedContext:
+    num_nodes: int
+    rank: int
+    master_ip: str
+    initialized: bool
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index() if self.initialized else 0
+
+    def shutdown(self) -> None:
+        """Counterpart of ``dist.destroy_process_group()`` (part2/2a/main.py:207)."""
+        if self.initialized:
+            jax.distributed.shutdown()
+
+
+def initialize_from_flags(
+    master_ip: str = DEFAULT_MASTER_IP,
+    rank: int = 0,
+    num_nodes: int = 1,
+) -> DistributedContext:
+    """Bring up the JAX coordination service iff this is a multi-node run."""
+    if num_nodes > 1:
+        jax.distributed.initialize(
+            coordinator_address=master_ip,
+            num_processes=num_nodes,
+            process_id=rank,
+        )
+        return DistributedContext(num_nodes, rank, master_ip, initialized=True)
+    return DistributedContext(num_nodes, rank, master_ip, initialized=False)

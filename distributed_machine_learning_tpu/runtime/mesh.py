@@ -1,0 +1,47 @@
+"""Device-mesh construction.
+
+The reference's "mesh" is a gloo process group over TCP
+(``dist.init_process_group`` — ``part2/2a/main.py:197``).  Here the unit
+of parallelism is a ``jax.sharding.Mesh`` over TPU chips; the data axis
+(``"batch"``) plays the role of the gloo world, with XLA collectives
+riding ICI.  The mesh is 1-D for the reference's data-parallel-only
+capability surface (SURVEY.md §2.3) but constructed through a general
+helper so additional axes (model/pipeline/sequence) slot in without
+touching callers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(
+    num_devices: int | None = None,
+    axis_names: tuple[str, ...] = (BATCH_AXIS,),
+    axis_shape: tuple[int, ...] | None = None,
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over (a prefix of) the available devices.
+
+    With defaults: a 1-D data-parallel mesh over all devices.  Pass
+    ``axis_names``/``axis_shape`` for multi-axis layouts, e.g.
+    ``axis_names=("batch", "model"), axis_shape=(4, 2)``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:num_devices]
+    if axis_shape is None:
+        axis_shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(axis_shape)) != len(devices):
+        raise ValueError(f"axis_shape {axis_shape} != {len(devices)} devices")
+    mesh_devices = np.asarray(devices).reshape(axis_shape)
+    return Mesh(mesh_devices, axis_names)

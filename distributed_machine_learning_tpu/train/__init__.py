@@ -1,0 +1,4 @@
+from distributed_machine_learning_tpu.train.sgd import sgd_init, sgd_update, SGDConfig
+from distributed_machine_learning_tpu.train.state import TrainState
+
+__all__ = ["sgd_init", "sgd_update", "SGDConfig", "TrainState"]

@@ -1,0 +1,89 @@
+"""Training/eval drivers with the reference's measurement protocol.
+
+Mirrors ``train_model``/``test_model`` (``part1/main.py:19-77`` and the
+clones in 2a/2b/part3): hard cap at 40 iterations, per-iteration wall
+clock with iteration 0 excluded (where XLA compilation lands, replacing
+the reference's warm-up), loss printed every 20 iterations, and the same
+total/average summary lines.  Timing brackets ``block_until_ready`` —
+JAX dispatch is async, so without the block the clock would measure
+enqueue latency, not the step.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+import numpy as np
+
+from distributed_machine_learning_tpu.train.state import TrainState
+from distributed_machine_learning_tpu.utils.logging import rank0_print
+from distributed_machine_learning_tpu.utils.timing import IterationTimer
+
+# Reference constants (part1/main.py:32-33, 49-50).
+MAX_ITERS = 40
+LOSS_PRINT_EVERY = 20
+
+
+def train_epoch(
+    train_step,
+    state: TrainState,
+    batches: Iterable,
+    place_batch=None,
+    max_iters: int = MAX_ITERS,
+    loss_print_every: int = LOSS_PRINT_EVERY,
+    timer: IterationTimer | None = None,
+) -> tuple[TrainState, IterationTimer]:
+    """One epoch, reference-style: returns (state, timer).
+
+    `place_batch(images, labels)` moves a host batch onto device(s)
+    (e.g. `shard_batch(mesh, ...)`); defaults to identity (jit handles
+    transfer for the single-device path).
+    """
+    timer = timer or IterationTimer(skip_first=1)
+    for batch_idx, (images, labels) in enumerate(batches):
+        if batch_idx == max_iters:  # part1/main.py:32-33
+            break
+        timer.start()
+        if place_batch is not None:
+            images, labels = place_batch(images, labels)
+        state, loss = train_step(state, images, labels)
+        loss = jax.block_until_ready(loss)
+        timer.stop()
+        if (batch_idx + 1) % loss_print_every == 0:  # part1/main.py:49-50
+            rank0_print(f"Loss at {batch_idx + 1}th batch is {float(loss)}")
+    rank0_print(timer.summary())  # part1/main.py:57-58
+    return state, timer
+
+
+def evaluate(
+    eval_step,
+    state: TrainState,
+    batches: Iterable,
+    num_test_samples: int | None = None,
+) -> tuple[float, float]:
+    """Full-test-set eval, ``test_model`` parity (``part1/main.py:62-77``):
+    test_loss = mean of per-batch mean losses; top-1 accuracy over the set.
+    Every reference rank evaluates the full test set independently; here a
+    single device does (params are replicated — same result by construction).
+    """
+    total_loss = 0.0
+    correct = 0
+    total = 0
+    num_batches = 0
+    for images, labels in batches:
+        loss, c = eval_step(state.params, state.batch_stats, images, labels)
+        total_loss += float(loss)
+        correct += int(c)
+        total += len(labels)
+        num_batches += 1
+    avg_loss = total_loss / max(num_batches, 1)
+    if num_test_samples is not None:
+        total = num_test_samples
+    accuracy = 100.0 * correct / max(total, 1)
+    rank0_print(
+        "Test set: Average loss: {:.4f}, Accuracy: {}/{} ({:.0f}%)\n".format(
+            avg_loss, correct, total, accuracy
+        )
+    )
+    return avg_loss, accuracy

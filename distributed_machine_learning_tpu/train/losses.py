@@ -1,0 +1,23 @@
+"""Loss and metric functions.
+
+The reference uses ``torch.nn.CrossEntropyLoss()`` with default mean
+reduction (``part1/main.py:115``) for both training and eval, and top-1
+accuracy via argmax (``part1/main.py:71-72``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy over the batch (CrossEntropyLoss parity)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)
+    return nll.mean()
+
+
+def count_correct(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Top-1 correct-prediction count (part1/main.py:71-72)."""
+    return (logits.argmax(axis=-1) == labels).sum()

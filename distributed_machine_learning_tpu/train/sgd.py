@@ -1,0 +1,60 @@
+"""SGD with momentum + weight decay, torch-update semantics.
+
+The reference uses ``optim.SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)``
+(``part1/main.py:120-121``, ``part2/2a/main.py:181-182``,
+``part3/main.py:138-139``).  torch's update rule (non-Nesterov) is:
+
+    g   = grad + weight_decay * param          # decoupled-from-nothing: L2 into grad
+    buf = momentum * buf + g                   # first step: buf = g
+    param -= lr * buf
+
+Note this differs from some textbook variants (no dampening, no lr inside
+the momentum buffer).  Initializing the buffer to zeros makes the first
+step come out to ``buf = g`` exactly, matching torch's lazy buffer init.
+
+Implemented as a pure (state, grads) -> (state, new_params) transform so it
+lives happily inside a jitted/shard_mapped train step.  An equivalent optax
+chain would be ``chain(add_decayed_weights(wd), trace(decay=m), scale(-lr))``;
+we keep the explicit form so the update rule is auditable against the
+reference and usable as a fusion target for a Pallas kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SGDConfig:
+    # Reference hyperparameters (part1/main.py:120-121); replicate exactly.
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+
+
+def sgd_init(params):
+    """Momentum buffers, zero-initialized (torch lazily inits to the first
+    gradient; zeros + the update rule below produce the identical result)."""
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def sgd_update(params, momentum_buf, grads, config: SGDConfig):
+    """One SGD step; returns (new_params, new_momentum_buf)."""
+
+    def _update(p, m, g):
+        g = g + config.weight_decay * p
+        m = config.momentum * m + g
+        p = p - config.learning_rate * m
+        return p, m
+
+    flat = jax.tree_util.tree_map(_update, params, momentum_buf, grads)
+    new_params = jax.tree_util.tree_map(
+        lambda pm: pm[0], flat, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    new_momentum = jax.tree_util.tree_map(
+        lambda pm: pm[1], flat, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    return new_params, new_momentum

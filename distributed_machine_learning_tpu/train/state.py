@@ -1,0 +1,41 @@
+"""Training state pytree.
+
+The reference keeps its state implicitly inside torch Modules and the
+optimizer (``part1/main.py:117-121``).  Here state is an explicit,
+immutable pytree so the whole train step is a pure function XLA can
+compile and shard: params, momentum buffers, BatchNorm running stats
+(part3's model is the only one with BN — ``part3/model.py:24``), and the
+step counter / PRNG key for data augmentation.
+"""
+
+from __future__ import annotations
+
+import jax
+from flax import struct
+
+from distributed_machine_learning_tpu.train.sgd import SGDConfig, sgd_init
+
+
+@struct.dataclass
+class TrainState:
+    params: dict
+    momentum: dict
+    batch_stats: dict  # empty dict for BN-free models (part1/2a/2b parity)
+    step: jax.Array
+    rng: jax.Array
+    config: SGDConfig = struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, params, batch_stats=None, rng=None, config: SGDConfig | None = None):
+        import jax.numpy as jnp
+
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return cls(
+            params=params,
+            momentum=sgd_init(params),
+            batch_stats={} if batch_stats is None else batch_stats,
+            step=jnp.zeros((), jnp.int32),
+            rng=rng,
+            config=config or SGDConfig(),
+        )

@@ -1,0 +1,224 @@
+"""Jitted train/eval steps over a device mesh.
+
+Replaces the reference's training driver + torch autograd + gloo stack
+(``train_model`` at ``part1/main.py:19-58`` and clones): one pure function
+per step — forward, loss, ``jax.grad``, the pluggable gradient-sync
+strategy, and the SGD update — compiled by XLA as a single program.
+Distribution is SPMD: the step is ``shard_map``-ed over the mesh's
+``"batch"`` axis with the batch sharded and the state replicated, so the
+sync strategy's collectives (psum / all-gather / ppermute ring) lower to
+ICI ops scheduled and overlapped by the compiler — the work DDP's C++
+reducer and autograd hooks do by hand in the reference (part3).
+
+Augmentation runs inside the step (see ``data/augment.py``), keyed per
+step and per mesh position, so each shard draws independent crops/flips
+the way each reference node draws from its own torch RNG.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax>=0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across the API rename
+    (new jax: check_vma; the experimental API this falls back to: check_rep)."""
+    try:
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:  # pragma: no cover
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+from distributed_machine_learning_tpu.data.augment import augment_batch, normalize
+from distributed_machine_learning_tpu.parallel.strategies import NoSync, SyncStrategy
+from distributed_machine_learning_tpu.runtime.mesh import BATCH_AXIS
+from distributed_machine_learning_tpu.train.losses import cross_entropy_loss, count_correct
+from distributed_machine_learning_tpu.train.sgd import sgd_update
+from distributed_machine_learning_tpu.train.state import TrainState
+
+
+def _apply_model(model, state: TrainState, x, labels, train: bool):
+    """Forward + loss; returns (loss, (logits, new_batch_stats))."""
+
+    def run(params):
+        variables: dict[str, Any] = {"params": params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+            if train:
+                logits, mutated = model.apply(
+                    variables, x, train=True, mutable=["batch_stats"]
+                )
+                return logits, mutated["batch_stats"]
+            logits = model.apply(variables, x, train=False)
+            return logits, state.batch_stats
+        logits = model.apply(variables, x, train=train)
+        return logits, {}
+
+    def loss_fn(params):
+        logits, new_stats = run(params)
+        return cross_entropy_loss(logits, labels), (logits, new_stats)
+
+    return loss_fn
+
+
+def _train_step_impl(
+    model,
+    strategy: SyncStrategy,
+    state: TrainState,
+    images_u8,
+    labels,
+    *,
+    axis_name: str | None,
+    axis_size: int,
+    augment: bool,
+    sync_bn: bool,
+):
+    step_rng = jax.random.fold_in(state.rng, state.step)
+    if axis_name is not None:
+        # Independent augmentation stream per mesh position (each reference
+        # node has its own torch RNG — part2/2a/main.py:199).
+        step_rng = jax.random.fold_in(step_rng, lax.axis_index(axis_name))
+    x = augment_batch(step_rng, images_u8) if augment else normalize(images_u8)
+
+    loss_fn = _apply_model(model, state, x, labels, train=True)
+    (loss, (_, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params
+    )
+
+    if axis_name is not None:
+        grads = strategy(grads, axis_name, axis_size)
+        if new_stats and sync_bn:
+            # part3's reference leaves BN running stats unsynced per node (a
+            # documented quirk — SURVEY.md §7.3); the TPU-idiomatic default
+            # axis-means them so replicated state stays bit-identical across
+            # devices (the framework's cross-replica invariant).
+            new_stats = jax.tree_util.tree_map(
+                lambda s: lax.pmean(s, axis_name), new_stats
+            )
+
+    new_params, new_momentum = sgd_update(
+        state.params, state.momentum, grads, state.config
+    )
+    new_state = state.replace(
+        params=new_params,
+        momentum=new_momentum,
+        batch_stats=new_stats,
+        step=state.step + 1,
+    )
+    if axis_name is not None:
+        # Report the global mean loss (each reference rank prints its own
+        # local loss; SPMD has one print stream, so surface the mean).
+        loss = lax.pmean(loss, axis_name)
+    return new_state, loss
+
+
+def make_train_step(
+    model,
+    strategy: SyncStrategy | None = None,
+    mesh: Mesh | None = None,
+    axis_name: str = BATCH_AXIS,
+    augment: bool = True,
+    sync_bn: bool = True,
+):
+    """Build the jitted train step.
+
+    Without a mesh: the part1 path — plain ``jit``, no collectives.
+    With a mesh: ``shard_map`` over ``axis_name``; batch sharded on axis 0,
+    state replicated; `strategy` decides how gradients synchronize.
+
+    Returns ``step(state, images_u8, labels) -> (state, loss)``.
+    """
+    strategy = strategy or NoSync()
+    if mesh is not None and isinstance(strategy, NoSync):
+        # Unsynced gradients under a replicated-state shard_map would let
+        # params silently diverge per device (out_specs claims replication).
+        # part1 semantics on a mesh is simply mesh=None.
+        raise ValueError(
+            "strategy 'none' (part1) cannot run on a mesh: gradients would "
+            "not be synchronized and replicated state would diverge; use "
+            "mesh=None, or pick all_reduce/gather_scatter/ring"
+        )
+
+    if mesh is None:
+        impl = partial(
+            _train_step_impl,
+            model,
+            strategy,
+            axis_name=None,
+            axis_size=1,
+            augment=augment,
+            sync_bn=sync_bn,
+        )
+        return jax.jit(impl, donate_argnums=(0,))
+
+    axis_size = mesh.shape[axis_name]
+    if not sync_bn:
+        # The reference's part3 leaves BN running stats unsynced per node
+        # (SURVEY.md §7.3) — but under SPMD with replicated state that
+        # would silently desynchronize the replicas.  Supporting the quirk
+        # would need per-device stats sharding; until then, refuse loudly.
+        raise ValueError(
+            "sync_bn=False is not supported on a mesh: per-device BN "
+            "running stats would diverge while being declared replicated "
+            "(the reference's unsynced-BN quirk needs per-device state "
+            "sharding; stats are axis-synced here instead)"
+        )
+    impl = partial(
+        _train_step_impl,
+        model,
+        strategy,
+        axis_name=axis_name,
+        axis_size=axis_size,
+        augment=augment,
+        sync_bn=sync_bn,
+    )
+    state_spec = P()  # replicated
+    batch_spec = P(axis_name)  # sharded along the data axis
+    sharded = _shard_map(
+        impl,
+        mesh=mesh,
+        in_specs=(state_spec, batch_spec, batch_spec),
+        out_specs=(state_spec, P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_eval_step(model):
+    """Jitted eval step: (params, batch_stats, images_u8, labels) →
+    (batch mean loss, correct count) — ``test_model`` parity
+    (``part1/main.py:62-77``): normalize only (no augmentation), BN in
+    inference mode, loss averaged per batch, top-1 correct counts."""
+
+    @jax.jit
+    def eval_step(params, batch_stats, images_u8, labels):
+        x = normalize(images_u8)
+        variables: dict[str, Any] = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        logits = model.apply(variables, x, train=False)
+        return cross_entropy_loss(logits, labels), count_correct(logits, labels)
+
+    return eval_step
+
+
+def shard_batch(mesh: Mesh, images_u8, labels, axis_name: str = BATCH_AXIS):
+    """Place a host batch onto the mesh, sharded along the batch axis."""
+    sharding = NamedSharding(mesh, P(axis_name))
+    return (
+        jax.device_put(jnp.asarray(images_u8), sharding),
+        jax.device_put(jnp.asarray(labels), sharding),
+    )

@@ -1,0 +1,4 @@
+from distributed_machine_learning_tpu.utils.timing import IterationTimer
+from distributed_machine_learning_tpu.utils.logging import rank0_print, get_logger
+
+__all__ = ["IterationTimer", "rank0_print", "get_logger"]

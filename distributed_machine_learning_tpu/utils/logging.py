@@ -1,0 +1,40 @@
+"""Rank-0-gated logging.
+
+The reference prints from every rank (its banner at ``part2/2a/main.py:200-203``
+even prints world size/rank per worker).  Under multi-host JAX every process
+runs the same program, so the idiomatic surface is: informational prints from
+process 0 only, with an escape hatch for per-rank diagnostics.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def rank0_print(*args, all_ranks: bool = False, **kwargs) -> None:
+    """print() on process 0 only (or all ranks when all_ranks=True)."""
+    if all_ranks or _process_index() == 0:
+        print(*args, **kwargs)
+        sys.stdout.flush()
+
+
+def get_logger(name: str = "dml_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+    return logger

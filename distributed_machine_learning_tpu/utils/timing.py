@@ -1,0 +1,60 @@
+"""Per-iteration timing harness.
+
+Reproduces the reference's measurement protocol (``part1/main.py:36,53-58``):
+wall-clock per iteration, iteration 0 excluded as warm-up, totals and the
+average over the remaining iterations printed at the end.  On TPU the
+warm-up iteration is where XLA compilation lands, so excluding iteration 0
+is exactly the right protocol here too — but the caller must block on the
+device result (``jax.block_until_ready``) before stopping the clock, since
+JAX dispatch is asynchronous (unlike the reference's synchronous CPU torch).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class IterationTimer:
+    """Accumulates per-iteration wall-clock, excluding `skip_first` iters.
+
+    The reference runs 40 iterations and divides total by 39
+    (``part1/main.py:53-58``): iteration 0 is measured but not accumulated.
+    """
+
+    skip_first: int = 1
+    times: list = field(default_factory=list)
+    _start: float = 0.0
+    _iter: int = 0
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+
+    def stop(self) -> float:
+        """Stop the clock; returns this iteration's time (always), and
+        accumulates it unless it is among the first `skip_first` iters."""
+        elapsed = time.perf_counter() - self._start
+        if self._iter >= self.skip_first:
+            self.times.append(elapsed)
+        self._iter += 1
+        return elapsed
+
+    @property
+    def total(self) -> float:
+        return sum(self.times)
+
+    @property
+    def average(self) -> float:
+        return self.total / len(self.times) if self.times else 0.0
+
+    @property
+    def count(self) -> int:
+        return len(self.times)
+
+    def summary(self) -> str:
+        # Same print surface as the reference (part1/main.py:57-58).
+        return (
+            f"Total execution time is : {self.total} seconds\n"
+            f"Average execution time is  : {self.average} seconds"
+        )

@@ -1,0 +1,55 @@
+"""Test harness: 8 virtual CPU devices (SURVEY.md §4 test strategy).
+
+Force the host platform and split it into 8 XLA devices so every
+distributed test exercises a real 8-way mesh without TPU hardware — the
+TPU-native analogue of the reference's 4-node gloo cluster.
+
+Note: this environment's sitecustomize imports jax at interpreter start
+with JAX_PLATFORMS=axon baked in, so setting the env var here is too late;
+``jax.config.update`` works post-import as long as no backend has
+initialized yet.  XLA_FLAGS must still land in os.environ before the CPU
+client spins up — which happens at the first ``jax.devices()`` call, i.e.
+after this module runs.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    """4-device mesh — the reference's world size (group25.pdf p.1)."""
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+    return make_mesh(4)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(69143)

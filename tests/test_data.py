@@ -1,0 +1,130 @@
+"""Data pipeline: sharding vs torch DistributedSampler (the reference's
+sharder — part2/2a/main.py:158-159), loaders, augmentation, normalization."""
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.data.cifar10 import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    Dataset,
+    load_cifar10,
+)
+from distributed_machine_learning_tpu.data.distributed_loader import (
+    DistributedBatchLoader,
+)
+from distributed_machine_learning_tpu.data.loader import BatchLoader
+from distributed_machine_learning_tpu.data.sharding import shard_indices
+
+
+def _tiny_dataset(n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    return Dataset(
+        images=rng.integers(0, 256, (n, 32, 32, 3), dtype=np.uint8),
+        labels=rng.integers(0, 10, n).astype(np.int32),
+        synthetic=True,
+    )
+
+
+@pytest.mark.parametrize("n,world", [(100, 4), (101, 4), (50000, 4), (16, 8)])
+def test_shard_indices_matches_torch_distributed_sampler(n, world):
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DistributedSampler
+
+    class _FakeDataset:
+        def __len__(self):
+            return n
+
+    for rank in range(world):
+        sampler = DistributedSampler(
+            _FakeDataset(), num_replicas=world, rank=rank, shuffle=False, seed=69143
+        )
+        expected = np.array(list(iter(sampler)))
+        ours = shard_indices(n, rank=rank, num_replicas=world, shuffle=False)
+        np.testing.assert_array_equal(ours, expected)
+
+
+def test_distributed_loader_rank_major_layout():
+    """Shard r of the global batch == rank r's DistributedSampler batch."""
+    ds = _tiny_dataset(512)
+    b, w = 8, 4
+    loader = DistributedBatchLoader(ds, per_rank_batch=b, num_ranks=w)
+    step0_imgs, step0_labels = next(iter(loader))
+    assert step0_imgs.shape == (b * w, 32, 32, 3)
+    for rank in range(w):
+        rank_indices = shard_indices(len(ds), rank, w)[:b]
+        shard = step0_labels[rank * b : (rank + 1) * b]
+        np.testing.assert_array_equal(shard, ds.labels[rank_indices])
+        np.testing.assert_array_equal(
+            step0_imgs[rank * b : (rank + 1) * b], ds.images[rank_indices]
+        )
+
+
+def test_distributed_global_batch_equals_part1_block():
+    """The union of the 4 workers' batches is part1's contiguous batch-256
+    block — 'test on the same data for all tasks' (part1/main.py:99)."""
+    ds = _tiny_dataset(512)
+    loader = DistributedBatchLoader(ds, per_rank_batch=64, num_ranks=4)
+    _, labels = next(iter(loader))
+    np.testing.assert_array_equal(np.sort(labels), np.sort(ds.labels[:256]))
+
+
+def test_batch_loader_covers_dataset_with_final_short_batch():
+    ds = _tiny_dataset(100)
+    loader = BatchLoader(ds, batch_size=32)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert sum(len(l) for _, l in batches) == 100
+    np.testing.assert_array_equal(
+        np.concatenate([l for _, l in batches]), ds.labels
+    )
+
+
+def test_synthetic_cifar10_is_deterministic(tmp_path):
+    a = load_cifar10(root=str(tmp_path / "nope"), download=False)
+    b = load_cifar10(root=str(tmp_path / "nope"), download=False)
+    assert a.synthetic and b.synthetic
+    assert len(a) == 50_000
+    np.testing.assert_array_equal(a.images, b.images)
+    np.testing.assert_array_equal(a.labels, b.labels)
+
+
+def test_cifar10_pickle_parser_roundtrip(tmp_path):
+    """Write a batch in the standard cifar-10-batches-py layout and parse it."""
+    import pickle, os
+
+    n = 20
+    rng = np.random.default_rng(3)
+    imgs_chw = rng.integers(0, 256, (n, 3, 32, 32), dtype=np.uint8)
+    labels = rng.integers(0, 10, n).tolist()
+    batch_dir = tmp_path / "cifar-10-batches-py"
+    os.makedirs(batch_dir)
+    payload = {b"data": imgs_chw.reshape(n, -1), b"labels": labels}
+    for name in [f"data_batch_{i}" for i in range(1, 6)]:
+        with open(batch_dir / name, "wb") as f:
+            pickle.dump(payload, f)
+    ds = load_cifar10(root=str(tmp_path), train=True, download=False)
+    assert not ds.synthetic
+    assert ds.images.shape == (5 * n, 32, 32, 3)
+    np.testing.assert_array_equal(ds.images[:n], imgs_chw.transpose(0, 2, 3, 1))
+    np.testing.assert_array_equal(ds.labels[:n], labels)
+
+
+def test_normalize_and_augment_shapes():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.data.augment import augment_batch, normalize
+
+    imgs = np.random.default_rng(0).integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
+    x = normalize(jnp.asarray(imgs))
+    assert x.shape == (4, 32, 32, 3) and x.dtype == jnp.float32
+    expected = (imgs.astype(np.float32) / 255.0 - CIFAR10_MEAN) / CIFAR10_STD
+    np.testing.assert_allclose(np.asarray(x), expected, rtol=1e-5)
+
+    y1 = augment_batch(jax.random.PRNGKey(0), jnp.asarray(imgs))
+    y2 = augment_batch(jax.random.PRNGKey(0), jnp.asarray(imgs))
+    y3 = augment_batch(jax.random.PRNGKey(1), jnp.asarray(imgs))
+    assert y1.shape == (4, 32, 32, 3)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))  # deterministic
+    assert not np.allclose(np.asarray(y1), np.asarray(y3))  # key-dependent

@@ -1,0 +1,58 @@
+"""VGG model family: shapes, parameter count, BN flag (reference
+``part1/model.py`` / ``part3/model.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.models.vgg import VGG, VGG11
+
+
+def _param_count(params):
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def test_vgg11_output_shape_and_param_count():
+    model = VGG11()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)))
+    logits = model.apply(variables, jnp.zeros((2, 32, 32, 3)))
+    assert logits.shape == (2, 10)
+    # Reference report: ~9.2M parameters (group25.pdf p.2; SURVEY.md §0.1).
+    n = _param_count(variables["params"])
+    assert 9_100_000 < n < 9_400_000, n
+
+
+@pytest.mark.parametrize("name", ["VGG11", "VGG13", "VGG16", "VGG19"])
+def test_whole_cfg_table_builds(name):
+    # part1/model.py:3-8 defines all four; we expose all four.
+    model = VGG(name_cfg=name)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    assert model.apply(variables, jnp.zeros((1, 32, 32, 3))).shape == (1, 10)
+
+
+def test_bn_flag_part3_parity():
+    # part3/model.py:24 enables BatchNorm; part1 has it commented out.
+    plain = VGG11().init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    assert "batch_stats" not in plain
+    bn = VGG11(use_bn=True)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    assert "batch_stats" in variables
+    # train=True mutates running stats
+    logits, mutated = bn.apply(
+        variables, jnp.ones((4, 32, 32, 3)), train=True, mutable=["batch_stats"]
+    )
+    assert logits.shape == (4, 10)
+    old = jax.tree_util.tree_leaves(variables["batch_stats"])
+    new = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(o, n) for o, n in zip(old, new))
+
+
+def test_bf16_compute_fp32_logits():
+    model = VGG11(compute_dtype=jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    # Params stay fp32 (master weights), logits come back fp32.
+    assert all(
+        p.dtype == jnp.float32 for p in jax.tree_util.tree_leaves(variables["params"])
+    )
+    assert model.apply(variables, jnp.zeros((1, 32, 32, 3))).dtype == jnp.float32

@@ -1,0 +1,99 @@
+"""The hand-rolled ppermute ring vs lax.psum/pmean (SURVEY.md §4d):
+property tests on an 8-device CPU mesh."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from distributed_machine_learning_tpu.ops.ring import (
+    ring_all_reduce,
+    ring_all_reduce_flat,
+)
+
+
+def _run_on_mesh(mesh, fn, per_device_inputs):
+    """shard_map a per-device fn over stacked inputs (leading axis = device)."""
+    wrapped = shard_map(
+        fn, mesh=mesh, in_specs=P("batch"), out_specs=P("batch"), check_vma=False
+    )
+    return jax.jit(wrapped)(per_device_inputs)
+
+
+@pytest.mark.parametrize("length", [1, 7, 8, 64, 1000, 4097])
+@pytest.mark.parametrize("mean", [False, True])
+def test_ring_flat_matches_psum(mesh8, length, mean, rng):
+    n = 8
+    data = rng.standard_normal((n, length)).astype(np.float32)
+    expected = data.sum(axis=0) / (n if mean else 1)
+
+    def per_device(x):
+        x = x.reshape(-1)  # shard has leading dim 1
+        out = ring_all_reduce_flat(x, "batch", n, mean=mean)
+        return out[None]
+
+    result = _run_on_mesh(mesh8, per_device, jnp.asarray(data))
+    # Every device must hold the same full reduction.
+    for d in range(n):
+        np.testing.assert_allclose(
+            np.asarray(result[d]), expected, rtol=1e-5, atol=1e-5
+        )
+
+
+@pytest.mark.parametrize("bucket_bytes", [64, 1024, 25 * 2**20])
+def test_ring_pytree_bucketing(mesh8, bucket_bytes, rng):
+    n = 8
+    tree_shapes = {"w": (33, 17), "b": (129,), "k": (3, 3, 4, 8)}
+    data = {
+        k: rng.standard_normal((n, *s)).astype(np.float32)
+        for k, s in tree_shapes.items()
+    }
+
+    def per_device(tree):
+        local = jax.tree_util.tree_map(lambda x: x[0], tree)
+        out = ring_all_reduce(
+            local, "batch", n, mean=True, bucket_bytes=bucket_bytes
+        )
+        return jax.tree_util.tree_map(lambda x: x[None], out)
+
+    wrapped = shard_map(
+        per_device, mesh=mesh8, in_specs=P("batch"), out_specs=P("batch"),
+        check_vma=False,
+    )
+    result = jax.jit(wrapped)(jax.tree_util.tree_map(jnp.asarray, data))
+    for k in tree_shapes:
+        expected = data[k].sum(axis=0) / n
+        for d in range(n):
+            np.testing.assert_allclose(
+                np.asarray(result[k][d]), expected, rtol=1e-5, atol=1e-5
+            )
+
+
+def test_ring_matches_pmean_collective(mesh4, rng):
+    """Direct head-to-head vs lax.pmean on the same mesh (world size 4 —
+    the reference cluster size)."""
+    n = 4
+    data = rng.standard_normal((n, 513)).astype(np.float32)
+
+    def per_device(x):
+        x = x.reshape(-1)
+        ours = ring_all_reduce_flat(x, "batch", n, mean=True)
+        theirs = lax.pmean(x, "batch")
+        return (ours - theirs)[None]
+
+    diff = _run_on_mesh(mesh4, per_device, jnp.asarray(data))
+    np.testing.assert_allclose(np.asarray(diff), 0.0, atol=1e-6)
+
+
+def test_ring_single_device_identity():
+    x = jnp.arange(10.0)
+    assert np.allclose(ring_all_reduce_flat(x, "batch", 1), x)

@@ -1,0 +1,157 @@
+"""Per-strategy single-step numerical equivalence vs the single-device
+baseline (SURVEY.md §4c) — the invariant the reference only eyeballed via
+loss-curve comparison (group25.pdf p.4-6), here as unit tests.
+
+Math (SURVEY.md §2.4): with global batch B split over N shards and
+mean-reduction cross-entropy,
+  - pmean of local grads == the single-device grad of the same global batch
+    → `ring` (DDP/part3 semantics) reproduces part1's update exactly;
+  - psum of local grads == N × the single-device grad
+    → `all_reduce`/`gather_scatter` (2a/2b SUM semantics) step with an
+    effective N× learning rate, exactly like the reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.parallel.strategies import get_strategy
+from distributed_machine_learning_tpu.train.sgd import SGDConfig
+from distributed_machine_learning_tpu.train.state import TrainState
+from distributed_machine_learning_tpu.train.step import (
+    make_eval_step,
+    make_train_step,
+    shard_batch,
+)
+
+GLOBAL_BATCH = 16
+
+
+@pytest.fixture(scope="module")
+def model():
+    return VGG11()
+
+
+@pytest.fixture(scope="module")
+def init_state(model):
+    variables = model.init(jax.random.PRNGKey(69143), jnp.zeros((1, 32, 32, 3)))
+
+    def fresh():
+        # Deep-copy: the train step donates its input state (in-place param
+        # update on device), so each test needs its own buffers.
+        params = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), variables["params"]
+        )
+        return TrainState.create(
+            params=params, rng=jax.random.PRNGKey(7), config=SGDConfig()
+        )
+
+    return fresh
+
+
+@pytest.fixture(scope="module")
+def batch(request):
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (GLOBAL_BATCH, 32, 32, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, (GLOBAL_BATCH,)).astype(np.int32)
+    return images, labels
+
+
+def _single_device_step(model, state, images, labels):
+    step = make_train_step(model, mesh=None, augment=False)
+    return step(state, jnp.asarray(images), jnp.asarray(labels))
+
+
+def _distributed_step(model, state, images, labels, mesh, strategy_name, **kw):
+    strategy = get_strategy(strategy_name, **kw)
+    step = make_train_step(model, strategy, mesh=mesh, augment=False)
+    x, y = shard_batch(mesh, images, labels)
+    return step(state, x, y)
+
+
+def _tree_allclose(a, b, rtol=1e-4, atol=1e-5):
+    for la, lb in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=rtol, atol=atol)
+
+
+def test_ring_step_equals_single_device(model, init_state, batch, mesh8):
+    images, labels = batch
+    ref_state, ref_loss = _single_device_step(model, init_state(), images, labels)
+    dist_state, dist_loss = _distributed_step(
+        model, init_state(), images, labels, mesh8, "ring", bucket_bytes=1 << 20
+    )
+    # part3/DDP mean semantics == part1's update on the same global batch.
+    np.testing.assert_allclose(float(dist_loss), float(ref_loss), rtol=1e-5)
+    _tree_allclose(dist_state.params, ref_state.params)
+
+
+def test_all_reduce_sum_is_nx_learning_rate(model, init_state, batch, mesh8):
+    """2b SUM semantics: the distributed update equals a single-device step
+    whose gradient is scaled by N (SURVEY.md §2.4)."""
+    images, labels = batch
+    n = 8
+    # Numpy snapshot of the shared init (step inputs get donated/deleted).
+    base_params = jax.tree_util.tree_map(np.asarray, init_state().params)
+    dist_state, _ = _distributed_step(
+        model, init_state(), images, labels, mesh8, "all_reduce"
+    )
+    ref_state, _ = _single_device_step(model, init_state(), images, labels)
+    # momentum starts at 0, so step-1 updates: dist Δ = lr*(N·g + wd·p),
+    # ref Δ = lr*(g + wd·p) ⇒ dist Δ − ref Δ = lr·(N−1)·g.
+    g_ref = jax.tree_util.tree_map(
+        lambda p0, p1: (p0 - np.asarray(p1)) / 0.1, base_params, ref_state.params,
+    )
+    g_dist = jax.tree_util.tree_map(
+        lambda p0, p1: (p0 - np.asarray(p1)) / 0.1, base_params, dist_state.params,
+    )
+    wd = 1e-4
+    for p, gr, gd in zip(
+        jax.tree_util.tree_leaves(base_params),
+        jax.tree_util.tree_leaves(g_ref),
+        jax.tree_util.tree_leaves(g_dist),
+    ):
+        pure_g = gr - wd * p  # single-device gradient
+        expected = n * pure_g + wd * p
+        np.testing.assert_allclose(gd, expected, rtol=5e-3, atol=1e-5)
+
+
+def test_gather_scatter_equals_all_reduce(model, init_state, batch, mesh8):
+    """2a and 2b produce identical updates (both SUM — SURVEY.md §2.4)."""
+    images, labels = batch
+    s_gs, _ = _distributed_step(
+        model, init_state(), images, labels, mesh8, "gather_scatter"
+    )
+    s_ar, _ = _distributed_step(
+        model, init_state(), images, labels, mesh8, "all_reduce"
+    )
+    _tree_allclose(s_gs.params, s_ar.params, rtol=1e-5, atol=1e-6)
+
+
+def test_bn_model_distributed_step(mesh8):
+    """part3 model (BN on) trains under the ring strategy; synced stats
+    stay identical across replicas by construction."""
+    model = VGG11(use_bn=True)
+    variables = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    state = TrainState.create(
+        params=variables["params"], batch_stats=variables["batch_stats"],
+        rng=jax.random.PRNGKey(3),
+    )
+    rng = np.random.default_rng(1)
+    images = rng.integers(0, 256, (GLOBAL_BATCH, 32, 32, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, (GLOBAL_BATCH,)).astype(np.int32)
+    step = make_train_step(model, get_strategy("ring"), mesh=mesh8, augment=False)
+    x, y = shard_batch(mesh8, images, labels)
+    old = [np.asarray(s) for s in jax.tree_util.tree_leaves(state.batch_stats)]
+    new_state, loss = step(state, x, y)
+    assert np.isfinite(float(loss))
+    # Running stats moved.
+    new = jax.tree_util.tree_leaves(new_state.batch_stats)
+    assert any(not np.allclose(o, np.asarray(n)) for o, n in zip(old, new))
+    # Eval path runs with the updated stats.
+    eval_step = make_eval_step(model)
+    loss, correct = eval_step(new_state.params, new_state.batch_stats,
+                              jnp.asarray(images), jnp.asarray(labels))
+    assert np.isfinite(float(loss)) and 0 <= int(correct) <= GLOBAL_BATCH
