@@ -15,6 +15,7 @@ disk — identical shapes/dtypes, so the throughput number is unaffected.
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
@@ -24,7 +25,7 @@ import numpy as np
 
 from distributed_machine_learning_tpu.cli.common import init_model_and_state
 from distributed_machine_learning_tpu.data.cifar10 import load_cifar10
-from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.models.registry import get_model, list_models
 from distributed_machine_learning_tpu.train.step import make_train_step
 
 BATCH = 256  # part1/main.py:18
@@ -33,7 +34,10 @@ BASELINE_IMGS_PER_SEC = 256 / 2.39  # group25.pdf p.2 → 107.1
 
 
 def main() -> None:
-    model = VGG11(compute_dtype=jnp.bfloat16)
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default="vgg11", choices=list_models())
+    args = parser.parse_args()
+    model = get_model(args.model, compute_dtype=jnp.bfloat16)
     state = init_model_and_state(model)
     step = make_train_step(model, mesh=None, augment=True)
 
@@ -64,7 +68,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "vgg11_cifar10_train_imgs_per_sec",
+                "metric": f"{args.model}_cifar10_train_imgs_per_sec",
                 "value": round(imgs_per_sec, 2),
                 "unit": "imgs/sec",
                 "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 2),

@@ -18,7 +18,7 @@ from distributed_machine_learning_tpu.data.distributed_loader import (
     DistributedBatchLoader,
 )
 from distributed_machine_learning_tpu.data.loader import BatchLoader
-from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.models.registry import get_model, list_models
 from distributed_machine_learning_tpu.parallel.strategies import get_strategy
 from distributed_machine_learning_tpu.runtime.distributed import (
     DEFAULT_MASTER_IP,
@@ -53,6 +53,10 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                         choices=["float32", "bfloat16"],
                         help="trunk compute dtype (bfloat16 targets the MXU)")
     # Extensions beyond the reference surface (defaults reproduce it).
+    parser.add_argument("--model", default="vgg11", type=str,
+                        choices=list_models(),
+                        help="model to train; default reproduces the "
+                             "reference's VGG11")
     parser.add_argument("--max-iters", default=40, type=int,
                         help="training iteration cap (reference: 40)")
     parser.add_argument("--batch-size", default=None, type=int,
@@ -84,7 +88,8 @@ def run_part(
     args,
     strategy_kwargs: dict | None = None,
 ) -> None:
-    """Train VGG-11/CIFAR-10 for `args.epochs` under one sync strategy."""
+    """Train `args.model` (default VGG-11) on CIFAR-10 for `args.epochs`
+    under one sync strategy."""
     import jax.numpy as jnp
 
     ctx = initialize_from_flags(args.master_ip, args.rank, args.num_nodes)
@@ -99,7 +104,8 @@ def run_part(
         )
 
         compute_dtype = jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
-        model = VGG11(use_bn=use_bn, compute_dtype=compute_dtype)
+        model = get_model(args.model, use_bn=use_bn,
+                          compute_dtype=compute_dtype)
         state = init_model_and_state(model)
         strategy = get_strategy(strategy_name, **(strategy_kwargs or {}))
         train_step = make_train_step(model, strategy, mesh=mesh)

@@ -1,3 +1,14 @@
+from distributed_machine_learning_tpu.models.registry import get_model, list_models
+from distributed_machine_learning_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+)
 from distributed_machine_learning_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19
 
-__all__ = ["VGG", "VGG11", "VGG13", "VGG16", "VGG19"]
+__all__ = [
+    "VGG", "VGG11", "VGG13", "VGG16", "VGG19",
+    "ResNet", "ResNet18", "ResNet34", "ResNet50",
+    "get_model", "list_models",
+]
