@@ -63,7 +63,24 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                         help="override the part's per-worker batch size")
     parser.add_argument("--eval-batches", default=None, type=int,
                         help="cap eval batches (default: full test set)")
+    parser.add_argument("--ckpt-dir", default=None, type=str,
+                        help="checkpoint directory; saves TrainState after "
+                             "each epoch (off by default — reference parity)")
+    parser.add_argument("--resume", action="store_true",
+                        help="resume weights/optimizer/step from the latest "
+                             "complete checkpoint in --ckpt-dir; the run then "
+                             "trains --epochs further epochs (the epoch count "
+                             "is not offset by prior progress)")
     return parser
+
+
+def parse_flags(parser: argparse.ArgumentParser, argv=None) -> argparse.Namespace:
+    """parse_args + cross-flag validation (fail at parse time, before any
+    distributed runtime spin-up)."""
+    args = parser.parse_args(argv)
+    if args.resume and not args.ckpt_dir:
+        parser.error("--resume requires --ckpt-dir")
+    return args
 
 
 def init_model_and_state(model, seed: int = SEED, config: SGDConfig | None = None):
@@ -107,6 +124,22 @@ def run_part(
         model = get_model(args.model, use_bn=use_bn,
                           compute_dtype=compute_dtype)
         state = init_model_and_state(model)
+        if args.resume:
+            from distributed_machine_learning_tpu.train.checkpoint import (
+                latest_checkpoint,
+                restore_checkpoint,
+            )
+
+            if not args.ckpt_dir:
+                raise ValueError("--resume requires --ckpt-dir")
+            latest = latest_checkpoint(args.ckpt_dir)
+            if latest is None:
+                rank0_print(f"No checkpoint under {args.ckpt_dir}; "
+                            "starting from scratch.")
+            else:
+                state = restore_checkpoint(latest, abstract_state=state)
+                rank0_print(f"Resumed from {latest} (step "
+                            f"{int(jax.device_get(state.step))})")
         strategy = get_strategy(strategy_name, **(strategy_kwargs or {}))
         train_step = make_train_step(model, strategy, mesh=mesh)
         eval_step = make_eval_step(model)
@@ -135,5 +168,12 @@ def run_part(
 
                 eval_batches = itertools.islice(iter(eval_batches), args.eval_batches)
             evaluate(eval_step, state, eval_batches)
+            if args.ckpt_dir:
+                from distributed_machine_learning_tpu.train.checkpoint import (
+                    save_checkpoint,
+                )
+
+                path = save_checkpoint(args.ckpt_dir, state)
+                rank0_print(f"Saved checkpoint to {path}")
     finally:
         ctx.shutdown()  # dist.destroy_process_group parity (part2/2a/main.py:207)

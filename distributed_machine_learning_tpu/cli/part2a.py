@@ -9,13 +9,13 @@ device (SURVEY.md §7.3).  Flags kept verbatim from
 
 from __future__ import annotations
 
-from distributed_machine_learning_tpu.cli.common import make_flag_parser, run_part
+from distributed_machine_learning_tpu.cli.common import make_flag_parser, parse_flags, run_part
 
 BATCH_SIZE = 64  # per worker — part2/2a/main.py:33
 
 
 def main(argv=None) -> None:
-    args = make_flag_parser(__doc__).parse_args(argv)
+    args = parse_flags(make_flag_parser(__doc__), argv)
     run_part("gather_scatter", per_rank_batch=BATCH_SIZE, use_bn=False, args=args)
 
 

@@ -16,10 +16,10 @@ from typing import Any
 
 from distributed_machine_learning_tpu.models import resnet, vgg
 
-_VGG_NAMES = {"vgg11": "VGG11", "vgg13": "VGG13", "vgg16": "VGG16",
-              "vgg19": "VGG19"}
-_RESNET_NAMES = {"resnet18": "ResNet18", "resnet34": "ResNet34",
-                 "resnet50": "ResNet50"}
+# Derived from each family's cfg table — one source of truth; a variant
+# added to a model module's _cfg is immediately available here.
+_VGG_NAMES = {k.lower(): k for k in vgg._cfg}
+_RESNET_NAMES = {k.lower(): k for k in resnet._cfg}
 
 
 def list_models() -> list[str]:
