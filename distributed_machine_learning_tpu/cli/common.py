@@ -34,6 +34,7 @@ from distributed_machine_learning_tpu.train.step import (
     shard_batch,
 )
 from distributed_machine_learning_tpu.utils.logging import rank0_print
+from distributed_machine_learning_tpu.utils.profiling import MetricsLogger, trace
 
 SEED = 69143  # part1/main.py:17
 EVAL_BATCH = 256
@@ -71,6 +72,12 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                              "complete checkpoint in --ckpt-dir; the run then "
                              "trains --epochs further epochs (the epoch count "
                              "is not offset by prior progress)")
+    parser.add_argument("--trace-dir", default=None, type=str,
+                        help="write a jax.profiler trace of the training "
+                             "loop here (view with TensorBoard/Perfetto)")
+    parser.add_argument("--metrics-file", default=None, type=str,
+                        help="write per-step metrics (step, loss, iteration "
+                             "seconds) here; .csv for CSV, else JSONL")
     return parser
 
 
@@ -109,6 +116,7 @@ def run_part(
     under one sync strategy."""
     import jax.numpy as jnp
 
+    metrics = MetricsLogger() if args.metrics_file else None
     ctx = initialize_from_flags(args.master_ip, args.rank, args.num_nodes)
     try:
         distributed = strategy_name != "none"
@@ -158,10 +166,11 @@ def run_part(
                 batches = DistributedBatchLoader(train_set, per_rank_batch, world)
             else:
                 batches = BatchLoader(train_set, per_rank_batch)
-            state, _ = train_epoch(
-                train_step, state, batches, place_batch=place,
-                max_iters=args.max_iters,
-            )
+            with trace(args.trace_dir):
+                state, _ = train_epoch(
+                    train_step, state, batches, place_batch=place,
+                    max_iters=args.max_iters, metrics=metrics,
+                )
             eval_batches = BatchLoader(test_set, EVAL_BATCH)
             if args.eval_batches is not None:
                 import itertools
@@ -176,4 +185,11 @@ def run_part(
                 path = save_checkpoint(args.ckpt_dir, state)
                 rank0_print(f"Saved checkpoint to {path}")
     finally:
+        # Flush in finally so a crash/interrupt mid-run keeps the rows
+        # already logged — the feature's main use is diagnosing bad runs.
+        if metrics is not None:
+            metrics.save(args.metrics_file)
+            rank0_print(
+                f"Wrote {len(metrics.rows)} metric rows to {args.metrics_file}"
+            )
         ctx.shutdown()  # dist.destroy_process_group parity (part2/2a/main.py:207)

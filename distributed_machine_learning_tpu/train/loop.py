@@ -33,6 +33,7 @@ def train_epoch(
     max_iters: int = MAX_ITERS,
     loss_print_every: int = LOSS_PRINT_EVERY,
     timer: IterationTimer | None = None,
+    metrics=None,
 ) -> tuple[TrainState, IterationTimer]:
     """One epoch, reference-style: returns (state, timer).
 
@@ -49,7 +50,10 @@ def train_epoch(
             images, labels = place_batch(images, labels)
         state, loss = train_step(state, images, labels)
         loss = jax.block_until_ready(loss)
-        timer.stop()
+        iter_time = timer.stop()
+        if metrics is not None:
+            metrics.log(step=int(state.step), loss=float(loss),
+                        iter_seconds=iter_time)
         if (batch_idx + 1) % loss_print_every == 0:  # part1/main.py:49-50
             rank0_print(f"Loss at {batch_idx + 1}th batch is {float(loss)}")
     rank0_print(timer.summary())  # part1/main.py:57-58

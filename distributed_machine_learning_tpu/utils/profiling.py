@@ -1,0 +1,102 @@
+"""Tracing / profiling + structured per-step metrics.
+
+The reference's observability is a hand-rolled wall-clock harness
+(``part1/main.py:36,53-58``) plus out-of-band dstat plots in its report
+(group25.pdf p.4,7) — SURVEY.md §5.  TPU-native equivalents:
+
+- :func:`trace` — context manager around ``jax.profiler`` producing an
+  XPlane/Perfetto trace directory (the principled replacement for the
+  report's external CPU/network plots: the trace shows MXU occupancy,
+  HBM traffic, and ICI collective time per step).
+- :class:`MetricsLogger` — per-step structured metrics (step, loss,
+  wall-clock) accumulated in memory and flushed to CSV and/or JSONL,
+  rank-0 gated; feeds the scaling-sweep harness.
+- :func:`annotate` — ``jax.profiler.TraceAnnotation`` wrapper so driver
+  phases (train/eval/checkpoint) show up as named spans in the trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import csv
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | os.PathLike | None):
+    """Profile the enclosed block with ``jax.profiler`` into `log_dir`.
+
+    No-op when `log_dir` is falsy, so call sites can thread a CLI flag
+    straight through.  View the result with TensorBoard's profile plugin
+    or Perfetto (the trace directory contains ``*.xplane.pb``).
+    """
+    if not log_dir:
+        yield
+        return
+    log_dir = os.fspath(log_dir)
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span in the profiler timeline (host side)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@dataclass
+class MetricsLogger:
+    """Accumulate per-step metric rows; flush to CSV / JSONL, rank-0 gated.
+
+    Rows are plain dicts; the column set is the union over rows (missing
+    keys serialize empty in CSV, absent in JSONL).
+    """
+
+    rows: list[dict] = field(default_factory=list)
+
+    def log(self, step: int, **metrics) -> None:
+        self.rows.append({"step": step, "time": time.time(), **metrics})
+
+    def save(self, path: str | os.PathLike) -> None:
+        """Write rows to `path`, format chosen by extension: ``.csv`` for
+        CSV, anything else JSONL.  The single dispatch point for every
+        caller (CLI, bench, sweep)."""
+        if os.fspath(path).endswith(".csv"):
+            self.to_csv(path)
+        else:
+            self.to_jsonl(path)
+
+    def to_csv(self, path: str | os.PathLike) -> None:
+        if jax.process_index() != 0:
+            return
+        columns: list[str] = []
+        for row in self.rows:
+            for key in row:
+                if key not in columns:
+                    columns.append(key)
+        os.makedirs(os.path.dirname(os.path.abspath(os.fspath(path))),
+                    exist_ok=True)
+        # Zero rows still writes the (possibly header-only) file, so a
+        # reported path always exists.
+        with open(path, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=columns)
+            if columns:
+                writer.writeheader()
+            writer.writerows(self.rows)
+
+    def to_jsonl(self, path: str | os.PathLike) -> None:
+        if jax.process_index() != 0:
+            return
+        os.makedirs(os.path.dirname(os.path.abspath(os.fspath(path))),
+                    exist_ok=True)
+        with open(path, "w") as f:
+            for row in self.rows:
+                f.write(json.dumps(row) + "\n")

@@ -1,0 +1,73 @@
+"""Profiling/metrics subsystem: trace no-op + real trace, metrics flush.
+
+TPU-native replacement for the reference's hand-rolled timing + external
+dstat plots (SURVEY.md §5 "Tracing / profiling").
+"""
+
+import csv
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from distributed_machine_learning_tpu.utils.profiling import (
+    MetricsLogger,
+    annotate,
+    trace,
+)
+
+
+def test_trace_noop_without_dir():
+    with trace(None):
+        pass  # must not start the profiler
+
+
+def test_trace_writes_profile(tmp_path):
+    log_dir = tmp_path / "prof"
+    with trace(log_dir):
+        with annotate("test-span"):
+            jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    found = []
+    for root, _, files in os.walk(log_dir):
+        found.extend(f for f in files if f.endswith(".xplane.pb"))
+    assert found, f"no xplane trace written under {log_dir}"
+
+
+def test_metrics_logger_csv_and_jsonl(tmp_path):
+    m = MetricsLogger()
+    m.log(step=1, loss=2.5, iter_seconds=0.1)
+    m.log(step=2, loss=2.4, iter_seconds=0.09, extra=7)
+
+    csv_path = tmp_path / "m.csv"
+    m.to_csv(csv_path)
+    with open(csv_path) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 2
+    assert rows[0]["step"] == "1" and rows[1]["extra"] == "7"
+    assert rows[0]["extra"] == ""  # union-of-columns semantics
+
+    jsonl_path = tmp_path / "m.jsonl"
+    m.to_jsonl(jsonl_path)
+    lines = [json.loads(l) for l in open(jsonl_path)]
+    assert lines[1]["loss"] == 2.4 and "extra" not in lines[0]
+
+
+def test_metrics_logger_empty_still_creates_file(tmp_path):
+    # A reported path must always exist, even with zero rows.
+    m = MetricsLogger()
+    p = tmp_path / "empty.csv"
+    m.save(p)
+    assert p.exists() and p.read_text() == ""
+    j = tmp_path / "empty.jsonl"
+    m.save(j)
+    assert j.exists() and j.read_text() == ""
+
+
+def test_metrics_save_dispatches_by_extension(tmp_path):
+    m = MetricsLogger()
+    m.log(step=1, loss=1.0)
+    m.save(tmp_path / "a.csv")
+    assert (tmp_path / "a.csv").read_text().startswith("step,")
+    m.save(tmp_path / "a.jsonl")
+    assert json.loads((tmp_path / "a.jsonl").read_text())["step"] == 1
