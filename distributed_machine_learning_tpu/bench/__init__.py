@@ -1,0 +1,5 @@
+"""Benchmark harnesses (weak-scaling sweep: ``bench.sweep``).
+
+Kept import-free so ``python -m distributed_machine_learning_tpu.bench.sweep``
+doesn't trip runpy's already-imported warning.
+"""

@@ -1,0 +1,41 @@
+"""Weak-scaling sweep harness (bench/sweep.py) on the virtual CPU mesh."""
+
+import jax
+import numpy as np
+
+from distributed_machine_learning_tpu.bench.sweep import (
+    run_point,
+    weak_scaling_sweep,
+)
+from distributed_machine_learning_tpu.models.vgg import VGG11
+
+
+def test_weak_scaling_sweep_structure():
+    model = VGG11()
+    points = weak_scaling_sweep(
+        model, "ring", device_counts=[1, 2], per_device_batch=4, timed_iters=2
+    )
+    assert [p.num_devices for p in points] == [1, 2]
+    assert points[0].strategy == "none"  # baseline: part1 path, no mesh
+    assert points[1].strategy == "ring"
+    for p in points:
+        assert p.imgs_per_sec > 0
+        assert np.isclose(
+            p.imgs_per_sec_per_device, p.imgs_per_sec / p.num_devices, rtol=1e-2
+        )
+    assert points[0].efficiency == 1.0
+    assert points[1].efficiency is not None and points[1].efficiency > 0
+
+
+def test_run_point_does_not_consume_shared_state():
+    """run_point must deep-copy a provided init state (steps donate it)."""
+    from distributed_machine_learning_tpu.cli.common import init_model_and_state
+
+    model = VGG11()
+    state = init_model_and_state(model)
+    run_point(model, "all_reduce", 2, per_device_batch=4, timed_iters=1,
+              init_state=state)
+    # Re-usable: a second point from the same state object still works.
+    p = run_point(model, "all_reduce", 2, per_device_batch=4, timed_iters=1,
+                  init_state=state)
+    assert p.imgs_per_sec > 0
