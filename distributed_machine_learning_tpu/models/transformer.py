@@ -1,0 +1,147 @@
+"""Decoder-only transformer LM with pluggable dense / ring attention.
+
+A model family beyond the reference's capability surface (its only model is
+a 32×32 CNN — ``part1/model.py``; SURVEY.md §2.3 records TP/SP/CP as
+absent) added because long-context is first-class here: with
+``attn_impl="ring"`` the module runs unchanged inside a ``shard_map`` whose
+``seq_axis`` shards the sequence across devices, attention becomes the
+exact blockwise ring of ``ops/ring_attention.py``, and context length
+scales linearly with the number of chips.
+
+TPU-first choices:
+- pre-LN blocks, GELU MLP — all weight matmuls are large, static-shape
+  einsums that tile straight onto the MXU;
+- rotary position embeddings (RoPE): positions enter through a rotation of
+  Q/K rather than a learned table, so a sequence-sharded device needs only
+  its global position offset (``lax.axis_index``), not an embedding slice;
+- bf16 trunk with fp32 logits/softmax (same policy as ``models/vgg.py``);
+- zero data-dependent Python control flow — one traced XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_machine_learning_tpu.ops.ring_attention import (
+    dense_self_attention,
+    ring_self_attention,
+)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0):
+    """Rotate [B, L, H, D] by per-position angles; fp32 math, dtype preserved."""
+    d_half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(d_half, dtype=jnp.float32) / d_half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [L, Dh/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    """Multi-head causal self-attention; ``ring`` shards the sequence."""
+
+    n_heads: int
+    attn_impl: str = "dense"  # "dense" | "ring"
+    seq_axis: str = "seq"
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, positions):
+        B, L, E = x.shape
+        assert E % self.n_heads == 0, "n_heads must divide d_model"
+        head_dim = E // self.n_heads
+        qkv = nn.DenseGeneral(
+            features=(3, self.n_heads, head_dim),
+            axis=-1,
+            dtype=self.compute_dtype,
+            name="qkv",
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, L, H, Dh]
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
+        if self.attn_impl == "ring":
+            out = ring_self_attention(
+                q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
+            )
+        else:
+            out = dense_self_attention(q, k, v, positions)
+        return nn.DenseGeneral(
+            features=E, axis=(-2, -1), dtype=self.compute_dtype, name="out"
+        )(out)
+
+
+class Block(nn.Module):
+    n_heads: int
+    d_ff: int
+    attn_impl: str
+    seq_axis: str
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, positions):
+        h = nn.LayerNorm(dtype=self.compute_dtype, name="ln1")(x)
+        x = x + Attention(
+            n_heads=self.n_heads,
+            attn_impl=self.attn_impl,
+            seq_axis=self.seq_axis,
+            compute_dtype=self.compute_dtype,
+            name="attn",
+        )(h, positions)
+        h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
+        h = nn.Dense(self.d_ff, dtype=self.compute_dtype, name="fc_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.compute_dtype, name="fc_out")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: tokens [B, L(local)] → logits [B, L(local), vocab].
+
+    With ``attn_impl="ring"`` the module must run inside ``shard_map`` with
+    ``seq_axis`` bound; it derives its global position offset from
+    ``lax.axis_index`` so sequence-sharded and unsharded runs produce
+    identical logits.
+    """
+
+    vocab_size: int
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int | None = None
+    attn_impl: str = "dense"
+    seq_axis: str = "seq"
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        del train  # no dropout/BN — kept for the shared train-step interface
+        B, L = tokens.shape
+        if self.attn_impl == "ring":
+            offset = lax.axis_index(self.seq_axis) * L
+        else:
+            offset = 0
+        positions = offset + jnp.arange(L)
+        x = nn.Embed(
+            self.vocab_size, self.d_model, dtype=self.compute_dtype, name="embed"
+        )(tokens)
+        d_ff = self.d_ff or 4 * self.d_model
+        for i in range(self.n_layers):
+            x = Block(
+                n_heads=self.n_heads,
+                d_ff=d_ff,
+                attn_impl=self.attn_impl,
+                seq_axis=self.seq_axis,
+                compute_dtype=self.compute_dtype,
+                name=f"block_{i}",
+            )(x, positions)
+        x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)

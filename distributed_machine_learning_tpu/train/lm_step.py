@@ -1,0 +1,129 @@
+"""Jitted LM train step over a 2-D (data × sequence) mesh.
+
+The CNN step (``train/step.py``) distributes over one data axis — the
+reference's whole capability surface.  Language models add the second
+axis: context parallelism.  Here the batch shards over ``data_axis`` AND
+the sequence over ``seq_axis``; attention runs as the exact ppermute ring
+(``ops/ring_attention.py``) along the sequence axis, and gradients
+all-reduce (pmean) over *both* axes — with mean per-token loss, the
+gradient of the global mean is exactly the two-axis pmean of local grads.
+
+State stays replicated (pure data/context parallelism; tensor-parallel
+sharded params are ``parallel/tensor_parallel.py``'s job).  The SGD
+update is the same hand-rolled kernel the CNN path uses.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
+from distributed_machine_learning_tpu.train.sgd import sgd_update
+from distributed_machine_learning_tpu.train.state import TrainState
+from distributed_machine_learning_tpu.train.step import _shard_map
+
+DATA_AXIS = "batch"
+SEQ_AXIS = "seq"
+
+
+def _lm_step_impl(model, state: TrainState, tokens, targets, *, axis_names):
+    def loss_fn(params):
+        logits = model.apply({"params": params}, tokens, train=True)
+        return lm_cross_entropy(logits, targets)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    if axis_names:
+        grads = lax.pmean(grads, axis_names)
+        loss = lax.pmean(loss, axis_names)
+    new_params, new_momentum = sgd_update(
+        state.params, state.momentum, grads, state.config
+    )
+    new_state = state.replace(
+        params=new_params, momentum=new_momentum, step=state.step + 1
+    )
+    return new_state, loss
+
+
+def make_lm_train_step(
+    model,
+    mesh: Mesh | None = None,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQ_AXIS,
+):
+    """Build ``step(state, tokens, targets) -> (state, loss)``.
+
+    Without a mesh: plain jit (model must use ``attn_impl="dense"``).
+    With a mesh: shard_map over (data_axis, seq_axis); tokens/targets
+    sharded [data, seq], state replicated.  A ring-attention model shards
+    the sequence for real; a dense model on a seq-axis-size-1 mesh is the
+    pure-DP special case.
+    """
+    if mesh is None:
+        impl = partial(_lm_step_impl, model, axis_names=())
+        return jax.jit(impl, donate_argnums=(0,))
+
+    missing = [a for a in (data_axis, seq_axis) if a not in mesh.axis_names]
+    if missing:
+        raise ValueError(
+            f"LM mesh must have axes ({data_axis!r}, {seq_axis!r}); missing "
+            f"{missing} in {mesh.axis_names} (use axis_shape=(1, n) or (n, 1) "
+            "to disable one dimension)"
+        )
+    axis_names = (data_axis, seq_axis)
+    if model.attn_impl == "ring" and seq_axis not in mesh.axis_names:
+        raise ValueError(
+            f"ring-attention model needs mesh axis {seq_axis!r}; "
+            f"mesh has {mesh.axis_names}"
+        )
+    if model.attn_impl != "ring" and mesh.shape[seq_axis] > 1:
+        # Dense attention only sees its local chunk with offset-0 positions:
+        # sharding the sequence under it would be silently wrong, not slow.
+        raise ValueError(
+            f"dense-attention model cannot shard the sequence: mesh axis "
+            f"{seq_axis!r} has size {mesh.shape[seq_axis]} > 1; use "
+            'attn_impl="ring" or an axis_shape with seq size 1'
+        )
+    impl = partial(_lm_step_impl, model, axis_names=axis_names)
+    batch_spec = P(data_axis, seq_axis)
+    sharded = _shard_map(
+        impl,
+        mesh=mesh,
+        in_specs=(P(), batch_spec, batch_spec),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def shard_lm_batch(
+    mesh: Mesh,
+    tokens,
+    targets,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQ_AXIS,
+):
+    """Place [B, L] token/target arrays: batch over data axis, sequence
+    over the ring axis."""
+    sharding = NamedSharding(mesh, P(data_axis, seq_axis))
+    return (
+        jax.device_put(jnp.asarray(tokens), sharding),
+        jax.device_put(jnp.asarray(targets), sharding),
+    )
+
+
+def init_lm_state(model, seed: int = 69143, batch: int = 1, seq_len: int = 8):
+    """Initialize LM params/state from the shared seed.
+
+    Initialization always runs the dense path (no mesh needed): parameter
+    shapes are independent of the attention implementation.
+    """
+    dense = model.clone(attn_impl="dense") if model.attn_impl != "dense" else model
+    rng = jax.random.PRNGKey(seed)
+    init_rng, state_rng = jax.random.split(rng)
+    tokens = jnp.zeros((batch, seq_len), jnp.int32)
+    variables = dense.init(init_rng, tokens, train=False)
+    return TrainState.create(params=variables["params"], rng=state_rng)

@@ -12,12 +12,24 @@ import jax.numpy as jnp
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Mean softmax cross-entropy over the batch (CrossEntropyLoss parity)."""
+    """Mean softmax cross-entropy over all leading axes (CrossEntropyLoss
+    parity; handles [B, C] classification and [B, L, C] token logits)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)
     return nll.mean()
 
 
 def count_correct(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Top-1 correct-prediction count (part1/main.py:71-72)."""
     return (logits.argmax(axis=-1) == labels).sum()
+
+
+def lm_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy over [B, L] targets.
+
+    Caller supplies already-shifted targets (under sequence sharding the
+    shift crosses chunk boundaries, so shifting belongs to the host data
+    pipeline, not the sharded step).  Equal chunk sizes make the global
+    mean equal the pmean of local means.
+    """
+    return cross_entropy_loss(logits, targets)
