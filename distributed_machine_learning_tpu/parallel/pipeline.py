@@ -1,0 +1,298 @@
+"""Pipeline parallelism: GPipe-style SPMD pipeline over a ``pipe`` mesh axis.
+
+Capability beyond the reference (PP absent — SURVEY.md §2.3), built the
+TPU way: transformer blocks are *stacked* along a leading layer axis and
+that axis is sharded over the mesh, so every device holds a contiguous
+span of layers.  The schedule is a single SPMD loop: each tick, every
+device applies its span to its current microbatch activation, then the
+activations rotate one hop along the ring via ``lax.ppermute``.  Stage 0
+injects a fresh embedded microbatch per tick; the last stage peels off
+finished microbatches into the loss.  After ``M + P − 1`` ticks all ``M``
+microbatches have flowed through all ``P`` stages.
+
+The backward pass needs no hand-written schedule: the transpose of
+``ppermute`` is the reverse ``ppermute``, so ``jax.grad`` of this loop IS
+the reverse pipeline, with XLA free to overlap the per-tick collective
+with the neighboring stage compute.
+
+Parameter layout inside ``shard_map``:
+  - ``blocks``: every Block param stacked to ``[n_layers, ...]``, sharded
+    ``P("pipe", ...)`` → local ``[n_layers/P, ...]``, consumed by
+    ``lax.scan`` (static shapes, one compiled block body per device);
+  - ``embed`` / ``ln_f`` / ``lm_head``: replicated; only one stage's
+    contribution is non-zero, so their gradients are ``psum``-ed over the
+    pipe axis (the zero shares from other stages are free).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu.models.transformer import Block, TransformerLM
+from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
+from distributed_machine_learning_tpu.train.sgd import sgd_update
+from distributed_machine_learning_tpu.train.state import TrainState
+from distributed_machine_learning_tpu.train.step import _shard_map
+
+PIPE_AXIS = "pipe"
+
+
+def _block_module(model: TransformerLM) -> Block:
+    return Block(
+        n_heads=model.n_heads,
+        d_ff=model.d_ff or 4 * model.d_model,
+        attn_impl="dense",
+        seq_axis=model.seq_axis,
+        compute_dtype=model.compute_dtype,
+    )
+
+
+def stack_lm_params(params: dict, n_layers: int) -> dict:
+    """TransformerLM params (block_0..block_{n-1} dicts) → pipeline layout
+    (one ``blocks`` tree with leading layer axis)."""
+    blocks = [params[f"block_{i}"] for i in range(n_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    return {
+        "embed": params["embed"],
+        "blocks": stacked,
+        "ln_f": params["ln_f"],
+        "lm_head": params["lm_head"],
+    }
+
+
+def unstack_lm_params(pipeline_params: dict, n_layers: int) -> dict:
+    """Inverse of ``stack_lm_params`` (for checkpoint interop/tests)."""
+    out = {
+        "embed": pipeline_params["embed"],
+        "ln_f": pipeline_params["ln_f"],
+        "lm_head": pipeline_params["lm_head"],
+    }
+    for i in range(n_layers):
+        out[f"block_{i}"] = jax.tree_util.tree_map(
+            lambda x, i=i: x[i], pipeline_params["blocks"]
+        )
+    return out
+
+
+def init_pipeline_state(model: TransformerLM, seed: int = 69143) -> TrainState:
+    """Initialize TransformerLM params (dense path) and restack them."""
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+    state = init_lm_state(model, seed=seed)
+    return TrainState.create(
+        params=stack_lm_params(state.params, model.n_layers),
+        rng=state.rng,
+        config=state.config,
+    )
+
+
+def _apply_local_span(block: Block, stacked_local, x, positions):
+    """Run this device's span of layers over x via lax.scan."""
+
+    def body(h, layer_params):
+        return block.apply({"params": layer_params}, h, positions), None
+
+    h, _ = lax.scan(body, x, stacked_local)
+    return h
+
+
+def _pipeline_forward_loss(
+    model: TransformerLM,
+    params: dict,
+    tokens_mb,  # [M, mb, L] int32 (replicated)
+    targets_mb,  # [M, mb, L] int32
+    *,
+    pipe_axis: str,
+    num_stages: int,
+):
+    """Forward + loss for all microbatches through the SPMD pipeline."""
+    import flax.linen as nn
+
+    block = _block_module(model)
+    M, mb, L = tokens_mb.shape
+    E = model.d_model
+    rank = lax.axis_index(pipe_axis)
+    positions = jnp.arange(L)
+    is_first = rank == 0
+    is_last = (rank == num_stages - 1).astype(jnp.float32)
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    # The exact stage-boundary modules TransformerLM uses, applied with the
+    # pipeline's param slices — bit-identical numerics to the dense model.
+    embed_mod = nn.Embed(model.vocab_size, E, dtype=model.compute_dtype)
+    ln_f_mod = nn.LayerNorm(dtype=model.compute_dtype)
+    head_mod = nn.Dense(model.vocab_size, dtype=model.compute_dtype)
+
+    def embed(tok):
+        return embed_mod.apply({"params": params["embed"]}, tok)
+
+    def head_loss(x, tgt):
+        h = ln_f_mod.apply({"params": params["ln_f"]}, x)
+        logits = head_mod.apply({"params": params["lm_head"]}, h)
+        return lm_cross_entropy(logits.astype(jnp.float32), tgt)
+
+    act = jnp.zeros((mb, L, E), model.compute_dtype)
+    loss_acc = jnp.zeros((), jnp.float32)
+    for t in range(M + num_stages - 1):
+        # Stage 0 ingests microbatch t (clamped index; masked elsewhere).
+        inject = embed(tokens_mb[min(t, M - 1)])
+        x = jnp.where(is_first, inject, act) if t < M else act
+        y = _apply_local_span(block, params["blocks"], x, positions)
+        # Last stage peels off microbatch m = t − (P−1).
+        m = t - (num_stages - 1)
+        if 0 <= m < M:
+            loss_m = head_loss(y, targets_mb[m])
+            loss_acc = loss_acc + is_last * loss_m
+        if t < M + num_stages - 2:
+            act = lax.ppermute(y, pipe_axis, perm)
+    # Local loss: non-zero on the last stage only.  The psum that shares it
+    # with every stage happens OUTSIDE value_and_grad — a psum inside the
+    # differentiated region would inflate cotangents by the axis size under
+    # shard_map with replication-checking off (its transpose conservatively
+    # psums the cotangent).
+    return loss_acc / M
+
+
+def _pp_step_impl(
+    model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis, num_stages
+):
+    loss_fn = partial(
+        _pipeline_forward_loss,
+        model,
+        tokens_mb=tokens_mb,
+        targets_mb=targets_mb,
+        pipe_axis=pipe_axis,
+        num_stages=num_stages,
+    )
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    # The local loss lives on the last stage only — share it.
+    loss = lax.psum(loss, pipe_axis)
+    # Replicated (non-"blocks") params: each stage holds a share that is
+    # zero unless it used the param — sum them.  Stage-sharded blocks grads
+    # are already exact locally.
+    for name in ("embed", "ln_f", "lm_head"):
+        grads[name] = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, pipe_axis), grads[name]
+        )
+    new_params, new_momentum = sgd_update(
+        state.params, state.momentum, grads, state.config
+    )
+    new_state = state.replace(
+        params=new_params, momentum=new_momentum, step=state.step + 1
+    )
+    return new_state, loss
+
+
+def _state_specs(pipe_axis: str, params_example: dict) -> TrainState:
+    """shard_map PartitionSpec pytree for a pipeline TrainState."""
+
+    def param_spec(tree, stacked: bool):
+        return jax.tree_util.tree_map(
+            lambda x: P(pipe_axis, *(None,) * (x.ndim - 1)) if stacked else P(),
+            tree,
+        )
+
+    def specs(params):
+        return {
+            "embed": param_spec(params["embed"], False),
+            "blocks": param_spec(params["blocks"], True),
+            "ln_f": param_spec(params["ln_f"], False),
+            "lm_head": param_spec(params["lm_head"], False),
+        }
+
+    return TrainState(
+        params=specs(params_example),
+        momentum=specs(params_example),
+        batch_stats={},
+        step=P(),
+        rng=P(),
+        config=None,
+    )
+
+
+def make_pp_lm_train_step(
+    model: TransformerLM,
+    mesh: Mesh,
+    num_microbatches: int,
+    pipe_axis: str = PIPE_AXIS,
+):
+    """Build ``step(state, tokens_mb, targets_mb) -> (state, loss)``.
+
+    ``tokens_mb``/``targets_mb``: [num_microbatches, mb, L] (see
+    ``microbatch``).  ``state`` from ``init_pipeline_state`` +
+    ``shard_pp_state``.  Requires ``n_layers % P == 0``.
+    """
+    if model.attn_impl != "dense":
+        raise ValueError("pipeline step requires attn_impl='dense'")
+    if pipe_axis not in mesh.axis_names:
+        raise ValueError(f"mesh is missing axis {pipe_axis!r}: {mesh.axis_names}")
+    num_stages = mesh.shape[pipe_axis]
+    if model.n_layers % num_stages:
+        raise ValueError(
+            f"n_layers={model.n_layers} must divide evenly into "
+            f"{num_stages} pipeline stages"
+        )
+    if num_microbatches < 1:
+        raise ValueError("num_microbatches must be >= 1")
+
+    impl = partial(
+        _pp_step_impl, model, pipe_axis=pipe_axis, num_stages=num_stages
+    )
+
+    jitted: dict = {}
+
+    def step(state: TrainState, tokens_mb, targets_mb):
+        if tokens_mb.shape[0] != num_microbatches:
+            raise ValueError(
+                f"expected {num_microbatches} microbatches, got input shaped "
+                f"{tokens_mb.shape} (use microbatch(tokens, targets, "
+                f"{num_microbatches}))"
+            )
+        key = jax.tree_util.tree_structure(state)
+        fn = jitted.get(key)
+        if fn is None:
+            state_spec = _state_specs(pipe_axis, state.params)
+            state_spec = state_spec.replace(config=state.config)
+            fn = jitted[key] = jax.jit(
+                _shard_map(
+                    impl,
+                    mesh=mesh,
+                    in_specs=(state_spec, P(), P()),
+                    out_specs=(state_spec, P()),
+                ),
+                donate_argnums=(0,),
+            )
+        return fn(state, tokens_mb, targets_mb)
+
+    return step
+
+
+def shard_pp_state(
+    state: TrainState, mesh: Mesh, pipe_axis: str = PIPE_AXIS
+) -> TrainState:
+    """Place a pipeline TrainState: blocks sharded over stages, rest
+    replicated."""
+    spec_state = _state_specs(pipe_axis, state.params)
+    spec_state = spec_state.replace(config=state.config)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, spec_state
+    )
+
+
+def microbatch(tokens, targets, num_microbatches: int):
+    """[B, L] → [M, B/M, L] microbatch stacks (GPipe input layout)."""
+    B = tokens.shape[0]
+    if B % num_microbatches:
+        raise ValueError(
+            f"batch {B} not divisible by num_microbatches={num_microbatches}"
+        )
+    shape = (num_microbatches, B // num_microbatches) + tokens.shape[1:]
+    return (
+        jnp.asarray(tokens).reshape(shape),
+        jnp.asarray(targets).reshape(shape),
+    )

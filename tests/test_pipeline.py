@@ -1,0 +1,94 @@
+"""Pipeline-parallel LM step (parallel/pipeline.py): the P-stage ppermute
+pipeline must take exactly the same training step as the dense model on a
+single device."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.models.transformer import TransformerLM
+from distributed_machine_learning_tpu.parallel.pipeline import (
+    init_pipeline_state,
+    make_pp_lm_train_step,
+    microbatch,
+    shard_pp_state,
+    stack_lm_params,
+    unstack_lm_params,
+)
+from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+from distributed_machine_learning_tpu.train.lm_step import (
+    init_lm_state,
+    make_lm_train_step,
+)
+
+VOCAB, B, L, LAYERS = 64, 4, 16, 4
+
+
+def tiny_lm():
+    return TransformerLM(
+        vocab_size=VOCAB, d_model=32, n_layers=LAYERS, n_heads=4
+    )
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(23)
+    toks = rng.integers(0, VOCAB, (B, L + 1))
+    return toks[:, :-1].astype(np.int32), toks[:, 1:].astype(np.int32)
+
+
+def test_stack_unstack_roundtrip():
+    model = tiny_lm()
+    params = init_lm_state(model).params
+    stacked = stack_lm_params(params, LAYERS)
+    assert stacked["blocks"]["attn"]["qkv"]["kernel"].shape[0] == LAYERS
+    restored = unstack_lm_params(stacked, LAYERS)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(restored)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("stages,microbatches", [(2, 2), (4, 2), (4, 4)])
+def test_pp_step_equals_single_device(batch, stages, microbatches):
+    tokens, targets = batch
+    model = tiny_lm()
+
+    ref_state = init_lm_state(model)
+    ref_step = make_lm_train_step(model, mesh=None)
+    ref_state, ref_loss = ref_step(
+        ref_state, jnp.asarray(tokens), jnp.asarray(targets)
+    )
+
+    mesh = make_mesh(stages, axis_names=("pipe",))
+    state = shard_pp_state(init_pipeline_state(model), mesh)
+    step = make_pp_lm_train_step(model, mesh, num_microbatches=microbatches)
+    x, y = microbatch(tokens, targets, microbatches)
+    state, loss = step(state, x, y)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    got = unstack_lm_params(state.params, LAYERS)
+    want = ref_state.params
+    key = lambda kv: str(kv[0])
+    for (ka, a), (kb, b) in zip(
+        sorted(jax.tree_util.tree_leaves_with_path(got), key=key),
+        sorted(jax.tree_util.tree_leaves_with_path(want), key=key),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5, err_msg=str(ka)
+        )
+
+
+def test_pp_guards(batch):
+    model = tiny_lm()
+    mesh3 = make_mesh(3, axis_names=("pipe",))
+    with pytest.raises(ValueError, match="divide evenly"):
+        make_pp_lm_train_step(model, mesh3, num_microbatches=2)
+    ring = TransformerLM(vocab_size=VOCAB, d_model=32, n_layers=4, n_heads=4,
+                         attn_impl="ring")
+    mesh2 = make_mesh(2, axis_names=("pipe",))
+    with pytest.raises(ValueError, match="dense"):
+        make_pp_lm_train_step(ring, mesh2, num_microbatches=2)
+    with pytest.raises(ValueError, match="not divisible"):
+        microbatch(np.zeros((4, 8)), np.zeros((4, 8)), 3)
