@@ -1,0 +1,160 @@
+"""Mixture-of-Experts transformer (Switch-style top-1 routing).
+
+Model family beyond the reference (EP/MoE absent — SURVEY.md §2.3), built
+for expert parallelism the GSPMD way: every expert-owned parameter carries
+a leading ``[n_experts, ...]`` axis, routing is expressed as static-shape
+einsums against a dispatch one-hot (no gather/scatter, no dynamic shapes),
+and when ``parallel/expert_parallel.py`` shards that leading axis over the
+mesh's ``expert`` axis, XLA's partitioner turns the dispatch/combine
+einsums into the token all-to-all — Switch Transformer's comm pattern,
+inserted by the compiler.
+
+Capacity semantics (Switch): each expert processes at most
+``capacity = ceil(tokens/n_experts · capacity_factor)`` tokens per batch;
+overflow tokens are dropped (their MLP output is zero and they pass
+through the residual unchanged — exactly Switch's overflow behavior).
+The router's load-balancing auxiliary loss (Switch eq. 4:
+``E · Σ_e f_e·P_e``) is sown into the ``losses`` collection; the MoE train
+step adds it with weight ``aux_loss_weight``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_machine_learning_tpu.models.transformer import Attention
+
+
+class MoEMLP(nn.Module):
+    """Top-1 routed expert MLP over [B, T, D] activations."""
+
+    n_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, D = x.shape
+        N = B * T
+        E = self.n_experts
+        capacity = max(1, math.ceil(N / E * self.capacity_factor))
+        tokens = x.reshape(N, D)
+
+        # Router in fp32: small matmul, precision matters for argmax ties.
+        gate = nn.Dense(E, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(gate, axis=-1)  # [N, E]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [N]
+        expert_prob = jnp.max(probs, axis=-1)  # [N]
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [N, E]
+
+        # Switch aux loss: E · Σ_e (token fraction)·(mean router prob).
+        frac = onehot.mean(axis=0)
+        mean_prob = probs.mean(axis=0)
+        self.sow("losses", "load_balancing", E * jnp.sum(frac * mean_prob))
+
+        # Position of each token within its expert's queue; drop overflow.
+        pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based where routed
+        within = (pos > 0) & (pos <= capacity)
+        slot = jax.nn.one_hot(
+            (pos - 1).clip(0).astype(jnp.int32), capacity, dtype=jnp.float32
+        )  # [N, E, C]
+        dmask = slot * within.astype(jnp.float32)[..., None]  # [N, E, C]
+
+        dt = self.compute_dtype
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (E, D, self.d_ff)
+        )
+        b_in = self.param("b_in", nn.initializers.zeros, (E, self.d_ff))
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (E, self.d_ff, D)
+        )
+        b_out = self.param("b_out", nn.initializers.zeros, (E, D))
+
+        # Dispatch → expert FFN → combine: three static einsums whose E axis
+        # shards over the mesh (the all_to_all lives inside the first/last).
+        xe = jnp.einsum("nd,nec->ecd", tokens.astype(dt), dmask.astype(dt))
+        h = nn.gelu(
+            jnp.einsum("ecd,edf->ecf", xe, w_in.astype(dt))
+            + b_in.astype(dt)[:, None, :]
+        )
+        ye = jnp.einsum("ecf,efd->ecd", h, w_out.astype(dt)) + b_out.astype(dt)[
+            :, None, :
+        ]
+        y = jnp.einsum("ecd,nec->nd", ye, dmask.astype(dt))
+        y = y * expert_prob[:, None].astype(dt)  # router-scaled (Switch)
+        return y.reshape(B, T, D)
+
+
+class MoEBlock(nn.Module):
+    n_heads: int
+    n_experts: int
+    d_ff: int
+    capacity_factor: float
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, positions):
+        h = nn.LayerNorm(dtype=self.compute_dtype, name="ln1")(x)
+        x = x + Attention(
+            n_heads=self.n_heads,
+            attn_impl="dense",
+            compute_dtype=self.compute_dtype,
+            name="attn",
+        )(h, positions)
+        h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
+        return x + MoEMLP(
+            n_experts=self.n_experts,
+            d_ff=self.d_ff,
+            capacity_factor=self.capacity_factor,
+            compute_dtype=self.compute_dtype,
+            name="moe",
+        )(h)
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with a routed expert MLP in every block."""
+
+    vocab_size: int
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    n_experts: int = 8
+    d_ff: int | None = None
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    compute_dtype: Any = jnp.float32
+    attn_impl: str = "dense"  # shared train-step interface
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        del train
+        if self.attn_impl != "dense":
+            raise NotImplementedError(
+                "MoETransformerLM only supports attn_impl='dense' (blocks "
+                "run dense attention); ring attention + MoE is not wired up"
+            )
+        B, L = tokens.shape
+        positions = jnp.arange(L)
+        x = nn.Embed(
+            self.vocab_size, self.d_model, dtype=self.compute_dtype, name="embed"
+        )(tokens)
+        for i in range(self.n_layers):
+            x = MoEBlock(
+                n_heads=self.n_heads,
+                n_experts=self.n_experts,
+                d_ff=self.d_ff or 4 * self.d_model,
+                capacity_factor=self.capacity_factor,
+                compute_dtype=self.compute_dtype,
+                name=f"block_{i}",
+            )(x, positions)
+        x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)

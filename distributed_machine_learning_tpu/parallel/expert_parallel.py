@@ -1,0 +1,108 @@
+"""Expert parallelism for the MoE transformer — GSPMD sharding rules.
+
+Same design as ``parallel/tensor_parallel.py``: declare where params live,
+jit the unmodified step with those shardings, and let XLA's partitioner
+derive the comm.  Expert-owned params (leading ``[n_experts, ...]`` axis:
+``w_in``/``b_in``/``w_out``/``b_out`` of every ``MoEMLP``) shard that axis
+over the mesh's ``expert`` axis; the dispatch/combine einsums in
+``models/moe.py`` then lower to the token all-to-all over ICI.  Everything
+else (attention, norms, router, embeddings) stays replicated; the batch
+shards over ``data_axis``, giving EP×DP on one mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu.models.moe import MoETransformerLM
+from distributed_machine_learning_tpu.parallel.gspmd import (
+    make_cached_sharded_step,
+    shard_state,
+    state_shardings,
+)
+from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
+from distributed_machine_learning_tpu.train.sgd import sgd_update
+from distributed_machine_learning_tpu.train.state import TrainState
+
+EXPERT_AXIS = "expert"
+_EXPERT_PARAMS = {"w_in", "b_in", "w_out", "b_out"}
+
+
+def ep_spec_for(path: tuple[str, ...], ndim: int, expert_axis: str = EXPERT_AXIS) -> P:
+    """Expert-owned leaves shard their leading axis; the rest replicate."""
+    if path and path[-1] in _EXPERT_PARAMS and "moe" in path:
+        return P(expert_axis, *(None,) * (ndim - 1))
+    return P(*(None,) * ndim)
+
+
+def _spec_for(expert_axis: str):
+    return lambda path, ndim: ep_spec_for(path, ndim, expert_axis)
+
+
+def ep_state_shardings(state: TrainState, mesh: Mesh, expert_axis: str = EXPERT_AXIS):
+    return state_shardings(state, mesh, _spec_for(expert_axis))
+
+
+def shard_ep_state(
+    state: TrainState, mesh: Mesh, expert_axis: str = EXPERT_AXIS
+) -> TrainState:
+    return shard_state(state, mesh, _spec_for(expert_axis))
+
+
+def _moe_step_impl(model: MoETransformerLM, state: TrainState, tokens, targets):
+    def loss_fn(params):
+        logits, mutated = model.apply(
+            {"params": params}, tokens, train=True, mutable=["losses"]
+        )
+        ce = lm_cross_entropy(logits, targets)
+        aux_leaves = jax.tree_util.tree_leaves(mutated.get("losses", {}))
+        aux = sum(jax.numpy.sum(a) for a in aux_leaves) if aux_leaves else 0.0
+        return ce + model.aux_loss_weight * aux, ce
+
+    (loss, ce), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+    new_params, new_momentum = sgd_update(
+        state.params, state.momentum, grads, state.config
+    )
+    new_state = state.replace(
+        params=new_params, momentum=new_momentum, step=state.step + 1
+    )
+    return new_state, ce
+
+
+def init_moe_state(model: MoETransformerLM, seed: int = 69143) -> TrainState:
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+    return init_lm_state(model, seed=seed)
+
+
+def make_ep_train_step(
+    model: MoETransformerLM,
+    mesh: Mesh | None = None,
+    data_axis: str = "batch",
+    expert_axis: str = EXPERT_AXIS,
+):
+    """Build the EP(+DP) MoE train step: ``step(state, tokens, targets) →
+    (state, ce_loss)``.  Without a mesh: plain jit (the single-device
+    reference).  With a mesh: state placed via ``shard_ep_state``,
+    tokens/targets sharded over ``data_axis`` (``shard_tp_batch`` works)."""
+    if model.attn_impl != "dense":
+        raise ValueError(
+            "expert-parallel step requires attn_impl='dense' "
+            "(MoEBlock runs dense attention; the sequence is not sharded here)"
+        )
+    impl = partial(_moe_step_impl, model)
+    if mesh is None:
+        return jax.jit(impl, donate_argnums=(0,))
+    for a in (data_axis, expert_axis):
+        if a not in mesh.axis_names:
+            raise ValueError(f"mesh is missing axis {a!r}: {mesh.axis_names}")
+    if model.n_experts % mesh.shape[expert_axis]:
+        raise ValueError(
+            f"n_experts={model.n_experts} must be divisible by the "
+            f"expert-axis size {mesh.shape[expert_axis]}"
+        )
+    batch_sharding = NamedSharding(mesh, P(data_axis, None))
+    return make_cached_sharded_step(impl, mesh, _spec_for(expert_axis), batch_sharding)

@@ -1,0 +1,75 @@
+"""Shared GSPMD machinery for the sharded-parameter strategies (TP / EP).
+
+Both tensor and expert parallelism follow the same recipe — a
+``spec_for(path, ndim)`` rule table mapped over the param tree, a
+TrainState-shaped sharding pytree, and a jit cache keyed by the state's
+tree structure (SGDConfig is *static* pytree metadata, so differently
+configured states need distinct jitted signatures).  This module is that
+recipe, written once.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu.train.state import TrainState
+
+SpecFor = Callable[[tuple[str, ...], int], P]
+
+
+def param_specs(params, spec_for: SpecFor):
+    """Map a path→PartitionSpec rule over a param tree."""
+
+    def spec(path, leaf):
+        keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
+        return spec_for(keys, leaf.ndim)
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def state_shardings(state: TrainState, mesh: Mesh, spec_for: SpecFor) -> TrainState:
+    """NamedSharding pytree for a TrainState: params and momentum follow
+    the rule table, everything else replicates."""
+    specs = param_specs(state.params, spec_for)
+    to_sharding = lambda s: NamedSharding(mesh, s)
+    return TrainState(
+        params=jax.tree_util.tree_map(to_sharding, specs),
+        momentum=jax.tree_util.tree_map(to_sharding, specs),
+        batch_stats=jax.tree_util.tree_map(
+            lambda _: to_sharding(P()), state.batch_stats
+        ),
+        step=to_sharding(P()),
+        rng=to_sharding(P()),
+        config=state.config,
+    )
+
+
+def shard_state(state: TrainState, mesh: Mesh, spec_for: SpecFor) -> TrainState:
+    """Place a host/replicated TrainState into the rule table's layout."""
+    return jax.tree_util.tree_map(
+        jax.device_put, state, state_shardings(state, mesh, spec_for)
+    )
+
+
+def make_cached_sharded_step(impl, mesh: Mesh, spec_for: SpecFor, batch_sharding):
+    """jit ``impl(state, tokens, targets)`` with shardings derived from the
+    first call's actual state, cached per state tree structure."""
+    jitted: dict = {}
+
+    def step(state: TrainState, tokens, targets):
+        key = jax.tree_util.tree_structure(state)
+        fn = jitted.get(key)
+        if fn is None:
+            shardings = state_shardings(state, mesh, spec_for)
+            fn = jitted[key] = jax.jit(
+                impl,
+                in_shardings=(shardings, batch_sharding, batch_sharding),
+                out_shardings=(shardings, NamedSharding(mesh, P())),
+                donate_argnums=(0,),
+            )
+        return fn(state, tokens, targets)
+
+    return step

@@ -136,20 +136,33 @@ def _pipeline_forward_loss(
         logits = head_mod.apply({"params": params["lm_head"]}, h)
         return lm_cross_entropy(logits.astype(jnp.float32), tgt)
 
-    act = jnp.zeros((mb, L, E), model.compute_dtype)
-    loss_acc = jnp.zeros((), jnp.float32)
-    for t in range(M + num_stages - 1):
+    # One lax.scan over the M+P−1 ticks: the body is traced once, so
+    # program size (and compile time) is independent of the microbatch
+    # count — tick-dependent behavior (injection window, peel-off window)
+    # is expressed as masks on the traced tick index.
+    def tick(carry, t):
+        act, loss_acc = carry
         # Stage 0 ingests microbatch t (clamped index; masked elsewhere).
-        inject = embed(tokens_mb[min(t, M - 1)])
-        x = jnp.where(is_first, inject, act) if t < M else act
+        inject = embed(
+            lax.dynamic_index_in_dim(tokens_mb, jnp.clip(t, 0, M - 1), keepdims=False)
+        )
+        x = jnp.where(is_first & (t < M), inject, act)
         y = _apply_local_span(block, params["blocks"], x, positions)
         # Last stage peels off microbatch m = t − (P−1).
         m = t - (num_stages - 1)
-        if 0 <= m < M:
-            loss_m = head_loss(y, targets_mb[m])
-            loss_acc = loss_acc + is_last * loss_m
-        if t < M + num_stages - 2:
-            act = lax.ppermute(y, pipe_axis, perm)
+        tgt = lax.dynamic_index_in_dim(
+            targets_mb, jnp.clip(m, 0, M - 1), keepdims=False
+        )
+        valid = ((m >= 0) & (m < M)).astype(jnp.float32)
+        loss_acc = loss_acc + is_last * valid * head_loss(y, tgt)
+        act = lax.ppermute(y, pipe_axis, perm)
+        return (act, loss_acc), None
+
+    act = jnp.zeros((mb, L, E), model.compute_dtype)
+    loss_acc = jnp.zeros((), jnp.float32)
+    (_, loss_acc), _ = lax.scan(
+        tick, (act, loss_acc), jnp.arange(M + num_stages - 1)
+    )
     # Local loss: non-zero on the last stage only.  The psum that shares it
     # with every stage happens OUTSIDE value_and_grad — a psum inside the
     # differentiated region would inflate cotangents by the axis size under

@@ -41,6 +41,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_machine_learning_tpu.parallel.gspmd import (
+    make_cached_sharded_step,
+    shard_state,
+    state_shardings,
+)
 from distributed_machine_learning_tpu.train.lm_step import _lm_step_impl
 from distributed_machine_learning_tpu.train.state import TrainState
 
@@ -68,14 +73,8 @@ def tp_spec_for(path: tuple[str, ...], ndim: int, model_axis: str = MODEL_AXIS) 
     return P(*(None,) * ndim)
 
 
-def _param_specs(params, model_axis: str):
-    def spec(path, leaf):
-        keys = tuple(
-            k.key if hasattr(k, "key") else str(k) for k in path
-        )
-        return tp_spec_for(keys, leaf.ndim, model_axis)
-
-    return jax.tree_util.tree_map_with_path(spec, params)
+def _spec_for(model_axis: str):
+    return lambda path, ndim: tp_spec_for(path, ndim, model_axis)
 
 
 def tp_state_shardings(
@@ -83,26 +82,14 @@ def tp_state_shardings(
 ):
     """NamedSharding pytree for a TrainState: params + momentum follow the
     TP layout, scalar fields replicate."""
-    param_specs = _param_specs(state.params, model_axis)
-    to_sharding = lambda s: NamedSharding(mesh, s)
-    return TrainState(
-        params=jax.tree_util.tree_map(to_sharding, param_specs),
-        momentum=jax.tree_util.tree_map(to_sharding, param_specs),
-        batch_stats=jax.tree_util.tree_map(
-            lambda _: to_sharding(P()), state.batch_stats
-        ),
-        step=to_sharding(P()),
-        rng=to_sharding(P()),
-        config=state.config,
-    )
+    return state_shardings(state, mesh, _spec_for(model_axis))
 
 
 def shard_tp_state(
     state: TrainState, mesh: Mesh, model_axis: str = MODEL_AXIS
 ) -> TrainState:
     """Place a (host or replicated) TrainState into the TP layout."""
-    shardings = tp_state_shardings(state, mesh, model_axis)
-    return jax.tree_util.tree_map(jax.device_put, state, shardings)
+    return shard_state(state, mesh, _spec_for(model_axis))
 
 
 def make_tp_lm_train_step(
@@ -139,22 +126,7 @@ def make_tp_lm_train_step(
         )
     batch_sharding = NamedSharding(mesh, P(data_axis, None))
     impl = partial(_lm_step_impl, model, axis_names=())
-    jitted: dict = {}
-
-    def step(state: TrainState, tokens, targets):
-        key = jax.tree_util.tree_structure(state)
-        fn = jitted.get(key)
-        if fn is None:
-            state_shardings = tp_state_shardings(state, mesh, model_axis)
-            fn = jitted[key] = jax.jit(
-                impl,
-                in_shardings=(state_shardings, batch_sharding, batch_sharding),
-                out_shardings=(state_shardings, NamedSharding(mesh, P())),
-                donate_argnums=(0,),
-            )
-        return fn(state, tokens, targets)
-
-    return step
+    return make_cached_sharded_step(impl, mesh, _spec_for(model_axis), batch_sharding)
 
 
 def shard_tp_batch(mesh: Mesh, tokens, targets, data_axis: str = "batch"):
