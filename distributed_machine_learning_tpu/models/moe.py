@@ -27,9 +27,6 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from distributed_machine_learning_tpu.models.transformer import Attention
-
-
 class MoEMLP(nn.Module):
     """Top-1 routed expert MLP over [B, T, D] activations."""
 
@@ -93,30 +90,26 @@ class MoEMLP(nn.Module):
         return y.reshape(B, T, D)
 
 
-class MoEBlock(nn.Module):
-    n_heads: int
-    n_experts: int
-    d_ff: int
-    capacity_factor: float
-    compute_dtype: Any
+def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
+    """A transformer Block whose MLP is the routed expert mixture — the
+    shared ``models.transformer.Block`` wiring, not a copy."""
+    from distributed_machine_learning_tpu.models.transformer import Block
 
-    @nn.compact
-    def __call__(self, x, positions):
-        h = nn.LayerNorm(dtype=self.compute_dtype, name="ln1")(x)
-        x = x + Attention(
-            n_heads=self.n_heads,
-            attn_impl="dense",
-            compute_dtype=self.compute_dtype,
-            name="attn",
-        )(h, positions)
-        h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
-        return x + MoEMLP(
-            n_experts=self.n_experts,
-            d_ff=self.d_ff,
-            capacity_factor=self.capacity_factor,
-            compute_dtype=self.compute_dtype,
+    return Block(
+        n_heads=model.n_heads,
+        d_ff=model.d_ff or 4 * model.d_model,
+        attn_impl="dense",
+        seq_axis="seq",
+        compute_dtype=model.compute_dtype,
+        mlp_factory=lambda: MoEMLP(
+            n_experts=model.n_experts,
+            d_ff=model.d_ff or 4 * model.d_model,
+            capacity_factor=model.capacity_factor,
+            compute_dtype=model.compute_dtype,
             name="moe",
-        )(h)
+        ),
+        name=name,
+    )
 
 
 class MoETransformerLM(nn.Module):
@@ -147,14 +140,7 @@ class MoETransformerLM(nn.Module):
             self.vocab_size, self.d_model, dtype=self.compute_dtype, name="embed"
         )(tokens)
         for i in range(self.n_layers):
-            x = MoEBlock(
-                n_heads=self.n_heads,
-                n_experts=self.n_experts,
-                d_ff=self.d_ff or 4 * self.d_model,
-                capacity_factor=self.capacity_factor,
-                compute_dtype=self.compute_dtype,
-                name=f"block_{i}",
-            )(x, positions)
+            x = _moe_block(self, name=f"block_{i}")(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)

@@ -1,4 +1,4 @@
-"""Decoder-only transformer LM with pluggable dense / ring attention.
+"""Decoder-only transformer LM with pluggable dense / ring / flash attention.
 
 A model family beyond the reference's capability surface (its only model is
 a 32×32 CNN — ``part1/model.py``; SURVEY.md §2.3 records TP/SP/CP as
@@ -46,10 +46,15 @@ def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0):
 
 
 class Attention(nn.Module):
-    """Multi-head causal self-attention; ``ring`` shards the sequence."""
+    """Multi-head causal self-attention.
+
+    ``attn_impl``: "dense" (full XLA attention), "ring" (sequence sharded
+    over ``seq_axis`` — ``ops/ring_attention.py``), or "flash" (the Pallas
+    kernel — ``ops/pallas/flash_attention.py``).
+    """
 
     n_heads: int
-    attn_impl: str = "dense"  # "dense" | "ring"
+    attn_impl: str = "dense"  # "dense" | "ring" | "flash"
     seq_axis: str = "seq"
     compute_dtype: Any = jnp.float32
 
@@ -71,6 +76,12 @@ class Attention(nn.Module):
             out = ring_self_attention(
                 q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
             )
+        elif self.attn_impl == "flash":
+            from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
+                flash_self_attention,
+            )
+
+            out = flash_self_attention(q, k, v)
         else:
             out = dense_self_attention(q, k, v, positions)
         return nn.DenseGeneral(
@@ -79,11 +90,16 @@ class Attention(nn.Module):
 
 
 class Block(nn.Module):
+    """Pre-LN transformer block.  ``mlp_factory`` swaps the feed-forward
+    sub-layer (e.g. for a routed MoE MLP — ``models/moe.py``) while the
+    residual/LN/attention wiring stays in one place."""
+
     n_heads: int
     d_ff: int
     attn_impl: str
     seq_axis: str
     compute_dtype: Any
+    mlp_factory: Any = None  # () -> nn.Module, or None for the dense MLP
 
     @nn.compact
     def __call__(self, x, positions):
@@ -96,6 +112,8 @@ class Block(nn.Module):
             name="attn",
         )(h, positions)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
+        if self.mlp_factory is not None:
+            return x + self.mlp_factory()(h)
         h = nn.Dense(self.d_ff, dtype=self.compute_dtype, name="fc_in")(h)
         h = nn.gelu(h)
         h = nn.Dense(x.shape[-1], dtype=self.compute_dtype, name="fc_out")(h)

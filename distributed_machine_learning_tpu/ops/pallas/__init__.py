@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the hot ops (flash attention).
+
+Kernels run compiled on TPU and in interpreter mode elsewhere (the CPU
+test mesh), so the same code path is exercised everywhere.
+"""

@@ -1,0 +1,170 @@
+"""Flash attention (causal) as a Pallas TPU kernel.
+
+The hot op of the transformer family, written for the hardware per the
+Pallas playbook (/opt/skills/guides/pallas_guide.md): the L×L score
+matrix never hits HBM — each grid step holds one Q block in VMEM, streams
+K/V blocks through the MXU, and maintains the online-softmax running
+(max, normalizer, accumulator) triple in fp32 registers.  Causal blocks
+entirely above the diagonal are skipped via the loop bound, so the kernel
+does ~half the FLOPs of dense attention.
+
+Differentiation: Pallas kernels are not auto-differentiable, so the op
+carries a ``jax.custom_vjp`` whose backward recomputes attention with the
+standard XLA einsum formulation (flash-style forward memory savings, dense
+backward — the usual first-rung trade; a full Pallas backward kernel is a
+later optimization).
+
+On non-TPU backends the kernel runs in interpreter mode, so tests on the
+CPU mesh exercise the identical code path the TPU compiles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports only resolve fully on TPU-capable installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, scale):
+    """One Q block vs all causally-visible K/V blocks, online softmax."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    D = q.shape[-1]
+    q_start = qi * block_q
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+
+    # K blocks at or below the diagonal: indices [0, num_k).
+    num_k = (q_start + block_q + block_k - 1) // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_start = kb * block_k
+        k = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, block_q: int, block_k: int):
+    """q/k/v: [BH, L, D] → [BH, L, D]."""
+    BH, L, D = q.shape
+    scale = 1.0 / (D**0.5)
+    grid = (BH, L // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k, scale=scale
+    )
+    if _HAS_PLTPU:
+        q_spec = pl.BlockSpec(
+            (1, block_q, D), lambda bh, qi: (bh, qi, 0),
+            memory_space=pltpu.VMEM,
+        )
+        kv_spec = pl.BlockSpec(
+            (1, L, D), lambda bh, qi: (bh, 0, 0), memory_space=pltpu.VMEM
+        )
+    else:  # pragma: no cover
+        q_spec = pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0))
+        kv_spec = pl.BlockSpec((1, L, D), lambda bh, qi: (bh, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+def _dense_bwd(q, k, v, g):
+    """Standard causal-softmax attention VJP in XLA ops ([BH, L, D])."""
+    BH, L, D = q.shape
+    scale = 1.0 / (D**0.5)
+    qf, kf, vf, gf = (a.astype(jnp.float32) for a in (q, k, v, g))
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    pos = jnp.arange(L)
+    causal = pos[:, None] >= pos[None, :]
+    s = jnp.where(causal[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _pick_block(L: int, target: int = 128) -> int:
+    for b in (target, 64, 32, 16, 8, 4, 2, 1):
+        if b <= L and L % b == 0:
+            return b
+    return 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _flash_core(q, k, v):
+    B, L, H, D = q.shape
+    blk = _pick_block(L)
+    fold = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    out = _flash_fwd(fold(q), fold(k), fold(v), blk, blk)
+    return out.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+
+def _flash_core_fwd(q, k, v):
+    return _flash_core(q, k, v), (q, k, v)
+
+
+def _flash_core_bwd(res, g):
+    q, k, v = res
+    B, L, H, D = q.shape
+    fold = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    dq, dk, dv = _dense_bwd(fold(q), fold(k), fold(v), fold(g))
+    unfold = lambda a: a.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_self_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Causal flash attention: [B, L, H, D] in and out.
+
+    Drop-in for ``ops.ring_attention.dense_self_attention`` on contiguous
+    (offset-0) sequences — the unsharded model path.
+    """
+    return _flash_core(q, k, v)

@@ -140,8 +140,7 @@ def _pipeline_forward_loss(
     # program size (and compile time) is independent of the microbatch
     # count — tick-dependent behavior (injection window, peel-off window)
     # is expressed as masks on the traced tick index.
-    def tick(carry, t):
-        act, loss_acc = carry
+    def tick_core(act, loss_acc, t):
         # Stage 0 ingests microbatch t (clamped index; masked elsewhere).
         inject = embed(
             lax.dynamic_index_in_dim(tokens_mb, jnp.clip(t, 0, M - 1), keepdims=False)
@@ -154,15 +153,20 @@ def _pipeline_forward_loss(
             targets_mb, jnp.clip(m, 0, M - 1), keepdims=False
         )
         valid = ((m >= 0) & (m < M)).astype(jnp.float32)
-        loss_acc = loss_acc + is_last * valid * head_loss(y, tgt)
-        act = lax.ppermute(y, pipe_axis, perm)
-        return (act, loss_acc), None
+        return y, loss_acc + is_last * valid * head_loss(y, tgt)
 
+    def tick(carry, t):
+        act, loss_acc = carry
+        y, loss_acc = tick_core(act, loss_acc, t)
+        return (lax.ppermute(y, pipe_axis, perm), loss_acc), None
+
+    T = M + num_stages - 1
     act = jnp.zeros((mb, L, E), model.compute_dtype)
     loss_acc = jnp.zeros((), jnp.float32)
-    (_, loss_acc), _ = lax.scan(
-        tick, (act, loss_acc), jnp.arange(M + num_stages - 1)
-    )
+    # Scan the first T−1 ticks; the final tick runs outside the scan so its
+    # output needs no (wasted) ppermute hop.
+    (act, loss_acc), _ = lax.scan(tick, (act, loss_acc), jnp.arange(T - 1))
+    _, loss_acc = tick_core(act, loss_acc, jnp.asarray(T - 1))
     # Local loss: non-zero on the last stage only.  The psum that shares it
     # with every stage happens OUTSIDE value_and_grad — a psum inside the
     # differentiated region would inflate cotangents by the axis size under
