@@ -78,6 +78,13 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
     parser.add_argument("--metrics-file", default=None, type=str,
                         help="write per-step metrics (step, loss, iteration "
                              "seconds) here; .csv for CSV, else JSONL")
+    parser.add_argument("--loader", default="auto",
+                        choices=["auto", "python", "native"],
+                        help="batch loader backend: 'native' is the C++ "
+                             "prefetching worker (native/dataloader.cc), "
+                             "'python' the pure-Python loader, 'auto' "
+                             "native-if-buildable (identical batch streams "
+                             "either way)")
     return parser
 
 
@@ -160,12 +167,36 @@ def run_part(
 
         if args.batch_size is not None:
             per_rank_batch = args.batch_size
+
+        loader_cls, dist_loader_cls = BatchLoader, DistributedBatchLoader
+        loader_choice = getattr(args, "loader", "auto")
+        if loader_choice in ("auto", "native"):
+            from distributed_machine_learning_tpu.data.native_loader import (
+                NativeBatchLoader,
+                NativeDistributedBatchLoader,
+                native_available,
+                native_unavailable_reason,
+            )
+
+            if native_available():
+                loader_cls, dist_loader_cls = (
+                    NativeBatchLoader,
+                    NativeDistributedBatchLoader,
+                )
+            elif loader_choice == "native":
+                raise RuntimeError(native_unavailable_reason())
+            else:
+                rank0_print(
+                    f"native loader unavailable, using python loader "
+                    f"({native_unavailable_reason()})"
+                )
+
         place = (lambda i, l: shard_batch(mesh, i, l)) if mesh is not None else None
         for _ in range(args.epochs):
             if distributed:
-                batches = DistributedBatchLoader(train_set, per_rank_batch, world)
+                batches = dist_loader_cls(train_set, per_rank_batch, world)
             else:
-                batches = BatchLoader(train_set, per_rank_batch)
+                batches = loader_cls(train_set, per_rank_batch)
             with trace(args.trace_dir):
                 state, _ = train_epoch(
                     train_step, state, batches, place_batch=place,

@@ -2,17 +2,18 @@
 
 The hot op of the transformer family, written for the hardware per the
 Pallas playbook (/opt/skills/guides/pallas_guide.md): the L×L score
-matrix never hits HBM — each grid step holds one Q block in VMEM, streams
-K/V blocks through the MXU, and maintains the online-softmax running
-(max, normalizer, accumulator) triple in fp32 registers.  Causal blocks
-entirely above the diagonal are skipped via the loop bound, so the kernel
-does ~half the FLOPs of dense attention.
+matrix never hits HBM, and on-chip memory is O(block), not O(L) — the
+grid is (batch·heads, Q blocks, K blocks) with the K dimension innermost,
+so Pallas streams one [block_k, D] K/V tile into VMEM per step while the
+online-softmax running (max, normalizer, accumulator) triple persists in
+VMEM scratch across the K steps of each Q block.  Blocks entirely above
+the causal diagonal skip their compute via ``pl.when``.
 
 Differentiation: Pallas kernels are not auto-differentiable, so the op
-carries a ``jax.custom_vjp`` whose backward recomputes attention with the
-standard XLA einsum formulation (flash-style forward memory savings, dense
-backward — the usual first-rung trade; a full Pallas backward kernel is a
-later optimization).
+carries a ``jax.custom_vjp`` whose backward is ``jax.vjp`` of the XLA
+dense reference (``ops.ring_attention.dense_self_attention``) — one
+source of truth for the semantics, flash-style memory only on the
+forward (a full Pallas backward kernel is a later optimization).
 
 On non-TPU backends the kernel runs in interpreter mode, so tests on the
 CPU mesh exercise the identical code path the TPU compiles.
@@ -33,100 +34,100 @@ try:  # pltpu imports only resolve fully on TPU-capable installs
 except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
+from distributed_machine_learning_tpu.ops.ring_attention import (
+    dense_self_attention,
+)
+
 NEG_INF = -1e30
+_LANES = 128  # VMEM lane width: m/l scratch is (block_q, _LANES)
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, scale):
-    """One Q block vs all causally-visible K/V blocks, online softmax."""
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, block_q, block_k, scale
+):
+    """One (Q block, K block) tile of the online-softmax recurrence."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
-    D = q.shape[-1]
+    kb = pl.program_id(2)
     q_start = qi * block_q
-    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_start = kb * block_k
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # K blocks at or below the diagonal: indices [0, num_k).
-    num_k = (q_start + block_q + block_k - 1) // block_k
-
-    def body(kb, carry):
-        m, l, acc = carry
-        k_start = kb * block_k
-        k = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+    # Skip blocks entirely above the causal diagonal.
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [block_q, block_k]
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
         k_pos = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m = m_ref[:, 0]  # [block_q]
+        l = l_ref[:, 0]
         m_new = jnp.maximum(m, s.max(axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
         p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
         l_new = l * alpha + p.sum(axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
-    o_ref[0] = out.astype(o_ref.dtype)
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
 
 
 def _flash_fwd(q, k, v, block_q: int, block_k: int):
     """q/k/v: [BH, L, D] → [BH, L, D]."""
     BH, L, D = q.shape
     scale = 1.0 / (D**0.5)
-    grid = (BH, L // block_q)
+    grid = (BH, L // block_q, L // block_k)
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k, scale=scale
     )
-    if _HAS_PLTPU:
-        q_spec = pl.BlockSpec(
-            (1, block_q, D), lambda bh, qi: (bh, qi, 0),
-            memory_space=pltpu.VMEM,
-        )
-        kv_spec = pl.BlockSpec(
-            (1, L, D), lambda bh, qi: (bh, 0, 0), memory_space=pltpu.VMEM
-        )
-    else:  # pragma: no cover
-        q_spec = pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0))
-        kv_spec = pl.BlockSpec((1, L, D), lambda bh, qi: (bh, 0, 0))
+    if not _HAS_PLTPU:  # pragma: no cover — pltpu ships with jax[tpu]/cpu alike
+        raise RuntimeError("pallas TPU support (jax.experimental.pallas.tpu) "
+                           "is unavailable; use attn_impl='dense'")
+    q_spec = pl.BlockSpec(
+        (1, block_q, D), lambda bh, qi, kb: (bh, qi, 0), memory_space=pltpu.VMEM
+    )
+    k_spec = pl.BlockSpec(
+        (1, block_k, D), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM
+    )
+    scratch = [
+        pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+        pltpu.VMEM((block_q, _LANES), jnp.float32),  # running normalizer
+        pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
+    ]
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
         grid=grid,
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=[q_spec, k_spec, k_spec],
         out_specs=q_spec,
+        scratch_shapes=scratch,
         interpret=_interpret(),
     )(q, k, v)
-
-
-def _dense_bwd(q, k, v, g):
-    """Standard causal-softmax attention VJP in XLA ops ([BH, L, D])."""
-    BH, L, D = q.shape
-    scale = 1.0 / (D**0.5)
-    qf, kf, vf, gf = (a.astype(jnp.float32) for a in (q, k, v, g))
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
-    pos = jnp.arange(L)
-    causal = pos[:, None] >= pos[None, :]
-    s = jnp.where(causal[None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
-    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
-    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def _pick_block(L: int, target: int = 128) -> int:
@@ -136,7 +137,7 @@ def _pick_block(L: int, target: int = 128) -> int:
     return 1
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=())
+@jax.custom_vjp
 def _flash_core(q, k, v):
     B, L, H, D = q.shape
     blk = _pick_block(L)
@@ -150,12 +151,11 @@ def _flash_core_fwd(q, k, v):
 
 
 def _flash_core_bwd(res, g):
+    # Backward = VJP of the dense XLA reference: one source of truth for
+    # the attention semantics (ops/ring_attention.py).
     q, k, v = res
-    B, L, H, D = q.shape
-    fold = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, L, D)
-    dq, dk, dv = _dense_bwd(fold(q), fold(k), fold(v), fold(g))
-    unfold = lambda a: a.reshape(B, H, L, D).transpose(0, 2, 1, 3)
-    return unfold(dq), unfold(dk), unfold(dv)
+    _, vjp = jax.vjp(dense_self_attention, q, k, v)
+    return vjp(g)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
