@@ -1,0 +1,57 @@
+"""Ulysses sequence parallelism: all-to-all head-sharded attention.
+
+The second context-parallel scheme (DeepSpeed-Ulysses pattern), next to
+the ppermute ring of ``ops/ring_attention.py``: instead of rotating K/V
+blocks, one ``lax.all_to_all`` re-shards activations from
+sequence-sharded [B, L/n, H, D] to head-sharded [B, L, H/n, D], every
+device runs ordinary dense causal attention over the *full* sequence for
+its slice of heads, and a second all-to-all restores sequence sharding.
+
+Trade-offs vs the ring (why both exist): Ulysses does 2 all-to-alls of
+activation size regardless of n (cheaper than the ring's n−1 rotations
+when heads are plentiful and ICI all-to-all bandwidth is good), but
+requires ``n_heads % n == 0`` and holds full-L scores per head slice;
+the ring has no head constraint and O(L·L/n) score memory.  Both are
+exact.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from distributed_machine_learning_tpu.ops.ring_attention import (
+    dense_self_attention,
+)
+
+
+def ulysses_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    axis_size: int,
+) -> jax.Array:
+    """Exact causal attention over sequence chunks sharded on ``axis_name``.
+
+    Must run inside ``shard_map``.  ``q``/``k``/``v``: local chunks
+    [B, L/n, H, D] in mesh-axis order; returns the local output chunk.
+    """
+    n = axis_size
+    if n == 1:
+        return dense_self_attention(q, k, v)
+    H = q.shape[2]
+    if H % n:
+        raise ValueError(
+            f"Ulysses needs n_heads divisible by the sequence-axis size: "
+            f"{H} heads over {n} devices (use the ring instead)"
+        )
+    # seq-sharded → head-sharded: each device keeps heads [r·H/n,(r+1)·H/n)
+    # for the FULL sequence (all_to_all concatenates chunks in axis order,
+    # so global sequence order is preserved).
+    to_heads = lambda x: lax.all_to_all(
+        x, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    out = dense_self_attention(to_heads(q), to_heads(k), to_heads(v))
+    # head-sharded → seq-sharded.
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
