@@ -8,6 +8,13 @@ from distributed_machine_learning_tpu.parallel.strategies import (
     STRATEGIES,
 )
 
+from distributed_machine_learning_tpu.parallel.fsdp import (
+    FSDPState,
+    make_fsdp_train_step,
+    shard_fsdp_state,
+    gather_fsdp_params,
+)
+
 __all__ = [
     "SyncStrategy",
     "NoSync",
@@ -16,4 +23,8 @@ __all__ = [
     "RingAllReduce",
     "get_strategy",
     "STRATEGIES",
+    "FSDPState",
+    "make_fsdp_train_step",
+    "shard_fsdp_state",
+    "gather_fsdp_params",
 ]

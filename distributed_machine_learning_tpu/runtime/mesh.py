@@ -16,7 +16,25 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+try:  # jax>=0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
 BATCH_AXIS = "batch"
+
+
+def shard_map_no_check(f, *, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across the API rename
+    (new jax: check_vma; the experimental API this falls back to: check_rep)."""
+    try:
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:  # pragma: no cover
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
 
 
 def make_mesh(

@@ -1,0 +1,55 @@
+"""Shared pieces of the train-step implementations.
+
+Both the replicated-DP step (``train/step.py``) and the ZeRO-3/FSDP step
+(``parallel/fsdp.py``) need the same forward/loss/mutable-BatchNorm
+plumbing and the same per-step, per-mesh-position RNG keying — factored
+here (dependency-free of ``parallel/``) so the two cannot drift apart and
+break the FSDP-vs-replicated-DP equivalence the tests assert.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax import lax
+
+from distributed_machine_learning_tpu.train.losses import cross_entropy_loss
+
+
+def step_rng(rng, step_ctr, axis_name: str | None):
+    """Per-step augmentation key; folds in the mesh position so each data
+    shard draws independent crops/flips the way each reference node draws
+    from its own torch RNG (``part2/2a/main.py:199``)."""
+    r = jax.random.fold_in(rng, step_ctr)
+    if axis_name is not None:
+        r = jax.random.fold_in(r, lax.axis_index(axis_name))
+    return r
+
+
+def make_loss_fn(model, batch_stats, x, labels, train: bool):
+    """Build ``loss_fn(params) -> (loss, (logits, new_batch_stats))``.
+
+    Handles the three BatchNorm cases: BN model in train mode (mutable
+    running stats), BN model in eval mode, BN-free model (empty stats).
+    """
+
+    def run(params):
+        variables: dict[str, Any] = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+            if train:
+                logits, mutated = model.apply(
+                    variables, x, train=True, mutable=["batch_stats"]
+                )
+                return logits, mutated["batch_stats"]
+            logits = model.apply(variables, x, train=False)
+            return logits, batch_stats
+        logits = model.apply(variables, x, train=train)
+        return logits, {}
+
+    def loss_fn(params):
+        logits, new_stats = run(params)
+        return cross_entropy_loss(logits, labels), (logits, new_stats)
+
+    return loss_fn

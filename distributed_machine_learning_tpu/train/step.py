@@ -25,54 +25,16 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax>=0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map_impl
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map_impl
-
-
-def _shard_map(f, *, mesh, in_specs, out_specs):
-    """shard_map with replication checking off, across the API rename
-    (new jax: check_vma; the experimental API this falls back to: check_rep)."""
-    try:
-        return _shard_map_impl(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
-        )
-    except TypeError:  # pragma: no cover
-        return _shard_map_impl(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
-        )
-
 from distributed_machine_learning_tpu.data.augment import augment_batch, normalize
 from distributed_machine_learning_tpu.parallel.strategies import NoSync, SyncStrategy
-from distributed_machine_learning_tpu.runtime.mesh import BATCH_AXIS
+from distributed_machine_learning_tpu.runtime.mesh import (
+    BATCH_AXIS,
+    shard_map_no_check as _shard_map,
+)
+from distributed_machine_learning_tpu.train.common import make_loss_fn, step_rng
 from distributed_machine_learning_tpu.train.losses import cross_entropy_loss, count_correct
 from distributed_machine_learning_tpu.train.sgd import sgd_update
 from distributed_machine_learning_tpu.train.state import TrainState
-
-
-def _apply_model(model, state: TrainState, x, labels, train: bool):
-    """Forward + loss; returns (loss, (logits, new_batch_stats))."""
-
-    def run(params):
-        variables: dict[str, Any] = {"params": params}
-        if state.batch_stats:
-            variables["batch_stats"] = state.batch_stats
-            if train:
-                logits, mutated = model.apply(
-                    variables, x, train=True, mutable=["batch_stats"]
-                )
-                return logits, mutated["batch_stats"]
-            logits = model.apply(variables, x, train=False)
-            return logits, state.batch_stats
-        logits = model.apply(variables, x, train=train)
-        return logits, {}
-
-    def loss_fn(params):
-        logits, new_stats = run(params)
-        return cross_entropy_loss(logits, labels), (logits, new_stats)
-
-    return loss_fn
 
 
 def _train_step_impl(
@@ -87,14 +49,10 @@ def _train_step_impl(
     augment: bool,
     sync_bn: bool,
 ):
-    step_rng = jax.random.fold_in(state.rng, state.step)
-    if axis_name is not None:
-        # Independent augmentation stream per mesh position (each reference
-        # node has its own torch RNG — part2/2a/main.py:199).
-        step_rng = jax.random.fold_in(step_rng, lax.axis_index(axis_name))
-    x = augment_batch(step_rng, images_u8) if augment else normalize(images_u8)
+    rng = step_rng(state.rng, state.step, axis_name)
+    x = augment_batch(rng, images_u8) if augment else normalize(images_u8)
 
-    loss_fn = _apply_model(model, state, x, labels, train=True)
+    loss_fn = make_loss_fn(model, state.batch_stats, x, labels, train=True)
     (loss, (_, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
         state.params
     )
