@@ -1,4 +1,5 @@
-"""Decoder-only transformer LM with pluggable dense / ring / flash attention.
+"""Decoder-only transformer LM with pluggable dense / ring / ulysses /
+flash attention.
 
 A model family beyond the reference's capability surface (its only model is
 a 32×32 CNN — ``part1/model.py``; SURVEY.md §2.3 records TP/SP/CP as
@@ -49,12 +50,13 @@ class Attention(nn.Module):
     """Multi-head causal self-attention.
 
     ``attn_impl``: "dense" (full XLA attention), "ring" (sequence sharded
-    over ``seq_axis`` — ``ops/ring_attention.py``), or "flash" (the Pallas
-    kernel — ``ops/pallas/flash_attention.py``).
+    over ``seq_axis`` — ``ops/ring_attention.py``), "ulysses" (sequence
+    sharded via all-to-all head re-sharding — ``ops/ulysses.py``), or
+    "flash" (the Pallas kernel — ``ops/pallas/flash_attention.py``).
     """
 
     n_heads: int
-    attn_impl: str = "dense"  # "dense" | "ring" | "flash"
+    attn_impl: str = "dense"  # "dense" | "ring" | "ulysses" | "flash"
     seq_axis: str = "seq"
     compute_dtype: Any = jnp.float32
 
@@ -74,6 +76,14 @@ class Attention(nn.Module):
         k = apply_rope(k, positions)
         if self.attn_impl == "ring":
             out = ring_self_attention(
+                q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
+            )
+        elif self.attn_impl == "ulysses":
+            from distributed_machine_learning_tpu.ops.ulysses import (
+                ulysses_self_attention,
+            )
+
+            out = ulysses_self_attention(
                 q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
             )
         elif self.attn_impl == "flash":
@@ -123,10 +133,11 @@ class Block(nn.Module):
 class TransformerLM(nn.Module):
     """Causal LM: tokens [B, L(local)] → logits [B, L(local), vocab].
 
-    With ``attn_impl="ring"`` the module must run inside ``shard_map`` with
-    ``seq_axis`` bound; it derives its global position offset from
-    ``lax.axis_index`` so sequence-sharded and unsharded runs produce
-    identical logits.
+    With ``attn_impl="ring"`` or ``"ulysses"`` (the two sequence-sharded
+    context-parallel schemes — ppermute K/V rotation vs all-to-all head
+    re-sharding) the module must run inside ``shard_map`` with ``seq_axis``
+    bound; it derives its global position offset from ``lax.axis_index`` so
+    sequence-sharded and unsharded runs produce identical logits.
     """
 
     vocab_size: int
@@ -142,7 +153,7 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, *, train: bool = False):
         del train  # no dropout/BN — kept for the shared train-step interface
         B, L = tokens.shape
-        if self.attn_impl == "ring":
+        if self.attn_impl in ("ring", "ulysses"):
             offset = lax.axis_index(self.seq_axis) * L
         else:
             offset = 0

@@ -29,6 +29,8 @@ N covers the whole model.
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -42,7 +44,7 @@ from distributed_machine_learning_tpu.runtime.mesh import (
     shard_map_no_check as _shard_map,
 )
 from distributed_machine_learning_tpu.train.common import make_loss_fn, step_rng
-from distributed_machine_learning_tpu.train.sgd import SGDConfig
+from distributed_machine_learning_tpu.train.sgd import SGDConfig, sgd_update
 from distributed_machine_learning_tpu.train.state import TrainState
 
 
@@ -125,58 +127,59 @@ def make_fsdp_train_step(
     """
     n = mesh.shape[axis_name]
 
-    def impl(param_shards, momentum_shards, batch_stats, step_ctr, rng,
-             lr, mom, wd, images_u8, labels):
-        # (1) All-gather the full flat parameter vector from the shards.
-        full_flat = lax.all_gather(param_shards, axis_name, tiled=True)
-        params = unravel(full_flat[:n_elems])
+    @lru_cache(maxsize=None)
+    def sharded_for(cfg: SGDConfig):
+        # cfg is static (FSDPState.config is not a pytree node), so it binds
+        # at trace time via this cache instead of threading lr/mom/wd
+        # through the shard_map as runtime scalars.
+        def impl(param_shards, momentum_shards, batch_stats, step_ctr, rng,
+                 images_u8, labels):
+            # (1) All-gather the full flat parameter vector from the shards.
+            full_flat = lax.all_gather(param_shards, axis_name, tiled=True)
+            params = unravel(full_flat[:n_elems])
 
-        r = step_rng(rng, step_ctr, axis_name)
-        x = augment_batch(r, images_u8) if augment else normalize(images_u8)
+            r = step_rng(rng, step_ctr, axis_name)
+            x = augment_batch(r, images_u8) if augment else normalize(images_u8)
 
-        loss_fn = make_loss_fn(model, batch_stats, x, labels, train=True)
-        (loss, (_, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params
+            loss_fn = make_loss_fn(model, batch_stats, x, labels, train=True)
+            (loss, (_, new_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+
+            # (3) Reduce-scatter: each device receives the mean-reduced slice
+            # it owns — half the ring, half the bytes of a full all-reduce.
+            flat_grads, _ = ravel_pytree(grads)
+            flat_grads = jnp.pad(flat_grads, (0, full_flat.shape[0] - n_elems))
+            grad_shard = lax.psum_scatter(flat_grads, axis_name, tiled=True) / n
+
+            # (4) SGD/momentum on the local shard only (shared torch update
+            # rule — train/sgd.py works on bare arrays): weight decay reads
+            # the local *param* shard, so no second all-gather is needed.
+            new_params, new_mom = sgd_update(
+                param_shards, momentum_shards, grad_shard, cfg
+            )
+
+            if new_stats:
+                new_stats = jax.tree_util.tree_map(
+                    lambda s: lax.pmean(s, axis_name), new_stats
+                )
+            return new_params, new_mom, new_stats, lax.pmean(loss, axis_name)
+
+        shard = P(axis_name)
+        return _shard_map(
+            impl,
+            mesh=mesh,
+            in_specs=(shard, shard, P(), P(), P(), shard, shard),
+            out_specs=(shard, shard, P(), P()),
         )
 
-        # (3) Reduce-scatter: each device receives the mean-reduced slice
-        # it owns — half the ring, half the bytes of a full all-reduce.
-        flat_grads, _ = ravel_pytree(grads)
-        flat_grads = jnp.pad(flat_grads, (0, full_flat.shape[0] - n_elems))
-        grad_shard = lax.psum_scatter(flat_grads, axis_name, tiled=True) / n
-
-        # (4) SGD/momentum on the local shard only (torch update rule —
-        # train/sgd.py): weight decay reads the local *param* shard, so no
-        # second all-gather is needed.
-        g = grad_shard + wd * param_shards
-        new_mom = mom * momentum_shards + g
-        new_params = param_shards - lr * new_mom
-
-        if new_stats:
-            new_stats = jax.tree_util.tree_map(
-                lambda s: lax.pmean(s, axis_name), new_stats
-            )
-        return new_params, new_mom, new_stats, lax.pmean(loss, axis_name)
-
-    shard = P(axis_name)
-    sharded = _shard_map(
-        impl,
-        mesh=mesh,
-        in_specs=(shard, shard, P(), P(), P(), P(), P(), P(), shard, shard),
-        out_specs=(shard, shard, P(), P()),
-    )
-
     def step(state: FSDPState, images_u8, labels):
-        cfg = state.config
-        new_params, new_mom, new_stats, loss = sharded(
+        new_params, new_mom, new_stats, loss = sharded_for(state.config)(
             state.param_shards,
             state.momentum_shards,
             state.batch_stats,
             state.step,
             state.rng,
-            jnp.float32(cfg.learning_rate),
-            jnp.float32(cfg.momentum),
-            jnp.float32(cfg.weight_decay),
             images_u8,
             labels,
         )

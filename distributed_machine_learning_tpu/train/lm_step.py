@@ -75,18 +75,13 @@ def make_lm_train_step(
             "to disable one dimension)"
         )
     axis_names = (data_axis, seq_axis)
-    if model.attn_impl == "ring" and seq_axis not in mesh.axis_names:
-        raise ValueError(
-            f"ring-attention model needs mesh axis {seq_axis!r}; "
-            f"mesh has {mesh.axis_names}"
-        )
-    if model.attn_impl != "ring" and mesh.shape[seq_axis] > 1:
+    if model.attn_impl not in ("ring", "ulysses") and mesh.shape[seq_axis] > 1:
         # Dense attention only sees its local chunk with offset-0 positions:
         # sharding the sequence under it would be silently wrong, not slow.
         raise ValueError(
             f"dense-attention model cannot shard the sequence: mesh axis "
             f"{seq_axis!r} has size {mesh.shape[seq_axis]} > 1; use "
-            'attn_impl="ring" or an axis_shape with seq size 1'
+            'attn_impl="ring"/"ulysses" or an axis_shape with seq size 1'
         )
     impl = partial(_lm_step_impl, model, axis_names=axis_names)
     batch_spec = P(data_axis, seq_axis)
