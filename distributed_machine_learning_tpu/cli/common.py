@@ -85,7 +85,49 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                              "'python' the pure-Python loader, 'auto' "
                              "native-if-buildable (identical batch streams "
                              "either way)")
+    parser.add_argument("--lr-schedule", dest="lr_schedule", default="constant",
+                        choices=["constant", "cosine", "step"],
+                        help="learning-rate schedule (train/schedule.py); "
+                             "'constant' reproduces the reference's fixed "
+                             "lr=0.1, 'cosine' adds linear warmup + cosine "
+                             "decay over the run, 'step' decays 10x at 50%% "
+                             "and 75%% of the run")
+    parser.add_argument("--warmup-steps", dest="warmup_steps", default=0,
+                        type=int, help="warmup steps for --lr-schedule=cosine")
+    parser.add_argument("--clip-norm", dest="clip_norm", default=None,
+                        type=float,
+                        help="clip the (synced) gradient to this global L2 "
+                             "norm before the update (off by default)")
     return parser
+
+
+def make_schedule(args, learning_rate: float, start_step: int = 0):
+    """Build the ``step -> lr`` schedule the flags describe (None for the
+    reference's fixed rate).
+
+    ``start_step``: the state's step counter at run start (non-zero after
+    ``--resume``).  The horizon covers *this run's* ``max_iters × epochs``
+    from there — otherwise a resumed cosine run would start past its own
+    total_steps and train at end_lr (zero) throughout.
+    """
+    from distributed_machine_learning_tpu.train.schedule import (
+        step_decay,
+        warmup_cosine,
+    )
+
+    total = max(args.max_iters * args.epochs, 1)
+    if args.lr_schedule == "cosine":
+        # parse_flags guarantees 0 <= warmup_steps < total.
+        base = warmup_cosine(learning_rate, args.warmup_steps, total)
+    elif args.lr_schedule == "step":
+        base = step_decay(
+            learning_rate, boundaries=(total // 2, (3 * total) // 4)
+        )
+    else:
+        return None
+    if start_step:
+        return lambda step: base(step - start_step)
+    return base
 
 
 def parse_flags(parser: argparse.ArgumentParser, argv=None) -> argparse.Namespace:
@@ -94,6 +136,18 @@ def parse_flags(parser: argparse.ArgumentParser, argv=None) -> argparse.Namespac
     args = parser.parse_args(argv)
     if args.resume and not args.ckpt_dir:
         parser.error("--resume requires --ckpt-dir")
+    if args.clip_norm is not None and args.clip_norm <= 0:
+        parser.error(f"--clip-norm must be positive, got {args.clip_norm}")
+    if args.warmup_steps < 0:
+        parser.error(f"--warmup-steps must be >= 0, got {args.warmup_steps}")
+    if args.lr_schedule == "cosine":
+        total = args.max_iters * args.epochs
+        if args.warmup_steps >= total:
+            parser.error(
+                f"--warmup-steps {args.warmup_steps} must be shorter than "
+                f"the run (max_iters × epochs = {total} steps): the rate "
+                "would never reach its peak"
+            )
     return args
 
 
@@ -156,7 +210,14 @@ def run_part(
                 rank0_print(f"Resumed from {latest} (step "
                             f"{int(jax.device_get(state.step))})")
         strategy = get_strategy(strategy_name, **(strategy_kwargs or {}))
-        train_step = make_train_step(model, strategy, mesh=mesh)
+        train_step = make_train_step(
+            model, strategy, mesh=mesh,
+            schedule=make_schedule(
+                args, state.config.learning_rate,
+                start_step=int(jax.device_get(state.step)),
+            ),
+            clip_norm=args.clip_norm,
+        )
         eval_step = make_eval_step(model)
 
         train_set = load_cifar10(args.data_root, train=True)

@@ -15,7 +15,18 @@ from distributed_machine_learning_tpu.parallel.fsdp import (
     gather_fsdp_params,
 )
 
+from distributed_machine_learning_tpu.parallel.parallel3d import (
+    make_3d_mesh,
+    make_3d_lm_train_step,
+    shard_3d_state,
+    shard_3d_batch,
+)
+
 __all__ = [
+    "make_3d_mesh",
+    "make_3d_lm_train_step",
+    "shard_3d_state",
+    "shard_3d_batch",
     "SyncStrategy",
     "NoSync",
     "AllReduce",

@@ -29,8 +29,6 @@ N covers the whole model.
 
 from __future__ import annotations
 
-from functools import lru_cache
-
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -127,11 +125,10 @@ def make_fsdp_train_step(
     """
     n = mesh.shape[axis_name]
 
-    @lru_cache(maxsize=None)
     def sharded_for(cfg: SGDConfig):
-        # cfg is static (FSDPState.config is not a pytree node), so it binds
-        # at trace time via this cache instead of threading lr/mom/wd
-        # through the shard_map as runtime scalars.
+        # cfg is static (FSDPState.config is not a pytree node), so the
+        # enclosing jit keys its trace cache on it and this builder runs
+        # once per config — no memoization needed here.
         def impl(param_shards, momentum_shards, batch_stats, step_ctr, rng,
                  images_u8, labels):
             # (1) All-gather the full flat parameter vector from the shards.

@@ -1,0 +1,210 @@
+"""Composed 3-D parallelism: data × pipeline × tensor on one mesh.
+
+The reference composes nothing — its one strategy axis is data-parallel
+gradient sync (SURVEY.md §2.3).  This module runs all three major
+parallelism dimensions simultaneously over a ``("batch", "pipe",
+"model")`` mesh, the way a real TPU pod is carved up:
+
+- **pipe** (pipeline): *manual* — the GPipe-style ppermute tick loop of
+  ``parallel/pipeline.py``, reused verbatim: transformer blocks stacked
+  on a leading layer axis and sharded over the pipe axis, activations
+  rotating one hop per tick.
+- **model** (tensor): *automatic* — block params additionally carry the
+  Megatron column/row splits of ``parallel/tensor_parallel.py`` on their
+  trailing dims; XLA's SPMD partitioner derives every activation sharding
+  and inserts the per-block all-reduces.
+- **batch** (data): *automatic* — each microbatch's batch dim is sharded
+  over the data axis; the partitioner emits the gradient all-reduce.
+
+The composition mechanism is partial-manual ``shard_map`` (jax's
+``axis_names``): only ``pipe`` is manual inside the body — giving us
+``lax.axis_index``/``ppermute`` for the schedule — while ``batch`` and
+``model`` stay under GSPMD propagation, seeded by the state's
+``NamedSharding``s at the jit boundary.  One compiled program carries the
+pipeline collectives, the Megatron all-reduces, and the data-parallel
+gradient reduction, and XLA is free to overlap all three.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu.models.transformer import TransformerLM
+from distributed_machine_learning_tpu.parallel.pipeline import (
+    _pp_step_impl,
+    _state_specs,
+    init_pipeline_state,
+    microbatch,
+)
+from distributed_machine_learning_tpu.parallel.tensor_parallel import tp_spec_for
+from distributed_machine_learning_tpu.runtime.mesh import (
+    make_mesh,
+    shard_map_no_check as _shard_map,
+)
+from distributed_machine_learning_tpu.train.state import TrainState
+
+DATA_AXIS = "batch"
+PIPE_AXIS = "pipe"
+MODEL_AXIS = "model"
+MESH_AXES = (DATA_AXIS, PIPE_AXIS, MODEL_AXIS)
+
+__all__ = [
+    "MESH_AXES",
+    "make_3d_mesh",
+    "p3_param_spec",
+    "shard_3d_state",
+    "make_3d_lm_train_step",
+    "shard_3d_batch",
+    "init_pipeline_state",
+    "microbatch",
+]
+
+
+def make_3d_mesh(dp: int, pp: int, tp: int, devices=None) -> Mesh:
+    """(dp, pp, tp)-shaped mesh over dp·pp·tp devices.
+
+    Axis order puts ``model`` innermost (fastest-varying chips): on real
+    hardware the Megatron all-reduces are the latency-critical
+    collectives, so they get the shortest ICI hops; the per-tick pipe
+    hop is next; the once-per-step data-parallel reduce rides whatever
+    is left (DCN across hosts).
+    """
+    return make_mesh(
+        dp * pp * tp, axis_names=MESH_AXES, axis_shape=(dp, pp, tp),
+        devices=devices,
+    )
+
+
+def p3_param_spec(
+    path: tuple[str, ...],
+    ndim: int,
+    pipe_axis: str = PIPE_AXIS,
+    model_axis: str = MODEL_AXIS,
+) -> P:
+    """PartitionSpec for one pipeline-layout parameter under 3-D layout.
+
+    ``blocks/...`` leaves have a leading stacked-layer dim sharded over
+    the pipe axis and Megatron splits (``tp_spec_for``) on the rest;
+    stage-boundary params (embed / ln_f / lm_head) replicate over pipe
+    and keep their plain TP spec — except the embedding table, which
+    replicates over model too: partitioning the token-gather's operand
+    dim trips an XLA SPMD-partitioner CHECK under partial-manual
+    shard_map (observed in XLA's PartitionGatherTrivialSlicedOperand-
+    Dimensions), and an O(V·E) table is small next to the block stack.
+    """
+    if path and path[0] == "blocks":
+        inner = tuple(tp_spec_for(path[1:], ndim - 1, model_axis))
+        return P(pipe_axis, *inner)
+    if path and path[0] == "embed":
+        return P(*(None,) * ndim)
+    return tp_spec_for(path, ndim, model_axis)
+
+
+def _state_shardings_3d(state: TrainState, mesh: Mesh) -> TrainState:
+    """NamedSharding pytree: params/momentum per ``p3_param_spec``,
+    scalar fields replicated."""
+
+    def spec(path, leaf):
+        keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
+        return NamedSharding(mesh, p3_param_spec(keys, leaf.ndim))
+
+    param_shardings = jax.tree_util.tree_map_with_path(spec, state.params)
+    replicated = NamedSharding(mesh, P())
+    return TrainState(
+        params=param_shardings,
+        momentum=param_shardings,
+        batch_stats=jax.tree_util.tree_map(lambda _: replicated, state.batch_stats),
+        step=replicated,
+        rng=replicated,
+        config=state.config,
+    )
+
+
+def shard_3d_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place a pipeline-layout TrainState (``init_pipeline_state``) into
+    the 3-D layout."""
+    return jax.tree_util.tree_map(
+        jax.device_put, state, _state_shardings_3d(state, mesh)
+    )
+
+
+def shard_3d_batch(mesh: Mesh, tokens_mb, targets_mb):
+    """[M, mb, L] microbatch stacks with the batch dim sharded over the
+    data axis (microbatch and sequence dims stay whole)."""
+    import jax.numpy as jnp
+
+    sharding = NamedSharding(mesh, P(None, DATA_AXIS, None))
+    return (
+        jax.device_put(jnp.asarray(tokens_mb), sharding),
+        jax.device_put(jnp.asarray(targets_mb), sharding),
+    )
+
+
+def make_3d_lm_train_step(
+    model: TransformerLM, mesh: Mesh, num_microbatches: int
+):
+    """Build ``step(state, tokens_mb, targets_mb) -> (state, loss)``.
+
+    ``state`` from ``init_pipeline_state`` + ``shard_3d_state``; inputs
+    from ``microbatch`` + ``shard_3d_batch``.  Requires ``n_layers``
+    divisible by the pipe-axis size and ``n_heads`` by the model-axis
+    size.  Reuses the pipeline step implementation unchanged — only the
+    shard_map becomes partial-manual and the jit shardings add the
+    batch/model dimensions.
+    """
+    if model.attn_impl != "dense":
+        raise ValueError("3-D step requires attn_impl='dense'")
+    missing = [a for a in MESH_AXES if a not in mesh.axis_names]
+    if missing:
+        raise ValueError(f"3-D mesh is missing axes {missing}: {mesh.axis_names}")
+    pp = mesh.shape[PIPE_AXIS]
+    tp = mesh.shape[MODEL_AXIS]
+    if model.n_layers % pp:
+        raise ValueError(
+            f"n_layers={model.n_layers} must divide into {pp} pipeline stages"
+        )
+    if model.n_heads % tp:
+        raise ValueError(
+            f"n_heads={model.n_heads} must be divisible by the model-axis "
+            f"size {tp}"
+        )
+    if num_microbatches < 1:
+        raise ValueError("num_microbatches must be >= 1")
+
+    impl = partial(_pp_step_impl, model, pipe_axis=PIPE_AXIS, num_stages=pp)
+    batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS, None))
+    jitted: dict = {}
+
+    def step(state: TrainState, tokens_mb, targets_mb):
+        if tokens_mb.shape[0] != num_microbatches:
+            raise ValueError(
+                f"expected {num_microbatches} microbatches, got input shaped "
+                f"{tokens_mb.shape}"
+            )
+        key = jax.tree_util.tree_structure(state)
+        fn = jitted.get(key)
+        if fn is None:
+            # in_specs constrain the MANUAL axis only (blocks stacked dim
+            # over pipe — pipeline.py's specs, reused); batch/model
+            # shardings enter through in_shardings and propagate via GSPMD.
+            pipe_spec = _state_specs(PIPE_AXIS, state.params)
+            pipe_spec = pipe_spec.replace(config=state.config)
+            shardings = _state_shardings_3d(state, mesh)
+            fn = jitted[key] = jax.jit(
+                _shard_map(
+                    impl,
+                    mesh=mesh,
+                    in_specs=(pipe_spec, P(), P()),
+                    out_specs=(pipe_spec, P()),
+                    manual_axes={PIPE_AXIS},
+                ),
+                in_shardings=(shardings, batch_sharding, batch_sharding),
+                out_shardings=(shardings, NamedSharding(mesh, P())),
+                donate_argnums=(0,),
+            )
+        return fn(state, tokens_mb, targets_mb)
+
+    return step

@@ -37,7 +37,9 @@ from distributed_machine_learning_tpu.models.transformer import Block, Transform
 from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
 from distributed_machine_learning_tpu.train.sgd import sgd_update
 from distributed_machine_learning_tpu.train.state import TrainState
-from distributed_machine_learning_tpu.train.step import _shard_map
+from distributed_machine_learning_tpu.runtime.mesh import (
+    shard_map_no_check as _shard_map,
+)
 
 PIPE_AXIS = "pipe"
 

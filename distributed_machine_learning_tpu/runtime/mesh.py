@@ -24,14 +24,25 @@ except ImportError:  # pragma: no cover
 BATCH_AXIS = "batch"
 
 
-def shard_map_no_check(f, *, mesh, in_specs, out_specs):
+def shard_map_no_check(f, *, mesh, in_specs, out_specs, manual_axes=None):
     """shard_map with replication checking off, across the API rename
-    (new jax: check_vma; the experimental API this falls back to: check_rep)."""
+    (new jax: check_vma; the experimental API this falls back to: check_rep).
+
+    ``manual_axes``: restrict manual sharding to a subset of mesh axes
+    (jax's ``axis_names``); the rest stay under automatic GSPMD
+    propagation — how the 3-D step composes a manual ppermute pipeline
+    with compiler-derived tensor/data parallelism
+    (``parallel/parallel3d.py``).  None (default) = fully manual.
+    """
+    kwargs = {} if manual_axes is None else {"axis_names": frozenset(manual_axes)}
     try:
         return _shard_map_impl(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False, **kwargs,
         )
     except TypeError:  # pragma: no cover
+        if manual_axes is not None:
+            raise
         return _shard_map_impl(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
         )

@@ -25,7 +25,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
 from distributed_machine_learning_tpu.train.sgd import sgd_update
 from distributed_machine_learning_tpu.train.state import TrainState
-from distributed_machine_learning_tpu.train.step import _shard_map
+from distributed_machine_learning_tpu.runtime.mesh import (
+    shard_map_no_check as _shard_map,
+)
 
 DATA_AXIS = "batch"
 SEQ_AXIS = "seq"
@@ -75,6 +77,13 @@ def make_lm_train_step(
             "to disable one dimension)"
         )
     axis_names = (data_axis, seq_axis)
+    if model.attn_impl == "ulysses" and model.n_heads % mesh.shape[seq_axis]:
+        # Fail at build time, not first-step trace time (ops/ulysses.py
+        # would raise the same constraint inside shard_map tracing).
+        raise ValueError(
+            f"Ulysses needs n_heads divisible by the sequence-axis size: "
+            f"{model.n_heads} heads over {mesh.shape[seq_axis]} devices"
+        )
     if model.attn_impl not in ("ring", "ulysses") and mesh.shape[seq_axis] > 1:
         # Dense attention only sees its local chunk with offset-0 positions:
         # sharding the sequence under it would be silently wrong, not slow.

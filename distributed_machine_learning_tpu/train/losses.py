@@ -13,10 +13,20 @@ import jax.numpy as jnp
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Mean softmax cross-entropy over all leading axes (CrossEntropyLoss
-    parity; handles [B, C] classification and [B, L, C] token logits)."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)
-    return nll.mean()
+    parity; handles [B, C] classification and [B, L, C] token logits).
+
+    The target logit is selected by a one-hot contraction rather than
+    ``take_along_axis``: on TPU a masked reduction vectorizes where a
+    gather serializes, and when the class dim is tensor-parallel-sharded
+    (column-split lm_head — ``parallel/parallel3d.py``) the reduction
+    partitions cleanly while a class-dim gather trips XLA's SPMD gather
+    partitioner.
+    """
+    logits32 = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits32, axis=-1)
+    one_hot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    target_logit = jnp.sum(logits32 * one_hot, axis=-1)
+    return (lse - target_logit).mean()
 
 
 def count_correct(logits: jax.Array, labels: jax.Array) -> jax.Array:

@@ -1,0 +1,85 @@
+"""Learning-rate schedules and gradient transforms.
+
+The reference trains with one fixed learning rate for its 40 iterations
+(SGD lr=0.1 — ``part1/main.py:120-121``); ``SGDConfig``'s static default
+replicates that.  Real training runs need the rate to move, so this
+module adds the standard schedule family — as pure ``step -> lr``
+functions of a traced step counter, so a schedule lives *inside* the
+jitted train step: no host round-trip per step, no recompile per lr
+value (the alternative — baking each lr into the static config — would
+retrace the program every time the rate changed).
+
+Gradient clipping follows the same design: a pure pytree → pytree
+transform applied after gradient sync (clip the *global* gradient, the
+DDP-semantics order) and before the SGD update.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def constant(lr: float):
+    """The reference's behavior: fixed rate (part1/main.py:120)."""
+
+    def schedule(step):
+        del step
+        return jnp.float32(lr)
+
+    return schedule
+
+
+def warmup_cosine(
+    peak_lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    end_lr: float = 0.0,
+):
+    """Linear warmup 0 → peak over ``warmup_steps``, then cosine decay to
+    ``end_lr`` at ``total_steps`` — the standard large-batch recipe."""
+    if total_steps <= warmup_steps:
+        raise ValueError(
+            f"total_steps={total_steps} must exceed warmup_steps={warmup_steps}"
+        )
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = peak_lr * step / max(warmup_steps, 1)
+        progress = jnp.clip(
+            (step - warmup_steps) / (total_steps - warmup_steps), 0.0, 1.0
+        )
+        cos = end_lr + 0.5 * (peak_lr - end_lr) * (1 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup_steps, warm, cos).astype(jnp.float32)
+
+    return schedule
+
+
+def step_decay(lr: float, boundaries: tuple[int, ...], gamma: float = 0.1):
+    """Multiply the rate by ``gamma`` at each boundary step (the classic
+    CIFAR/ImageNet staircase)."""
+    bounds = jnp.asarray(sorted(boundaries), jnp.int32)
+
+    def schedule(step):
+        n_passed = jnp.sum(jnp.asarray(step, jnp.int32) >= bounds)
+        return jnp.float32(lr) * jnp.float32(gamma) ** n_passed
+
+    return schedule
+
+
+def global_norm(tree) -> jax.Array:
+    """fp32 global L2 norm of a pytree."""
+    return jnp.sqrt(
+        sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree_util.tree_leaves(tree)
+        )
+    )
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    """Scale the gradient pytree so its global L2 norm is at most
+    ``max_norm`` (fp32 norm arithmetic regardless of leaf dtype)."""
+    norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)

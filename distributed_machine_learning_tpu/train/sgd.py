@@ -41,13 +41,19 @@ def sgd_init(params):
     return jax.tree_util.tree_map(jnp.zeros_like, params)
 
 
-def sgd_update(params, momentum_buf, grads, config: SGDConfig):
-    """One SGD step; returns (new_params, new_momentum_buf)."""
+def sgd_update(params, momentum_buf, grads, config: SGDConfig, lr=None):
+    """One SGD step; returns (new_params, new_momentum_buf).
+
+    ``lr``: optional traced scalar overriding ``config.learning_rate`` —
+    how a schedule (``train/schedule.py``) feeds a per-step rate into the
+    jitted update without retracing (the config value is static).
+    """
+    lr = config.learning_rate if lr is None else lr
 
     def _update(p, m, g):
         g = g + config.weight_decay * p
         m = config.momentum * m + g
-        p = p - config.learning_rate * m
+        p = p - lr * m
         return p, m
 
     flat = jax.tree_util.tree_map(_update, params, momentum_buf, grads)

@@ -48,6 +48,8 @@ def _train_step_impl(
     axis_size: int,
     augment: bool,
     sync_bn: bool,
+    schedule=None,
+    clip_norm: float | None = None,
 ):
     rng = step_rng(state.rng, state.step, axis_name)
     x = augment_batch(rng, images_u8) if augment else normalize(images_u8)
@@ -68,8 +70,19 @@ def _train_step_impl(
                 lambda s: lax.pmean(s, axis_name), new_stats
             )
 
+    if clip_norm is not None:
+        # After sync: clip the global gradient (DDP-semantics order).
+        from distributed_machine_learning_tpu.train.schedule import (
+            clip_by_global_norm,
+        )
+
+        grads = clip_by_global_norm(grads, clip_norm)
     new_params, new_momentum = sgd_update(
-        state.params, state.momentum, grads, state.config
+        state.params,
+        state.momentum,
+        grads,
+        state.config,
+        lr=None if schedule is None else schedule(state.step),
     )
     new_state = state.replace(
         params=new_params,
@@ -91,12 +104,18 @@ def make_train_step(
     axis_name: str = BATCH_AXIS,
     augment: bool = True,
     sync_bn: bool = True,
+    schedule=None,
+    clip_norm: float | None = None,
 ):
     """Build the jitted train step.
 
     Without a mesh: the part1 path — plain ``jit``, no collectives.
     With a mesh: ``shard_map`` over ``axis_name``; batch sharded on axis 0,
     state replicated; `strategy` decides how gradients synchronize.
+
+    ``schedule``: optional ``step -> lr`` fn (``train/schedule.py``)
+    overriding the static config rate; ``clip_norm``: optional global-norm
+    gradient clip, applied after sync.
 
     Returns ``step(state, images_u8, labels) -> (state, loss)``.
     """
@@ -120,6 +139,8 @@ def make_train_step(
             axis_size=1,
             augment=augment,
             sync_bn=sync_bn,
+            schedule=schedule,
+            clip_norm=clip_norm,
         )
         return jax.jit(impl, donate_argnums=(0,))
 
@@ -143,6 +164,8 @@ def make_train_step(
         axis_size=axis_size,
         augment=augment,
         sync_bn=sync_bn,
+        schedule=schedule,
+        clip_norm=clip_norm,
     )
     state_spec = P()  # replicated
     batch_spec = P(axis_name)  # sharded along the data axis

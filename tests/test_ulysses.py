@@ -80,6 +80,19 @@ def test_ulysses_single_shard_is_dense(qkv):
     )
 
 
+def test_ulysses_step_builder_validates_heads():
+    """make_lm_train_step fails at build time, not first-step trace time."""
+    from distributed_machine_learning_tpu.models.transformer import TransformerLM
+    from distributed_machine_learning_tpu.train.lm_step import make_lm_train_step
+
+    model = TransformerLM(
+        vocab_size=64, d_model=36, n_layers=1, n_heads=6, attn_impl="ulysses"
+    )
+    mesh = make_mesh(8, axis_names=("batch", "seq"), axis_shape=(2, 4))
+    with pytest.raises(ValueError, match="divisible"):
+        make_lm_train_step(model, mesh=mesh)
+
+
 def test_ulysses_lm_step_matches_dense():
     """Full train step: Ulysses LM on a (batch=2, seq=4) mesh takes the
     same first step as the unsharded dense LM (loss + params agree)."""
