@@ -40,14 +40,20 @@ SEED = 69143  # part1/main.py:17
 EVAL_BATCH = 256
 
 
-def make_flag_parser(description: str) -> argparse.ArgumentParser:
-    """The reference's exact flag surface (part2/2a/main.py:210-218)."""
-    parser = argparse.ArgumentParser(description=description)
+def add_node_flags(parser: argparse.ArgumentParser) -> None:
+    """The reference's exact connectivity flags (part2/2a/main.py:210-218)
+    — one definition shared by every entrypoint parser."""
     parser.add_argument("--master-ip", dest="master_ip", default=DEFAULT_MASTER_IP,
                         type=str, help="coordinator address host:port")
     parser.add_argument("--rank", default=0, type=int, help="process rank")
     parser.add_argument("--num-nodes", dest="num_nodes", default=1, type=int,
                         help="number of processes")
+
+
+def make_flag_parser(description: str) -> argparse.ArgumentParser:
+    """The reference's exact flag surface (part2/2a/main.py:210-218)."""
+    parser = argparse.ArgumentParser(description=description)
+    add_node_flags(parser)
     parser.add_argument("--data-root", default="./data", type=str)
     parser.add_argument("--epochs", default=1, type=int)  # range(1): part1/main.py:123
     parser.add_argument("--compute-dtype", default="float32",
@@ -97,7 +103,23 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
     parser.add_argument("--clip-norm", dest="clip_norm", default=None,
                         type=float,
                         help="clip the (synced) gradient to this global L2 "
-                             "norm before the update (off by default)")
+                             "norm before the update (off by default). "
+                             "Clips whatever the sync strategy produced: "
+                             "part2a/2b SUM gradients over the world "
+                             "(reference semantics, SURVEY.md §2.4), so "
+                             "their clip engages world-size-times earlier "
+                             "than part3's mean gradient — and once it "
+                             "engages, a clipped SUM equals a clipped "
+                             "mean, cancelling the SUM strategies' "
+                             "effective-LR scaling")
+    parser.add_argument("--grad-accum", dest="grad_accum", default=1, type=int,
+                        help="split each per-device batch into this many "
+                             "sequential microbatches, accumulating "
+                             "gradients for one update (accum-fold lower "
+                             "activation memory; identical update when "
+                             "augmentation is off — with augmentation each "
+                             "microbatch draws its own crops/flips, and BN "
+                             "stats update per microbatch)")
     return parser
 
 
@@ -138,6 +160,8 @@ def parse_flags(parser: argparse.ArgumentParser, argv=None) -> argparse.Namespac
         parser.error("--resume requires --ckpt-dir")
     if args.clip_norm is not None and args.clip_norm <= 0:
         parser.error(f"--clip-norm must be positive, got {args.clip_norm}")
+    if args.grad_accum < 1:
+        parser.error(f"--grad-accum must be >= 1, got {args.grad_accum}")
     if args.warmup_steps < 0:
         parser.error(f"--warmup-steps must be >= 0, got {args.warmup_steps}")
     if args.lr_schedule == "cosine":
@@ -217,6 +241,7 @@ def run_part(
                 start_step=int(jax.device_get(state.step)),
             ),
             clip_norm=args.clip_norm,
+            accum_steps=args.grad_accum,
         )
         eval_step = make_eval_step(model)
 

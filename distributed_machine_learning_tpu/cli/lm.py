@@ -1,0 +1,214 @@
+"""Language-model training entrypoint — every parallelism scheme behind
+one flag.
+
+The reference's CLI surface only trains its CNN (SURVEY.md §1); this
+entrypoint gives the transformer stack the same driveable surface, with
+``--parallel`` selecting how the step distributes over the mesh:
+
+  dp       data parallelism (replicated params, pmean grads)
+  ring     context parallelism — ppermute ring attention over the
+           sequence axis (ops/ring_attention.py)
+  ulysses  context parallelism — all-to-all head re-sharding
+           (ops/ulysses.py)
+  tp       tensor parallelism — Megatron layout via GSPMD
+           (parallel/tensor_parallel.py)
+  pp       pipeline parallelism — GPipe ppermute pipeline
+           (parallel/pipeline.py)
+  3d       data × pipeline × tensor composed
+           (parallel/parallel3d.py)
+
+Data is a deterministic synthetic byte stream (seeded from the shared
+69143) — the reference's CIFAR runs are likewise about the training
+machinery, not the dataset.  The measurement protocol is the reference's:
+``--max-iters`` capped, iteration 0 excluded from timing, loss printed
+every 20 iterations, total/average summary at the end
+(``part1/main.py:32-58``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from distributed_machine_learning_tpu.cli.common import SEED
+from distributed_machine_learning_tpu.models.transformer import TransformerLM
+from distributed_machine_learning_tpu.runtime.distributed import (
+    initialize_from_flags,
+)
+from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+from distributed_machine_learning_tpu.train.loop import train_epoch
+from distributed_machine_learning_tpu.utils.logging import rank0_print
+
+
+def make_parser():
+    import argparse
+
+    from distributed_machine_learning_tpu.cli.common import add_node_flags
+
+    p = argparse.ArgumentParser(description=__doc__)
+    add_node_flags(p)
+    p.add_argument("--parallel", default="dp",
+                   choices=["dp", "ring", "ulysses", "tp", "pp", "3d"])
+    p.add_argument("--d-model", dest="d_model", default=256, type=int)
+    p.add_argument("--n-layers", dest="n_layers", default=4, type=int)
+    p.add_argument("--n-heads", dest="n_heads", default=8, type=int)
+    p.add_argument("--vocab", default=256, type=int,
+                   help="byte-level vocabulary by default")
+    p.add_argument("--seq-len", dest="seq_len", default=256, type=int)
+    p.add_argument("--batch-size", dest="batch_size", default=8, type=int,
+                   help="global batch (sequences per step)")
+    p.add_argument("--max-iters", dest="max_iters", default=40, type=int)
+    p.add_argument("--microbatches", default=2, type=int,
+                   help="pipeline microbatches (pp/3d)")
+    p.add_argument("--dp", default=None, type=int,
+                   help="data-axis size for --parallel 3d "
+                        "(default: devices // (pp*tp))")
+    p.add_argument("--pp", default=2, type=int,
+                   help="pipe-axis size for --parallel 3d")
+    p.add_argument("--tp", default=2, type=int,
+                   help="model-axis size for --parallel 3d")
+    p.add_argument("--compute-dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    return p
+
+
+def synthetic_tokens(rng: np.random.Generator, batch: int, seq_len: int,
+                     vocab: int):
+    """[B, L+1] int32 token block; [:, :-1] feeds, [:, 1:] targets."""
+    return rng.integers(0, vocab, (batch, seq_len + 1)).astype(np.int32)
+
+
+def build(args):
+    """(step, state, place) for the chosen parallelism scheme."""
+    import jax.numpy as jnp
+
+    n = jax.device_count()
+    dtype = jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
+    common = dict(
+        vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
+        n_heads=args.n_heads, compute_dtype=dtype,
+    )
+
+    if args.parallel in ("dp", "ring", "ulysses"):
+        from distributed_machine_learning_tpu.train.lm_step import (
+            init_lm_state,
+            make_lm_train_step,
+            shard_lm_batch,
+        )
+
+        if args.parallel == "dp":
+            if args.batch_size % n:
+                raise ValueError(
+                    f"--batch-size {args.batch_size} must be divisible by "
+                    f"the {n}-device data axis"
+                )
+            mesh = make_mesh(n, ("batch", "seq"), (n, 1))
+            model = TransformerLM(**common)
+        else:
+            if args.seq_len % n:
+                raise ValueError(
+                    f"--seq-len {args.seq_len} must be divisible by the "
+                    f"{n}-device sequence axis ({args.parallel} shards the "
+                    "sequence)"
+                )
+            mesh = make_mesh(n, ("batch", "seq"), (1, n))
+            model = TransformerLM(attn_impl=args.parallel, **common)
+        state = init_lm_state(model, seed=SEED)
+        step = make_lm_train_step(model, mesh=mesh)
+        place = lambda x, y: shard_lm_batch(mesh, x, y)
+        return step, state, place
+
+    if args.parallel == "tp":
+        from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+            make_tp_lm_train_step,
+            shard_tp_batch,
+            shard_tp_state,
+        )
+        from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+        mesh = make_mesh(n, ("batch", "model"), (1, n))
+        model = TransformerLM(**common)
+        # Build the step first: its validation (n_heads % model-axis size)
+        # gives a clear error before any state is placed.
+        step = make_tp_lm_train_step(model, mesh)
+        state = shard_tp_state(init_lm_state(model, seed=SEED), mesh)
+        place = lambda x, y: shard_tp_batch(mesh, x, y)
+        return step, state, place
+
+    if args.parallel == "pp":
+        from distributed_machine_learning_tpu.parallel.pipeline import (
+            init_pipeline_state,
+            make_pp_lm_train_step,
+            microbatch,
+            shard_pp_state,
+        )
+
+        mesh = make_mesh(n, ("pipe",))
+        model = TransformerLM(**common)
+        step = make_pp_lm_train_step(model, mesh, args.microbatches)
+        state = shard_pp_state(init_pipeline_state(model, seed=SEED), mesh)
+        place = lambda x, y: microbatch(x, y, args.microbatches)
+        return step, state, place
+
+    # 3d
+    from distributed_machine_learning_tpu.parallel.parallel3d import (
+        init_pipeline_state,
+        make_3d_lm_train_step,
+        make_3d_mesh,
+        microbatch,
+        shard_3d_batch,
+        shard_3d_state,
+    )
+
+    if args.pp < 1 or args.tp < 1:
+        raise ValueError(
+            f"--pp and --tp must be >= 1, got pp={args.pp} tp={args.tp}"
+        )
+    if args.dp is not None and args.dp < 1:
+        raise ValueError(f"--dp must be >= 1, got {args.dp}")
+    dp = args.dp if args.dp is not None else max(n // (args.pp * args.tp), 1)
+    if dp * args.pp * args.tp != n:
+        raise ValueError(
+            f"3-D mesh dp×pp×tp = {dp}×{args.pp}×{args.tp} = "
+            f"{dp * args.pp * args.tp} must equal the device count {n} "
+            "(a prefix-subset mesh would silently idle the rest)"
+        )
+    mesh = make_3d_mesh(dp, args.pp, args.tp)
+    model = TransformerLM(**common)
+    step = make_3d_lm_train_step(model, mesh, args.microbatches)
+    state = shard_3d_state(init_pipeline_state(model, seed=SEED), mesh)
+    place = lambda x, y: shard_3d_batch(mesh, *microbatch(x, y, args.microbatches))
+    return step, state, place
+
+
+def main(argv=None) -> None:
+    args = make_parser().parse_args(argv)
+    ctx = initialize_from_flags(args.master_ip, args.rank, args.num_nodes)
+    try:
+        rank0_print(
+            f"lm parallel={args.parallel} devices={jax.device_count()} "
+            f"d_model={args.d_model} layers={args.n_layers} "
+            f"seq_len={args.seq_len} batch={args.batch_size}"
+        )
+        step, state, place = build(args)
+        rng = np.random.default_rng(SEED)
+
+        def batches():
+            for _ in range(args.max_iters):
+                block = synthetic_tokens(
+                    rng, args.batch_size, args.seq_len, args.vocab
+                )
+                yield block[:, :-1], block[:, 1:]
+
+        # The shared driver owns the measurement protocol (iter-0-excluded
+        # timing, loss cadence, summary format) — one copy for CNN and LM.
+        train_epoch(
+            step, state, batches(), place_batch=place,
+            max_iters=args.max_iters,
+        )
+    finally:
+        ctx.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -136,6 +136,14 @@ def shard_3d_batch(mesh: Mesh, tokens_mb, targets_mb):
     data axis (microbatch and sequence dims stay whole)."""
     import jax.numpy as jnp
 
+    dp = mesh.shape[DATA_AXIS]
+    mb = jnp.asarray(tokens_mb).shape[1]
+    if mb % dp:
+        raise ValueError(
+            f"microbatch size {mb} must be divisible by the {dp}-device "
+            f"data axis (global batch = microbatches × mb; pick a batch "
+            "divisible by microbatches × dp)"
+        )
     sharding = NamedSharding(mesh, P(None, DATA_AXIS, None))
     return (
         jax.device_put(jnp.asarray(tokens_mb), sharding),

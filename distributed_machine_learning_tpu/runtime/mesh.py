@@ -40,9 +40,13 @@ def shard_map_no_check(f, *, mesh, in_specs, out_specs, manual_axes=None):
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False, **kwargs,
         )
-    except TypeError:  # pragma: no cover
+    except TypeError as e:  # pragma: no cover
         if manual_axes is not None:
-            raise
+            raise RuntimeError(
+                "partial-manual shard_map (manual_axes=...) needs a jax "
+                "version whose shard_map accepts the axis_names parameter; "
+                "this jax only has the legacy check_rep API"
+            ) from e
         return _shard_map_impl(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
         )

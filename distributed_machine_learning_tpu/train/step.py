@@ -50,14 +50,54 @@ def _train_step_impl(
     sync_bn: bool,
     schedule=None,
     clip_norm: float | None = None,
+    accum_steps: int = 1,
 ):
     rng = step_rng(state.rng, state.step, axis_name)
-    x = augment_batch(rng, images_u8) if augment else normalize(images_u8)
+    if accum_steps == 1:
+        x = augment_batch(rng, images_u8) if augment else normalize(images_u8)
+        loss_fn = make_loss_fn(model, state.batch_stats, x, labels, train=True)
+        (loss, (_, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+    else:
+        # Gradient accumulation: split the (local) batch into microbatches
+        # and scan, accumulating gradients — the program stays one
+        # microbatch big, peak activation memory drops accum_steps-fold,
+        # and with equal microbatches mean-of-means == the full-batch mean
+        # so the update is identical (BN-free; BN running stats update
+        # per microbatch, sequentially, like small-batch torch training).
+        B = images_u8.shape[0]
+        if B % accum_steps:
+            raise ValueError(
+                f"per-device batch {B} not divisible by accum_steps="
+                f"{accum_steps}"
+            )
+        micro_imgs = images_u8.reshape(
+            accum_steps, B // accum_steps, *images_u8.shape[1:]
+        )
+        micro_labels = labels.reshape(accum_steps, B // accum_steps)
+        micro_rngs = jax.random.split(rng, accum_steps)
 
-    loss_fn = make_loss_fn(model, state.batch_stats, x, labels, train=True)
-    (loss, (_, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-        state.params
-    )
+        def body(carry, xs):
+            stats, grads_acc, loss_acc = carry
+            mi, ml, r = xs
+            x = augment_batch(r, mi) if augment else normalize(mi)
+            loss_fn = make_loss_fn(model, stats, x, ml, train=True)
+            (loss, (_, new_stats)), g = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, g)
+            return (new_stats if new_stats else stats, grads_acc,
+                    loss_acc + loss), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        (new_stats, grads, loss), _ = lax.scan(
+            body,
+            (state.batch_stats, zeros, jnp.zeros((), jnp.float32)),
+            (micro_imgs, micro_labels, micro_rngs),
+        )
+        grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
+        loss = loss / accum_steps
 
     if axis_name is not None:
         grads = strategy(grads, axis_name, axis_size)
@@ -106,6 +146,7 @@ def make_train_step(
     sync_bn: bool = True,
     schedule=None,
     clip_norm: float | None = None,
+    accum_steps: int = 1,
 ):
     """Build the jitted train step.
 
@@ -115,10 +156,15 @@ def make_train_step(
 
     ``schedule``: optional ``step -> lr`` fn (``train/schedule.py``)
     overriding the static config rate; ``clip_norm``: optional global-norm
-    gradient clip, applied after sync.
+    gradient clip, applied after sync; ``accum_steps``: split each batch
+    into this many sequential microbatches, accumulating gradients
+    (identical update for BN-free models, accum-fold lower activation
+    memory).
 
     Returns ``step(state, images_u8, labels) -> (state, loss)``.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     strategy = strategy or NoSync()
     if mesh is not None and isinstance(strategy, NoSync):
         # Unsynced gradients under a replicated-state shard_map would let
@@ -141,6 +187,7 @@ def make_train_step(
             sync_bn=sync_bn,
             schedule=schedule,
             clip_norm=clip_norm,
+            accum_steps=accum_steps,
         )
         return jax.jit(impl, donate_argnums=(0,))
 
@@ -166,6 +213,7 @@ def make_train_step(
         sync_bn=sync_bn,
         schedule=schedule,
         clip_norm=clip_norm,
+        accum_steps=accum_steps,
     )
     state_spec = P()  # replicated
     batch_spec = P(axis_name)  # sharded along the data axis

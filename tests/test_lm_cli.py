@@ -1,0 +1,69 @@
+"""LM CLI entrypoint: each --parallel mode runs end-to-end (tiny configs,
+8-device CPU mesh) and dp/ring agree on the loss trajectory."""
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.cli.lm import main, make_parser
+
+TINY = [
+    "--d-model", "32", "--n-layers", "2", "--n-heads", "4",
+    "--seq-len", "16", "--batch-size", "8", "--vocab", "64",
+    "--max-iters", "3",
+]
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        ["--parallel", "dp"],
+        ["--parallel", "ring"],
+        ["--parallel", "ulysses", "--n-heads", "8"],
+        ["--parallel", "tp", "--n-heads", "8"],
+        ["--parallel", "pp", "--n-layers", "8"],
+        ["--parallel", "3d", "--n-heads", "8", "--pp", "2", "--tp", "2"],
+    ],
+    ids=["dp", "ring", "ulysses", "tp", "pp", "3d"],
+)
+def test_lm_cli_runs(extra, capsys):
+    main(TINY + extra)
+    out = capsys.readouterr().out
+    assert "Total execution time" in out
+
+
+def test_lm_cli_dp_ring_same_loss(capsys):
+    """dp and ring consume the same synthetic stream and replicate the
+    same model — their printed losses must match."""
+    main(TINY + ["--max-iters", "20", "--parallel", "dp"])
+    dp_out = capsys.readouterr().out
+    main(TINY + ["--max-iters", "20", "--parallel", "ring"])
+    ring_out = capsys.readouterr().out
+
+    def loss_of(out):
+        for line in out.splitlines():
+            if line.startswith("Loss at"):
+                return float(line.rsplit(" ", 1)[-1])
+        raise AssertionError(f"no loss line in {out!r}")
+
+    np.testing.assert_allclose(loss_of(dp_out), loss_of(ring_out), rtol=1e-5)
+
+
+def test_lm_cli_bad_config_fails_fast():
+    with pytest.raises(ValueError, match="pipeline stages"):
+        main(TINY + ["--parallel", "pp", "--n-layers", "3"])
+    # a 3-D mesh that would idle devices is refused, not silently shrunk
+    with pytest.raises(ValueError, match="device count"):
+        main(TINY + ["--parallel", "3d", "--n-heads", "8", "--pp", "3",
+                     "--tp", "2"])
+    with pytest.raises(ValueError, match="--dp"):
+        main(TINY + ["--parallel", "3d", "--dp", "0", "--pp", "2",
+                     "--tp", "2"])
+    with pytest.raises(ValueError, match="--pp and --tp"):
+        main(TINY + ["--parallel", "3d", "--pp", "0", "--tp", "2"])
+    with pytest.raises(ValueError, match="divisible"):
+        main(TINY + ["--parallel", "dp", "--batch-size", "12"])
+    with pytest.raises(ValueError, match="sequence axis"):
+        main(TINY + ["--parallel", "ring", "--seq-len", "100"])
+    with pytest.raises(ValueError, match="data axis"):
+        main(TINY + ["--parallel", "3d", "--n-heads", "8", "--pp", "2",
+                     "--tp", "2", "--batch-size", "6"])
