@@ -1,0 +1,58 @@
+"""Shared scan-epoch timing harness for the benchmark entrypoints.
+
+One copy of the measurement protocol (bench.py and bench/sweep.py both
+use it): all timed iterations run as ONE jitted ``lax.scan`` over
+pre-staged device-resident batches, and timing brackets a HOST VALUE
+FETCH of the final loss.  Rationale — per-step Python dispatch would
+dominate on a remote/tunneled device (~100 ms round-trip vs a ~4 ms
+step), and an asynchronously-dispatched backend can return from
+``block_until_ready`` before compute actually finishes, so only a value
+fetch is trustworthy; the reference's excluded iteration 0
+(``part1/main.py:53-58``) maps to the excluded compile run.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+
+def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1):
+    """Time ``len(imgs)`` train steps as one compiled scan.
+
+    ``step``: un-jitted ``(state, x, y) -> (state, loss)`` (build with
+    ``make_train_step(..., jit=False)``).  ``imgs``/``lbls``: stacked
+    [T, ...] device arrays, one leading slice per iteration.  Runs once
+    untimed (compile, the reference's iteration 0), then ``reps`` timed
+    runs; returns ``(best_seconds, final_loss, state)``.
+
+    Raises ``RuntimeError`` on a non-finite final loss — a benchmark
+    number from a diverged run must never be reported.
+    """
+
+    @jax.jit
+    def run(state, imgs, lbls):
+        def body(st, xy):
+            st, loss = step(st, *xy)
+            return st, loss
+
+        return jax.lax.scan(body, state, (imgs, lbls))
+
+    state, losses = run(state, imgs, lbls)
+    float(losses[-1])  # compile + completion
+
+    best = float("inf")
+    final_loss = float("nan")
+    for _ in range(max(reps, 1)):
+        start = time.perf_counter()
+        state, losses = run(state, imgs, lbls)
+        final_loss = float(losses[-1])  # forces real device completion
+        best = min(best, time.perf_counter() - start)
+    if not np.isfinite(final_loss):
+        raise RuntimeError(
+            f"benchmark run diverged (final loss {final_loss}); refusing to "
+            "report a throughput number"
+        )
+    return best, final_loss, state

@@ -25,8 +25,7 @@ import numpy as np
 
 from distributed_machine_learning_tpu.parallel.strategies import get_strategy
 from distributed_machine_learning_tpu.runtime.mesh import make_mesh
-from distributed_machine_learning_tpu.train.step import make_train_step, shard_batch
-from distributed_machine_learning_tpu.utils.timing import IterationTimer
+from distributed_machine_learning_tpu.train.step import make_train_step
 
 
 @dataclass
@@ -72,40 +71,46 @@ def run_point(
         raise ValueError(f"num_devices must be >= 1, got {num_devices}")
     if timed_iters < 1:
         raise ValueError(f"timed_iters must be >= 1, got {timed_iters}")
-    if init_state is not None:
-        # The train step donates its input state; deep-copy so one shared
-        # init can seed every point of a sweep.
-        state = jax.tree_util.tree_map(
-            lambda x: jax.numpy.array(x, copy=True), init_state
-        )
-    else:
-        state = init_model_and_state(model)
+    # Nothing in the scan-epoch path donates buffers (the step is built
+    # with jit=False and the harness jit has no donate_argnums), so one
+    # shared init can seed every point as-is.
+    state = init_state if init_state is not None else init_model_and_state(model)
     rng = np.random.default_rng(seed)
     global_batch = per_device_batch * num_devices
 
     if num_devices == 1:
         mesh = None
-        step = make_train_step(model, mesh=None)
-        place = lambda i, l: (jax.numpy.asarray(i), jax.numpy.asarray(l))
+        step = make_train_step(model, mesh=None, jit=False)
     else:
         mesh = make_mesh(num_devices)
-        step = make_train_step(model, get_strategy(strategy_name), mesh=mesh)
-        place = lambda i, l: shard_batch(mesh, i, l)
+        step = make_train_step(
+            model, get_strategy(strategy_name), mesh=mesh, jit=False
+        )
 
-    timer = IterationTimer(skip_first=1)  # iteration 0 = compile (reference protocol)
-    for _ in range(timed_iters + 1):
-        x, y = place(*_synthetic_batch(rng, global_batch))
-        timer.start()
-        state, loss = step(state, x, y)
-        jax.block_until_ready(loss)
-        timer.stop()
+    # Shared scan-epoch methodology (bench/harness.py): one compiled scan,
+    # timing bracketed by a value fetch, compile run excluded.
+    from distributed_machine_learning_tpu.bench.harness import timed_scan_epoch
 
-    imgs_per_sec = global_batch * timer.count / timer.total
+    batches = [_synthetic_batch(rng, global_batch) for _ in range(timed_iters)]
+    imgs = np.stack([b[0] for b in batches])
+    lbls = np.stack([b[1] for b in batches])
+    if mesh is None:
+        dx, dy = jax.numpy.asarray(imgs), jax.numpy.asarray(lbls)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P(None, "batch"))
+        dx = jax.device_put(jax.numpy.asarray(imgs), sharding)
+        dy = jax.device_put(jax.numpy.asarray(lbls), sharding)
+
+    elapsed, _, state = timed_scan_epoch(step, state, dx, dy, reps=1)
+
+    imgs_per_sec = global_batch * timed_iters / elapsed
     return ScalePoint(
         num_devices=num_devices,
         strategy=strategy_name if num_devices > 1 else "none",
         per_device_batch=per_device_batch,
-        timed_iters=timer.count,
+        timed_iters=timed_iters,
         imgs_per_sec=imgs_per_sec,
         imgs_per_sec_per_device=imgs_per_sec / num_devices,
     )

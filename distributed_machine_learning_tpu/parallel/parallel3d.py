@@ -30,6 +30,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_machine_learning_tpu.models.transformer import TransformerLM
@@ -137,7 +138,7 @@ def shard_3d_batch(mesh: Mesh, tokens_mb, targets_mb):
     import jax.numpy as jnp
 
     dp = mesh.shape[DATA_AXIS]
-    mb = jnp.asarray(tokens_mb).shape[1]
+    mb = np.shape(tokens_mb)[1]
     if mb % dp:
         raise ValueError(
             f"microbatch size {mb} must be divisible by the {dp}-device "

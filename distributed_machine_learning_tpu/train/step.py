@@ -147,6 +147,7 @@ def make_train_step(
     schedule=None,
     clip_norm: float | None = None,
     accum_steps: int = 1,
+    jit: bool = True,
 ):
     """Build the jitted train step.
 
@@ -160,6 +161,11 @@ def make_train_step(
     into this many sequential microbatches, accumulating gradients
     (identical update for BN-free models, accum-fold lower activation
     memory).
+
+    ``jit=False`` returns the un-jitted step function (no donation) — for
+    callers that embed the step in a larger compiled program, e.g. the
+    benchmark's ``lax.scan``-ed epoch (bench.py) where per-step dispatch
+    would dominate on a remote/tunneled device.
 
     Returns ``step(state, images_u8, labels) -> (state, loss)``.
     """
@@ -189,7 +195,7 @@ def make_train_step(
             clip_norm=clip_norm,
             accum_steps=accum_steps,
         )
-        return jax.jit(impl, donate_argnums=(0,))
+        return jax.jit(impl, donate_argnums=(0,)) if jit else impl
 
     axis_size = mesh.shape[axis_name]
     if not sync_bn:
@@ -223,25 +229,46 @@ def make_train_step(
         in_specs=(state_spec, batch_spec, batch_spec),
         out_specs=(state_spec, P()),
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    return jax.jit(sharded, donate_argnums=(0,)) if jit else sharded
 
 
-def make_eval_step(model):
+def make_eval_step(model, mesh: Mesh | None = None, axis_name: str = BATCH_AXIS):
     """Jitted eval step: (params, batch_stats, images_u8, labels) →
     (batch mean loss, correct count) — ``test_model`` parity
     (``part1/main.py:62-77``): normalize only (no augmentation), BN in
-    inference mode, loss averaged per batch, top-1 correct counts."""
+    inference mode, loss averaged per batch, top-1 correct counts.
 
-    @jax.jit
-    def eval_step(params, batch_stats, images_u8, labels):
+    With a mesh, evaluation is *sharded*: each device scores its slice of
+    the batch and the per-batch mean loss / correct count come back via
+    ``pmean``/``psum`` — an N-fold speedup over the reference's
+    every-rank-evaluates-everything protocol (SURVEY.md §3.5) with
+    identical results (equal shards ⇒ pmean of shard means == the global
+    batch mean).
+    """
+
+    def eval_impl(params, batch_stats, images_u8, labels, *, axis=None):
         x = normalize(images_u8)
         variables: dict[str, Any] = {"params": params}
         if batch_stats:
             variables["batch_stats"] = batch_stats
         logits = model.apply(variables, x, train=False)
-        return cross_entropy_loss(logits, labels), count_correct(logits, labels)
+        loss = cross_entropy_loss(logits, labels)
+        correct = count_correct(logits, labels)
+        if axis is not None:
+            loss = lax.pmean(loss, axis)
+            correct = lax.psum(correct, axis)
+        return loss, correct
 
-    return eval_step
+    if mesh is None:
+        return jax.jit(eval_impl)
+
+    sharded = _shard_map(
+        partial(eval_impl, axis=axis_name),
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name), P(axis_name)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded)
 
 
 def shard_batch(mesh: Mesh, images_u8, labels, axis_name: str = BATCH_AXIS):

@@ -128,3 +128,49 @@ def test_normalize_and_augment_shapes():
     assert y1.shape == (4, 32, 32, 3)
     np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))  # deterministic
     assert not np.allclose(np.asarray(y1), np.asarray(y3))  # key-dependent
+
+
+def test_augment_einsum_crop_matches_gather_formulation():
+    """The MXU-friendly one-hot-einsum crop (data/augment.py) must be
+    bit-identical to the naive per-image dynamic_slice + flip formulation
+    it replaced (same keys -> same offsets, coins, and pixels)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_machine_learning_tpu.data.augment import (
+        augment_batch,
+        normalize,
+    )
+
+    def gather_augment(key, images_u8, padding=4):
+        def crop_one(key, img):
+            h, w, _ = img.shape
+            padded = jnp.pad(
+                img, ((padding, padding), (padding, padding), (0, 0))
+            )
+            kx, ky = jax.random.split(key)
+            top = jax.random.randint(kx, (), 0, 2 * padding + 1)
+            left = jax.random.randint(ky, (), 0, 2 * padding + 1)
+            return jax.lax.dynamic_slice(
+                padded, (top, left, 0), (h, w, img.shape[2])
+            )
+
+        n = images_u8.shape[0]
+        crop_keys = jax.random.split(jax.random.fold_in(key, 0), n)
+        flip_key = jax.random.fold_in(key, 1)
+        cropped = jax.vmap(crop_one)(crop_keys, images_u8)
+        flip = jax.random.bernoulli(flip_key, 0.5, (n,))
+        flipped = jnp.where(
+            flip[:, None, None, None], cropped[:, :, ::-1, :], cropped
+        )
+        return normalize(flipped)
+
+    rng = np.random.default_rng(7)
+    imgs = jnp.asarray(rng.integers(0, 256, (32, 32, 32, 3), dtype=np.uint8))
+    for seed in (0, 69143):
+        key = jax.random.PRNGKey(seed)
+        np.testing.assert_array_equal(
+            np.asarray(gather_augment(key, imgs)),
+            np.asarray(augment_batch(key, imgs)),
+        )
