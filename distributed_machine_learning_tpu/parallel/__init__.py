@@ -22,7 +22,18 @@ from distributed_machine_learning_tpu.parallel.parallel3d import (
     shard_3d_batch,
 )
 
+from distributed_machine_learning_tpu.parallel.zero1 import (
+    Zero1State,
+    make_zero1_train_step,
+    shard_zero1_state,
+    zero1_params,
+)
+
 __all__ = [
+    "Zero1State",
+    "make_zero1_train_step",
+    "shard_zero1_state",
+    "zero1_params",
     "make_3d_mesh",
     "make_3d_lm_train_step",
     "shard_3d_state",

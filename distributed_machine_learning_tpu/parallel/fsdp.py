@@ -69,6 +69,45 @@ def _padded_len(n_elems: int, n_dev: int) -> int:
     return -(-n_elems // n_dev) * n_dev
 
 
+def flat_mean_grad_shard(
+    model, params, batch_stats, x, labels, axis_name: str, n: int,
+    padded_len: int,
+):
+    """Shared back half of the flat-shard schemes' forward/backward:
+    loss + grads on full params, flatten/pad, reduce-scatter the MEAN
+    gradient so each device holds only the slice it owns, axis-sync BN
+    stats and the loss.  Returns ``(loss, new_stats, grad_shard)``.
+    One copy so ZeRO-1 and ZeRO-3 cannot drift apart.
+    """
+    loss_fn = make_loss_fn(model, batch_stats, x, labels, train=True)
+    (loss, (_, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        params
+    )
+    flat_grads, _ = ravel_pytree(grads)
+    flat_grads = jnp.pad(flat_grads, (0, padded_len - flat_grads.shape[0]))
+    grad_shard = lax.psum_scatter(flat_grads, axis_name, tiled=True) / n
+    if new_stats:
+        new_stats = jax.tree_util.tree_map(
+            lambda s: lax.pmean(s, axis_name), new_stats
+        )
+    return lax.pmean(loss, axis_name), new_stats, grad_shard
+
+
+def flatten_padded(state: TrainState, n_dev: int):
+    """Flatten params + momentum to N-divisible padded vectors — the
+    shared front half of every flat-shard scheme (ZeRO-1 and ZeRO-3).
+
+    Returns ``(param_flat, momentum_flat, unravel, n_elems)``.
+    """
+    flat, unravel = ravel_pytree(state.params)
+    n_elems = int(flat.shape[0])
+    padded = _padded_len(n_elems, n_dev)
+    flat = jnp.pad(flat, (0, padded - n_elems))
+    mom_flat, _ = ravel_pytree(state.momentum)
+    mom_flat = jnp.pad(mom_flat, (0, padded - mom_flat.shape[0]))
+    return flat, mom_flat, unravel, n_elems
+
+
 def shard_fsdp_state(
     state: TrainState, mesh: Mesh, axis_name: str = BATCH_AXIS
 ):
@@ -79,14 +118,9 @@ def shard_fsdp_state(
     unpadded parameter count — both needed by
     :func:`make_fsdp_train_step` and by checkpoint export.
     """
-    flat, unravel = ravel_pytree(state.params)
-    n_elems = int(flat.shape[0])
-    n = mesh.shape[axis_name]
-    padded = _padded_len(n_elems, n)
-    flat = jnp.pad(flat, (0, padded - n_elems))
-    mom_flat, _ = ravel_pytree(state.momentum)
-    mom_flat = jnp.pad(mom_flat, (0, padded - mom_flat.shape[0]))
-
+    flat, mom_flat, unravel, n_elems = flatten_padded(
+        state, mesh.shape[axis_name]
+    )
     sharding = NamedSharding(mesh, P(axis_name))
     replicated = NamedSharding(mesh, P())
     fsdp_state = FSDPState(
@@ -138,16 +172,13 @@ def make_fsdp_train_step(
             r = step_rng(rng, step_ctr, axis_name)
             x = augment_batch(r, images_u8) if augment else normalize(images_u8)
 
-            loss_fn = make_loss_fn(model, batch_stats, x, labels, train=True)
-            (loss, (_, new_stats)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
-
-            # (3) Reduce-scatter: each device receives the mean-reduced slice
-            # it owns — half the ring, half the bytes of a full all-reduce.
-            flat_grads, _ = ravel_pytree(grads)
-            flat_grads = jnp.pad(flat_grads, (0, full_flat.shape[0] - n_elems))
-            grad_shard = lax.psum_scatter(flat_grads, axis_name, tiled=True) / n
+            # (2)+(3) forward/backward + reduce-scatter of the MEAN grad —
+            # each device receives the slice it owns (half the ring, half
+            # the bytes of a full all-reduce).
+            loss, new_stats, grad_shard = flat_mean_grad_shard(
+                model, params, batch_stats, x, labels, axis_name, n,
+                full_flat.shape[0],
+            )
 
             # (4) SGD/momentum on the local shard only (shared torch update
             # rule — train/sgd.py works on bare arrays): weight decay reads
@@ -155,12 +186,7 @@ def make_fsdp_train_step(
             new_params, new_mom = sgd_update(
                 param_shards, momentum_shards, grad_shard, cfg
             )
-
-            if new_stats:
-                new_stats = jax.tree_util.tree_map(
-                    lambda s: lax.pmean(s, axis_name), new_stats
-                )
-            return new_params, new_mom, new_stats, lax.pmean(loss, axis_name)
+            return new_params, new_mom, new_stats, loss
 
         shard = P(axis_name)
         return _shard_map(

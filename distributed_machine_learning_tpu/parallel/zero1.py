@@ -1,0 +1,171 @@
+"""ZeRO-1: optimizer-state sharding under replicated parameters.
+
+The middle point of the ZeRO family this framework offers (SURVEY.md
+§2.3 records all of it as absent in the reference):
+
+- replicated DP (``parallel/strategies.py``) — params + momentum on
+  every device;
+- **ZeRO-1 (this module)** — params replicated, momentum sharded 1/N;
+- ZeRO-3/FSDP (``parallel/fsdp.py``) — params *and* momentum sharded.
+
+The step:
+
+  1. forward/backward on the replicated params (local gradients);
+  2. ``lax.psum_scatter`` the flattened gradient — each device receives
+     only the mean-reduced slice it owns (half the ring);
+  3. SGD/momentum update on that slice against its momentum shard;
+  4. ``lax.all_gather`` the updated parameter slices back to the full
+     replicated vector (the other half of the ring).
+
+Per-step traffic is exactly one all-reduce's worth (reduce-scatter +
+all-gather), the same bytes replicated DP pays — ZeRO-1 costs no extra
+bandwidth and saves (N−1)/N of the momentum memory, the reason it is
+the default first rung of optimizer sharding.  Flat-vector layout and
+padding follow ``parallel/fsdp.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu.data.augment import augment_batch, normalize
+from distributed_machine_learning_tpu.parallel.fsdp import (
+    _padded_len,
+    flat_mean_grad_shard,
+    flatten_padded,
+    fsdp_memory_footprint,
+)
+from distributed_machine_learning_tpu.runtime.mesh import (
+    BATCH_AXIS,
+    shard_map_no_check as _shard_map,
+)
+from distributed_machine_learning_tpu.train.common import step_rng
+from distributed_machine_learning_tpu.train.sgd import SGDConfig, sgd_update
+from distributed_machine_learning_tpu.train.state import TrainState
+
+
+@struct.dataclass
+class Zero1State:
+    """Replicated flat params + 1/N momentum shards per device."""
+
+    param_flat: jax.Array  # [padded_len], replicated
+    momentum_shards: jax.Array  # [padded_len] global, sharded over batch axis
+    batch_stats: dict
+    step: jax.Array
+    rng: jax.Array
+    config: SGDConfig = struct.field(pytree_node=False)
+
+
+def shard_zero1_state(state: TrainState, mesh: Mesh, axis_name: str = BATCH_AXIS):
+    """Flatten a replicated TrainState into the ZeRO-1 layout.
+
+    Returns ``(zero1_state, unravel, n_elems)`` — ``unravel`` maps the
+    unpadded flat vector back to the params pytree.
+    """
+    flat, mom_flat, unravel, n_elems = flatten_padded(
+        state, mesh.shape[axis_name]
+    )
+    z1 = Zero1State(
+        param_flat=jax.device_put(flat, NamedSharding(mesh, P())),
+        momentum_shards=jax.device_put(mom_flat, NamedSharding(mesh, P(axis_name))),
+        batch_stats=jax.device_put(
+            state.batch_stats, NamedSharding(mesh, P())
+        ),
+        step=jax.device_put(state.step, NamedSharding(mesh, P())),
+        rng=jax.device_put(state.rng, NamedSharding(mesh, P())),
+        config=state.config,
+    )
+    return z1, unravel, n_elems
+
+
+def zero1_params(state: Zero1State, unravel, n_elems: int):
+    """The params pytree (for eval/checkpoint) — params are replicated,
+    so this is just an unravel, no collective."""
+    return unravel(jnp.asarray(state.param_flat)[:n_elems])
+
+
+def make_zero1_train_step(
+    model,
+    mesh: Mesh,
+    unravel,
+    n_elems: int,
+    axis_name: str = BATCH_AXIS,
+    augment: bool = True,
+):
+    """Build the jitted ZeRO-1 train step (MEAN gradient semantics).
+
+    Returns ``step(zero1_state, images_u8, labels) -> (state, loss)``
+    with the batch sharded along the data axis.
+    """
+    n = mesh.shape[axis_name]
+
+    def sharded_for(cfg: SGDConfig):
+        def impl(param_flat, momentum_shard, batch_stats, step_ctr, rng,
+                 images_u8, labels):
+            shard_len = param_flat.shape[0] // n
+            rank = lax.axis_index(axis_name)
+            params = unravel(param_flat[:n_elems])
+
+            r = step_rng(rng, step_ctr, axis_name)
+            x = augment_batch(r, images_u8) if augment else normalize(images_u8)
+
+            # (2) forward/backward + reduce-scatter of the MEAN gradient —
+            # shared with ZeRO-3 (parallel/fsdp.py) so the schemes cannot
+            # drift apart.
+            loss, new_stats, grad_shard = flat_mean_grad_shard(
+                model, params, batch_stats, x, labels, axis_name, n,
+                param_flat.shape[0],
+            )
+
+            # (3) Update the owned param slice against the momentum shard.
+            p_shard = lax.dynamic_slice(
+                param_flat, (rank * shard_len,), (shard_len,)
+            )
+            new_p_shard, new_m_shard = sgd_update(
+                p_shard, momentum_shard, grad_shard, cfg
+            )
+
+            # (4) All-gather the updated slices into the full vector.
+            new_flat = lax.all_gather(new_p_shard, axis_name, tiled=True)
+            return new_flat, new_m_shard, new_stats, loss
+
+        shard = P(axis_name)
+        return _shard_map(
+            impl,
+            mesh=mesh,
+            in_specs=(P(), shard, P(), P(), P(), shard, shard),
+            out_specs=(P(), shard, P(), P()),
+        )
+
+    def step(state: Zero1State, images_u8, labels):
+        new_flat, new_mom, new_stats, loss = sharded_for(state.config)(
+            state.param_flat,
+            state.momentum_shards,
+            state.batch_stats,
+            state.step,
+            state.rng,
+            images_u8,
+            labels,
+        )
+        new_state = state.replace(
+            param_flat=new_flat,
+            momentum_shards=new_mom,
+            batch_stats=new_stats,
+            step=state.step + 1,
+        )
+        return new_state, loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def zero1_memory_footprint(n_params: int, n_dev: int, bytes_per_elem: int = 4):
+    """Per-device param+momentum bytes: replicated vs ZeRO-1 vs ZeRO-3."""
+    fp = fsdp_memory_footprint(n_params, n_dev, bytes_per_elem)
+    padded = _padded_len(n_params, n_dev)
+    fp["zero1"] = (n_params + padded // n_dev) * bytes_per_elem
+    return fp

@@ -1,0 +1,90 @@
+"""ZeRO-1 optimizer-state sharding: must take exactly the step the
+replicated mean-semantics DP baseline takes, with 1/N momentum memory."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.cli.common import init_model_and_state
+from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.parallel.strategies import get_strategy
+from distributed_machine_learning_tpu.parallel.zero1 import (
+    make_zero1_train_step,
+    shard_zero1_state,
+    zero1_memory_footprint,
+    zero1_params,
+)
+from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+from distributed_machine_learning_tpu.train.step import (
+    make_train_step,
+    shard_batch,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    x = rng.integers(0, 256, (16, 32, 32, 3), dtype=np.uint8)
+    y = rng.integers(0, 10, 16).astype(np.int32)
+    return x, y
+
+
+@pytest.mark.parametrize("use_bn", [False, True])
+def test_zero1_matches_replicated_ring(data, use_bn):
+    """Two ZeRO-1 steps == two replicated ring (mean) steps: params track
+    bitwise-ish, momentum shards reassemble to the replicated buffers."""
+    x, y = data
+    model = VGG11(use_bn=use_bn)
+    mesh = make_mesh(8)
+    mx, my = shard_batch(mesh, x, y)
+
+    ref_step = make_train_step(
+        model, get_strategy("ring"), mesh=mesh, augment=False
+    )
+    ref = init_model_and_state(model)
+
+    z1, unravel, n_elems = shard_zero1_state(init_model_and_state(model), mesh)
+    z1_step = make_zero1_train_step(model, mesh, unravel, n_elems,
+                                    augment=False)
+
+    for _ in range(2):
+        ref, ref_loss = ref_step(ref, mx, my)
+        z1, z1_loss = z1_step(z1, mx, my)
+
+    np.testing.assert_allclose(float(z1_loss), float(ref_loss), rtol=1e-5)
+    got = zero1_params(z1, unravel, n_elems)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref.params), jax.tree_util.tree_leaves(got)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+    if use_bn:
+        for a, b in zip(
+            jax.tree_util.tree_leaves(ref.batch_stats),
+            jax.tree_util.tree_leaves(z1.batch_stats),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            )
+
+
+def test_zero1_momentum_is_sharded(data):
+    x, y = data
+    model = VGG11()
+    mesh = make_mesh(8)
+    z1, unravel, n_elems = shard_zero1_state(init_model_and_state(model), mesh)
+    # momentum: one shard per device; params: replicated everywhere
+    assert len(z1.momentum_shards.sharding.device_set) == 8
+    mom_shard = z1.momentum_shards.addressable_shards[0]
+    assert mom_shard.data.shape[0] * 8 == z1.momentum_shards.shape[0]
+    p_shard = z1.param_flat.addressable_shards[0]
+    assert p_shard.data.shape == z1.param_flat.shape  # replicated
+
+
+def test_zero1_memory_footprint():
+    fp = zero1_memory_footprint(1000, 8)
+    assert fp["replicated"] == 2 * 1000 * 4
+    assert fp["zero1"] == (1000 + 1000 // 8) * 4  # params + 1/8 momentum
+    assert fp["fsdp"] == 2 * (1000 // 8) * 4
+    assert fp["fsdp"] < fp["zero1"] < fp["replicated"]
