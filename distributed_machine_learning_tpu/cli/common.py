@@ -112,6 +112,20 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                              "engages, a clipped SUM equals a clipped "
                              "mean, cancelling the SUM strategies' "
                              "effective-LR scaling")
+    from distributed_machine_learning_tpu.train.optimizers import (
+        optimizer_names,
+    )
+
+    parser.add_argument("--optimizer", default="sgd", choices=optimizer_names(),
+                        help="'sgd' reproduces the reference "
+                             "(lr=0.1/momentum/wd — part1/main.py:120-121); "
+                             "'lars' adds layer-wise adaptive rate scaling "
+                             "for large global batches (train/lars.py)")
+    parser.add_argument("--wire-dtype", dest="wire_dtype", default=None,
+                        choices=["bfloat16"],
+                        help="compress ring all-reduce payloads to this "
+                             "dtype on the wire (part3 ring only; halves "
+                             "ring bytes for fp32 gradients)")
     parser.add_argument("--dist-eval", dest="dist_eval", action="store_true",
                         help="shard evaluation batches over the mesh "
                              "(pmean/psum reductions) instead of the "
@@ -221,7 +235,12 @@ def run_part(
         compute_dtype = jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
         model = get_model(args.model, use_bn=use_bn,
                           compute_dtype=compute_dtype)
-        state = init_model_and_state(model)
+        from distributed_machine_learning_tpu.train.optimizers import (
+            get_optimizer,
+        )
+
+        opt_config = get_optimizer(args.optimizer)[0]()
+        state = init_model_and_state(model, config=opt_config)
         if args.resume:
             from distributed_machine_learning_tpu.train.checkpoint import (
                 latest_checkpoint,
@@ -238,7 +257,45 @@ def run_part(
                 state = restore_checkpoint(latest, abstract_state=state)
                 rank0_print(f"Resumed from {latest} (step "
                             f"{int(jax.device_get(state.step))})")
-        strategy = get_strategy(strategy_name, **(strategy_kwargs or {}))
+                want = opt_config
+                if type(state.config) is not type(want):
+                    # The checkpoint records its optimizer config class;
+                    # SGD's (raw-gradient-scale) and LARS's
+                    # (lr·trust·ratio-scaled) momentum buffers are not
+                    # interchangeable, so switching optimizers at resume
+                    # resets them rather than misapplying them.
+                    rank0_print(
+                        f"WARNING: checkpoint was trained with "
+                        f"{type(state.config).__name__} but this run uses "
+                        f"--optimizer {args.optimizer}; resetting momentum "
+                        "buffers (params/step/stats are kept)."
+                    )
+                    state = state.replace(
+                        config=want,
+                        momentum=jax.tree_util.tree_map(
+                            jax.numpy.zeros_like, state.momentum
+                        ),
+                    )
+                if mesh is not None:
+                    # Restored arrays come back committed to the default
+                    # device; the distributed step needs them replicated
+                    # over the mesh (the shard_map's in_specs say P()) —
+                    # mixing a device-0-committed state with mesh-sharded
+                    # batches is a hard error, not just slow.
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    state = jax.device_put(
+                        state, NamedSharding(mesh, PartitionSpec())
+                    )
+        strategy_kwargs = dict(strategy_kwargs or {})
+        if args.wire_dtype and strategy_name == "ring":
+            strategy_kwargs["wire_dtype"] = args.wire_dtype
+        elif args.wire_dtype:
+            rank0_print(
+                "WARNING: --wire-dtype only applies to the ring strategy "
+                f"(part3); strategy {strategy_name!r} runs uncompressed."
+            )
+        strategy = get_strategy(strategy_name, **strategy_kwargs)
         train_step = make_train_step(
             model, strategy, mesh=mesh,
             schedule=make_schedule(
@@ -247,6 +304,7 @@ def run_part(
             ),
             clip_norm=args.clip_norm,
             accum_steps=args.grad_accum,
+            optimizer=args.optimizer,
         )
         eval_step = make_eval_step(model)
         if args.dist_eval and mesh is None:

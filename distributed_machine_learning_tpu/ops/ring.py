@@ -53,15 +53,30 @@ def ring_all_reduce_flat(
     axis_name: str,
     axis_size: int,
     mean: bool = False,
+    wire_dtype=None,
 ) -> jax.Array:
     """All-reduce a flat vector via an explicit ppermute ring.
 
     Must be called inside ``shard_map`` (or any context where ``axis_name``
     is bound).  ``axis_size`` is the static ring size (mesh axis length).
+
+    ``wire_dtype`` (e.g. ``jnp.bfloat16``): compress every hop's payload
+    to this dtype on the wire, upcasting before the fp32 accumulation —
+    the gradient-compression trick of the multi-hop compressed all-reduce
+    literature (see PAPERS.md): halves ring bytes for fp32 gradients at
+    the cost of quantizing each partial sum once per hop.  None = exact.
     """
     n = axis_size
     if n == 1:
         return x
+
+    def hop(v):
+        if wire_dtype is None:
+            return lax.ppermute(v, axis_name, perm)
+        return lax.ppermute(v.astype(wire_dtype), axis_name, perm).astype(
+            x.dtype
+        )
+
     orig_len = x.shape[0]
     chunk = -(-orig_len // n)  # ceil division
     padded = jnp.pad(x, (0, n * chunk - orig_len))
@@ -78,20 +93,26 @@ def ring_all_reduce_flat(
     for s in range(n - 1):
         send_row = (-s) % n
         recv_row = (-s - 1) % n
-        send = chunks[send_row]
-        recvd = lax.ppermute(send, axis_name, perm)
+        recvd = hop(chunks[send_row])
         chunks = chunks.at[recv_row].add(recvd)
     # Rank r now owns the full sum of global chunk (r+1) mod n == row 1.
     own = chunks[1 % n]
     if mean:
         own = own / n
+    if wire_dtype is not None:
+        # Quantize the completed chunk ONCE before phase 2, including the
+        # owner's own stored copy: receivers see bf16(own), so the owner
+        # must too, or ranks end the all-reduce with slightly different
+        # "synced" gradients and replicated params silently drift apart
+        # (further hops re-quantize the same values — idempotent).
+        own = own.astype(wire_dtype).astype(x.dtype)
 
     # Phase 2 — all-gather the completed chunks around the same ring.
     out = jnp.zeros_like(chunks)
     out = out.at[1 % n].set(own)
     cur = own
     for s in range(n - 1):
-        cur = lax.ppermute(cur, axis_name, perm)
+        cur = hop(cur)
         # After s+1 hops, the chunk arriving at rank r was completed by rank
         # (r − s − 1), i.e. global chunk (r − s) mod n == local row (−s) mod n.
         out = out.at[(-s) % n].set(cur)
@@ -106,26 +127,28 @@ def ring_all_reduce(
     axis_size: int,
     mean: bool = True,
     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+    wire_dtype=None,
 ) -> object:
     """Bucketed ring all-reduce over a gradient pytree.
 
     ``mean=True`` reproduces DDP's averaging (part3 semantics — SURVEY.md
     §2.4); ``mean=False`` gives the SUM semantics of parts 2a/2b.
+    ``wire_dtype``: optional on-the-wire compression (see
+    :func:`ring_all_reduce_flat`).
     """
     flat, unravel = ravel_pytree(grads)
-    if axis_size == 1:
+    if axis_size == 1 or flat.shape[0] == 0:
         return grads
     bucket_elems = max(1, int(bucket_bytes) // flat.dtype.itemsize)
     num_buckets = -(-flat.shape[0] // bucket_elems)
-    if num_buckets <= 1:
-        return unravel(ring_all_reduce_flat(flat, axis_name, axis_size, mean=mean))
     reduced = [
         ring_all_reduce_flat(
             flat[i * bucket_elems : min((i + 1) * bucket_elems, flat.shape[0])],
             axis_name,
             axis_size,
             mean=mean,
+            wire_dtype=wire_dtype,
         )
         for i in range(num_buckets)
     ]
-    return unravel(jnp.concatenate(reduced))
+    return unravel(reduced[0] if num_buckets == 1 else jnp.concatenate(reduced))

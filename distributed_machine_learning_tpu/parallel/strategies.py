@@ -86,19 +86,29 @@ class GatherScatter(SyncStrategy):
 
 @dataclass(frozen=True)
 class RingAllReduce(SyncStrategy):
-    """part3 north-star: bucketed explicit ppermute ring, DDP mean semantics."""
+    """part3 north-star: bucketed explicit ppermute ring, DDP mean semantics.
+
+    ``wire_dtype="bfloat16"`` compresses each hop's payload on the wire
+    (half the ring bytes for fp32 gradients — the compressed-all-reduce
+    technique from the retrieved literature, PAPERS.md); default exact.
+    """
 
     name = "ring"
     mean: bool = True
     bucket_bytes: int = DEFAULT_BUCKET_BYTES
+    wire_dtype: str | None = None
 
     def __call__(self, grads, axis_name: str, axis_size: int):
+        import jax.numpy as jnp
+
         return ring_all_reduce(
             grads,
             axis_name,
             axis_size,
             mean=self.mean,
             bucket_bytes=self.bucket_bytes,
+            wire_dtype=None if self.wire_dtype is None
+            else jnp.dtype(self.wire_dtype).type,
         )
 
 

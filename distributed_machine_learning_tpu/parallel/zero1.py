@@ -30,7 +30,6 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 from jax import lax
-from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_machine_learning_tpu.data.augment import augment_batch, normalize
@@ -164,8 +163,14 @@ def make_zero1_train_step(
 
 
 def zero1_memory_footprint(n_params: int, n_dev: int, bytes_per_elem: int = 4):
-    """Per-device param+momentum bytes: replicated vs ZeRO-1 vs ZeRO-3."""
+    """Per-device param+momentum bytes: replicated vs ZeRO-1 vs ZeRO-3.
+
+    ZeRO-1 counts the *padded* replicated vector — what
+    :func:`shard_zero1_state` actually materializes per device — plus the
+    1/N momentum shard (also padded, matching the momentum term of
+    ``fsdp_memory_footprint``).
+    """
     fp = fsdp_memory_footprint(n_params, n_dev, bytes_per_elem)
     padded = _padded_len(n_params, n_dev)
-    fp["zero1"] = (n_params + padded // n_dev) * bytes_per_elem
+    fp["zero1"] = (padded + padded // n_dev) * bytes_per_elem
     return fp

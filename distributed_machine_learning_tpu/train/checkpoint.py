@@ -24,7 +24,6 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
-from distributed_machine_learning_tpu.train.sgd import SGDConfig
 from distributed_machine_learning_tpu.train.state import TrainState
 
 _CONFIG_FILE = "sgd_config.json"
@@ -58,7 +57,14 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState) -> str:
                    force=True)
     if jax.process_index() == 0:
         with open(os.path.join(path, _CONFIG_FILE), "w") as f:
-            json.dump(dataclasses.asdict(state.config), f)
+            # Record the config class so restore rebuilds the right
+            # optimizer config (LARSConfig carries extra fields that
+            # SGDConfig(**...) would reject).
+            json.dump(
+                {"__class__": type(state.config).__name__,
+                 **dataclasses.asdict(state.config)},
+                f,
+            )
     return path
 
 
@@ -114,8 +120,14 @@ def restore_checkpoint(
             tree = ckptr.restore(os.path.join(path, _STATE_DIR), args=restore_args)
         else:
             tree = ckptr.restore(os.path.join(path, _STATE_DIR))
+    from distributed_machine_learning_tpu.train.optimizers import (
+        config_class_by_name,
+    )
+
     with open(os.path.join(path, _CONFIG_FILE)) as f:
-        config = SGDConfig(**json.load(f))
+        payload = json.load(f)
+    # "SGDConfig" default: checkpoints written before the class tag existed.
+    config = config_class_by_name(payload.pop("__class__", "SGDConfig"))(**payload)
     return TrainState(
         params=tree["params"],
         momentum=tree["momentum"],

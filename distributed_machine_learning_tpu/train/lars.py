@@ -1,0 +1,80 @@
+"""LARS — layer-wise adaptive rate scaling for large-batch SGD.
+
+The retrieved large-batch literature (PAPERS.md: "Extremely Large
+Minibatch SGD", "Massively Distributed SGD") scales data-parallel
+training to batch sizes where plain SGD+momentum diverges; the fix both
+lines of work rely on is LARS (You et al., "Large Batch Training of
+Convolutional Networks"): each layer's step is normalized by the ratio
+of its weight norm to its gradient norm, so no layer's update can run
+away from its weights no matter how the global batch (and with it the
+summed gradient) grows.
+
+Update rule (the apex/LARC convention, momentum on the scaled step):
+
+    scale = trust_coefficient · ||w|| / (||g|| + wd·||w|| + eps)
+            if both norms > 0, else 1   (zero-norm leaves — e.g.
+            zero-init biases at step 0 — take the PLAIN lr; trust
+            applies only to the adaptive ratio)
+    step  = lr · scale · (g + wd·w)
+    m     = momentum · m + step
+    w    -= m
+
+Drop-in companion to ``train/sgd.py``: same ``(params, momentum, grads,
+config, lr=None)`` signature, same zero-initialized momentum buffers, so
+``make_train_step(optimizer="lars")`` swaps it into the jitted step (and
+every sync strategy / schedule / clipping option composes unchanged).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from distributed_machine_learning_tpu.train.sgd import SGDConfig, apply_update
+
+
+@dataclass(frozen=True)
+class LARSConfig(SGDConfig):
+    # Reference-parity base hyperparams (part1/main.py:120-121) plus the
+    # LARS trust coefficient (paper's η, typically 1e-3).
+    trust_coefficient: float = 1e-3
+    eps: float = 1e-9
+
+
+def lars_update(params, momentum_buf, grads, config: LARSConfig, lr=None):
+    """One LARS step; returns (new_params, new_momentum_buf)."""
+    if not isinstance(config, LARSConfig):
+        # Fail loudly: a plain SGDConfig here means the state was built
+        # without config=LARSConfig() and the momentum semantics (raw-
+        # gradient scale vs lr·trust·ratio-scaled steps) would not match.
+        raise TypeError(
+            f"lars_update needs a LARSConfig on the TrainState, got "
+            f"{type(config).__name__}; build the state with "
+            "init_model_and_state(model, config=LARSConfig())"
+        )
+    lr = config.learning_rate if lr is None else lr
+    trust = config.trust_coefficient
+    eps = config.eps
+
+    def _update(p, m, g):
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(p32.reshape(-1))
+        g_norm = jnp.linalg.norm(g32.reshape(-1))
+        # The trust coefficient applies only to the adaptive ratio (the
+        # apex/LARC convention): zero-norm leaves (e.g. zero-init biases
+        # at step 0) fall back to the PLAIN lr — multiplying trust into
+        # the fallback would freeze them ~1/trust-fold vs SGD.
+        scale = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            trust * w_norm / (g_norm + config.weight_decay * w_norm + eps),
+            1.0,
+        )
+        step = lr * scale * (g32 + config.weight_decay * p32)
+        m = config.momentum * m + step.astype(m.dtype)
+        p = p - m.astype(p.dtype)
+        return p, m
+
+    return apply_update(_update, params, momentum_buf, grads)

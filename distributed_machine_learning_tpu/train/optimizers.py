@@ -1,0 +1,42 @@
+"""Optimizer registry: one table mapping name → (config class, update fn).
+
+Single source of truth consumed by the train-step builder
+(``train/step.py``), the CLI (``cli/common.py`` — flag choices and config
+construction), and checkpoint restore (``train/checkpoint.py`` — config
+class by saved name), so adding an optimizer is one entry here instead of
+four coordinated edits.
+"""
+
+from __future__ import annotations
+
+from distributed_machine_learning_tpu.train.lars import LARSConfig, lars_update
+from distributed_machine_learning_tpu.train.sgd import SGDConfig, sgd_update
+
+OPTIMIZERS = {
+    "sgd": (SGDConfig, sgd_update),
+    "lars": (LARSConfig, lars_update),
+}
+
+
+def optimizer_names() -> list[str]:
+    return sorted(OPTIMIZERS)
+
+
+def get_optimizer(name: str):
+    """(config_class, update_fn) for ``name``; raises on unknown names."""
+    try:
+        return OPTIMIZERS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown optimizer {name!r}; choose from {optimizer_names()}"
+        ) from None
+
+
+def config_class_by_name(class_name: str):
+    """Config class by its __name__ (checkpoint restore)."""
+    for cfg_cls, _ in OPTIMIZERS.values():
+        if cfg_cls.__name__ == class_name:
+            return cfg_cls
+    raise ValueError(
+        f"unknown optimizer config class in checkpoint: {class_name!r}"
+    )

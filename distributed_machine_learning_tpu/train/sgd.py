@@ -41,6 +41,16 @@ def sgd_init(params):
     return jax.tree_util.tree_map(jnp.zeros_like, params)
 
 
+def apply_update(update, params, momentum_buf, grads):
+    """Map a per-leaf ``(p, m, g) -> (new_p, new_m)`` rule over the trees
+    and unzip the pairs — shared by every optimizer (sgd, lars)."""
+    flat = jax.tree_util.tree_map(update, params, momentum_buf, grads)
+    is_pair = lambda x: isinstance(x, tuple)
+    new_params = jax.tree_util.tree_map(lambda pm: pm[0], flat, is_leaf=is_pair)
+    new_momentum = jax.tree_util.tree_map(lambda pm: pm[1], flat, is_leaf=is_pair)
+    return new_params, new_momentum
+
+
 def sgd_update(params, momentum_buf, grads, config: SGDConfig, lr=None):
     """One SGD step; returns (new_params, new_momentum_buf).
 
@@ -56,11 +66,4 @@ def sgd_update(params, momentum_buf, grads, config: SGDConfig, lr=None):
         p = p - lr * m
         return p, m
 
-    flat = jax.tree_util.tree_map(_update, params, momentum_buf, grads)
-    new_params = jax.tree_util.tree_map(
-        lambda pm: pm[0], flat, is_leaf=lambda x: isinstance(x, tuple)
-    )
-    new_momentum = jax.tree_util.tree_map(
-        lambda pm: pm[1], flat, is_leaf=lambda x: isinstance(x, tuple)
-    )
-    return new_params, new_momentum
+    return apply_update(_update, params, momentum_buf, grads)

@@ -51,6 +51,7 @@ def _train_step_impl(
     schedule=None,
     clip_norm: float | None = None,
     accum_steps: int = 1,
+    update_fn=sgd_update,
 ):
     rng = step_rng(state.rng, state.step, axis_name)
     if accum_steps == 1:
@@ -117,7 +118,7 @@ def _train_step_impl(
         )
 
         grads = clip_by_global_norm(grads, clip_norm)
-    new_params, new_momentum = sgd_update(
+    new_params, new_momentum = update_fn(
         state.params,
         state.momentum,
         grads,
@@ -148,6 +149,7 @@ def make_train_step(
     clip_norm: float | None = None,
     accum_steps: int = 1,
     jit: bool = True,
+    optimizer: str = "sgd",
 ):
     """Build the jitted train step.
 
@@ -162,6 +164,10 @@ def make_train_step(
     (identical update for BN-free models, accum-fold lower activation
     memory).
 
+    ``optimizer``: "sgd" (reference parity — train/sgd.py) or "lars"
+    (layer-wise adaptive rate scaling for large global batches —
+    train/lars.py; pair with an LARSConfig on the TrainState).
+
     ``jit=False`` returns the un-jitted step function (no donation) — for
     callers that embed the step in a larger compiled program, e.g. the
     benchmark's ``lax.scan``-ed epoch (bench.py) where per-step dispatch
@@ -171,6 +177,9 @@ def make_train_step(
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    from distributed_machine_learning_tpu.train.optimizers import get_optimizer
+
+    _, update_fn = get_optimizer(optimizer)
     strategy = strategy or NoSync()
     if mesh is not None and isinstance(strategy, NoSync):
         # Unsynced gradients under a replicated-state shard_map would let
@@ -194,6 +203,7 @@ def make_train_step(
             schedule=schedule,
             clip_norm=clip_norm,
             accum_steps=accum_steps,
+            update_fn=update_fn,
         )
         return jax.jit(impl, donate_argnums=(0,)) if jit else impl
 
@@ -220,6 +230,7 @@ def make_train_step(
         schedule=schedule,
         clip_norm=clip_norm,
         accum_steps=accum_steps,
+        update_fn=update_fn,
     )
     state_spec = P()  # replicated
     batch_spec = P(axis_name)  # sharded along the data axis

@@ -59,6 +59,12 @@ def test_zero1_matches_replicated_ring(data, use_bn):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
         )
+    # momentum shards reassemble to the replicated baseline's buffers
+    from jax.flatten_util import ravel_pytree
+
+    ref_mom = np.asarray(ravel_pytree(ref.momentum)[0])
+    z1_mom = np.asarray(z1.momentum_shards)[: ref_mom.shape[0]]
+    np.testing.assert_allclose(z1_mom, ref_mom, rtol=1e-4, atol=1e-6)
     if use_bn:
         for a, b in zip(
             jax.tree_util.tree_leaves(ref.batch_stats),
