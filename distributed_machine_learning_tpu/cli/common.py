@@ -120,7 +120,9 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                         help="'sgd' reproduces the reference "
                              "(lr=0.1/momentum/wd — part1/main.py:120-121); "
                              "'lars' adds layer-wise adaptive rate scaling "
-                             "for large global batches (train/lars.py)")
+                             "for large global batches (train/lars.py); "
+                             "'adamw' is the decoupled-decay Adam "
+                             "(train/adamw.py)")
     parser.add_argument("--wire-dtype", dest="wire_dtype", default=None,
                         choices=["bfloat16"],
                         help="compress ring all-reduce payloads to this "
@@ -131,6 +133,15 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                              "(pmean/psum reductions) instead of the "
                              "reference's every-rank-evaluates-everything "
                              "protocol; identical results, N-fold faster")
+    parser.add_argument("--watchdog-timeout", dest="watchdog_timeout",
+                        default=0, type=float,
+                        help="seconds without a completed step before the "
+                             "watchdog (runtime/resilience.py) declares a "
+                             "stall and dumps thread stacks — detects hung "
+                             "collectives (a dead peer leaves the reference "
+                             "blocked forever, SURVEY.md §5); 0 disables. "
+                             "Set it above the first step's XLA compile "
+                             "time (~20-40s cold)")
     parser.add_argument("--grad-accum", dest="grad_accum", default=1, type=int,
                         help="split each per-device batch into this many "
                              "sequential microbatches, accumulating "
@@ -222,6 +233,8 @@ def run_part(
 
     metrics = MetricsLogger() if args.metrics_file else None
     ctx = initialize_from_flags(args.master_ip, args.rank, args.num_nodes)
+    preemption = None
+    watchdog = None
     try:
         distributed = strategy_name != "none"
         mesh = make_mesh() if distributed else None
@@ -243,6 +256,7 @@ def run_part(
         state = init_model_and_state(model, config=opt_config)
         if args.resume:
             from distributed_machine_learning_tpu.train.checkpoint import (
+                checkpoint_config,
                 latest_checkpoint,
                 restore_checkpoint,
             )
@@ -254,7 +268,16 @@ def run_part(
                 rank0_print(f"No checkpoint under {args.ckpt_dir}; "
                             "starting from scratch.")
             else:
-                state = restore_checkpoint(latest, abstract_state=state)
+                # The restore template must use the *saved* momentum
+                # layout (AdamW's {"mu","nu"} dict vs SGD's buffer tree);
+                # a cross-optimizer resume rebuilds it below.
+                saved_cfg = checkpoint_config(latest)
+                abstract = (
+                    state
+                    if type(saved_cfg) is type(opt_config)
+                    else init_model_and_state(model, config=saved_cfg)
+                )
+                state = restore_checkpoint(latest, abstract_state=abstract)
                 rank0_print(f"Resumed from {latest} (step "
                             f"{int(jax.device_get(state.step))})")
                 want = opt_config
@@ -270,11 +293,16 @@ def run_part(
                         f"--optimizer {args.optimizer}; resetting momentum "
                         "buffers (params/step/stats are kept)."
                     )
+                    from distributed_machine_learning_tpu.train.optimizers import (
+                        init_for_config,
+                    )
+
                     state = state.replace(
                         config=want,
-                        momentum=jax.tree_util.tree_map(
-                            jax.numpy.zeros_like, state.momentum
-                        ),
+                        # Fresh buffers in the NEW optimizer's layout —
+                        # zeroing the old tree would hand e.g. an SGD
+                        # buffer tree to AdamW's {"mu","nu"} update.
+                        momentum=init_for_config(want)(state.params),
                     )
                 if mesh is not None:
                     # Restored arrays come back committed to the default
@@ -357,7 +385,17 @@ def run_part(
                 )
 
         place = (lambda i, l: shard_batch(mesh, i, l)) if mesh is not None else None
+        from distributed_machine_learning_tpu.runtime.resilience import (
+            PreemptionHandler,
+            Watchdog,
+        )
+
+        preemption = PreemptionHandler().install()
+        if args.watchdog_timeout:
+            watchdog = Watchdog(timeout_s=args.watchdog_timeout).start()
         for _ in range(args.epochs):
+            if preemption.requested:
+                break
             if distributed:
                 batches = dist_loader_cls(train_set, per_rank_batch, world)
             else:
@@ -366,13 +404,21 @@ def run_part(
                 state, _ = train_epoch(
                     train_step, state, batches, place_batch=place,
                     max_iters=args.max_iters, metrics=metrics,
+                    stop=preemption, watchdog=watchdog,
                 )
-            eval_batches = BatchLoader(test_set, EVAL_BATCH)
-            if args.eval_batches is not None:
-                import itertools
+            if not preemption.requested:
+                eval_batches = BatchLoader(test_set, EVAL_BATCH)
+                if args.eval_batches is not None:
+                    import itertools
 
-                eval_batches = itertools.islice(iter(eval_batches), args.eval_batches)
-            evaluate(eval_step, state, eval_batches)
+                    eval_batches = itertools.islice(
+                        iter(eval_batches), args.eval_batches
+                    )
+                evaluate(eval_step, state, eval_batches)
+                if watchdog is not None:
+                    # Eval/checkpoint time is not step time — don't let a
+                    # long eval read as a hung collective.
+                    watchdog.beat()
             if args.ckpt_dir:
                 from distributed_machine_learning_tpu.train.checkpoint import (
                     save_checkpoint,
@@ -380,9 +426,24 @@ def run_part(
 
                 path = save_checkpoint(args.ckpt_dir, state)
                 rank0_print(f"Saved checkpoint to {path}")
+                if watchdog is not None:
+                    watchdog.beat()
+            if preemption.requested:
+                rank0_print(
+                    "preemption checkpoint complete; exiting cleanly "
+                    "(resume with --resume)"
+                    if args.ckpt_dir
+                    else "stop requested; exiting (no --ckpt-dir, so no "
+                         "checkpoint was written)"
+                )
+                break
     finally:
         # Flush in finally so a crash/interrupt mid-run keeps the rows
         # already logged — the feature's main use is diagnosing bad runs.
+        if watchdog is not None:
+            watchdog.stop()
+        if preemption is not None:
+            preemption.uninstall()
         if metrics is not None:
             metrics.save(args.metrics_file)
             rank0_print(

@@ -69,6 +69,15 @@ def make_parser():
                    help="model-axis size for --parallel 3d")
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    from distributed_machine_learning_tpu.train.optimizers import (
+        optimizer_names,
+    )
+
+    p.add_argument("--optimizer", default="adamw", choices=optimizer_names(),
+                   help="LM default is adamw (train/adamw.py); sgd gives "
+                        "the reference's torch-semantics update")
+    p.add_argument("--lr", default=None, type=float,
+                   help="override the optimizer config's learning rate")
     return p
 
 
@@ -88,6 +97,10 @@ def build(args):
         vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
         n_heads=args.n_heads, compute_dtype=dtype,
     )
+    from distributed_machine_learning_tpu.train.optimizers import get_optimizer
+
+    cfg_cls = get_optimizer(args.optimizer)[0]
+    opt_config = cfg_cls() if args.lr is None else cfg_cls(learning_rate=args.lr)
 
     if args.parallel in ("dp", "ring", "ulysses"):
         from distributed_machine_learning_tpu.train.lm_step import (
@@ -113,7 +126,7 @@ def build(args):
                 )
             mesh = make_mesh(n, ("batch", "seq"), (1, n))
             model = TransformerLM(attn_impl=args.parallel, **common)
-        state = init_lm_state(model, seed=SEED)
+        state = init_lm_state(model, seed=SEED, config=opt_config)
         step = make_lm_train_step(model, mesh=mesh)
         place = lambda x, y: shard_lm_batch(mesh, x, y)
         return step, state, place
@@ -131,7 +144,7 @@ def build(args):
         # Build the step first: its validation (n_heads % model-axis size)
         # gives a clear error before any state is placed.
         step = make_tp_lm_train_step(model, mesh)
-        state = shard_tp_state(init_lm_state(model, seed=SEED), mesh)
+        state = shard_tp_state(init_lm_state(model, seed=SEED, config=opt_config), mesh)
         place = lambda x, y: shard_tp_batch(mesh, x, y)
         return step, state, place
 
@@ -146,7 +159,7 @@ def build(args):
         mesh = make_mesh(n, ("pipe",))
         model = TransformerLM(**common)
         step = make_pp_lm_train_step(model, mesh, args.microbatches)
-        state = shard_pp_state(init_pipeline_state(model, seed=SEED), mesh)
+        state = shard_pp_state(init_pipeline_state(model, seed=SEED, config=opt_config), mesh)
         place = lambda x, y: microbatch(x, y, args.microbatches)
         return step, state, place
 
@@ -176,7 +189,7 @@ def build(args):
     mesh = make_3d_mesh(dp, args.pp, args.tp)
     model = TransformerLM(**common)
     step = make_3d_lm_train_step(model, mesh, args.microbatches)
-    state = shard_3d_state(init_pipeline_state(model, seed=SEED), mesh)
+    state = shard_3d_state(init_pipeline_state(model, seed=SEED, config=opt_config), mesh)
     place = lambda x, y: shard_3d_batch(mesh, *microbatch(x, y, args.microbatches))
     return step, state, place
 

@@ -1,0 +1,6 @@
+from distributed_machine_learning_tpu.inference.generate import (
+    generate,
+    make_generate_fn,
+)
+
+__all__ = ["generate", "make_generate_fn"]

@@ -1,0 +1,121 @@
+"""Autoregressive generation with a KV cache.
+
+The reference has no inference path at all (its ``test_model`` is
+classification eval — ``part1/main.py:62-77``); this module is the LM
+serving half this framework adds: prefill the prompt once, then decode
+one token per step against per-layer K/V caches
+(``models/transformer.py`` ``decode=True``), the whole loop a single
+jitted program (`lax.scan`) — no per-token Python dispatch, which on a
+remote/tunneled TPU would cost more than the step itself (same argument
+as bench.py's scanned epoch).
+
+TPU notes: the decode step is memory-bound (matvec against the cache),
+so the cache stays in the model's compute dtype (bf16 halves HBM
+traffic); sampling math is fp32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _sample(logits, rng, temperature: float, top_k: int | None):
+    """One sampling decision per batch row.  [B, V] fp32 → [B] int32."""
+    logits = logits.astype(jnp.float32)
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if temperature == 0.0:  # greedy (static: part of the compiled program)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(rng, logits / temperature, axis=-1).astype(
+        jnp.int32
+    )
+
+
+def make_generate_fn(
+    model,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+):
+    """Build a jitted ``fn(params, prompt, rng) -> tokens``.
+
+    ``prompt``: [B, Lp] int32; returns [B, Lp + max_new_tokens] with the
+    prompt preserved as a prefix.  ``temperature=0`` is greedy decoding
+    (``rng`` unused); ``top_k`` restricts sampling to the k highest
+    logits.  The model is cloned to dense cached attention — parameters
+    from any training-time ``attn_impl`` (ring/ulysses/flash share the
+    exact same parameter structure) drop in unchanged.
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    dm = model.clone(attn_impl="dense", decode=True)
+    sample = partial(_sample, temperature=temperature, top_k=top_k)
+
+    @jax.jit
+    def run(params, prompt, rng):
+        B, Lp = prompt.shape
+        max_len = Lp + max_new_tokens
+        # Cache layout via eval_shape (no FLOPs): init in decode mode with
+        # a [B, max_len] input sizes every layer's K/V cache.
+        shapes = jax.eval_shape(
+            lambda: dm.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((B, max_len), jnp.int32),
+                train=False,
+            )
+        )["cache"]
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+        )
+
+        # Prefill: one pass over the whole prompt fills slots [0, Lp).
+        logits, vars_ = dm.apply(
+            {"params": params, "cache": cache}, prompt, train=False,
+            mutable=["cache"],
+        )
+        rng, r = jax.random.split(rng)
+        tok = sample(logits[:, -1], r)  # first generated token
+
+        def body(carry, _):
+            cache, tok, rng = carry
+            logits, vars_ = dm.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                train=False, mutable=["cache"],
+            )
+            rng, r = jax.random.split(rng)
+            nxt = sample(logits[:, -1], r)
+            return (vars_["cache"], nxt, rng), tok
+
+        (_, last, _), toks = lax.scan(
+            body, (vars_["cache"], tok, rng), None, length=max_new_tokens - 1
+        )
+        # toks: [max_new-1, B] tokens 1..max_new-1; `last` is the final one.
+        gen = jnp.concatenate([toks, last[None]], axis=0).swapaxes(0, 1)
+        return jnp.concatenate([prompt, gen], axis=1)
+
+    return run
+
+
+def generate(
+    model,
+    params,
+    prompt,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    rng=None,
+):
+    """One-shot convenience wrapper around :func:`make_generate_fn`.
+
+    For repeated generation at fixed shapes, build the fn once instead —
+    this wrapper retraces on every call.
+    """
+    fn = make_generate_fn(model, max_new_tokens, temperature, top_k)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return fn(params, jnp.asarray(prompt, jnp.int32), rng)

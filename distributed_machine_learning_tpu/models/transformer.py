@@ -46,6 +46,33 @@ def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0):
     return rotated.astype(x.dtype)
 
 
+def _cached_attention(q, k_cache, v_cache, q_positions):
+    """Attention of fresh queries against the full K/V cache.
+
+    ``q``: [B, Lq, H, D] at absolute positions ``q_positions`` ([Lq]);
+    ``k_cache``/``v_cache``: [B, S, H, D] where slot j holds position j
+    (zeros beyond the write frontier — masked out by causality, since
+    unwritten slots all have j > max(q_positions)).  fp32 softmax, dtype
+    preserved — matching :func:`dense_self_attention`.
+    """
+    B, Lq, H, D = q.shape
+    S = k_cache.shape[1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32),
+        k_cache.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * (1.0 / (D**0.5))
+    mask = jnp.arange(S)[None, :] <= q_positions[:, None]  # [Lq, S]
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v_cache.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
 class Attention(nn.Module):
     """Multi-head causal self-attention.
 
@@ -53,12 +80,19 @@ class Attention(nn.Module):
     over ``seq_axis`` — ``ops/ring_attention.py``), "ulysses" (sequence
     sharded via all-to-all head re-sharding — ``ops/ulysses.py``), or
     "flash" (the Pallas kernel — ``ops/pallas/flash_attention.py``).
+
+    ``decode=True`` switches to KV-cached autoregressive inference: K/V
+    land in a ``"cache"`` variable collection sized by the init-time
+    input length, and each apply attends its (short) input against the
+    whole cache — the O(1)-per-token decode path behind
+    ``inference/generate.py``.
     """
 
     n_heads: int
     attn_impl: str = "dense"  # "dense" | "ring" | "ulysses" | "flash"
     seq_axis: str = "seq"
     compute_dtype: Any = jnp.float32
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -74,7 +108,21 @@ class Attention(nn.Module):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, L, H, Dh]
         q = apply_rope(q, positions)
         k = apply_rope(k, positions)
-        if self.attn_impl == "ring":
+        if self.decode:
+            # Cache shape fixes the max sequence length at init time
+            # (init runs with a [B, max_len] input — generate.py).  Keys
+            # are RoPE-rotated at their absolute position before being
+            # written, so cached entries never need re-rotation.
+            ck = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            if not self.is_initializing():
+                start = positions[0]
+                ck.value = lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
+                cv.value = lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
+                out = _cached_attention(q, ck.value, cv.value, positions)
+            else:
+                out = dense_self_attention(q, k, v, positions)
+        elif self.attn_impl == "ring":
             out = ring_self_attention(
                 q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
             )
@@ -110,6 +158,7 @@ class Block(nn.Module):
     seq_axis: str
     compute_dtype: Any
     mlp_factory: Any = None  # () -> nn.Module, or None for the dense MLP
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -119,6 +168,7 @@ class Block(nn.Module):
             attn_impl=self.attn_impl,
             seq_axis=self.seq_axis,
             compute_dtype=self.compute_dtype,
+            decode=self.decode,
             name="attn",
         )(h, positions)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
@@ -148,16 +198,33 @@ class TransformerLM(nn.Module):
     attn_impl: str = "dense"
     seq_axis: str = "seq"
     compute_dtype: Any = jnp.float32
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
         del train  # no dropout/BN — kept for the shared train-step interface
         B, L = tokens.shape
-        if self.attn_impl in ("ring", "ulysses"):
-            offset = lax.axis_index(self.seq_axis) * L
+        if self.decode:
+            if self.attn_impl != "dense":
+                raise ValueError(
+                    "decode mode runs dense cached attention; clone the "
+                    'model with attn_impl="dense" (generate.py does this)'
+                )
+            # Autoregressive position tracking: one counter for the whole
+            # stack (every layer sees the same absolute positions).
+            idx = self.variable(
+                "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+            )
+            start = idx.value
+            positions = start + jnp.arange(L)
+            if not self.is_initializing():
+                idx.value = start + L
         else:
-            offset = 0
-        positions = offset + jnp.arange(L)
+            if self.attn_impl in ("ring", "ulysses"):
+                offset = lax.axis_index(self.seq_axis) * L
+            else:
+                offset = 0
+            positions = offset + jnp.arange(L)
         x = nn.Embed(
             self.vocab_size, self.d_model, dtype=self.compute_dtype, name="embed"
         )(tokens)
@@ -169,6 +236,7 @@ class TransformerLM(nn.Module):
                 attn_impl=self.attn_impl,
                 seq_axis=self.seq_axis,
                 compute_dtype=self.compute_dtype,
+                decode=self.decode,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)

@@ -24,7 +24,7 @@ from distributed_machine_learning_tpu.parallel.gspmd import (
     state_shardings,
 )
 from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
-from distributed_machine_learning_tpu.train.sgd import sgd_update
+from distributed_machine_learning_tpu.train.optimizers import update_fn_for_config
 from distributed_machine_learning_tpu.train.state import TrainState
 
 EXPERT_AXIS = "expert"
@@ -63,8 +63,8 @@ def _moe_step_impl(model: MoETransformerLM, state: TrainState, tokens, targets):
         return ce + model.aux_loss_weight * aux, ce
 
     (loss, ce), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
-    new_params, new_momentum = sgd_update(
-        state.params, state.momentum, grads, state.config
+    new_params, new_momentum = update_fn_for_config(state.config)(
+        state.params, state.momentum, grads, state.config, step=state.step
     )
     new_state = state.replace(
         params=new_params, momentum=new_momentum, step=state.step + 1

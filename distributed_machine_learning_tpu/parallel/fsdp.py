@@ -118,6 +118,14 @@ def shard_fsdp_state(
     unpadded parameter count — both needed by
     :func:`make_fsdp_train_step` and by checkpoint export.
     """
+    if type(state.config) is not SGDConfig:
+        # The flat-shard layout slices the parameter vector arbitrarily:
+        # elementwise SGD is exact on any slice, but LARS (per-layer
+        # norms) and AdamW (a {"mu","nu"} moment layout) are not.
+        raise ValueError(
+            "ZeRO-3/FSDP supports plain SGD momentum only; got "
+            f"{type(state.config).__name__}"
+        )
     flat, mom_flat, unravel, n_elems = flatten_padded(
         state, mesh.shape[axis_name]
     )

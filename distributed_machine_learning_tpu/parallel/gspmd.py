@@ -32,12 +32,20 @@ def param_specs(params, spec_for: SpecFor):
 
 def state_shardings(state: TrainState, mesh: Mesh, spec_for: SpecFor) -> TrainState:
     """NamedSharding pytree for a TrainState: params and momentum follow
-    the rule table, everything else replicates."""
+    the rule table, everything else replicates.
+
+    The momentum slot is either params-shaped (SGD/LARS) or a dict of
+    params-shaped trees (AdamW's ``{"mu","nu"}`` — train/adamw.py);
+    each moment tree inherits its parameter's spec."""
+    from distributed_machine_learning_tpu.train.optimizers import moment_layout
+
     specs = param_specs(state.params, spec_for)
     to_sharding = lambda s: NamedSharding(mesh, s)
+    spec_shardings = jax.tree_util.tree_map(to_sharding, specs)
+    mom_shardings = moment_layout(spec_shardings, state.params, state.momentum)
     return TrainState(
-        params=jax.tree_util.tree_map(to_sharding, specs),
-        momentum=jax.tree_util.tree_map(to_sharding, specs),
+        params=spec_shardings,
+        momentum=mom_shardings,
         batch_stats=jax.tree_util.tree_map(
             lambda _: to_sharding(P()), state.batch_stats
         ),

@@ -112,11 +112,15 @@ def _state_shardings_3d(state: TrainState, mesh: Mesh) -> TrainState:
         keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
         return NamedSharding(mesh, p3_param_spec(keys, leaf.ndim))
 
+    from distributed_machine_learning_tpu.train.optimizers import (
+        moment_layout as _moment_layout,
+    )
+
     param_shardings = jax.tree_util.tree_map_with_path(spec, state.params)
     replicated = NamedSharding(mesh, P())
     return TrainState(
         params=param_shardings,
-        momentum=param_shardings,
+        momentum=_moment_layout(param_shardings, state.params, state.momentum),
         batch_stats=jax.tree_util.tree_map(lambda _: replicated, state.batch_stats),
         step=replicated,
         rng=replicated,
@@ -199,7 +203,8 @@ def make_3d_lm_train_step(
             # in_specs constrain the MANUAL axis only (blocks stacked dim
             # over pipe — pipeline.py's specs, reused); batch/model
             # shardings enter through in_shardings and propagate via GSPMD.
-            pipe_spec = _state_specs(PIPE_AXIS, state.params)
+            pipe_spec = _state_specs(PIPE_AXIS, state.params,
+                                     state.momentum)
             pipe_spec = pipe_spec.replace(config=state.config)
             shardings = _state_shardings_3d(state, mesh)
             fn = jitted[key] = jax.jit(

@@ -35,7 +35,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_machine_learning_tpu.models.transformer import Block, TransformerLM
 from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
-from distributed_machine_learning_tpu.train.sgd import sgd_update
+from distributed_machine_learning_tpu.train.optimizers import (
+    moment_layout as _moment_layout,
+    update_fn_for_config,
+)
 from distributed_machine_learning_tpu.train.state import TrainState
 from distributed_machine_learning_tpu.runtime.mesh import (
     shard_map_no_check as _shard_map,
@@ -81,11 +84,13 @@ def unstack_lm_params(pipeline_params: dict, n_layers: int) -> dict:
     return out
 
 
-def init_pipeline_state(model: TransformerLM, seed: int = 69143) -> TrainState:
-    """Initialize TransformerLM params (dense path) and restack them."""
+def init_pipeline_state(model: TransformerLM, seed: int = 69143,
+                        config=None) -> TrainState:
+    """Initialize TransformerLM params (dense path) and restack them.
+    ``config``: optional optimizer config (as in ``init_lm_state``)."""
     from distributed_machine_learning_tpu.train.lm_step import init_lm_state
 
-    state = init_lm_state(model, seed=seed)
+    state = init_lm_state(model, seed=seed, config=config)
     return TrainState.create(
         params=stack_lm_params(state.params, model.n_layers),
         rng=state.rng,
@@ -180,6 +185,19 @@ def _pipeline_forward_loss(
 def _pp_step_impl(
     model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis, num_stages
 ):
+    from distributed_machine_learning_tpu.train.lars import LARSConfig
+
+    if type(state.config) is LARSConfig:
+        # Inside this shard_map each device's "blocks" leaves are only its
+        # stage's slice, so LARS's per-leaf norms would be stage-local and
+        # the trust ratios would change with the stage count — the same
+        # flat-slice inexactness ZeRO-1/FSDP refuse (zero1.py / fsdp.py).
+        raise ValueError(
+            "LARS is not supported under pipeline/3-D parallelism: "
+            "per-leaf weight/grad norms would be computed on per-stage "
+            "slices; use sgd or adamw (elementwise updates are exact on "
+            "any slice)"
+        )
     loss_fn = partial(
         _pipeline_forward_loss,
         model,
@@ -198,8 +216,8 @@ def _pp_step_impl(
         grads[name] = jax.tree_util.tree_map(
             lambda g: lax.psum(g, pipe_axis), grads[name]
         )
-    new_params, new_momentum = sgd_update(
-        state.params, state.momentum, grads, state.config
+    new_params, new_momentum = update_fn_for_config(state.config)(
+        state.params, state.momentum, grads, state.config, step=state.step
     )
     new_state = state.replace(
         params=new_params, momentum=new_momentum, step=state.step + 1
@@ -207,7 +225,9 @@ def _pp_step_impl(
     return new_state, loss
 
 
-def _state_specs(pipe_axis: str, params_example: dict) -> TrainState:
+def _state_specs(
+    pipe_axis: str, params_example: dict, momentum_example=None
+) -> TrainState:
     """shard_map PartitionSpec pytree for a pipeline TrainState."""
 
     def param_spec(tree, stacked: bool):
@@ -224,9 +244,10 @@ def _state_specs(pipe_axis: str, params_example: dict) -> TrainState:
             "lm_head": param_spec(params["lm_head"], False),
         }
 
+    p_specs = specs(params_example)
     return TrainState(
-        params=specs(params_example),
-        momentum=specs(params_example),
+        params=p_specs,
+        momentum=_moment_layout(p_specs, params_example, momentum_example),
         batch_stats={},
         step=P(),
         rng=P(),
@@ -275,7 +296,8 @@ def make_pp_lm_train_step(
         key = jax.tree_util.tree_structure(state)
         fn = jitted.get(key)
         if fn is None:
-            state_spec = _state_specs(pipe_axis, state.params)
+            state_spec = _state_specs(pipe_axis, state.params,
+                                      state.momentum)
             state_spec = state_spec.replace(config=state.config)
             fn = jitted[key] = jax.jit(
                 _shard_map(
@@ -296,7 +318,7 @@ def shard_pp_state(
 ) -> TrainState:
     """Place a pipeline TrainState: blocks sharded over stages, rest
     replicated."""
-    spec_state = _state_specs(pipe_axis, state.params)
+    spec_state = _state_specs(pipe_axis, state.params, state.momentum)
     spec_state = spec_state.replace(config=state.config)
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, spec_state

@@ -66,6 +66,14 @@ def shard_zero1_state(state: TrainState, mesh: Mesh, axis_name: str = BATCH_AXIS
     Returns ``(zero1_state, unravel, n_elems)`` — ``unravel`` maps the
     unpadded flat vector back to the params pytree.
     """
+    if type(state.config) is not SGDConfig:
+        # The flat-shard layout slices the parameter vector arbitrarily:
+        # elementwise SGD is exact on any slice, but LARS (per-layer
+        # norms) and AdamW (a {"mu","nu"} moment layout) are not.
+        raise ValueError(
+            "ZeRO-1 supports plain SGD momentum only; got "
+            f"{type(state.config).__name__}"
+        )
     flat, mom_flat, unravel, n_elems = flatten_padded(
         state, mesh.shape[axis_name]
     )

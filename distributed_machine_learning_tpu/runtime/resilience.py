@@ -1,0 +1,186 @@
+"""Failure detection + preemption-safe shutdown.
+
+The reference has neither (SURVEY.md §5): a crashed rank leaves the
+other three blocked inside a synchronous gloo collective forever, and
+the only cleanup is ``dist.destroy_process_group()`` on the happy path
+(``part2/2a/main.py:207``).  On TPU pods the equivalent failure modes
+are a hung ICI/DCN collective (peer died) and *preemption* — the
+scheduler SIGTERMs the job and reclaims the slice.  This module is the
+framework's answer to both:
+
+- :class:`Watchdog` — a daemon thread fed one ``beat()`` per completed
+  step.  If no step lands within ``timeout_s`` it declares a stall,
+  dumps every Python thread's stack (so the operator sees *which*
+  collective is stuck), and invokes ``on_stall`` — by default a loud
+  report; pass ``exit_code`` to make it terminate the process instead,
+  the "fail fast so the supervisor restarts from the latest checkpoint"
+  policy every production trainer settles on.
+- :class:`PreemptionHandler` — installs signal handlers (SIGTERM, and
+  the platform's advance-warning signal if any) that set a flag the
+  training loop polls at step boundaries (``train_epoch(stop=...)``);
+  the runner then writes a final checkpoint and exits cleanly, so a
+  preempted run resumes exactly where it stopped (``--resume``).
+
+Both are host-side Python: they watch the XLA program from outside and
+never touch the compiled step, so they cost nothing on the device.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Callable
+
+
+class Watchdog:
+    """Detects a stalled training step (hung collective, dead peer).
+
+    Usage::
+
+        wd = Watchdog(timeout_s=300)
+        wd.start()
+        ...
+        wd.beat()   # once per completed step
+        ...
+        wd.stop()
+
+    or as a context manager.  ``on_stall(elapsed_s)`` runs in the
+    watchdog thread on the first stall; the default prints a report and
+    dumps all thread stacks.  ``exit_code``: if not None, the process
+    exits with this code after ``on_stall`` — turning a silent hang
+    into a fast, restartable failure.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_stall: Callable[[float], None] | None = None,
+        exit_code: int | None = None,
+        poll_s: float | None = None,
+    ):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = timeout_s
+        self.on_stall = on_stall
+        self.exit_code = exit_code
+        self.poll_s = poll_s if poll_s is not None else min(timeout_s / 4, 1.0)
+        self.stalled = False
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat(self) -> None:
+        """Record liveness — call once per completed step."""
+        self._last_beat = time.monotonic()
+
+    def _run(self) -> None:
+        reported = False
+        while not self._stop.wait(self.poll_s):
+            elapsed = time.monotonic() - self._last_beat
+            if elapsed >= self.timeout_s:
+                if reported:
+                    continue  # one report per stall episode
+                reported = True
+                self.stalled = True
+                if self.on_stall is not None:
+                    self.on_stall(elapsed)
+                else:
+                    print(
+                        f"[watchdog] no step completed in {elapsed:.1f}s "
+                        f"(timeout {self.timeout_s}s) — likely a hung "
+                        "collective (dead peer?) or a stuck input "
+                        "pipeline; dumping thread stacks:",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    faulthandler.dump_traceback(file=sys.stderr)
+                if self.exit_code is not None:
+                    os._exit(self.exit_code)
+            else:
+                # A beat landed after a stall: the step recovered (e.g. a
+                # slow eval or checkpoint in between) — keep monitoring
+                # and allow the next episode to be reported too.
+                reported = False
+
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            raise RuntimeError("watchdog already started")
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="step-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class PreemptionHandler:
+    """Turns termination signals into a cooperative stop flag.
+
+    ``signals``: defaults to SIGTERM (what TPU/Borg/k8s preemption
+    sends).  The previous handlers are preserved and restored by
+    ``uninstall()`` (or context-manager exit); the framework's handler
+    only sets the flag — shutdown work (final checkpoint) belongs to
+    the training loop, at a step boundary, where state is consistent.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._prev: dict[int, object] = {}
+        self._installed = False
+        self.requested = False
+
+    def _handle(self, signum, frame):
+        del frame
+        self.requested = True
+        print(
+            f"[preemption] caught signal {signum}; will checkpoint and "
+            "stop at the next step boundary",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def install(self) -> "PreemptionHandler":
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError(
+                "signal handlers can only be installed from the main thread"
+            )
+        for s in self._signals:
+            self._prev[s] = signal.signal(s, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def __call__(self) -> bool:
+        """The stop predicate ``train_epoch(stop=...)`` polls."""
+        return self.requested

@@ -1,0 +1,98 @@
+"""AdamW — decoupled-weight-decay Adam (Loshchilov & Hutter).
+
+The reference's only optimizer is SGD+momentum (``part1/main.py:120-121``
+— SURVEY.md §2.5); that is kept as the parity default (``train/sgd.py``).
+AdamW is the extension the transformer-LM side of this framework needs:
+large-batch LM training is Adam-shaped, and every modern LM recipe pairs
+it with decoupled weight decay.
+
+Update rule (torch ``optim.AdamW`` semantics; ``t = step + 1``):
+
+    mu  = b1·mu + (1−b1)·g
+    nu  = b2·nu + (1−b2)·g²
+    m̂   = mu / (1 − b1ᵗ)          # bias correction
+    n̂   = nu / (1 − b2ᵗ)
+    p  −= lr · ( m̂ / (√n̂ + eps) + wd·p )
+
+The wd term uses the *pre-update* parameter, which makes the combined
+form above identical to torch's sequential "decay, then Adam step" (the
+Adam term never reads p).  Moments are kept in fp32 regardless of the
+parameter dtype — bf16 moment accumulation visibly degrades LM loss
+curves, and the fp32 master-moment convention is what both torch and
+optax implement.
+
+Drop-in companion to ``train/sgd.py``: same
+``(params, moments, grads, config, lr=None, step=None)`` signature; the
+``moments`` slot of ``TrainState`` holds ``{"mu": tree, "nu": tree}``
+(initialized by :func:`adamw_init` via the optimizer registry), and
+``step`` must be the state's step counter — bias correction is
+mandatory, not optional.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class AdamWConfig:
+    # LM-flavored defaults (the CNN parity paths default to SGD).
+    learning_rate: float = 3e-4
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.01
+
+
+def adamw_init(params):
+    """First/second-moment buffers — fp32 zeros, one pair per leaf."""
+    zeros32 = lambda p: jnp.zeros(jnp.shape(p), jnp.float32)
+    return {
+        "mu": jax.tree_util.tree_map(zeros32, params),
+        "nu": jax.tree_util.tree_map(zeros32, params),
+    }
+
+
+def adamw_update(params, moments, grads, config: AdamWConfig, lr=None, step=None):
+    """One AdamW step; returns ``(new_params, new_moments)``.
+
+    ``lr``: optional traced scalar overriding ``config.learning_rate``
+    (schedule support, as in ``train/sgd.py``).  ``step``: the 0-indexed
+    step counter *before* this update (``TrainState.step``); required.
+    """
+    if type(config) is not AdamWConfig:
+        raise TypeError(
+            f"adamw_update needs an AdamWConfig on the TrainState, got "
+            f"{type(config).__name__}; build the state with "
+            "config=AdamWConfig()"
+        )
+    if step is None:
+        raise ValueError(
+            "adamw_update requires step= (the TrainState step counter) "
+            "for bias correction"
+        )
+    lr = config.learning_rate if lr is None else lr
+    t = jnp.asarray(step, jnp.float32) + 1.0
+    bc1 = 1.0 - jnp.power(config.beta1, t)
+    bc2 = 1.0 - jnp.power(config.beta2, t)
+
+    def _update(p, m, v, g):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = config.beta1 * m + (1.0 - config.beta1) * g32
+        v = config.beta2 * v + (1.0 - config.beta2) * jnp.square(g32)
+        adam_term = (m / bc1) / (jnp.sqrt(v / bc2) + config.eps)
+        p32 = p32 - lr * (adam_term + config.weight_decay * p32)
+        return p32.astype(p.dtype), m, v
+
+    flat = jax.tree_util.tree_map(
+        _update, params, moments["mu"], moments["nu"], grads
+    )
+    is_triple = lambda x: isinstance(x, tuple)
+    pick = lambda i: jax.tree_util.tree_map(
+        lambda tup: tup[i], flat, is_leaf=is_triple
+    )
+    return pick(0), {"mu": pick(1), "nu": pick(2)}

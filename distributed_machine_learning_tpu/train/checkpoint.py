@@ -96,6 +96,21 @@ def latest_checkpoint(directory: str | os.PathLike) -> str | None:
     return None
 
 
+def checkpoint_config(path: str | os.PathLike):
+    """The optimizer config instance a checkpoint was saved with — lets a
+    resume build its abstract template with the *saved* momentum layout
+    (AdamW's moment dict vs SGD's buffer tree) before restoring."""
+    with open(os.path.join(os.fspath(path), _CONFIG_FILE)) as f:
+        payload = json.load(f)
+    from distributed_machine_learning_tpu.train.optimizers import (
+        config_class_by_name,
+    )
+
+    return config_class_by_name(payload.pop("__class__", "SGDConfig"))(
+        **payload
+    )
+
+
 def restore_checkpoint(
     path: str | os.PathLike, abstract_state: TrainState | None = None
 ) -> TrainState:

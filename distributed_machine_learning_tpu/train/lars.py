@@ -43,8 +43,11 @@ class LARSConfig(SGDConfig):
     eps: float = 1e-9
 
 
-def lars_update(params, momentum_buf, grads, config: LARSConfig, lr=None):
-    """One LARS step; returns (new_params, new_momentum_buf)."""
+def lars_update(params, momentum_buf, grads, config: LARSConfig, lr=None,
+                step=None):
+    """One LARS step; returns (new_params, new_momentum_buf).  ``step``
+    is accepted for signature uniformity (AdamW) and ignored."""
+    del step
     if not isinstance(config, LARSConfig):
         # Fail loudly: a plain SGDConfig here means the state was built
         # without config=LARSConfig() and the momentum semantics (raw-

@@ -23,7 +23,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
-from distributed_machine_learning_tpu.train.sgd import sgd_update
+from distributed_machine_learning_tpu.train.optimizers import update_fn_for_config
 from distributed_machine_learning_tpu.train.state import TrainState
 from distributed_machine_learning_tpu.runtime.mesh import (
     shard_map_no_check as _shard_map,
@@ -42,8 +42,8 @@ def _lm_step_impl(model, state: TrainState, tokens, targets, *, axis_names):
     if axis_names:
         grads = lax.pmean(grads, axis_names)
         loss = lax.pmean(loss, axis_names)
-    new_params, new_momentum = sgd_update(
-        state.params, state.momentum, grads, state.config
+    new_params, new_momentum = update_fn_for_config(state.config)(
+        state.params, state.momentum, grads, state.config, step=state.step
     )
     new_state = state.replace(
         params=new_params, momentum=new_momentum, step=state.step + 1
@@ -119,15 +119,20 @@ def shard_lm_batch(
     )
 
 
-def init_lm_state(model, seed: int = 69143, batch: int = 1, seq_len: int = 8):
+def init_lm_state(model, seed: int = 69143, batch: int = 1, seq_len: int = 8,
+                  config=None):
     """Initialize LM params/state from the shared seed.
 
     Initialization always runs the dense path (no mesh needed): parameter
-    shapes are independent of the attention implementation.
+    shapes are independent of the attention implementation.  ``config``:
+    optional optimizer config (default SGD parity; pass ``AdamWConfig()``
+    for the LM-standard AdamW — the step dispatches on the config type).
     """
     dense = model.clone(attn_impl="dense") if model.attn_impl != "dense" else model
     rng = jax.random.PRNGKey(seed)
     init_rng, state_rng = jax.random.split(rng)
     tokens = jnp.zeros((batch, seq_len), jnp.int32)
     variables = dense.init(init_rng, tokens, train=False)
-    return TrainState.create(params=variables["params"], rng=state_rng)
+    return TrainState.create(
+        params=variables["params"], rng=state_rng, config=config
+    )

@@ -34,16 +34,29 @@ def train_epoch(
     loss_print_every: int = LOSS_PRINT_EVERY,
     timer: IterationTimer | None = None,
     metrics=None,
+    stop=None,
+    watchdog=None,
 ) -> tuple[TrainState, IterationTimer]:
     """One epoch, reference-style: returns (state, timer).
 
     `place_batch(images, labels)` moves a host batch onto device(s)
     (e.g. `shard_batch(mesh, ...)`); defaults to identity (jit handles
     transfer for the single-device path).
+
+    ``stop``: optional zero-arg predicate polled at every step boundary
+    (e.g. a ``runtime/resilience.PreemptionHandler``) — True ends the
+    epoch cleanly with state consistent, so the caller can checkpoint.
+    ``watchdog``: optional ``runtime/resilience.Watchdog``; beaten once
+    per completed step.
     """
     timer = timer or IterationTimer(skip_first=1)
     for batch_idx, (images, labels) in enumerate(batches):
         if batch_idx == max_iters:  # part1/main.py:32-33
+            break
+        if stop is not None and stop():
+            rank0_print(
+                f"stop requested; ending epoch after {batch_idx} iterations"
+            )
             break
         timer.start()
         if place_batch is not None:
@@ -51,6 +64,8 @@ def train_epoch(
         state, loss = train_step(state, images, labels)
         loss = jax.block_until_ready(loss)
         iter_time = timer.stop()
+        if watchdog is not None:
+            watchdog.beat()
         if metrics is not None:
             metrics.log(step=int(state.step), loss=float(loss),
                         iter_seconds=iter_time)

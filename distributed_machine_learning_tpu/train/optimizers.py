@@ -1,20 +1,38 @@
-"""Optimizer registry: one table mapping name → (config class, update fn).
+"""Optimizer registry: one table mapping name → (config, init, update).
 
 Single source of truth consumed by the train-step builder
 (``train/step.py``), the CLI (``cli/common.py`` — flag choices and config
-construction), and checkpoint restore (``train/checkpoint.py`` — config
-class by saved name), so adding an optimizer is one entry here instead of
-four coordinated edits.
+construction), state creation (``train/state.py`` — momentum-buffer
+layout per optimizer), and checkpoint restore (``train/checkpoint.py`` —
+config class by saved name), so adding an optimizer is one entry here
+instead of five coordinated edits.
+
+Every update fn shares the signature
+``(params, moments, grads, config, lr=None, step=None) ->
+(new_params, new_moments)`` where ``moments`` is whatever the matching
+init fn built (a zeros tree for SGD/LARS, an fp32 ``{"mu","nu"}`` pair of
+trees for AdamW) and ``step`` is the pre-update step counter (used by
+AdamW's bias correction, ignored by the others).
 """
 
 from __future__ import annotations
 
+from distributed_machine_learning_tpu.train.adamw import (
+    AdamWConfig,
+    adamw_init,
+    adamw_update,
+)
 from distributed_machine_learning_tpu.train.lars import LARSConfig, lars_update
-from distributed_machine_learning_tpu.train.sgd import SGDConfig, sgd_update
+from distributed_machine_learning_tpu.train.sgd import (
+    SGDConfig,
+    sgd_init,
+    sgd_update,
+)
 
 OPTIMIZERS = {
-    "sgd": (SGDConfig, sgd_update),
-    "lars": (LARSConfig, lars_update),
+    "sgd": (SGDConfig, sgd_init, sgd_update),
+    "lars": (LARSConfig, sgd_init, lars_update),
+    "adamw": (AdamWConfig, adamw_init, adamw_update),
 }
 
 
@@ -23,7 +41,8 @@ def optimizer_names() -> list[str]:
 
 
 def get_optimizer(name: str):
-    """(config_class, update_fn) for ``name``; raises on unknown names."""
+    """(config_class, init_fn, update_fn) for ``name``; raises on unknown
+    names."""
     try:
         return OPTIMIZERS[name]
     except KeyError:
@@ -32,9 +51,62 @@ def get_optimizer(name: str):
         ) from None
 
 
+def _entry_for_config(config):
+    for cfg_cls, init_fn, update_fn in OPTIMIZERS.values():
+        if type(config) is cfg_cls:
+            return cfg_cls, init_fn, update_fn
+    if isinstance(config, SGDConfig):
+        # Unknown SGDConfig subclass: momentum layout is SGD's.
+        return SGDConfig, sgd_init, sgd_update
+    raise ValueError(
+        f"no registered optimizer for config type {type(config).__name__}"
+    )
+
+
+def init_for_config(config):
+    """Momentum/moments init fn matching a config instance — how
+    ``TrainState.create`` builds the right buffer layout."""
+    return _entry_for_config(config)[1]
+
+
+def update_fn_for_config(config):
+    """Update fn matching a config instance.  The config is static
+    (``pytree_node=False``) so this dispatch happens at trace time —
+    step impls that can't take an ``optimizer`` build argument (LM,
+    pipeline, expert-parallel) use it to honor the state's config."""
+    return _entry_for_config(config)[2]
+
+
+def moment_layout(params_specs, params_example, momentum_example):
+    """Project a per-parameter spec/sharding tree onto the momentum slot.
+
+    The momentum slot is either params-shaped (SGD/LARS) or a dict of
+    params-shaped moment trees (AdamW's ``{"mu","nu"}``); each moment
+    tree inherits its parameter's entry.  Shared by every sharded-state
+    builder (``parallel/gspmd.py``, ``parallel/pipeline.py``,
+    ``parallel/parallel3d.py``) so a new moment layout is one edit here.
+    """
+    import jax
+
+    if momentum_example is None:
+        return params_specs
+    p_struct = jax.tree_util.tree_structure(params_example)
+    if jax.tree_util.tree_structure(momentum_example) == p_struct:
+        return params_specs
+    if isinstance(momentum_example, dict) and all(
+        jax.tree_util.tree_structure(v) == p_struct
+        for v in momentum_example.values()
+    ):
+        return {k: params_specs for k in momentum_example}
+    raise ValueError(
+        "momentum layout matches neither the param tree nor a dict of "
+        "param-shaped moment trees; cannot derive its specs"
+    )
+
+
 def config_class_by_name(class_name: str):
     """Config class by its __name__ (checkpoint restore)."""
-    for cfg_cls, _ in OPTIMIZERS.values():
+    for cfg_cls, _init, _update in OPTIMIZERS.values():
         if cfg_cls.__name__ == class_name:
             return cfg_cls
     raise ValueError(

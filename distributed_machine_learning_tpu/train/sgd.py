@@ -51,13 +51,17 @@ def apply_update(update, params, momentum_buf, grads):
     return new_params, new_momentum
 
 
-def sgd_update(params, momentum_buf, grads, config: SGDConfig, lr=None):
+def sgd_update(params, momentum_buf, grads, config: SGDConfig, lr=None,
+               step=None):
     """One SGD step; returns (new_params, new_momentum_buf).
 
     ``lr``: optional traced scalar overriding ``config.learning_rate`` —
     how a schedule (``train/schedule.py``) feeds a per-step rate into the
     jitted update without retracing (the config value is static).
+    ``step`` is accepted for signature uniformity with AdamW (which needs
+    it for bias correction) and ignored.
     """
+    del step
     lr = config.learning_rate if lr is None else lr
 
     def _update(p, m, g):

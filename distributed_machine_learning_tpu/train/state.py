@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax
 from flax import struct
 
-from distributed_machine_learning_tpu.train.sgd import SGDConfig, sgd_init
+from distributed_machine_learning_tpu.train.sgd import SGDConfig
 
 
 @struct.dataclass
@@ -29,13 +29,18 @@ class TrainState:
     def create(cls, params, batch_stats=None, rng=None, config: SGDConfig | None = None):
         import jax.numpy as jnp
 
+        from distributed_machine_learning_tpu.train.optimizers import (
+            init_for_config,
+        )
+
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        config = config or SGDConfig()
         return cls(
             params=params,
-            momentum=sgd_init(params),
+            momentum=init_for_config(config)(params),
             batch_stats={} if batch_stats is None else batch_stats,
             step=jnp.zeros((), jnp.int32),
             rng=rng,
-            config=config or SGDConfig(),
+            config=config,
         )

@@ -33,7 +33,6 @@ from distributed_machine_learning_tpu.runtime.mesh import (
 )
 from distributed_machine_learning_tpu.train.common import make_loss_fn, step_rng
 from distributed_machine_learning_tpu.train.losses import cross_entropy_loss, count_correct
-from distributed_machine_learning_tpu.train.sgd import sgd_update
 from distributed_machine_learning_tpu.train.state import TrainState
 
 
@@ -51,8 +50,15 @@ def _train_step_impl(
     schedule=None,
     clip_norm: float | None = None,
     accum_steps: int = 1,
-    update_fn=sgd_update,
+    update_fn=None,
 ):
+    if update_fn is None:
+        # Dispatch on the state's (static) optimizer config at trace time.
+        from distributed_machine_learning_tpu.train.optimizers import (
+            update_fn_for_config,
+        )
+
+        update_fn = update_fn_for_config(state.config)
     rng = step_rng(state.rng, state.step, axis_name)
     if accum_steps == 1:
         x = augment_batch(rng, images_u8) if augment else normalize(images_u8)
@@ -124,6 +130,7 @@ def _train_step_impl(
         grads,
         state.config,
         lr=None if schedule is None else schedule(state.step),
+        step=state.step,
     )
     new_state = state.replace(
         params=new_params,
@@ -149,7 +156,7 @@ def make_train_step(
     clip_norm: float | None = None,
     accum_steps: int = 1,
     jit: bool = True,
-    optimizer: str = "sgd",
+    optimizer: str | None = None,
 ):
     """Build the jitted train step.
 
@@ -164,9 +171,9 @@ def make_train_step(
     (identical update for BN-free models, accum-fold lower activation
     memory).
 
-    ``optimizer``: "sgd" (reference parity — train/sgd.py) or "lars"
-    (layer-wise adaptive rate scaling for large global batches —
-    train/lars.py; pair with an LARSConfig on the TrainState).
+    ``optimizer``: None (default) dispatches on the TrainState's config
+    type — SGDConfig → sgd (reference parity), LARSConfig → lars,
+    AdamWConfig → adamw; an explicit registry name pins the update fn.
 
     ``jit=False`` returns the un-jitted step function (no donation) — for
     callers that embed the step in a larger compiled program, e.g. the
@@ -179,7 +186,9 @@ def make_train_step(
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     from distributed_machine_learning_tpu.train.optimizers import get_optimizer
 
-    _, update_fn = get_optimizer(optimizer)
+    # optimizer=None → dispatch from the TrainState's config at trace time
+    # (the natural path); an explicit name pins the update fn regardless.
+    update_fn = None if optimizer is None else get_optimizer(optimizer)[2]
     strategy = strategy or NoSync()
     if mesh is not None and isinstance(strategy, NoSync):
         # Unsynced gradients under a replicated-state shard_map would let

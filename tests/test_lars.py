@@ -181,3 +181,30 @@ def test_ring_empty_gradtree_is_noop():
 
     out = ring_all_reduce({}, "batch", 8)
     assert out == {}
+
+
+def test_lars_rejected_under_pipeline():
+    # Stage-local leaf norms would silently change LARS's trust ratios
+    # with the stage count (see parallel/pipeline.py guard).
+    import numpy as np
+    import pytest
+
+    from distributed_machine_learning_tpu.models.transformer import TransformerLM
+    from distributed_machine_learning_tpu.parallel.pipeline import (
+        init_pipeline_state,
+        make_pp_lm_train_step,
+        microbatch,
+        shard_pp_state,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=2, n_heads=2)
+    mesh = make_mesh(2, ("pipe",))
+    state = shard_pp_state(
+        init_pipeline_state(model, config=LARSConfig()), mesh
+    )
+    step = make_pp_lm_train_step(model, mesh, num_microbatches=2)
+    toks = np.zeros((4, 9), np.int32)
+    px, py = microbatch(toks[:, :-1], toks[:, 1:], 2)
+    with pytest.raises(ValueError, match="LARS"):
+        step(state, px, py)
