@@ -388,14 +388,17 @@ def run_part(
         from distributed_machine_learning_tpu.runtime.resilience import (
             PreemptionHandler,
             Watchdog,
+            agree_stop,
         )
 
         preemption = PreemptionHandler().install()
+        # Multi-host: every host must leave the step loop at the SAME
+        # boundary or the stragglers hang in a collective (agree_stop
+        # max-reduces the flag; free on single-host runs).
+        stop_agreed = lambda: agree_stop(preemption.requested)
         if args.watchdog_timeout:
             watchdog = Watchdog(timeout_s=args.watchdog_timeout).start()
         for _ in range(args.epochs):
-            if preemption.requested:
-                break
             if distributed:
                 batches = dist_loader_cls(train_set, per_rank_batch, world)
             else:
@@ -404,9 +407,12 @@ def run_part(
                 state, _ = train_epoch(
                     train_step, state, batches, place_batch=place,
                     max_iters=args.max_iters, metrics=metrics,
-                    stop=preemption, watchdog=watchdog,
+                    stop=stop_agreed, watchdog=watchdog,
                 )
-            if not preemption.requested:
+            # One agreed decision governs the whole epoch tail — eval,
+            # checkpoint, and loop exit must diverge on NO host.
+            stopping = stop_agreed()
+            if not stopping:
                 eval_batches = BatchLoader(test_set, EVAL_BATCH)
                 if args.eval_batches is not None:
                     import itertools
@@ -428,7 +434,7 @@ def run_part(
                 rank0_print(f"Saved checkpoint to {path}")
                 if watchdog is not None:
                     watchdog.beat()
-            if preemption.requested:
+            if stopping:
                 rank0_print(
                     "preemption checkpoint complete; exiting cleanly "
                     "(resume with --resume)"

@@ -27,7 +27,14 @@ def _sample(logits, rng, temperature: float, top_k: int | None):
     """One sampling decision per batch row.  [B, V] fp32 → [B] int32."""
     logits = logits.astype(jnp.float32)
     if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        if top_k > logits.shape[-1]:
+            raise ValueError(
+                f"top_k={top_k} exceeds the vocabulary size "
+                f"{logits.shape[-1]}"
+            )
+        # lax.top_k is O(V·k) vs a full O(V log V) sort — this runs once
+        # per decoded token inside the scan, so it matters at real vocabs.
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if temperature == 0.0:  # greedy (static: part of the compiled program)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -199,6 +199,10 @@ class TransformerLM(nn.Module):
     seq_axis: str = "seq"
     compute_dtype: Any = jnp.float32
     decode: bool = False
+    remat: bool = False  # jax.checkpoint each block: activation memory
+    # drops from O(L·E) per layer to per-block boundaries, recomputing the
+    # block in backward — the HBM-for-FLOPs trade that lets long-context
+    # (ring/ulysses) runs fit; FLOPs +~33%, memory ÷ ~n_layers.
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
@@ -229,8 +233,13 @@ class TransformerLM(nn.Module):
             self.vocab_size, self.d_model, dtype=self.compute_dtype, name="embed"
         )(tokens)
         d_ff = self.d_ff or 4 * self.d_model
+        # nn.remat must see concrete (non-decode) blocks: the decode path
+        # mutates cache variables, which checkpointing cannot replay.
+        block_cls = (
+            nn.remat(Block) if (self.remat and not self.decode) else Block
+        )
         for i in range(self.n_layers):
-            x = Block(
+            x = block_cls(
                 n_heads=self.n_heads,
                 d_ff=d_ff,
                 attn_impl=self.attn_impl,

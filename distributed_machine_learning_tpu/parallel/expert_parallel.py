@@ -72,10 +72,13 @@ def _moe_step_impl(model: MoETransformerLM, state: TrainState, tokens, targets):
     return new_state, ce
 
 
-def init_moe_state(model: MoETransformerLM, seed: int = 69143) -> TrainState:
+def init_moe_state(model: MoETransformerLM, seed: int = 69143,
+                   config=None) -> TrainState:
+    """``config``: optional optimizer config (as in ``init_lm_state``);
+    the EP step dispatches its update from the state's config type."""
     from distributed_machine_learning_tpu.train.lm_step import init_lm_state
 
-    return init_lm_state(model, seed=seed)
+    return init_lm_state(model, seed=seed, config=config)
 
 
 def make_ep_train_step(

@@ -98,11 +98,23 @@ def init_pipeline_state(model: TransformerLM, seed: int = 69143,
     )
 
 
-def _apply_local_span(block: Block, stacked_local, x, positions):
-    """Run this device's span of layers over x via lax.scan."""
+def _apply_local_span(block: Block, stacked_local, x, positions,
+                      remat: bool = False):
+    """Run this device's span of layers over x via lax.scan.
+
+    ``remat=True`` wraps each layer application in ``jax.checkpoint``:
+    the backward pipeline then recomputes block activations instead of
+    holding every (tick × layer) activation live — the memory term that
+    otherwise scales with microbatch count under grad-of-scan."""
+
+    def apply_layer(layer_params, h):
+        return block.apply({"params": layer_params}, h, positions)
+
+    if remat:
+        apply_layer = jax.checkpoint(apply_layer)
 
     def body(h, layer_params):
-        return block.apply({"params": layer_params}, h, positions), None
+        return apply_layer(layer_params, h), None
 
     h, _ = lax.scan(body, x, stacked_local)
     return h
@@ -153,7 +165,8 @@ def _pipeline_forward_loss(
             lax.dynamic_index_in_dim(tokens_mb, jnp.clip(t, 0, M - 1), keepdims=False)
         )
         x = jnp.where(is_first & (t < M), inject, act)
-        y = _apply_local_span(block, params["blocks"], x, positions)
+        y = _apply_local_span(block, params["blocks"], x, positions,
+                              remat=model.remat)
         # Last stage peels off microbatch m = t − (P−1).
         m = t - (num_stages - 1)
         tgt = lax.dynamic_index_in_dim(

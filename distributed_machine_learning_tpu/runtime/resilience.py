@@ -35,6 +35,8 @@ import threading
 import time
 from typing import Callable
 
+import jax
+
 
 class Watchdog:
     """Detects a stalled training step (hung collective, dead peer).
@@ -184,3 +186,26 @@ class PreemptionHandler:
     def __call__(self) -> bool:
         """The stop predicate ``train_epoch(stop=...)`` polls."""
         return self.requested
+
+
+def agree_stop(local: bool) -> bool:
+    """Cross-host agreement on a stop decision.
+
+    A per-host flag is not enough on multi-host runs: a signal lands on
+    different hosts at different times, and a host that exits its step
+    loop one iteration early leaves the others blocked forever inside a
+    collective — the exact hang this module exists to prevent.  This
+    max-reduces the flag over all processes (any host requesting stop
+    stops everyone) at a common point in the loop, so every host leaves
+    at the same step boundary.  Single-process: returns ``local`` with
+    no collective.
+    """
+    if jax.process_count() == 1:
+        return bool(local)
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    return bool(
+        multihost_utils.process_allgather(np.int32(bool(local))).max()
+    )

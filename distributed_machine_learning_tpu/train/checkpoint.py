@@ -106,6 +106,7 @@ def checkpoint_config(path: str | os.PathLike):
         config_class_by_name,
     )
 
+    # "SGDConfig" default: checkpoints written before the class tag existed.
     return config_class_by_name(payload.pop("__class__", "SGDConfig"))(
         **payload
     )
@@ -135,14 +136,7 @@ def restore_checkpoint(
             tree = ckptr.restore(os.path.join(path, _STATE_DIR), args=restore_args)
         else:
             tree = ckptr.restore(os.path.join(path, _STATE_DIR))
-    from distributed_machine_learning_tpu.train.optimizers import (
-        config_class_by_name,
-    )
-
-    with open(os.path.join(path, _CONFIG_FILE)) as f:
-        payload = json.load(f)
-    # "SGDConfig" default: checkpoints written before the class tag existed.
-    config = config_class_by_name(payload.pop("__class__", "SGDConfig"))(**payload)
+    config = checkpoint_config(path)
     return TrainState(
         params=tree["params"],
         momentum=tree["momentum"],

@@ -1,0 +1,102 @@
+"""Numerical sanitizers — the SPMD answer to SURVEY.md §5's
+"race detection / sanitizers" row.
+
+Under jit+SPMD data races are structurally impossible (no shared mutable
+state; collectives are the only cross-device edges), so the failure mode
+that actually bites is *numerical*: a NaN/inf born in some fused kernel
+surfaces dozens of ops later as a garbage loss.  Two tools:
+
+- :func:`checked` — wrap any jittable fn with ``jax.experimental.checkify``
+  float checks: every op that produces a NaN/±inf is annotated with its
+  source location, and the wrapper raises at the first offender instead
+  of propagating garbage.  Debug-only: the checks block fusion, so use it
+  to localize, not to train.
+- :func:`find_nonfinite` — scan a pytree (params, grads, activations)
+  and report the path, count, and first index of every non-finite leaf —
+  the fast post-mortem for a checkpoint or a captured gradient.
+
+Example::
+
+    step_dbg = checked(make_train_step(model, jit=False))
+    state, loss = step_dbg(state, x, y)   # raises with op provenance
+
+    bad = find_nonfinite(grads)
+    # {'block_0/attn/qkv/kernel': 'nan x3 (first at (0, 1, 0, 7))'}
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import checkify
+
+
+def checked(fn, *, jit: bool = True):
+    """Wrap ``fn`` so any NaN/inf produced inside raises a
+    ``checkify.JaxRuntimeError`` with the originating op's source line.
+
+    ``fn`` must be jit-compatible (pure, traceable).  The returned
+    wrapper has the same signature and return value.
+    """
+    checked_fn = checkify.checkify(fn, errors=checkify.float_checks)
+    if jit:
+        checked_fn = jax.jit(checked_fn)
+
+    def wrapper(*args, **kwargs):
+        err, out = checked_fn(*args, **kwargs)
+        checkify.check_error(err)  # no-op if clean; raises with provenance
+        return out
+
+    return wrapper
+
+
+def find_nonfinite(tree) -> dict[str, str]:
+    """Report every non-finite leaf of a pytree.
+
+    Returns ``{path: "nan x<count> (first at <index>)"}`` — empty dict
+    means the tree is clean.  Pulls values to host; debug-only.
+    """
+    report: dict[str, str] = {}
+
+    def visit(path, leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        if not np.issubdtype(arr.dtype, np.floating):
+            return
+        bad = ~np.isfinite(arr)
+        if bad.any():
+            first = np.unravel_index(int(np.argmax(bad)), arr.shape)
+            kinds = []
+            if np.isnan(arr).any():
+                kinds.append("nan")
+            if np.isposinf(arr).any():
+                kinds.append("+inf")
+            if np.isneginf(arr).any():
+                kinds.append("-inf")
+            key = "/".join(
+                str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+            )
+            report[key] = (
+                f"{'/'.join(kinds)} x{int(bad.sum())} (first at {first})"
+            )
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return report
+
+
+def assert_all_finite(tree, what: str = "tree") -> None:
+    """Raise ``ValueError`` with the full report if ``tree`` has any
+    non-finite leaf (a pytree-wide ``torch.autograd.set_detect_anomaly``
+    substitute for the post-hoc case)."""
+    report = find_nonfinite(tree)
+    if report:
+        lines = "\n".join(f"  {k}: {v}" for k, v in sorted(report.items()))
+        raise ValueError(f"non-finite values in {what}:\n{lines}")
+
+
+def all_devices_identical(x) -> bool:
+    """True iff every device's copy of a (supposedly) replicated array is
+    bit-identical — the reference's cross-rank accuracy check
+    (group25.pdf p.5, SURVEY.md §4) as a direct assertion on state."""
+    arrs = [np.asarray(s.data) for s in x.addressable_shards]
+    return all(np.array_equal(arrs[0], a, equal_nan=True) for a in arrs[1:])

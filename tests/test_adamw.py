@@ -181,3 +181,15 @@ def test_zero_sharding_rejects_adamw(mesh4):
         shard_zero1_state(state, mesh4)
     with pytest.raises(ValueError, match="SGD"):
         shard_fsdp_state(state, mesh4)
+
+
+def test_moe_state_accepts_config():
+    from distributed_machine_learning_tpu.models.moe import MoETransformerLM
+    from distributed_machine_learning_tpu.parallel.expert_parallel import (
+        init_moe_state,
+    )
+
+    moe = MoETransformerLM(vocab_size=32, d_model=16, n_layers=2,
+                           n_heads=2, n_experts=2)
+    state = init_moe_state(moe, config=AdamWConfig())
+    assert set(state.momentum) == {"mu", "nu"}

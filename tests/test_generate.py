@@ -80,3 +80,13 @@ def test_tokens_in_vocab_range(rng):
                    temperature=0.8, top_k=5, rng=jax.random.PRNGKey(1))
     arr = np.asarray(out)
     assert arr.min() >= 0 and arr.max() < VOCAB
+
+
+def test_top_k_exceeding_vocab_is_a_clear_error(rng):
+    import pytest
+
+    model, params = _model_and_params()
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (1, 3)), jnp.int32)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, max_new_tokens=2,
+                 temperature=1.0, top_k=VOCAB + 1)

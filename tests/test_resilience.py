@@ -94,3 +94,10 @@ def test_train_epoch_stops_at_boundary_and_beats_watchdog(rng):
     assert calls["n"] == 3
     assert int(state.step) == 3
     assert not wd.stalled
+
+
+def test_agree_stop_single_process():
+    from distributed_machine_learning_tpu.runtime.resilience import agree_stop
+
+    assert agree_stop(True) is True
+    assert agree_stop(False) is False
