@@ -389,13 +389,16 @@ def run_part(
             PreemptionHandler,
             Watchdog,
             agree_stop,
+            periodic_agree_stop,
         )
 
         preemption = PreemptionHandler().install()
         # Multi-host: every host must leave the step loop at the SAME
-        # boundary or the stragglers hang in a collective (agree_stop
-        # max-reduces the flag; free on single-host runs).
-        stop_agreed = lambda: agree_stop(preemption.requested)
+        # boundary or the stragglers hang in a collective.  The in-loop
+        # predicate agrees cross-host every few steps (per-step agreement
+        # would tax every step with an allgather); the epoch tail agrees
+        # unconditionally.
+        in_loop_stop = periodic_agree_stop(lambda: preemption.requested)
         if args.watchdog_timeout:
             watchdog = Watchdog(timeout_s=args.watchdog_timeout).start()
         for _ in range(args.epochs):
@@ -407,11 +410,11 @@ def run_part(
                 state, _ = train_epoch(
                     train_step, state, batches, place_batch=place,
                     max_iters=args.max_iters, metrics=metrics,
-                    stop=stop_agreed, watchdog=watchdog,
+                    stop=in_loop_stop, watchdog=watchdog,
                 )
             # One agreed decision governs the whole epoch tail — eval,
             # checkpoint, and loop exit must diverge on NO host.
-            stopping = stop_agreed()
+            stopping = agree_stop(preemption.requested)
             if not stopping:
                 eval_batches = BatchLoader(test_set, EVAL_BATCH)
                 if args.eval_batches is not None:

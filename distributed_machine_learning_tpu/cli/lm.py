@@ -78,6 +78,12 @@ def make_parser():
                         "the reference's torch-semantics update")
     p.add_argument("--lr", default=None, type=float,
                    help="override the optimizer config's learning rate")
+    p.add_argument("--fused-ce-chunks", dest="fused_ce_chunks", default=None,
+                   type=int,
+                   help="compute the loss fused with the lm_head in this "
+                        "many vocab chunks (ops/fused_ce.py) — the "
+                        "[B,L,vocab] logits are never materialized; "
+                        "dp/ring/ulysses modes only")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each transformer block: activation "
                         "memory drops ~n_layers-fold for ~33%% more FLOPs "
@@ -105,6 +111,12 @@ def build(args):
 
     cfg_cls = get_optimizer(args.optimizer)[0]
     opt_config = cfg_cls() if args.lr is None else cfg_cls(learning_rate=args.lr)
+    if args.fused_ce_chunks and args.parallel not in ("dp", "ring", "ulysses"):
+        raise ValueError(
+            "--fused-ce-chunks applies to the dp/ring/ulysses step only "
+            "(tp shards the lm_head, pp computes the loss on the last "
+            "stage)"
+        )
 
     if args.parallel in ("dp", "ring", "ulysses"):
         from distributed_machine_learning_tpu.train.lm_step import (
@@ -131,7 +143,8 @@ def build(args):
             mesh = make_mesh(n, ("batch", "seq"), (1, n))
             model = TransformerLM(attn_impl=args.parallel, **common)
         state = init_lm_state(model, seed=SEED, config=opt_config)
-        step = make_lm_train_step(model, mesh=mesh)
+        step = make_lm_train_step(model, mesh=mesh,
+                                  fused_ce_chunks=args.fused_ce_chunks)
         place = lambda x, y: shard_lm_batch(mesh, x, y)
         return step, state, place
 

@@ -205,7 +205,12 @@ class TransformerLM(nn.Module):
     # (ring/ulysses) runs fit; FLOPs +~33%, memory ÷ ~n_layers.
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False):
+    def __call__(self, tokens, *, train: bool = False,
+                 return_hidden: bool = False):
+        """``return_hidden=True`` returns the post-``ln_f`` hidden states
+        [B, L, E] instead of logits, skipping the ``lm_head`` projection —
+        the entry point for the fused head+loss (``ops/fused_ce.py``),
+        which never materializes [B, L, vocab]."""
         del train  # no dropout/BN — kept for the shared train-step interface
         B, L = tokens.shape
         if self.decode:
@@ -249,5 +254,7 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)
+        if return_hidden:
+            return x
         logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)

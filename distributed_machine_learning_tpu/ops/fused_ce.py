@@ -1,0 +1,127 @@
+"""Fused LM-head + cross-entropy: loss without materializing the logits.
+
+At real LM scale the ``[tokens, vocab]`` logit tensor is the single
+largest activation of the whole network — batch 8 × seq 4096 × vocab
+128k in fp32 is 16 GB, bigger than the model.  The reference never hits
+this (its classifier head is 10-wide — ``part1/model.py:44``), but a
+long-context LM framework must.  This op computes
+
+    mean over tokens of  [ logsumexp(h·W + b) − (h·W + b)[target] ]
+
+chunk by chunk over the vocabulary: each chunk materializes only a
+``[T, chunk]`` logit block, maintains a running online logsumexp (the
+same max-rescaling recurrence flash attention uses over keys), and picks
+out the target logit for targets that fall inside the chunk.  Peak
+activation memory drops from O(T·V) to O(T·V/num_chunks).
+
+The chunk loop is a static Python loop over ``lax.slice`` columns of the
+*original* kernel — no padded/transposed copy is ever built, XLA fuses
+each slice into its matmul, and the matmul runs in the inputs' dtype
+(bf16 stays on the bf16 MXU path) with fp32 accumulation
+(``preferred_element_type``); only the logsumexp/softmax bookkeeping is
+fp32.  The backward pass is a custom VJP that replays the same loop,
+recomputing each logit block from the saved per-token logsumexp
+(``probs = exp(logits − lse)``), accumulating ``dh`` and emitting
+per-chunk ``dW``/``db`` — so backward peak memory matches forward.
+
+Numerics match the unfused loss to fp32 roundoff (reduction order
+differs across chunks).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # finite -inf stand-in (running-max init)
+
+
+def _chunk_bounds(V: int, num_chunks: int) -> list[tuple[int, int]]:
+    """Static (start, stop) per chunk; empty tail chunks are dropped."""
+    if num_chunks < 1:
+        raise ValueError(f"num_chunks must be >= 1, got {num_chunks}")
+    C = -(-V // num_chunks)
+    return [(s, min(s + C, V)) for s in range(0, V, C)]
+
+
+def _block(h, kernel, bias, start: int, stop: int):
+    """fp32 logits for vocab columns [start, stop) — the matmul runs in
+    the inputs' dtype (bf16 stays bf16 on the MXU), accumulating fp32."""
+    k_c = lax.slice(kernel, (0, start), (kernel.shape[0], stop))
+    logits = jnp.dot(h, k_c, preferred_element_type=jnp.float32)
+    return logits + lax.slice(bias, (start,), (stop,)).astype(jnp.float32)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_linear_cross_entropy(hidden, kernel, bias, targets,
+                               num_chunks: int = 8):
+    """Mean cross-entropy of ``softmax(hidden @ kernel + bias)`` against
+    ``targets`` without materializing the ``[T, V]`` logits.
+
+    ``hidden``: [T, E]; ``kernel``: [E, V]; ``bias``: [V];
+    ``targets``: [T] int.  ``num_chunks``: vocabulary chunks (static);
+    peak logit memory is ``T × ceil(V/num_chunks)``.
+    """
+    loss, _ = _fused_fwd_impl(hidden, kernel, bias, targets, num_chunks)
+    return loss
+
+
+def _fused_fwd_impl(hidden, kernel, bias, targets, num_chunks):
+    T = hidden.shape[0]
+    m = jnp.full((T,), NEG_INF, jnp.float32)
+    s = jnp.zeros((T,), jnp.float32)
+    tgt = jnp.zeros((T,), jnp.float32)
+    for start, stop in _chunk_bounds(kernel.shape[1], num_chunks):
+        logits = _block(hidden, kernel, bias, start, stop)  # [T, C] fp32
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]
+        ).sum(axis=-1)
+        m = m_new
+        # Target logit if it falls in this chunk (one-hot contraction —
+        # same TP-friendly trick as train/losses.py; out-of-range rows
+        # produce an all-zero row, contributing nothing).
+        one_hot = jax.nn.one_hot(targets - start, stop - start,
+                                 dtype=jnp.float32)
+        tgt = tgt + jnp.sum(logits * one_hot, axis=-1)
+    lse = m + jnp.log(s)
+    return (lse - tgt).mean(), lse
+
+
+def _fused_fwd(hidden, kernel, bias, targets, num_chunks):
+    loss, lse = _fused_fwd_impl(hidden, kernel, bias, targets, num_chunks)
+    return loss, (hidden, kernel, bias, targets, lse)
+
+
+def _fused_bwd(num_chunks, res, g):
+    hidden, kernel, bias, targets, lse = res
+    T = hidden.shape[0]
+    scale = g / T  # d(mean)/d(per-token loss)
+    dh = jnp.zeros(hidden.shape, jnp.float32)
+    dk_parts, db_parts = [], []
+    for start, stop in _chunk_bounds(kernel.shape[1], num_chunks):
+        logits = _block(hidden, kernel, bias, start, stop)  # recomputed
+        probs = jnp.exp(logits - lse[:, None])
+        one_hot = jax.nn.one_hot(targets - start, stop - start,
+                                 dtype=jnp.float32)
+        dlogits = (probs - one_hot) * scale  # [T, C] fp32
+        k_c = lax.slice(kernel, (0, start), (kernel.shape[0], stop))
+        dh = dh + jnp.dot(dlogits, k_c.T.astype(jnp.float32))
+        dk_parts.append(
+            jnp.dot(hidden.astype(jnp.float32).T, dlogits)
+        )  # [E, C]
+        db_parts.append(dlogits.sum(axis=0))  # [C]
+    dk = jnp.concatenate(dk_parts, axis=1)
+    db = jnp.concatenate(db_parts)
+    return (
+        dh.astype(hidden.dtype),
+        dk.astype(kernel.dtype),
+        db.astype(bias.dtype),
+        None,
+    )
+
+
+fused_linear_cross_entropy.defvjp(_fused_fwd, _fused_bwd)

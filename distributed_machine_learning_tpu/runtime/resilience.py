@@ -188,6 +188,39 @@ class PreemptionHandler:
         return self.requested
 
 
+def periodic_agree_stop(local_fn: Callable[[], bool], every: int = 10):
+    """A stop predicate for ``train_epoch`` that reaches cross-host
+    agreement only every ``every``-th poll.
+
+    On multi-host runs ``agree_stop`` is a blocking allgather; paying it
+    before *every* step taxes the whole run for an event that happens at
+    most once.  Polling the agreement every N steps keeps the
+    hang-free guarantee (all hosts skip and poll on the same iterations,
+    since they count polls in lockstep) at 1/N the cost — preemption
+    grace periods are tens of seconds, so a few extra steps of latency
+    are immaterial.  Single-process: ``agree_stop`` is local and free,
+    and ``every`` is forced to 1 so the signal is honored immediately.
+    Once stopped, stays stopped.
+    """
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+    if jax.process_count() == 1:
+        every = 1
+    state = {"polls": 0, "stopped": False}
+
+    def stop() -> bool:
+        if state["stopped"]:
+            return True
+        i = state["polls"]
+        state["polls"] += 1
+        if i % every:
+            return False  # off-cycle: no collective, no decision
+        state["stopped"] = agree_stop(local_fn())
+        return state["stopped"]
+
+    return stop
+
+
 def agree_stop(local: bool) -> bool:
     """Cross-host agreement on a stop decision.
 

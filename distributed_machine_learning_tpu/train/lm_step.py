@@ -33,8 +33,28 @@ DATA_AXIS = "batch"
 SEQ_AXIS = "seq"
 
 
-def _lm_step_impl(model, state: TrainState, tokens, targets, *, axis_names):
+def _lm_step_impl(model, state: TrainState, tokens, targets, *, axis_names,
+                  fused_ce_chunks: int | None = None):
     def loss_fn(params):
+        if fused_ce_chunks:
+            # Fused head+loss: the [B, L, vocab] logits are never
+            # materialized — the model returns post-ln_f hidden states
+            # and ops/fused_ce.py scans the vocab in chunks.
+            from distributed_machine_learning_tpu.ops.fused_ce import (
+                fused_linear_cross_entropy,
+            )
+
+            hidden = model.apply(
+                {"params": params}, tokens, train=True, return_hidden=True
+            )
+            E = hidden.shape[-1]
+            return fused_linear_cross_entropy(
+                hidden.reshape(-1, E),
+                params["lm_head"]["kernel"],
+                params["lm_head"]["bias"],
+                targets.reshape(-1),
+                fused_ce_chunks,
+            )
         logits = model.apply({"params": params}, tokens, train=True)
         return lm_cross_entropy(logits, targets)
 
@@ -56,6 +76,7 @@ def make_lm_train_step(
     mesh: Mesh | None = None,
     data_axis: str = DATA_AXIS,
     seq_axis: str = SEQ_AXIS,
+    fused_ce_chunks: int | None = None,
 ):
     """Build ``step(state, tokens, targets) -> (state, loss)``.
 
@@ -64,9 +85,19 @@ def make_lm_train_step(
     sharded [data, seq], state replicated.  A ring-attention model shards
     the sequence for real; a dense model on a seq-axis-size-1 mesh is the
     pure-DP special case.
+
+    ``fused_ce_chunks``: if set (>= 1), compute the loss fused with the
+    lm_head over this many vocab chunks (``ops/fused_ce.py``) — the
+    [B, L, vocab] logits are never materialized.
     """
+    if fused_ce_chunks is not None and fused_ce_chunks < 1:
+        raise ValueError(
+            f"fused_ce_chunks must be >= 1 (got {fused_ce_chunks}); "
+            "use None for the unfused loss"
+        )
     if mesh is None:
-        impl = partial(_lm_step_impl, model, axis_names=())
+        impl = partial(_lm_step_impl, model, axis_names=(),
+                       fused_ce_chunks=fused_ce_chunks)
         return jax.jit(impl, donate_argnums=(0,))
 
     missing = [a for a in (data_axis, seq_axis) if a not in mesh.axis_names]
@@ -92,7 +123,8 @@ def make_lm_train_step(
             f"{seq_axis!r} has size {mesh.shape[seq_axis]} > 1; use "
             'attn_impl="ring"/"ulysses" or an axis_shape with seq size 1'
         )
-    impl = partial(_lm_step_impl, model, axis_names=axis_names)
+    impl = partial(_lm_step_impl, model, axis_names=axis_names,
+                   fused_ce_chunks=fused_ce_chunks)
     batch_spec = P(data_axis, seq_axis)
     sharded = _shard_map(
         impl,

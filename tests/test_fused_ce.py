@@ -1,0 +1,150 @@
+"""Fused lm_head + cross-entropy (ops/fused_ce.py): the chunked scan
+must reproduce the unfused loss AND its gradients to fp32 roundoff, for
+every chunking (including non-dividing), and compose with the LM step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.ops.fused_ce import (
+    fused_linear_cross_entropy,
+)
+
+T, E, V = 12, 8, 22
+
+
+def _inputs(rng):
+    h = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((E, V)) * 0.3, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(V) * 0.1, jnp.float32)
+    t = jnp.asarray(rng.integers(0, V, T), jnp.int32)
+    return h, k, b, t
+
+
+def _unfused(h, k, b, t):
+    from distributed_machine_learning_tpu.train.losses import cross_entropy_loss
+
+    return cross_entropy_loss(h @ k + b, t)
+
+
+@pytest.mark.parametrize("num_chunks", [1, 2, 4, 7, 22])
+def test_loss_matches_unfused(rng, num_chunks):
+    # 7 and 22: chunk sizes that don't divide / exactly cover the vocab —
+    # the -inf-bias padding path.
+    h, k, b, t = _inputs(rng)
+    fused = fused_linear_cross_entropy(h, k, b, t, num_chunks)
+    np.testing.assert_allclose(
+        float(fused), float(_unfused(h, k, b, t)), rtol=1e-6
+    )
+
+
+def test_grads_match_unfused(rng):
+    h, k, b, t = _inputs(rng)
+    gf = jax.grad(
+        lambda h, k, b: fused_linear_cross_entropy(h, k, b, t, 4),
+        argnums=(0, 1, 2),
+    )(h, k, b)
+    gu = jax.grad(
+        lambda h, k, b: _unfused(h, k, b, t), argnums=(0, 1, 2)
+    )(h, k, b)
+    for a, b_ in zip(gf, gu):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=2e-5, atol=1e-7
+        )
+
+
+def test_bf16_hidden_fp32_loss(rng):
+    h, k, b, t = _inputs(rng)
+    loss = fused_linear_cross_entropy(h.astype(jnp.bfloat16), k, b, t, 2)
+    assert loss.dtype == jnp.float32
+    g = jax.grad(
+        lambda hh: fused_linear_cross_entropy(hh, k, b, t, 2)
+    )(h.astype(jnp.bfloat16))
+    assert g.dtype == jnp.bfloat16
+
+
+def test_lm_step_with_fused_ce_matches_dense(rng):
+    from distributed_machine_learning_tpu.models.transformer import TransformerLM
+    from distributed_machine_learning_tpu.train.lm_step import (
+        init_lm_state,
+        make_lm_train_step,
+    )
+
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=2, n_heads=2)
+    toks = jnp.asarray(rng.integers(0, 32, (2, 9)), jnp.int32)
+    s0 = init_lm_state(model)
+    s1 = init_lm_state(model)
+    dense_step = make_lm_train_step(model)
+    fused_step = make_lm_train_step(model, fused_ce_chunks=3)
+    s0, l0 = dense_step(s0, toks[:, :-1], toks[:, 1:])
+    s1, l1 = fused_step(s1, toks[:, :-1], toks[:, 1:])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(s0.params),
+                    jax.tree_util.tree_leaves(s1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_fused_ce_under_ring_context_parallel(rng):
+    # Sequence-sharded: each shard's fused local mean pmeans to the
+    # global mean, same as the unfused path.
+    from distributed_machine_learning_tpu.models.transformer import TransformerLM
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+    from distributed_machine_learning_tpu.train.lm_step import (
+        init_lm_state,
+        make_lm_train_step,
+        shard_lm_batch,
+    )
+
+    mesh = make_mesh(4, ("batch", "seq"), (1, 4))
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=2, n_heads=2,
+                          attn_impl="ring")
+    state = init_lm_state(model)
+    toks = rng.integers(0, 32, (2, 17)).astype(np.int32)
+    x, y = shard_lm_batch(mesh, toks[:, :-1], toks[:, 1:])
+    step = make_lm_train_step(model, mesh=mesh, fused_ce_chunks=2)
+    state, loss = step(state, x, y)
+
+    dense = TransformerLM(vocab_size=32, d_model=16, n_layers=2, n_heads=2)
+    ds = init_lm_state(dense)
+    dstep = make_lm_train_step(dense)
+    ds, dloss = dstep(ds, jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:]))
+    np.testing.assert_allclose(float(loss), float(dloss), rtol=1e-5)
+
+
+def test_fused_ce_chunk_validation(rng):
+    from distributed_machine_learning_tpu.train.lm_step import make_lm_train_step
+    from distributed_machine_learning_tpu.models.transformer import TransformerLM
+
+    h, k, b, t = _inputs(rng)
+    with pytest.raises(ValueError, match="num_chunks"):
+        fused_linear_cross_entropy(h, k, b, t, 0)
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=1, n_heads=2)
+    with pytest.raises(ValueError, match="fused_ce_chunks"):
+        make_lm_train_step(model, fused_ce_chunks=0)
+    with pytest.raises(ValueError, match="fused_ce_chunks"):
+        make_lm_train_step(model, fused_ce_chunks=-2)
+
+
+def test_more_chunks_than_vocab(rng):
+    # num_chunks > V: empty tail chunks are statically dropped.
+    h, k, b, t = _inputs(rng)
+    fused = fused_linear_cross_entropy(h, k, b, t, V + 9)
+    np.testing.assert_allclose(
+        float(fused), float(_unfused(h, k, b, t)), rtol=1e-6
+    )
+
+
+def test_bf16_kernel_stays_bf16_on_the_wire(rng):
+    # The matmul input dtype is preserved (no fp32 kernel copy): grads
+    # come back in the kernel's dtype and the loss is finite.
+    h, k, b, t = _inputs(rng)
+    kb = k.astype(jnp.bfloat16)
+    loss, grads = jax.value_and_grad(
+        lambda kk: fused_linear_cross_entropy(
+            h.astype(jnp.bfloat16), kk, b, t, 3
+        )
+    )(kb)
+    assert np.isfinite(float(loss))
+    assert grads.dtype == jnp.bfloat16

@@ -101,3 +101,30 @@ def test_agree_stop_single_process():
 
     assert agree_stop(True) is True
     assert agree_stop(False) is False
+
+
+def test_periodic_agree_stop_single_process_is_immediate():
+    from distributed_machine_learning_tpu.runtime.resilience import (
+        periodic_agree_stop,
+    )
+
+    flag = {"v": False}
+    stop = periodic_agree_stop(lambda: flag["v"], every=10)
+    assert not stop()
+    flag["v"] = True
+    # Single-process forces every=1: honored on the very next poll,
+    # and sticky afterwards.
+    assert stop()
+    flag["v"] = False
+    assert stop()
+
+
+def test_periodic_agree_stop_validates_every():
+    import pytest
+
+    from distributed_machine_learning_tpu.runtime.resilience import (
+        periodic_agree_stop,
+    )
+
+    with pytest.raises(ValueError):
+        periodic_agree_stop(lambda: False, every=0)
