@@ -78,6 +78,15 @@ def make_parser():
                         "the reference's torch-semantics update")
     p.add_argument("--lr", default=None, type=float,
                    help="override the optimizer config's learning rate")
+    p.add_argument("--data-dir", dest="data_dir", default=None, type=str,
+                   help="train on real text: every text file under this "
+                        "directory becomes a byte-level corpus "
+                        "(data/text.py; vocab auto-set to 257 = bytes+BOS); "
+                        "default trains on the deterministic synthetic "
+                        "stream")
+    p.add_argument("--eval-batches", dest="eval_batches", default=0, type=int,
+                   help="after training, evaluate perplexity on this many "
+                        "held-out windows (dp/ring/ulysses modes; 0 skips)")
     p.add_argument("--fused-ce-chunks", dest="fused_ce_chunks", default=None,
                    type=int,
                    help="compute the loss fused with the lm_head in this "
@@ -146,7 +155,7 @@ def build(args):
         step = make_lm_train_step(model, mesh=mesh,
                                   fused_ce_chunks=args.fused_ce_chunks)
         place = lambda x, y: shard_lm_batch(mesh, x, y)
-        return step, state, place
+        return step, state, place, model
 
     if args.parallel == "tp":
         from distributed_machine_learning_tpu.parallel.tensor_parallel import (
@@ -163,7 +172,7 @@ def build(args):
         step = make_tp_lm_train_step(model, mesh)
         state = shard_tp_state(init_lm_state(model, seed=SEED, config=opt_config), mesh)
         place = lambda x, y: shard_tp_batch(mesh, x, y)
-        return step, state, place
+        return step, state, place, model
 
     if args.parallel == "pp":
         from distributed_machine_learning_tpu.parallel.pipeline import (
@@ -178,7 +187,7 @@ def build(args):
         step = make_pp_lm_train_step(model, mesh, args.microbatches)
         state = shard_pp_state(init_pipeline_state(model, seed=SEED, config=opt_config), mesh)
         place = lambda x, y: microbatch(x, y, args.microbatches)
-        return step, state, place
+        return step, state, place, model
 
     # 3d
     from distributed_machine_learning_tpu.parallel.parallel3d import (
@@ -208,7 +217,7 @@ def build(args):
     step = make_3d_lm_train_step(model, mesh, args.microbatches)
     state = shard_3d_state(init_pipeline_state(model, seed=SEED, config=opt_config), mesh)
     place = lambda x, y: shard_3d_batch(mesh, *microbatch(x, y, args.microbatches))
-    return step, state, place
+    return step, state, place, model
 
 
 def main(argv=None) -> None:
@@ -220,22 +229,88 @@ def main(argv=None) -> None:
             f"d_model={args.d_model} layers={args.n_layers} "
             f"seq_len={args.seq_len} batch={args.batch_size}"
         )
-        step, state, place = build(args)
+        corpus = None
+        if args.data_dir is not None:
+            from distributed_machine_learning_tpu.data.text import (
+                VOCAB_SIZE,
+                load_corpus,
+            )
+
+            corpus = load_corpus(args.data_dir)
+            if args.vocab < VOCAB_SIZE:
+                rank0_print(
+                    f"--data-dir is byte-level: vocab {args.vocab} -> "
+                    f"{VOCAB_SIZE} (256 bytes + BOS)"
+                )
+                args.vocab = VOCAB_SIZE
+            rank0_print(f"corpus: {len(corpus)} tokens from {args.data_dir}")
+        step, state, place, model = build(args)
         rng = np.random.default_rng(SEED)
 
-        def batches():
-            for _ in range(args.max_iters):
-                block = synthetic_tokens(
-                    rng, args.batch_size, args.seq_len, args.vocab
+        if corpus is not None:
+            from distributed_machine_learning_tpu.data.text import (
+                TextWindowLoader,
+            )
+
+            # Rank-strided window sharding across processes: each host
+            # draws its slice of the same global stream, so DP over
+            # hosts sees distinct data (the DistributedSampler contract).
+            world = jax.process_count()
+            if args.batch_size % world:
+                raise ValueError(
+                    f"--batch-size {args.batch_size} must be divisible "
+                    f"by the {world} processes"
                 )
-                yield block[:, :-1], block[:, 1:]
+            batches = lambda: iter(TextWindowLoader(
+                corpus, args.batch_size // world, args.seq_len, seed=SEED,
+                rank=jax.process_index(), world=world,
+            ))
+        else:
+            def batches():
+                for _ in range(args.max_iters):
+                    block = synthetic_tokens(
+                        rng, args.batch_size, args.seq_len, args.vocab
+                    )
+                    yield block[:, :-1], block[:, 1:]
 
         # The shared driver owns the measurement protocol (iter-0-excluded
         # timing, loss cadence, summary format) — one copy for CNN and LM.
-        train_epoch(
+        state, _ = train_epoch(
             step, state, batches(), place_batch=place,
             max_iters=args.max_iters,
         )
+        if args.eval_batches:
+            if args.parallel not in ("dp", "ring", "ulysses"):
+                rank0_print(
+                    "WARNING: --eval-batches only supports the "
+                    "replicated-param modes (dp/ring/ulysses); skipping "
+                    f"eval for --parallel {args.parallel}"
+                )
+            else:
+                from distributed_machine_learning_tpu.data.text import (
+                    eval_windows,
+                )
+                from distributed_machine_learning_tpu.train.lm_step import (
+                    make_lm_eval_step,
+                )
+                from distributed_machine_learning_tpu.train.loop import (
+                    evaluate_lm,
+                )
+
+                if corpus is not None:
+                    ev = eval_windows(corpus, args.batch_size, args.seq_len,
+                                      args.eval_batches)
+                else:
+                    ev_rng = np.random.default_rng(SEED + 1)
+                    ev = (
+                        (b[:, :-1], b[:, 1:])
+                        for b in (
+                            synthetic_tokens(ev_rng, args.batch_size,
+                                             args.seq_len, args.vocab)
+                            for _ in range(args.eval_batches)
+                        )
+                    )
+                evaluate_lm(make_lm_eval_step(model), state.params, ev)
     finally:
         ctx.shutdown()
 

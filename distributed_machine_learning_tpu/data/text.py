@@ -1,0 +1,134 @@
+"""Byte-level text dataset for LM training — real data, zero deps.
+
+The CNN side has a real dataset pipeline (``data/cifar10.py`` replacing
+torchvision — SURVEY.md §1 "data pipeline"); the LM side until now
+trained on synthetic random tokens.  This module gives it real text with
+the same design rules as the CIFAR pipeline:
+
+- **no external deps**: any directory of text files (code, markdown,
+  logs) becomes a corpus; bytes are the tokens (vocab 256 + BOS=256 →
+  257), so there is no tokenizer artifact to ship or download;
+- **deterministic**: files are read in sorted order, windows are drawn
+  by a seeded PRNG — every host computes the identical stream;
+- **sharded like DistributedSampler(shuffle=False)**: rank r takes
+  windows r, r+R, r+2R… of the global window sequence
+  (``part2/2a/main.py:158-159`` semantics, applied to windows).
+
+Batches are ``[B, L+1]`` int32 blocks; ``[:, :-1]`` feeds the model and
+``[:, 1:]`` are the shifted targets (the shift happens on the host —
+under sequence sharding it must cross chunk boundaries, see
+``train/lm_step.py``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+BOS = 256
+VOCAB_SIZE = 257  # 256 byte values + BOS
+
+_TEXT_EXTS = (".txt", ".md", ".py", ".cc", ".h", ".json", ".rst", ".toml",
+              ".yaml", ".yml", ".cfg", ".sh")
+
+
+def load_corpus(root: str | os.PathLike, max_bytes: int | None = None,
+                exts: tuple[str, ...] = _TEXT_EXTS) -> np.ndarray:
+    """Concatenate every text file under ``root`` (sorted walk, BOS
+    between documents) into one uint16 token array."""
+    root = os.fspath(root)
+    if os.path.isfile(root):
+        paths = [root]
+    else:
+        paths = sorted(
+            os.path.join(dirpath, f)
+            for dirpath, _, files in os.walk(root)
+            for f in files
+            if f.endswith(exts)
+        )
+    if not paths:
+        raise FileNotFoundError(
+            f"no text files ({'/'.join(e.lstrip('.') for e in exts)}) "
+            f"under {root!r}"
+        )
+    parts = [np.array([BOS], np.uint16)]
+    total = 1
+    for p in paths:
+        with open(p, "rb") as f:
+            raw = f.read()
+        parts.append(np.frombuffer(raw, np.uint8).astype(np.uint16))
+        parts.append(np.array([BOS], np.uint16))
+        total += len(raw) + 1
+        if max_bytes is not None and total >= max_bytes:
+            break
+    corpus = np.concatenate(parts)
+    if max_bytes is not None:
+        corpus = corpus[:max_bytes]
+    return corpus
+
+
+class TextWindowLoader:
+    """Seeded random-window batches over a token array.
+
+    Yields ``[B, seq_len+1]`` int32 blocks forever (the training driver
+    owns the iteration cap — ``train/loop.py``).  ``rank``/``world``
+    shard the window sequence rank-strided, so the union over ranks is
+    the same window stream a single process would draw — the exact
+    sharding contract of the CNN's ``DistributedBatchLoader``.
+    """
+
+    def __init__(self, corpus: np.ndarray, batch: int, seq_len: int,
+                 seed: int = 69143, rank: int = 0, world: int = 1):
+        if len(corpus) < seq_len + 1:
+            raise ValueError(
+                f"corpus has {len(corpus)} tokens, need >= {seq_len + 1}"
+            )
+        if not (0 <= rank < world):
+            raise ValueError(f"rank {rank} outside world {world}")
+        if batch < 1 or seq_len < 1:
+            raise ValueError(
+                f"batch and seq_len must be >= 1, got {batch}, {seq_len}"
+            )
+        self.corpus = corpus
+        self.batch = batch
+        self.seq_len = seq_len
+        self.rank = rank
+        self.world = world
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        B, L = self.batch, self.seq_len
+        starts_per_draw = B * self.world
+        while True:
+            # One global draw; every rank computes it identically and
+            # keeps its stride (deterministic cross-host agreement with
+            # zero communication — seeds replace gloo's rendezvous).
+            # Valid starts: 0 .. len-(L+1) inclusive (window is L+1 wide);
+            # integers() is exclusive-high.
+            starts = self._rng.integers(
+                0, len(self.corpus) - L, starts_per_draw
+            )
+            mine = starts[self.rank :: self.world]
+            block = np.stack(
+                [self.corpus[s : s + L + 1] for s in mine]
+            ).astype(np.int32)
+            yield block[:, :-1], block[:, 1:]
+
+
+def eval_windows(corpus: np.ndarray, batch: int, seq_len: int,
+                 num_batches: int, seed: int = 69143 + 1):
+    """A fixed, finite eval set: ``num_batches`` deterministic windows
+    disjoint from nothing in particular (held out by seed, the same
+    convention the reference uses for its fixed test split)."""
+    if len(corpus) < seq_len + 1:
+        raise ValueError(
+            f"corpus has {len(corpus)} tokens, need >= {seq_len + 1}"
+        )
+    rng = np.random.default_rng(seed)
+    for _ in range(num_batches):
+        starts = rng.integers(0, len(corpus) - seq_len, batch)
+        block = np.stack(
+            [corpus[s : s + seq_len + 1] for s in starts]
+        ).astype(np.int32)
+        yield block[:, :-1], block[:, 1:]

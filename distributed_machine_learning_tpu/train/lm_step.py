@@ -135,6 +135,30 @@ def make_lm_train_step(
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+def make_lm_eval_step(model):
+    """Jitted LM eval: ``(params, tokens, targets) -> (nll_sum, count)``.
+
+    Returns the *sum* of per-token negative log-likelihoods and the
+    token count, so the caller can pool across batches of any size and
+    compute exact corpus-level perplexity ``exp(total_nll / total_count)``
+    (``train/loop.py::evaluate_lm``) — the LM analogue of the CNN's
+    ``test_model`` protocol (``part1/main.py:62-77``).  Params are
+    replicated in the dp/ring/ulysses schemes, so eval runs dense on one
+    program (the model is cloned to dense attention).
+    """
+    dense = model.clone(attn_impl="dense") if model.attn_impl != "dense" else model
+
+    @jax.jit
+    def eval_step(params, tokens, targets):
+        logits = dense.apply({"params": params}, tokens, train=False)
+        # mean CE × count = exact NLL sum; one shared loss implementation
+        # keeps eval ppl and training loss from ever diverging.
+        nll = lm_cross_entropy(logits, targets) * targets.size
+        return nll, jnp.asarray(targets.size, jnp.int32)
+
+    return eval_step
+
+
 def shard_lm_batch(
     mesh: Mesh,
     tokens,

@@ -75,6 +75,32 @@ def train_epoch(
     return state, timer
 
 
+def evaluate_lm(eval_step, params, batches: Iterable) -> tuple[float, float]:
+    """Corpus-level LM eval: pooled mean NLL/token and perplexity.
+
+    ``eval_step`` from ``train/lm_step.py::make_lm_eval_step``; batches
+    yield host ``(tokens, targets)`` pairs.  Pools nll *sums* and token
+    counts so unequal batch sizes still give the exact corpus mean
+    (unlike the reference's mean-of-batch-means — ``part1/main.py:74``,
+    which this deliberately improves on for the LM path).
+    """
+    import math
+
+    total_nll = 0.0
+    total_tokens = 0
+    for tokens, targets in batches:
+        nll, count = eval_step(params, tokens, targets)
+        total_nll += float(nll)
+        total_tokens += int(count)
+    mean_nll = total_nll / max(total_tokens, 1)
+    ppl = math.exp(min(mean_nll, 700.0))  # overflow guard for garbage models
+    rank0_print(
+        f"Eval: nll/token {mean_nll:.4f}, perplexity {ppl:.2f} "
+        f"({total_tokens} tokens)"
+    )
+    return mean_nll, ppl
+
+
 def evaluate(
     eval_step,
     state: TrainState,

@@ -1,0 +1,160 @@
+"""Byte-level text corpus + LM perplexity eval (data/text.py,
+train/lm_step.py::make_lm_eval_step): determinism, sharding union, and
+eval math."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.data.text import (
+    BOS,
+    VOCAB_SIZE,
+    TextWindowLoader,
+    eval_windows,
+    load_corpus,
+)
+
+
+def _write_corpus(tmp_path):
+    (tmp_path / "a.txt").write_text("hello world")
+    (tmp_path / "b.md").write_text("byte level")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "c.py").write_text("print(1)")
+    (tmp_path / "skip.bin").write_bytes(b"\x00\x01")  # not a text ext
+    return tmp_path
+
+
+def test_load_corpus_sorted_with_bos(tmp_path):
+    corpus = load_corpus(_write_corpus(tmp_path))
+    # Leading BOS + one BOS after each of the 3 text files; .bin skipped.
+    assert (corpus == BOS).sum() == 4
+    text = bytes(t for t in corpus.tolist() if t != BOS).decode()
+    assert text == "hello worldbyte levelprint(1)"
+    assert corpus.max() <= BOS and VOCAB_SIZE == 257
+
+
+def test_load_corpus_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_corpus(tmp_path / "empty_dir_that_has_no_files")
+
+
+def test_loader_deterministic_and_shaped(tmp_path):
+    corpus = load_corpus(_write_corpus(tmp_path))
+    a = iter(TextWindowLoader(corpus, batch=3, seq_len=8, seed=7))
+    b = iter(TextWindowLoader(corpus, batch=3, seq_len=8, seed=7))
+    xa, ya = next(a)
+    xb, yb = next(b)
+    assert xa.shape == (3, 8) and ya.shape == (3, 8)
+    np.testing.assert_array_equal(xa, xb)
+    np.testing.assert_array_equal(ya[:, :-1], xa[:, 1:])  # shifted targets
+
+
+def test_rank_sharding_union_matches_single_stream(tmp_path):
+    # Rank-strided windows: the union over ranks == the world-size-1
+    # stream drawn with batch B*world (DistributedSampler semantics).
+    corpus = load_corpus(_write_corpus(tmp_path))
+    world = 4
+    full = next(iter(TextWindowLoader(corpus, batch=8, seq_len=4, seed=3)))[0]
+    shards = [
+        next(iter(TextWindowLoader(corpus, batch=2, seq_len=4, seed=3,
+                                   rank=r, world=world)))[0]
+        for r in range(world)
+    ]
+    recombined = np.empty_like(full)
+    for r in range(world):
+        recombined[r::world] = shards[r]
+    np.testing.assert_array_equal(recombined, full)
+
+
+def test_loader_validation(tmp_path):
+    corpus = load_corpus(_write_corpus(tmp_path))
+    with pytest.raises(ValueError, match="corpus"):
+        TextWindowLoader(corpus, batch=1, seq_len=10_000)
+    with pytest.raises(ValueError, match="rank"):
+        TextWindowLoader(corpus, batch=1, seq_len=4, rank=2, world=2)
+    with pytest.raises(ValueError, match="batch"):
+        TextWindowLoader(corpus, batch=0, seq_len=4)
+
+
+def test_eval_windows_fixed(tmp_path):
+    corpus = load_corpus(_write_corpus(tmp_path))
+    a = list(eval_windows(corpus, batch=2, seq_len=4, num_batches=3))
+    b = list(eval_windows(corpus, batch=2, seq_len=4, num_batches=3))
+    assert len(a) == 3
+    for (xa, _), (xb, _) in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)
+
+
+def test_lm_eval_perplexity_math(rng):
+    # Pooled NLL over unequal batches must equal the exact corpus mean;
+    # cross-check perplexity against the per-token loss definition.
+    from distributed_machine_learning_tpu.models.transformer import TransformerLM
+    from distributed_machine_learning_tpu.train.lm_step import (
+        init_lm_state,
+        make_lm_eval_step,
+    )
+    from distributed_machine_learning_tpu.train.loop import evaluate_lm
+    from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
+
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=1, n_heads=2)
+    state = init_lm_state(model)
+    step = make_lm_eval_step(model)
+    b1 = rng.integers(0, 32, (2, 9)).astype(np.int32)
+    b2 = rng.integers(0, 32, (1, 9)).astype(np.int32)  # unequal batch
+    batches = [(b[:, :-1], b[:, 1:]) for b in (b1, b2)]
+    mean_nll, ppl = evaluate_lm(step, state.params, batches)
+
+    tot, cnt = 0.0, 0
+    for x, y in batches:
+        logits = model.apply({"params": state.params}, jnp.asarray(x),
+                             train=False)
+        tot += float(lm_cross_entropy(logits, jnp.asarray(y))) * y.size
+        cnt += y.size
+    assert mean_nll == pytest.approx(tot / cnt, rel=1e-6)
+    assert ppl == pytest.approx(math.exp(tot / cnt), rel=1e-6)
+
+
+def test_eval_step_uses_dense_for_ring_model(rng):
+    from distributed_machine_learning_tpu.models.transformer import TransformerLM
+    from distributed_machine_learning_tpu.train.lm_step import (
+        init_lm_state,
+        make_lm_eval_step,
+    )
+
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=1, n_heads=2,
+                          attn_impl="ring")
+    state = init_lm_state(model)
+    step = make_lm_eval_step(model)  # clones to dense: runs without a mesh
+    b = rng.integers(0, 32, (2, 9)).astype(np.int32)
+    nll, count = step(state.params, b[:, :-1], b[:, 1:])
+    assert np.isfinite(float(nll)) and int(count) == 16
+
+
+def test_smallest_legal_corpus_and_last_window_reachable():
+    # len == seq_len+1 must yield the single valid window (regression:
+    # the start bound was off by one and crashed exactly this case).
+    corpus = np.arange(9, dtype=np.uint16)
+    x, y = next(iter(TextWindowLoader(corpus, batch=2, seq_len=8)))
+    np.testing.assert_array_equal(x, np.tile(np.arange(8), (2, 1)))
+    np.testing.assert_array_equal(y, np.tile(np.arange(1, 9), (2, 1)))
+    ex, ey = next(iter(eval_windows(corpus, 1, 8, 1)))
+    np.testing.assert_array_equal(ex[0], np.arange(8))
+
+    # Larger corpus: the final start (len - L - 1) must be drawable.
+    corpus = np.arange(12, dtype=np.uint16)
+    seen_last = False
+    loader = iter(TextWindowLoader(corpus, batch=16, seq_len=4, seed=0))
+    for _ in range(50):
+        x, _ = next(loader)
+        if (x[:, 0] == 7).any():  # start 7 == 12 - 4 - 1
+            seen_last = True
+            break
+    assert seen_last
+
+
+def test_eval_windows_validates_short_corpus():
+    with pytest.raises(ValueError, match="corpus"):
+        next(eval_windows(np.arange(4, dtype=np.uint16), 1, 8, 1))
