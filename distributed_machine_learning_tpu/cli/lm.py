@@ -6,6 +6,9 @@ entrypoint gives the transformer stack the same driveable surface, with
 ``--parallel`` selecting how the step distributes over the mesh:
 
   dp       data parallelism (replicated params, pmean grads)
+  fsdp     ZeRO-3 sharded data parallelism — params + optimizer state
+           1/N per device (parallel/fsdp.py); pair with adamw, whose
+           fp32 moments are the memory ZeRO shards
   ring     context parallelism — ppermute ring attention over the
            sequence axis (ops/ring_attention.py)
   ulysses  context parallelism — all-to-all head re-sharding
@@ -48,7 +51,8 @@ def make_parser():
     p = argparse.ArgumentParser(description=__doc__)
     add_node_flags(p)
     p.add_argument("--parallel", default="dp",
-                   choices=["dp", "ring", "ulysses", "tp", "pp", "3d"])
+                   choices=["dp", "ring", "ulysses", "fsdp", "tp", "pp",
+                            "3d"])
     p.add_argument("--d-model", dest="d_model", default=256, type=int)
     p.add_argument("--n-layers", dest="n_layers", default=4, type=int)
     p.add_argument("--n-heads", dest="n_heads", default=8, type=int)
@@ -86,7 +90,8 @@ def make_parser():
                         "stream")
     p.add_argument("--eval-batches", dest="eval_batches", default=0, type=int,
                    help="after training, evaluate perplexity on this many "
-                        "held-out windows (dp/ring/ulysses modes; 0 skips)")
+                        "held-out windows (dp/ring/ulysses, and fsdp on a "
+                        "single process; 0 skips)")
     p.add_argument("--fused-ce-chunks", dest="fused_ce_chunks", default=None,
                    type=int,
                    help="compute the loss fused with the lm_head in this "
@@ -107,7 +112,9 @@ def synthetic_tokens(rng: np.random.Generator, batch: int, seq_len: int,
 
 
 def build(args):
-    """(step, state, place) for the chosen parallelism scheme."""
+    """(step, state, place, model, params_fn) for the chosen parallelism
+    scheme; ``params_fn(state)`` yields the replicated params pytree for
+    eval (a gather for fsdp)."""
     import jax.numpy as jnp
 
     n = jax.device_count()
@@ -120,11 +127,13 @@ def build(args):
 
     cfg_cls = get_optimizer(args.optimizer)[0]
     opt_config = cfg_cls() if args.lr is None else cfg_cls(learning_rate=args.lr)
-    if args.fused_ce_chunks and args.parallel not in ("dp", "ring", "ulysses"):
+    if args.fused_ce_chunks and args.parallel not in (
+        "dp", "ring", "ulysses", "fsdp"
+    ):
         raise ValueError(
-            "--fused-ce-chunks applies to the dp/ring/ulysses step only "
-            "(tp shards the lm_head, pp computes the loss on the last "
-            "stage)"
+            "--fused-ce-chunks applies to the dp/ring/ulysses/fsdp steps "
+            "only (tp shards the lm_head, pp computes the loss on the "
+            "last stage)"
         )
 
     if args.parallel in ("dp", "ring", "ulysses"):
@@ -155,7 +164,38 @@ def build(args):
         step = make_lm_train_step(model, mesh=mesh,
                                   fused_ce_chunks=args.fused_ce_chunks)
         place = lambda x, y: shard_lm_batch(mesh, x, y)
-        return step, state, place, model
+        return step, state, place, model, lambda st: st.params
+
+    if args.parallel == "fsdp":
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_machine_learning_tpu.parallel.fsdp import (
+            gather_fsdp_params,
+            make_fsdp_lm_train_step,
+            shard_fsdp_state,
+        )
+        from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+        if args.batch_size % n:
+            raise ValueError(
+                f"--batch-size {args.batch_size} must be divisible by "
+                f"the {n}-device data axis"
+            )
+        mesh = make_mesh(n)
+        model = TransformerLM(**common)
+        fstate, unravel, n_elems = shard_fsdp_state(
+            init_lm_state(model, seed=SEED, config=opt_config), mesh
+        )
+        step = make_fsdp_lm_train_step(
+            model, mesh, unravel, n_elems,
+            fused_ce_chunks=args.fused_ce_chunks,
+        )
+        sharding = NamedSharding(mesh, P("batch"))
+        place = lambda x, y: (
+            jax.device_put(x, sharding), jax.device_put(y, sharding)
+        )
+        params_fn = lambda st: gather_fsdp_params(st, unravel, n_elems)
+        return step, fstate, place, model, params_fn
 
     if args.parallel == "tp":
         from distributed_machine_learning_tpu.parallel.tensor_parallel import (
@@ -172,7 +212,7 @@ def build(args):
         step = make_tp_lm_train_step(model, mesh)
         state = shard_tp_state(init_lm_state(model, seed=SEED, config=opt_config), mesh)
         place = lambda x, y: shard_tp_batch(mesh, x, y)
-        return step, state, place, model
+        return step, state, place, model, lambda st: st.params
 
     if args.parallel == "pp":
         from distributed_machine_learning_tpu.parallel.pipeline import (
@@ -187,7 +227,7 @@ def build(args):
         step = make_pp_lm_train_step(model, mesh, args.microbatches)
         state = shard_pp_state(init_pipeline_state(model, seed=SEED, config=opt_config), mesh)
         place = lambda x, y: microbatch(x, y, args.microbatches)
-        return step, state, place, model
+        return step, state, place, model, lambda st: st.params
 
     # 3d
     from distributed_machine_learning_tpu.parallel.parallel3d import (
@@ -217,7 +257,7 @@ def build(args):
     step = make_3d_lm_train_step(model, mesh, args.microbatches)
     state = shard_3d_state(init_pipeline_state(model, seed=SEED, config=opt_config), mesh)
     place = lambda x, y: shard_3d_batch(mesh, *microbatch(x, y, args.microbatches))
-    return step, state, place, model
+    return step, state, place, model, lambda st: st.params
 
 
 def main(argv=None) -> None:
@@ -244,7 +284,7 @@ def main(argv=None) -> None:
                 )
                 args.vocab = VOCAB_SIZE
             rank0_print(f"corpus: {len(corpus)} tokens from {args.data_dir}")
-        step, state, place, model = build(args)
+        step, state, place, model, params_fn = build(args)
         rng = np.random.default_rng(SEED)
 
         if corpus is not None:
@@ -252,18 +292,14 @@ def main(argv=None) -> None:
                 TextWindowLoader,
             )
 
-            # Rank-strided window sharding across processes: each host
-            # draws its slice of the same global stream, so DP over
-            # hosts sees distinct data (the DistributedSampler contract).
-            world = jax.process_count()
-            if args.batch_size % world:
-                raise ValueError(
-                    f"--batch-size {args.batch_size} must be divisible "
-                    f"by the {world} processes"
-                )
+            # Same convention as the synthetic path: every process
+            # draws the identical FULL global batch (seeded), and
+            # place() shards it over the mesh — so the global data
+            # stream is process-count-invariant.  (TextWindowLoader's
+            # rank/world striding is the per-host-slice alternative for
+            # pipelines that assemble global arrays from local shards.)
             batches = lambda: iter(TextWindowLoader(
-                corpus, args.batch_size // world, args.seq_len, seed=SEED,
-                rank=jax.process_index(), world=world,
+                corpus, args.batch_size, args.seq_len, seed=SEED,
             ))
         else:
             def batches():
@@ -280,11 +316,15 @@ def main(argv=None) -> None:
             max_iters=args.max_iters,
         )
         if args.eval_batches:
-            if args.parallel not in ("dp", "ring", "ulysses"):
+            eval_ok = args.parallel in ("dp", "ring", "ulysses") or (
+                args.parallel == "fsdp" and jax.process_count() == 1
+            )
+            if not eval_ok:
                 rank0_print(
-                    "WARNING: --eval-batches only supports the "
-                    "replicated-param modes (dp/ring/ulysses); skipping "
-                    f"eval for --parallel {args.parallel}"
+                    "WARNING: --eval-batches supports dp/ring/ulysses "
+                    "(and single-process fsdp, whose param gather is "
+                    "host-local); skipping eval for --parallel "
+                    f"{args.parallel}"
                 )
             else:
                 from distributed_machine_learning_tpu.data.text import (
@@ -310,7 +350,7 @@ def main(argv=None) -> None:
                             for _ in range(args.eval_batches)
                         )
                     )
-                evaluate_lm(make_lm_eval_step(model), state.params, ev)
+                evaluate_lm(make_lm_eval_step(model), params_fn(state), ev)
     finally:
         ctx.shutdown()
 

@@ -68,6 +68,17 @@ def load_corpus(root: str | os.PathLike, max_bytes: int | None = None,
     return corpus
 
 
+def _draw_windows(corpus: np.ndarray, rng: np.random.Generator,
+                  batch: int, seq_len: int) -> np.ndarray:
+    """[batch, seq_len+1] int32 windows — the single window-drawing
+    implementation (bounds: starts in [0, len-(L+1)]; ``integers`` is
+    exclusive-high) shared by the training loader and ``eval_windows``."""
+    starts = rng.integers(0, len(corpus) - seq_len, batch)
+    return np.stack(
+        [corpus[s : s + seq_len + 1] for s in starts]
+    ).astype(np.int32)
+
+
 class TextWindowLoader:
     """Seeded random-window batches over a token array.
 
@@ -98,21 +109,14 @@ class TextWindowLoader:
         self._rng = np.random.default_rng(seed)
 
     def __iter__(self):
-        B, L = self.batch, self.seq_len
-        starts_per_draw = B * self.world
         while True:
             # One global draw; every rank computes it identically and
             # keeps its stride (deterministic cross-host agreement with
             # zero communication — seeds replace gloo's rendezvous).
-            # Valid starts: 0 .. len-(L+1) inclusive (window is L+1 wide);
-            # integers() is exclusive-high.
-            starts = self._rng.integers(
-                0, len(self.corpus) - L, starts_per_draw
-            )
-            mine = starts[self.rank :: self.world]
-            block = np.stack(
-                [self.corpus[s : s + L + 1] for s in mine]
-            ).astype(np.int32)
+            block = _draw_windows(
+                self.corpus, self._rng, self.batch * self.world,
+                self.seq_len,
+            )[self.rank :: self.world]
             yield block[:, :-1], block[:, 1:]
 
 
@@ -127,8 +131,5 @@ def eval_windows(corpus: np.ndarray, batch: int, seq_len: int,
         )
     rng = np.random.default_rng(seed)
     for _ in range(num_batches):
-        starts = rng.integers(0, len(corpus) - seq_len, batch)
-        block = np.stack(
-            [corpus[s : s + seq_len + 1] for s in starts]
-        ).astype(np.int32)
+        block = _draw_windows(corpus, rng, batch, seq_len)
         yield block[:, :-1], block[:, 1:]

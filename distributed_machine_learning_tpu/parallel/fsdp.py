@@ -42,7 +42,9 @@ from distributed_machine_learning_tpu.runtime.mesh import (
     shard_map_no_check as _shard_map,
 )
 from distributed_machine_learning_tpu.train.common import make_loss_fn, step_rng
-from distributed_machine_learning_tpu.train.sgd import SGDConfig, sgd_update
+from distributed_machine_learning_tpu.train.lars import LARSConfig
+from distributed_machine_learning_tpu.train.optimizers import update_fn_for_config
+from distributed_machine_learning_tpu.train.sgd import SGDConfig
 from distributed_machine_learning_tpu.train.state import TrainState
 
 
@@ -58,7 +60,9 @@ class FSDPState:
     """
 
     param_shards: jax.Array
-    momentum_shards: jax.Array
+    # Flat like param_shards for SGD; a {"mu","nu"} dict of flat vectors
+    # for AdamW (both elementwise — exact on arbitrary slices).
+    momentum_shards: jax.Array | dict
     batch_stats: dict
     step: jax.Array
     rng: jax.Array
@@ -103,8 +107,19 @@ def flatten_padded(state: TrainState, n_dev: int):
     n_elems = int(flat.shape[0])
     padded = _padded_len(n_elems, n_dev)
     flat = jnp.pad(flat, (0, padded - n_elems))
-    mom_flat, _ = ravel_pytree(state.momentum)
-    mom_flat = jnp.pad(mom_flat, (0, padded - mom_flat.shape[0]))
+
+    def flat_pad(tree):
+        f, _ = ravel_pytree(tree)
+        return jnp.pad(f, (0, padded - f.shape[0]))
+
+    p_struct = jax.tree_util.tree_structure(state.params)
+    if jax.tree_util.tree_structure(state.momentum) == p_struct:
+        mom_flat = flat_pad(state.momentum)  # SGD: one buffer vector
+    else:
+        # AdamW: each param-shaped moment tree flattens in the same leaf
+        # order as the params, so flat index i of mu/nu is the moment of
+        # flat param i — slicing stays aligned.
+        mom_flat = {k: flat_pad(v) for k, v in state.momentum.items()}
     return flat, mom_flat, unravel, n_elems
 
 
@@ -118,13 +133,13 @@ def shard_fsdp_state(
     unpadded parameter count — both needed by
     :func:`make_fsdp_train_step` and by checkpoint export.
     """
-    if type(state.config) is not SGDConfig:
+    if isinstance(state.config, LARSConfig):
         # The flat-shard layout slices the parameter vector arbitrarily:
-        # elementwise SGD is exact on any slice, but LARS (per-layer
-        # norms) and AdamW (a {"mu","nu"} moment layout) are not.
+        # elementwise updates (SGD, AdamW) are exact on any slice, but
+        # LARS's per-leaf norms would become per-slice norms.
         raise ValueError(
-            "ZeRO-3/FSDP supports plain SGD momentum only; got "
-            f"{type(state.config).__name__}"
+            "ZeRO-3/FSDP cannot shard LARS (per-layer norms are not "
+            "sliceable); use sgd or adamw"
         )
     flat, mom_flat, unravel, n_elems = flatten_padded(
         state, mesh.shape[axis_name]
@@ -133,7 +148,9 @@ def shard_fsdp_state(
     replicated = NamedSharding(mesh, P())
     fsdp_state = FSDPState(
         param_shards=jax.device_put(flat, sharding),
-        momentum_shards=jax.device_put(mom_flat, sharding),
+        momentum_shards=jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding), mom_flat
+        ),
         batch_stats=jax.device_put(state.batch_stats, replicated),
         step=jax.device_put(state.step, replicated),
         rng=jax.device_put(state.rng, replicated),
@@ -188,11 +205,13 @@ def make_fsdp_train_step(
                 full_flat.shape[0],
             )
 
-            # (4) SGD/momentum on the local shard only (shared torch update
-            # rule — train/sgd.py works on bare arrays): weight decay reads
-            # the local *param* shard, so no second all-gather is needed.
-            new_params, new_mom = sgd_update(
-                param_shards, momentum_shards, grad_shard, cfg
+            # (4) Optimizer update on the local shard only (the registry
+            # update fns work on bare arrays / dicts of arrays): weight
+            # decay reads the local *param* shard, so no second
+            # all-gather is needed.
+            new_params, new_mom = update_fn_for_config(cfg)(
+                param_shards, momentum_shards, grad_shard, cfg,
+                step=step_ctr,
             )
             return new_params, new_mom, new_stats, loss
 
@@ -218,6 +237,85 @@ def make_fsdp_train_step(
             param_shards=new_params,
             momentum_shards=new_mom,
             batch_stats=new_stats,
+            step=state.step + 1,
+        )
+        return new_state, loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_fsdp_lm_train_step(
+    model,
+    mesh: Mesh,
+    unravel,
+    n_elems: int,
+    axis_name: str = BATCH_AXIS,
+    fused_ce_chunks: int | None = None,
+):
+    """ZeRO-3 for the transformer LM: params + optimizer state sharded
+    1/N over the data axis, batch sharded over the same axis.
+
+    The flat-shard machinery is model-agnostic, so this is the same
+    all-gather → fwd/bwd → psum_scatter → local-shard-update recipe as
+    the CNN step, with the LM loss (``train/lm_step.py::lm_loss`` —
+    optionally the fused head+loss) in the middle.  Pair with AdamW
+    (``config=AdamWConfig()``): the two fp32 moment vectors are the
+    memory ZeRO exists to shard.  Dense attention only (ring/ulysses
+    need a 2-D mesh; composing FSDP×CP is future work).
+
+    Returns ``step(fsdp_state, tokens, targets) -> (fsdp_state, loss)``.
+    """
+    if model.attn_impl != "dense":
+        raise ValueError(
+            "FSDP LM step requires attn_impl='dense' (sequence-sharded "
+            "attention needs a second mesh axis)"
+        )
+    n = mesh.shape[axis_name]
+
+    def sharded_for(cfg):
+        def impl(param_shards, momentum_shards, step_ctr, rng, tokens,
+                 targets):
+            del rng  # no augmentation on the LM path
+            from distributed_machine_learning_tpu.train.lm_step import lm_loss
+
+            full_flat = lax.all_gather(param_shards, axis_name, tiled=True)
+            params = unravel(full_flat[:n_elems])
+
+            loss, grads = jax.value_and_grad(
+                lambda p: lm_loss(model, p, tokens, targets, fused_ce_chunks)
+            )(params)
+            flat_grads, _ = ravel_pytree(grads)
+            flat_grads = jnp.pad(
+                flat_grads, (0, full_flat.shape[0] - flat_grads.shape[0])
+            )
+            grad_shard = lax.psum_scatter(flat_grads, axis_name, tiled=True) / n
+
+            new_params, new_mom = update_fn_for_config(cfg)(
+                param_shards, momentum_shards, grad_shard, cfg,
+                step=step_ctr,
+            )
+            return new_params, new_mom, lax.pmean(loss, axis_name)
+
+        shard = P(axis_name)
+        return _shard_map(
+            impl,
+            mesh=mesh,
+            in_specs=(shard, shard, P(), P(), shard, shard),
+            out_specs=(shard, shard, P()),
+        )
+
+    def step(state: FSDPState, tokens, targets):
+        new_params, new_mom, loss = sharded_for(state.config)(
+            state.param_shards,
+            state.momentum_shards,
+            state.step,
+            state.rng,
+            tokens,
+            targets,
+        )
+        new_state = state.replace(
+            param_shards=new_params,
+            momentum_shards=new_mom,
             step=state.step + 1,
         )
         return new_state, loss

@@ -44,7 +44,9 @@ from distributed_machine_learning_tpu.runtime.mesh import (
     shard_map_no_check as _shard_map,
 )
 from distributed_machine_learning_tpu.train.common import step_rng
-from distributed_machine_learning_tpu.train.sgd import SGDConfig, sgd_update
+from distributed_machine_learning_tpu.train.lars import LARSConfig
+from distributed_machine_learning_tpu.train.optimizers import update_fn_for_config
+from distributed_machine_learning_tpu.train.sgd import SGDConfig
 from distributed_machine_learning_tpu.train.state import TrainState
 
 
@@ -53,7 +55,9 @@ class Zero1State:
     """Replicated flat params + 1/N momentum shards per device."""
 
     param_flat: jax.Array  # [padded_len], replicated
-    momentum_shards: jax.Array  # [padded_len] global, sharded over batch axis
+    # [padded_len] global, sharded over the batch axis; a {"mu","nu"}
+    # dict of such vectors for AdamW.
+    momentum_shards: jax.Array | dict
     batch_stats: dict
     step: jax.Array
     rng: jax.Array
@@ -66,20 +70,22 @@ def shard_zero1_state(state: TrainState, mesh: Mesh, axis_name: str = BATCH_AXIS
     Returns ``(zero1_state, unravel, n_elems)`` — ``unravel`` maps the
     unpadded flat vector back to the params pytree.
     """
-    if type(state.config) is not SGDConfig:
-        # The flat-shard layout slices the parameter vector arbitrarily:
-        # elementwise SGD is exact on any slice, but LARS (per-layer
-        # norms) and AdamW (a {"mu","nu"} moment layout) are not.
+    if isinstance(state.config, LARSConfig):
+        # Elementwise updates (SGD, AdamW) are exact on any slice of the
+        # flat vector; LARS's per-leaf norms are not.
         raise ValueError(
-            "ZeRO-1 supports plain SGD momentum only; got "
-            f"{type(state.config).__name__}"
+            "ZeRO-1 cannot shard LARS (per-layer norms are not "
+            "sliceable); use sgd or adamw"
         )
     flat, mom_flat, unravel, n_elems = flatten_padded(
         state, mesh.shape[axis_name]
     )
     z1 = Zero1State(
         param_flat=jax.device_put(flat, NamedSharding(mesh, P())),
-        momentum_shards=jax.device_put(mom_flat, NamedSharding(mesh, P(axis_name))),
+        momentum_shards=jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P(axis_name))),
+            mom_flat,
+        ),
         batch_stats=jax.device_put(
             state.batch_stats, NamedSharding(mesh, P())
         ),
@@ -133,8 +139,8 @@ def make_zero1_train_step(
             p_shard = lax.dynamic_slice(
                 param_flat, (rank * shard_len,), (shard_len,)
             )
-            new_p_shard, new_m_shard = sgd_update(
-                p_shard, momentum_shard, grad_shard, cfg
+            new_p_shard, new_m_shard = update_fn_for_config(cfg)(
+                p_shard, momentum_shard, grad_shard, cfg, step=step_ctr
             )
 
             # (4) All-gather the updated slices into the full vector.

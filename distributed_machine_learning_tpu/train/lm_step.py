@@ -33,30 +33,39 @@ DATA_AXIS = "batch"
 SEQ_AXIS = "seq"
 
 
+def lm_loss(model, params, tokens, targets,
+            fused_ce_chunks: int | None = None):
+    """The LM training loss — one definition shared by the replicated
+    step below and the ZeRO-3 LM step (``parallel/fsdp.py``).
+
+    With ``fused_ce_chunks`` the head+loss are fused: the [B, L, vocab]
+    logits are never materialized — the model returns post-ln_f hidden
+    states and ``ops/fused_ce.py`` scans the vocab in chunks.
+    """
+    if fused_ce_chunks:
+        from distributed_machine_learning_tpu.ops.fused_ce import (
+            fused_linear_cross_entropy,
+        )
+
+        hidden = model.apply(
+            {"params": params}, tokens, train=True, return_hidden=True
+        )
+        E = hidden.shape[-1]
+        return fused_linear_cross_entropy(
+            hidden.reshape(-1, E),
+            params["lm_head"]["kernel"],
+            params["lm_head"]["bias"],
+            targets.reshape(-1),
+            fused_ce_chunks,
+        )
+    logits = model.apply({"params": params}, tokens, train=True)
+    return lm_cross_entropy(logits, targets)
+
+
 def _lm_step_impl(model, state: TrainState, tokens, targets, *, axis_names,
                   fused_ce_chunks: int | None = None):
     def loss_fn(params):
-        if fused_ce_chunks:
-            # Fused head+loss: the [B, L, vocab] logits are never
-            # materialized — the model returns post-ln_f hidden states
-            # and ops/fused_ce.py scans the vocab in chunks.
-            from distributed_machine_learning_tpu.ops.fused_ce import (
-                fused_linear_cross_entropy,
-            )
-
-            hidden = model.apply(
-                {"params": params}, tokens, train=True, return_hidden=True
-            )
-            E = hidden.shape[-1]
-            return fused_linear_cross_entropy(
-                hidden.reshape(-1, E),
-                params["lm_head"]["kernel"],
-                params["lm_head"]["bias"],
-                targets.reshape(-1),
-                fused_ce_chunks,
-            )
-        logits = model.apply({"params": params}, tokens, train=True)
-        return lm_cross_entropy(logits, targets)
+        return lm_loss(model, params, tokens, targets, fused_ce_chunks)
 
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
     if axis_names:
