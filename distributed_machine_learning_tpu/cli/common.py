@@ -73,6 +73,13 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
     parser.add_argument("--ckpt-dir", default=None, type=str,
                         help="checkpoint directory; saves TrainState after "
                              "each epoch (off by default — reference parity)")
+    parser.add_argument("--async-ckpt", dest="async_ckpt",
+                        action="store_true",
+                        help="write checkpoints asynchronously (orbax "
+                             "background thread; train/checkpoint.py::"
+                             "AsyncCheckpointWriter) — training continues "
+                             "while the save serializes; the run waits for "
+                             "the last save before exiting")
     parser.add_argument("--resume", action="store_true",
                         help="resume weights/optimizer/step from the latest "
                              "complete checkpoint in --ckpt-dir; the run then "
@@ -235,6 +242,7 @@ def run_part(
     ctx = initialize_from_flags(args.master_ip, args.rank, args.num_nodes)
     preemption = None
     watchdog = None
+    ckpt_writer = None
     try:
         distributed = strategy_name != "none"
         mesh = make_mesh() if distributed else None
@@ -430,11 +438,18 @@ def run_part(
                     watchdog.beat()
             if args.ckpt_dir:
                 from distributed_machine_learning_tpu.train.checkpoint import (
+                    AsyncCheckpointWriter,
                     save_checkpoint,
                 )
 
-                path = save_checkpoint(args.ckpt_dir, state)
-                rank0_print(f"Saved checkpoint to {path}")
+                if args.async_ckpt:
+                    if ckpt_writer is None:
+                        ckpt_writer = AsyncCheckpointWriter()
+                    path = ckpt_writer.save(args.ckpt_dir, state)
+                    rank0_print(f"Saving checkpoint to {path} (async)")
+                else:
+                    path = save_checkpoint(args.ckpt_dir, state)
+                    rank0_print(f"Saved checkpoint to {path}")
                 if watchdog is not None:
                     watchdog.beat()
             if stopping:
@@ -450,7 +465,12 @@ def run_part(
         # Flush in finally so a crash/interrupt mid-run keeps the rows
         # already logged — the feature's main use is diagnosing bad runs.
         if watchdog is not None:
+            # Disarm before the (potentially long) final async-save
+            # flush — a blocking close() with no beats is not a stall.
             watchdog.stop()
+        if ckpt_writer is not None:
+            # Don't exit with a half-written async save in flight.
+            ckpt_writer.close()
         if preemption is not None:
             preemption.uninstall()
         if metrics is not None:

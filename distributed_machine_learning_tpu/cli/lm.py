@@ -56,6 +56,10 @@ def make_parser():
     p.add_argument("--d-model", dest="d_model", default=256, type=int)
     p.add_argument("--n-layers", dest="n_layers", default=4, type=int)
     p.add_argument("--n-heads", dest="n_heads", default=8, type=int)
+    p.add_argument("--n-kv-heads", dest="n_kv_heads", default=None, type=int,
+                   help="grouped-query attention: K/V heads shared by "
+                        "query-head groups (1 = MQA; shrinks the decode "
+                        "KV cache by n_heads/n_kv_heads); default = MHA")
     p.add_argument("--vocab", default=256, type=int,
                    help="byte-level vocabulary by default")
     p.add_argument("--seq-len", dest="seq_len", default=256, type=int)
@@ -122,6 +126,7 @@ def build(args):
     common = dict(
         vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
         n_heads=args.n_heads, compute_dtype=dtype, remat=args.remat,
+        n_kv_heads=args.n_kv_heads,
     )
     from distributed_machine_learning_tpu.train.optimizers import get_optimizer
 

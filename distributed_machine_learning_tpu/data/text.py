@@ -68,15 +68,19 @@ def load_corpus(root: str | os.PathLike, max_bytes: int | None = None,
     return corpus
 
 
-def _draw_windows(corpus: np.ndarray, rng: np.random.Generator,
-                  batch: int, seq_len: int) -> np.ndarray:
-    """[batch, seq_len+1] int32 windows — the single window-drawing
-    implementation (bounds: starts in [0, len-(L+1)]; ``integers`` is
-    exclusive-high) shared by the training loader and ``eval_windows``."""
-    starts = rng.integers(0, len(corpus) - seq_len, batch)
+def _gather_windows(corpus: np.ndarray, starts: np.ndarray,
+                    seq_len: int) -> np.ndarray:
     return np.stack(
         [corpus[s : s + seq_len + 1] for s in starts]
     ).astype(np.int32)
+
+
+def _draw_windows(corpus: np.ndarray, rng: np.random.Generator,
+                  batch: int, seq_len: int) -> np.ndarray:
+    """[batch, seq_len+1] int32 windows — the single window-drawing
+    implementation shared by the training loader and ``eval_windows``."""
+    starts = rng.integers(0, len(corpus) - seq_len, batch)
+    return _gather_windows(corpus, starts, seq_len)
 
 
 class TextWindowLoader:
@@ -109,14 +113,18 @@ class TextWindowLoader:
         self._rng = np.random.default_rng(seed)
 
     def __iter__(self):
+        L = self.seq_len
         while True:
-            # One global draw; every rank computes it identically and
-            # keeps its stride (deterministic cross-host agreement with
-            # zero communication — seeds replace gloo's rendezvous).
-            block = _draw_windows(
-                self.corpus, self._rng, self.batch * self.world,
-                self.seq_len,
-            )[self.rank :: self.world]
+            # One global START draw; every rank computes it identically
+            # (deterministic cross-host agreement with zero communication
+            # — seeds replace gloo's rendezvous) but gathers only its own
+            # stride's windows: 1/world of the copy cost.
+            starts = self._rng.integers(
+                0, len(self.corpus) - L, self.batch * self.world
+            )
+            block = _gather_windows(
+                self.corpus, starts[self.rank :: self.world], L
+            )
             yield block[:, :-1], block[:, 1:]
 
 

@@ -46,6 +46,16 @@ def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0):
     return rotated.astype(x.dtype)
 
 
+def _repeat_kv(t: jax.Array, n_rep: int) -> jax.Array:
+    """[B, L, Hkv, D] → [B, L, Hkv·n_rep, D]: expand grouped K/V heads so
+    every attention impl sees full-width heads (XLA fuses the broadcast
+    into the attention matmuls; only the decode *cache* stays narrow —
+    that is GQA's memory win)."""
+    if n_rep == 1:
+        return t
+    return jnp.repeat(t, n_rep, axis=2)
+
+
 def _cached_attention(q, k_cache, v_cache, q_positions):
     """Attention of fresh queries against the full K/V cache.
 
@@ -93,21 +103,43 @@ class Attention(nn.Module):
     seq_axis: str = "seq"
     compute_dtype: Any = jnp.float32
     decode: bool = False
+    # Grouped-query attention: K/V get n_kv_heads heads (< n_heads),
+    # each shared by n_heads/n_kv_heads query heads; 1 = MQA.  None
+    # keeps classic MHA with the fused qkv projection (and its param
+    # layout — existing checkpoints are untouched).
+    n_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x, positions):
         B, L, E = x.shape
         assert E % self.n_heads == 0, "n_heads must divide d_model"
         head_dim = E // self.n_heads
-        qkv = nn.DenseGeneral(
-            features=(3, self.n_heads, head_dim),
-            axis=-1,
-            dtype=self.compute_dtype,
-            name="qkv",
-        )(x)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, L, H, Dh]
+        if self.n_kv_heads is None or self.n_kv_heads == self.n_heads:
+            qkv = nn.DenseGeneral(
+                features=(3, self.n_heads, head_dim),
+                axis=-1,
+                dtype=self.compute_dtype,
+                name="qkv",
+            )(x)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,L,H,Dh]
+        else:
+            if self.n_heads % self.n_kv_heads:
+                raise ValueError(
+                    f"n_kv_heads={self.n_kv_heads} must divide "
+                    f"n_heads={self.n_heads}"
+                )
+            q = nn.DenseGeneral(
+                features=(self.n_heads, head_dim), axis=-1,
+                dtype=self.compute_dtype, name="q",
+            )(x)
+            kv = nn.DenseGeneral(
+                features=(2, self.n_kv_heads, head_dim), axis=-1,
+                dtype=self.compute_dtype, name="kv",
+            )(x)
+            k, v = kv[:, :, 0], kv[:, :, 1]  # [B, L, Hkv, Dh]
         q = apply_rope(q, positions)
         k = apply_rope(k, positions)
+        n_rep = self.n_heads // k.shape[2]
         if self.decode:
             # Cache shape fixes the max sequence length at init time
             # (init runs with a [B, max_len] input — generate.py).  Keys
@@ -119,12 +151,20 @@ class Attention(nn.Module):
                 start = positions[0]
                 ck.value = lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
                 cv.value = lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
-                out = _cached_attention(q, ck.value, cv.value, positions)
+                out = _cached_attention(
+                    q,
+                    _repeat_kv(ck.value, n_rep),
+                    _repeat_kv(cv.value, n_rep),
+                    positions,
+                )
             else:
-                out = dense_self_attention(q, k, v, positions)
+                out = dense_self_attention(
+                    q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), positions
+                )
         elif self.attn_impl == "ring":
             out = ring_self_attention(
-                q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
+                q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
+                self.seq_axis, lax.axis_size(self.seq_axis)
             )
         elif self.attn_impl == "ulysses":
             from distributed_machine_learning_tpu.ops.ulysses import (
@@ -132,16 +172,21 @@ class Attention(nn.Module):
             )
 
             out = ulysses_self_attention(
-                q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
+                q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
+                self.seq_axis, lax.axis_size(self.seq_axis)
             )
         elif self.attn_impl == "flash":
             from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
                 flash_self_attention,
             )
 
-            out = flash_self_attention(q, k, v)
+            out = flash_self_attention(
+                q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
+            )
         else:
-            out = dense_self_attention(q, k, v, positions)
+            out = dense_self_attention(
+                q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), positions
+            )
         return nn.DenseGeneral(
             features=E, axis=(-2, -1), dtype=self.compute_dtype, name="out"
         )(out)
@@ -159,6 +204,7 @@ class Block(nn.Module):
     compute_dtype: Any
     mlp_factory: Any = None  # () -> nn.Module, or None for the dense MLP
     decode: bool = False
+    n_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -169,6 +215,7 @@ class Block(nn.Module):
             seq_axis=self.seq_axis,
             compute_dtype=self.compute_dtype,
             decode=self.decode,
+            n_kv_heads=self.n_kv_heads,
             name="attn",
         )(h, positions)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
@@ -199,6 +246,10 @@ class TransformerLM(nn.Module):
     seq_axis: str = "seq"
     compute_dtype: Any = jnp.float32
     decode: bool = False
+    # GQA: n_kv_heads < n_heads shares each K/V head across a group of
+    # query heads (1 = MQA) — the decode KV cache shrinks by the group
+    # factor.  None = classic MHA (fused qkv param layout).
+    n_kv_heads: int | None = None
     remat: bool = False  # jax.checkpoint each block: activation memory
     # drops from O(L·E) per layer to per-block boundaries, recomputing the
     # block in backward — the HBM-for-FLOPs trade that lets long-context
@@ -251,6 +302,7 @@ class TransformerLM(nn.Module):
                 seq_axis=self.seq_axis,
                 compute_dtype=self.compute_dtype,
                 decode=self.decode,
+                n_kv_heads=self.n_kv_heads,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)

@@ -54,6 +54,7 @@ def _block_module(model: TransformerLM) -> Block:
         attn_impl="dense",
         seq_axis=model.seq_axis,
         compute_dtype=model.compute_dtype,
+        n_kv_heads=model.n_kv_heads,
     )
 
 

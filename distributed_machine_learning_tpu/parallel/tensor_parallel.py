@@ -60,6 +60,12 @@ def tp_spec_for(path: tuple[str, ...], ndim: int, model_axis: str = MODEL_AXIS) 
     m = model_axis
     if module == "qkv":
         return P(None, None, m, None) if leaf == "kernel" else P(None, m, None)
+    if module == "q":
+        # GQA query projection: kernel [E, H, Dh], bias [H, Dh].
+        return P(None, m, None) if leaf == "kernel" else P(m, None)
+    if module == "kv":
+        # GQA K/V projection: kernel [E, 2, Hkv, Dh], bias [2, Hkv, Dh].
+        return P(None, None, m, None) if leaf == "kernel" else P(None, m, None)
     if module == "out" and leaf == "kernel":
         return P(m, None, None)
     if module == "fc_in":
@@ -123,6 +129,12 @@ def make_tp_lm_train_step(
         raise ValueError(
             f"n_heads={model.n_heads} must be divisible by the model-axis "
             f"size {n_model} (heads are sharded over {model_axis!r})"
+        )
+    n_kv = getattr(model, "n_kv_heads", None)
+    if n_kv is not None and n_kv % n_model:
+        raise ValueError(
+            f"n_kv_heads={n_kv} must be divisible by the model-axis size "
+            f"{n_model} (K/V heads are sharded over {model_axis!r})"
         )
     batch_sharding = NamedSharding(mesh, P(data_axis, None))
     impl = partial(_lm_step_impl, model, axis_names=())

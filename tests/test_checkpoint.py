@@ -100,3 +100,31 @@ def test_resume_matches_uninterrupted_trajectory(tmp_path, rng):
     for a, b in zip(jax.tree_util.tree_leaves(s.momentum),
                     jax.tree_util.tree_leaves(s3.momentum)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_checkpoint_roundtrip(tmp_path, rng):
+    # Async save must land the same complete layout as the sync writer,
+    # be invisible to latest_checkpoint until finished, and restore
+    # bit-identically.
+    import numpy as np
+
+    from distributed_machine_learning_tpu.cli.common import init_model_and_state
+    from distributed_machine_learning_tpu.models.vgg import VGG11
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        AsyncCheckpointWriter,
+        latest_checkpoint,
+        restore_checkpoint,
+    )
+
+    state = init_model_and_state(VGG11(use_bn=False))
+    with AsyncCheckpointWriter() as writer:
+        path = writer.save(tmp_path, state)
+        writer.wait()
+    assert latest_checkpoint(tmp_path) == path
+    restored = restore_checkpoint(path, abstract_state=state)
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert type(restored.config) is type(state.config)
