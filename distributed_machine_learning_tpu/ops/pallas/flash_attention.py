@@ -1,22 +1,34 @@
-"""Flash attention (causal) as a Pallas TPU kernel.
+"""Flash attention (causal) as Pallas TPU kernels — forward AND backward.
 
 The hot op of the transformer family, written for the hardware per the
 Pallas playbook (/opt/skills/guides/pallas_guide.md): the L×L score
-matrix never hits HBM, and on-chip memory is O(block), not O(L) — the
-grid is (batch·heads, Q blocks, K blocks) with the K dimension innermost,
-so Pallas streams one [block_k, D] K/V tile into VMEM per step while the
-online-softmax running (max, normalizer, accumulator) triple persists in
-VMEM scratch across the K steps of each Q block.  Blocks entirely above
-the causal diagonal skip their compute via ``pl.when``.
+matrix never hits HBM in either direction, and on-chip memory is
+O(block), not O(L).
 
-Differentiation: Pallas kernels are not auto-differentiable, so the op
-carries a ``jax.custom_vjp`` whose backward is ``jax.vjp`` of the XLA
-dense reference (``ops.ring_attention.dense_self_attention``) — one
-source of truth for the semantics, flash-style memory only on the
-forward (a full Pallas backward kernel is a later optimization).
+Forward: grid (batch·heads, Q blocks, K blocks) with the K dimension
+innermost, so Pallas streams one [block_k, D] K/V tile into VMEM per
+step while the online-softmax running (max, normalizer, accumulator)
+triple persists in VMEM scratch across the K steps of each Q block.
+Blocks entirely above the causal diagonal skip their compute via
+``pl.when``.  The forward also emits the per-row logsumexp — the one
+O(L) residual the backward needs.
 
-On non-TPU backends the kernel runs in interpreter mode, so tests on the
-CPU mesh exercise the identical code path the TPU compiles.
+Backward: the standard two-kernel flash-bwd split (no atomics needed —
+each kernel owns its accumulator):
+
+- **dQ kernel**, grid (BH, Q blocks, K blocks): recomputes each score
+  block from Q/K and the saved logsumexp (``p = exp(s − lse)``), forms
+  ``ds = p·(dp − Δ)`` with ``Δ = rowsum(dO ∘ O)`` precomputed outside,
+  and accumulates ``dq += ds·K`` in VMEM scratch over the K steps.
+- **dK/dV kernel**, grid (BH, K blocks, Q blocks): same recomputation
+  with Q innermost, accumulating ``dv += pᵀ·dO`` and ``dk += dsᵀ·Q``.
+
+Total backward traffic is O(L·D) per tensor plus the recomputed block
+matmuls — the memory profile that lets long-context training fit, where
+the XLA dense VJP would materialize the [H, L, L] probability tensor.
+
+On non-TPU backends the kernels run in interpreter mode, so tests on
+the CPU mesh exercise the identical code path the TPU compiles.
 """
 
 from __future__ import annotations
@@ -34,10 +46,6 @@ try:  # pltpu imports only resolve fully on TPU-capable installs
 except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
-from distributed_machine_learning_tpu.ops.ring_attention import (
-    dense_self_attention,
-)
-
 NEG_INF = -1e30
 _LANES = 128  # VMEM lane width: m/l scratch is (block_q, _LANES)
 
@@ -46,8 +54,23 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _block_scores(q, k, q_start, k_start, block_q, block_k, scale):
+    """Masked scaled scores for one (Q, K) tile — shared fwd/bwd."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, block_q, block_k, scale
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+    *, block_q, block_k, scale,
 ):
     """One (Q block, K block) tile of the online-softmax recurrence."""
     qi = pl.program_id(1)
@@ -67,16 +90,7 @@ def _flash_fwd_kernel(
         q = q_ref[0].astype(jnp.float32)  # [block_q, D]
         k = k_ref[0].astype(jnp.float32)  # [block_k, D]
         v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [block_q, block_k]
-        q_pos = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
 
         m = m_ref[:, 0]  # [block_q]
         l = l_ref[:, 0]
@@ -95,10 +109,14 @@ def _flash_fwd_kernel(
     def _finalize():
         l = jnp.maximum(l_ref[:, 0], 1e-30)
         o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        # Lane-replicated logsumexp (TPU tiling wants a 128-lane minor
+        # dim — same layout the reference TPU flash kernel uses).
+        lse = m_ref[:, 0] + jnp.log(l)
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, block_q: int, block_k: int):
-    """q/k/v: [BH, L, D] → [BH, L, D]."""
+    """q/k/v: [BH, L, D] → (out [BH, L, D], lse [BH, L] fp32)."""
     BH, L, D = q.shape
     scale = 1.0 / (D**0.5)
     grid = (BH, L // block_q, L // block_k)
@@ -114,6 +132,10 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int):
     k_spec = pl.BlockSpec(
         (1, block_k, D), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM
     )
+    lse_spec = pl.BlockSpec(
+        (1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
     scratch = [
         pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
         pltpu.VMEM((block_q, _LANES), jnp.float32),  # running normalizer
@@ -121,13 +143,157 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int):
     ]
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L, _LANES), jnp.float32),
+        ),
         grid=grid,
         in_specs=[q_spec, k_spec, k_spec],
-        out_specs=q_spec,
+        out_specs=(q_spec, lse_spec),
         scratch_shapes=scratch,
         interpret=_interpret(),
     )(q, k, v)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, block_q, block_k, scale,
+):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]  # [block_q] (lane-replicated storage)
+        delta = delta_ref[0][:, 0]
+        s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, block_q, block_k, scale,
+):
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(q_start + block_q - 1 >= k_start)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
+        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+        p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # pᵀ·dO → [block_k, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # dsᵀ·Q → [block_k, D]
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, do, lse, delta, block_q: int, block_k: int):
+    """[BH, L, D] tensors → (dq, dk, dv)."""
+    BH, L, D = q.shape
+    scale = 1.0 / (D**0.5)
+
+    q_spec_q = pl.BlockSpec(
+        (1, block_q, D), lambda bh, qi, kb: (bh, qi, 0), memory_space=pltpu.VMEM
+    )
+    k_spec_q = pl.BlockSpec(
+        (1, block_k, D), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM
+    )
+    row_spec_q = pl.BlockSpec(
+        (1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+            scale=scale,
+        ),
+        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        grid=(BH, L // block_q, L // block_k),
+        in_specs=[q_spec_q, k_spec_q, k_spec_q, q_spec_q, row_spec_q,
+                  row_spec_q],
+        out_specs=q_spec_q,
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # dK/dV: K blocks own the accumulators, Q innermost.
+    q_spec_k = pl.BlockSpec(
+        (1, block_q, D), lambda bh, kb, qi: (bh, qi, 0), memory_space=pltpu.VMEM
+    )
+    k_spec_k = pl.BlockSpec(
+        (1, block_k, D), lambda bh, kb, qi: (bh, kb, 0), memory_space=pltpu.VMEM
+    )
+    row_spec_k = pl.BlockSpec(
+        (1, block_q, _LANES), lambda bh, kb, qi: (bh, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+            scale=scale,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, L, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, L, D), v.dtype),
+        ),
+        grid=(BH, L // block_k, L // block_q),
+        in_specs=[q_spec_k, k_spec_k, k_spec_k, q_spec_k, row_spec_k,
+                  row_spec_k],
+        out_specs=(k_spec_k, k_spec_k),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 def _pick_block(L: int, target: int = 128) -> int:
@@ -137,25 +303,46 @@ def _pick_block(L: int, target: int = 128) -> int:
     return 1
 
 
+def _fold(a):
+    B, L, H, D = a.shape
+    return a.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+
+
+def _unfold(a, B, H):
+    BH, L, D = a.shape
+    return a.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+
 @jax.custom_vjp
 def _flash_core(q, k, v):
     B, L, H, D = q.shape
     blk = _pick_block(L)
-    fold = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, L, D)
-    out = _flash_fwd(fold(q), fold(k), fold(v), blk, blk)
-    return out.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+    out, _ = _flash_fwd(_fold(q), _fold(k), _fold(v), blk, blk)
+    return _unfold(out, B, H)
 
 
 def _flash_core_fwd(q, k, v):
-    return _flash_core(q, k, v), (q, k, v)
+    B, L, H, D = q.shape
+    blk = _pick_block(L)
+    out, lse = _flash_fwd(_fold(q), _fold(k), _fold(v), blk, blk)
+    return _unfold(out, B, H), (q, k, v, out, lse)
 
 
 def _flash_core_bwd(res, g):
-    # Backward = VJP of the dense XLA reference: one source of truth for
-    # the attention semantics (ops/ring_attention.py).
-    q, k, v = res
-    _, vjp = jax.vjp(dense_self_attention, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res  # out/lse already folded [BH, ...]
+    B, L, H, D = q.shape
+    blk = _pick_block(L)
+    do = _fold(g)
+    # Δ = rowsum(dO ∘ O): O(L·D) elementwise — XLA fuses it; no kernel
+    # needed.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [BH, L]
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
+    dq, dk, dv = _flash_bwd(
+        _fold(q), _fold(k), _fold(v), do, lse, delta, blk, blk
+    )
+    return _unfold(dq, B, H), _unfold(dk, B, H), _unfold(dv, B, H)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -165,6 +352,8 @@ def flash_self_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     """Causal flash attention: [B, L, H, D] in and out.
 
     Drop-in for ``ops.ring_attention.dense_self_attention`` on contiguous
-    (offset-0) sequences — the unsharded model path.
+    (offset-0) sequences — the unsharded model path.  Both directions run
+    as Pallas kernels (O(block) on-chip memory; the backward recomputes
+    score blocks from the forward's saved logsumexp).
     """
     return _flash_core(q, k, v)
