@@ -19,7 +19,28 @@ import jax
 import numpy as np
 
 
-def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1):
+def two_point_fit(timed, chain: int) -> float:
+    """Per-dispatch seconds from a two-point fit: ``timed(n)`` measures n
+    back-to-back dispatches + one host fetch; the slope between the
+    1-dispatch and chain-dispatch measurements cancels the constant
+    tunnel round-trip.  Shared by bench.py and bench_lm.py so the
+    methodology cannot diverge.
+
+    Guards both sides: RTT jitter can make the slope exceed the chained
+    average (impossible physically — take the min) or go non-positive
+    (slow RTT on t1, fast on tk — fall back to the overhead-inclusive
+    chained average rather than report a negative time)."""
+    t1 = timed(1)
+    if chain <= 1:
+        return t1
+    tk = timed(chain)
+    slope = (tk - t1) / (chain - 1)
+    if slope <= 0:
+        return tk / chain
+    return min(slope, tk / chain)
+
+
+def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1, chain: int = 1):
     """Time ``len(imgs)`` train steps as one compiled scan.
 
     ``step``: un-jitted ``(state, x, y) -> (state, loss)`` (build with
@@ -27,6 +48,19 @@ def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1):
     [T, ...] device arrays, one leading slice per iteration.  Runs once
     untimed (compile, the reference's iteration 0), then ``reps`` timed
     runs; returns ``(best_seconds, final_loss, state)``.
+
+    ``chain > 1`` measures by a two-point fit: each timed measurement
+    still brackets dispatch + one host value fetch, but a second set of
+    measurements enqueues ``chain`` back-to-back dispatches of the SAME
+    epoch (every run starts from the untouched initial state, so the
+    numerics of each are identical to the canonical single run — no
+    1000-step divergence) before the single fetch, and the per-scan time
+    is the slope ``(t_chain - t_1) / (chain - 1)``.  The constant tunnel
+    round-trip (tens of ms on a remote chip, run-to-run variable — the
+    r01 bench's 17% swing) cancels in the subtraction, leaving pure
+    device time per 39-step scan.  The reference's own protocol has no
+    such overhead to exclude — its timer wraps on-node compute only
+    (part1/main.py:53-58).
 
     Raises ``RuntimeError`` on a non-finite final loss — a benchmark
     number from a diverged run must never be reported.
@@ -40,19 +74,25 @@ def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1):
 
         return jax.lax.scan(body, state, (imgs, lbls))
 
-    state, losses = run(state, imgs, lbls)
-    float(losses[-1])  # compile + completion
-
-    best = float("inf")
-    final_loss = float("nan")
-    for _ in range(max(reps, 1)):
-        start = time.perf_counter()
-        state, losses = run(state, imgs, lbls)
-        final_loss = float(losses[-1])  # forces real device completion
-        best = min(best, time.perf_counter() - start)
+    state0 = state
+    out_state, losses = run(state0, imgs, lbls)
+    final_loss = float(losses[-1])  # compile + completion
     if not np.isfinite(final_loss):
         raise RuntimeError(
             f"benchmark run diverged (final loss {final_loss}); refusing to "
             "report a throughput number"
         )
-    return best, final_loss, state
+
+    def timed(n_dispatches):
+        """Best-of-reps seconds for n async same-epoch dispatches + 1 fetch."""
+        best = float("inf")
+        for _ in range(max(reps, 1)):
+            start = time.perf_counter()
+            for _ in range(n_dispatches):
+                _, losses = run(state0, imgs, lbls)
+            float(losses[-1])  # forces real device completion of the queue
+            best = min(best, time.perf_counter() - start)
+        return best
+
+    best = two_point_fit(timed, chain)
+    return best, final_loss, out_state

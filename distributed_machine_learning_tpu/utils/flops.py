@@ -1,0 +1,54 @@
+"""Model-FLOPs estimates so every benchmark number carries an MFU.
+
+The reference reports raw wall-clock only (group25.pdf §6); an MFU line
+turns a throughput number into a statement about how much of the chip it
+uses — the difference between "fast" and "done".  Estimates follow the
+standard accounting: a training step costs ~3× the forward pass (forward
++ backward w.r.t. inputs + backward w.r.t. weights); matmul/conv FLOPs
+count multiply and add separately (factor 2).
+"""
+
+from __future__ import annotations
+
+# bf16 peak of the attached chip class (TPU v5 lite — docs/PERF.md).
+# Overridable per call: MFU against the wrong peak is worse than no MFU.
+DEFAULT_PEAK_TFLOPS = 197.0
+
+
+def vgg_forward_flops_per_image(
+    cfg: list, image_hw: int = 32, in_channels: int = 3,
+    num_classes: int = 10, kernel: int = 3,
+) -> float:
+    """Forward FLOPs/image for a reference-style VGG cfg list
+    (ints = conv out-channels, 'M' = 2×2 max-pool halving the spatial dim
+    — models/vgg.py:_cfg ≡ part1/model.py:3-8)."""
+    hw = image_hw
+    cin = in_channels
+    total = 0.0
+    for item in cfg:
+        if item == "M":
+            hw //= 2
+            continue
+        total += 2.0 * hw * hw * cin * item * kernel * kernel
+        cin = item
+    total += 2.0 * cin * num_classes  # the Linear(512, 10) head
+    return total
+
+
+def vgg_train_flops_per_image(cfg: list, **kw) -> float:
+    return 3.0 * vgg_forward_flops_per_image(cfg, **kw)
+
+
+def transformer_train_flops_per_token(
+    n_params: int, n_layers: int, d_model: int, seq_len: int
+) -> float:
+    """~6·P per token for the matmuls (fwd 2P + bwd 4P) plus the
+    attention score/value matmuls: 12·L·d·T per token fwd+bwd
+    (2 matmuls × 2 FLOPs × T·d each, × 3 for training)."""
+    return 6.0 * n_params + 12.0 * n_layers * d_model * seq_len
+
+
+def mfu(
+    achieved_flops_per_sec: float, peak_tflops: float = DEFAULT_PEAK_TFLOPS
+) -> float:
+    return achieved_flops_per_sec / (peak_tflops * 1e12)
