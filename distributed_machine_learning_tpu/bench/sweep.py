@@ -56,6 +56,7 @@ def run_point(
     timed_iters: int = 10,
     seed: int = 0,
     init_state=None,
+    devices=None,
 ) -> ScalePoint:
     """Measure one (strategy, device-count) point.
 
@@ -63,7 +64,9 @@ def run_point(
     baseline carries zero collective overhead — the honest denominator for
     weak-scaling efficiency.  ``model`` is a flax module instance;
     ``init_state`` (optional) is a pre-built TrainState to reuse across
-    points so each point times the step, not initialization.
+    points so each point times the step, not initialization.  ``devices``
+    (optional) pins the point to an explicit device list (e.g. virtual
+    CPU devices under a TPU-default backend, the dryrun path).
     """
     from distributed_machine_learning_tpu.cli.common import init_model_and_state
 
@@ -82,7 +85,7 @@ def run_point(
         mesh = None
         step = make_train_step(model, mesh=None, jit=False)
     else:
-        mesh = make_mesh(num_devices)
+        mesh = make_mesh(num_devices, devices=devices)
         step = make_train_step(
             model, get_strategy(strategy_name), mesh=mesh, jit=False
         )
@@ -96,6 +99,11 @@ def run_point(
     lbls = np.stack([b[1] for b in batches])
     if mesh is None:
         dx, dy = jax.numpy.asarray(imgs), jax.numpy.asarray(lbls)
+        if devices is not None:
+            # Commit inputs to the pinned device so jit runs there, not on
+            # the ambient default backend.
+            dx = jax.device_put(dx, devices[0])
+            dy = jax.device_put(dy, devices[0])
     else:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -122,13 +130,16 @@ def weak_scaling_sweep(
     device_counts: list[int] | None = None,
     per_device_batch: int = 64,
     timed_iters: int = 10,
+    devices=None,
 ) -> list[ScalePoint]:
     """Sweep device counts at fixed per-device batch; annotate efficiency
     relative to the smallest point's per-device throughput."""
     if device_counts is None:
-        n = jax.device_count()
+        n = len(devices) if devices is not None else jax.device_count()
         device_counts = [d for d in (1, 2, 4, 8, 16, 32) if d <= n]
-    device_counts = sorted(device_counts)
+    device_counts = sorted(set(device_counts))
+    if not device_counts:
+        raise ValueError("device_counts is empty: nothing to sweep")
     from distributed_machine_learning_tpu.cli.common import init_model_and_state
 
     state = init_model_and_state(model)
@@ -140,6 +151,7 @@ def weak_scaling_sweep(
             per_device_batch=per_device_batch,
             timed_iters=timed_iters,
             init_state=state,
+            devices=devices,
         )
         for d in device_counts
     ]
