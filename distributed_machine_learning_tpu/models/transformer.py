@@ -83,13 +83,29 @@ def _cached_attention(q, k_cache, v_cache, q_positions):
     return out.astype(q.dtype)
 
 
+def _flash_wins(L: int) -> bool:
+    """attn_impl="auto" policy: the Pallas flash kernels beat XLA dense
+    from 1k context up on the measured chip (docs/PERF.md r02 table:
+    243k vs 171k tok/s @1k) and are the only option past ~8-16k where
+    dense's L² program stops compiling; below 1k — or at lengths whose
+    largest power-of-two divisor is under 128, which would degrade the
+    kernel's blocks — the dense path's fusion wins."""
+    from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
+        _pick,
+    )
+
+    return L >= 1024 and _pick(L, 128) >= 128
+
+
 class Attention(nn.Module):
     """Multi-head causal self-attention.
 
     ``attn_impl``: "dense" (full XLA attention), "ring" (sequence sharded
     over ``seq_axis`` — ``ops/ring_attention.py``), "ulysses" (sequence
-    sharded via all-to-all head re-sharding — ``ops/ulysses.py``), or
-    "flash" (the Pallas kernel — ``ops/pallas/flash_attention.py``).
+    sharded via all-to-all head re-sharding — ``ops/ulysses.py``),
+    "flash" (the Pallas kernel — ``ops/pallas/flash_attention.py``), or
+    "auto" (flash from 1k context up, dense below — the measured
+    crossover, see ``_flash_wins``).
 
     ``decode=True`` switches to KV-cached autoregressive inference: K/V
     land in a ``"cache"`` variable collection sized by the init-time
@@ -99,7 +115,7 @@ class Attention(nn.Module):
     """
 
     n_heads: int
-    attn_impl: str = "dense"  # "dense" | "ring" | "ulysses" | "flash"
+    attn_impl: str = "dense"  # "dense" | "ring" | "ulysses" | "flash" | "auto"
     seq_axis: str = "seq"
     compute_dtype: Any = jnp.float32
     decode: bool = False
@@ -175,7 +191,9 @@ class Attention(nn.Module):
                 q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
                 self.seq_axis, lax.axis_size(self.seq_axis)
             )
-        elif self.attn_impl == "flash":
+        elif self.attn_impl == "flash" or (
+            self.attn_impl == "auto" and _flash_wins(L)
+        ):
             from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
                 flash_self_attention,
             )

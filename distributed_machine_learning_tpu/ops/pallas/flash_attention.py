@@ -10,8 +10,11 @@ innermost, so Pallas streams one [block_k, D] K/V tile into VMEM per
 step while the online-softmax running (max, normalizer, accumulator)
 triple persists in VMEM scratch across the K steps of each Q block.
 Blocks entirely above the causal diagonal skip their compute via
-``pl.when``.  The forward also emits the per-row logsumexp — the one
-O(L) residual the backward needs.
+``pl.when`` AND their DMA: the K/V index map clamps the block index to
+the last in-range tile, and Pallas elides copies whose block index did
+not change between grid steps — so causal masking saves both halves of
+the work, not just the FLOPs.  The forward also emits the per-row
+logsumexp — the one O(L) residual the backward needs.
 
 Backward: the standard two-kernel flash-bwd split (no atomics needed —
 each kernel owns its accumulator):
@@ -22,6 +25,17 @@ each kernel owns its accumulator):
   and accumulates ``dq += ds·K`` in VMEM scratch over the K steps.
 - **dK/dV kernel**, grid (BH, K blocks, Q blocks): same recomputation
   with Q innermost, accumulating ``dv += pᵀ·dO`` and ``dk += dsᵀ·Q``.
+
+MXU discipline: matmuls run on the INPUT dtype (bf16 in training) with
+``preferred_element_type=f32`` accumulation — a bf16×bf16→f32 matmul is
+a single MXU pass, where an f32×f32 matmul costs several (XLA's own
+attention runs bf16 too, so anything else loses to dense by
+construction).  The online-softmax state (m, l, acc) stays f32.
+
+Blocks are rectangular and picked per (L, D): the stationary operand's
+block (Q for forward/dQ, K for dK/dV) is made large — arithmetic
+intensity of the streaming phase is proportional to the stationary
+block's rows — while the streamed block stays at MXU width.
 
 Total backward traffic is O(L·D) per tensor plus the recomputed block
 matmuls — the memory profile that lets long-context training fit, where
@@ -54,8 +68,51 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _compiler_params():
+    """batch·head and the stationary block axis are parallel; the
+    streamed (innermost) axis carries the scratch accumulator between
+    steps and must stay sequential."""
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
+
+
+def _pick(L: int, target: int) -> int:
+    """Largest power-of-two block <= target that divides L."""
+    b = 1
+    for c in (2, 4, 8, 16, 32, 64, 128, 256, 512):
+        if c <= target and c <= L and L % c == 0:
+            b = c
+    return b
+
+
+def _fwd_blocks(L: int) -> tuple[int, int]:
+    # Q is stationary across the streamed K steps: big block_q buys
+    # arithmetic intensity (FLOPs/byte of streamed K/V ∝ block_q).
+    return _pick(L, 512), _pick(L, 256)
+
+
+def _dkv_blocks(L: int) -> tuple[int, int]:
+    # K/V stationary, Q/dO streamed: mirror image.
+    return _pick(L, 256), _pick(L, 512)
+
+
+def _last_kb(qi, block_q: int, block_k: int):
+    """Last K block index intersecting the causal triangle of Q block qi."""
+    return ((qi + 1) * block_q - 1) // block_k
+
+
+def _first_qi(kb, block_q: int, block_k: int):
+    """First Q block index intersecting the causal triangle of K block kb."""
+    return (kb * block_k) // block_q
+
+
 def _block_scores(q, k, q_start, k_start, block_q, block_k, scale):
-    """Masked scaled scores for one (Q, K) tile — shared fwd/bwd."""
+    """Masked scaled scores for one (Q, K) tile — shared fwd/bwd.
+
+    The dot runs on the input dtype (bf16 on the training path) with f32
+    accumulation: one MXU pass instead of the multi-pass f32 emulation.
+    """
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -84,12 +141,13 @@ def _flash_fwd_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Skip blocks entirely above the causal diagonal.
+    # Skip blocks entirely above the causal diagonal (their DMA is
+    # already elided by the clamped index map).
     @pl.when(k_start <= q_start + block_q - 1)
     def _update():
-        q = q_ref[0].astype(jnp.float32)  # [block_q, D]
-        k = k_ref[0].astype(jnp.float32)  # [block_k, D]
-        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0]  # [block_q, D], input dtype
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
         s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
 
         m = m_ref[:, 0]  # [block_q]
@@ -100,7 +158,8 @@ def _flash_fwd_kernel(
         p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
         l_new = l * alpha + p.sum(axis=-1)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
@@ -129,8 +188,14 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int):
     q_spec = pl.BlockSpec(
         (1, block_q, D), lambda bh, qi, kb: (bh, qi, 0), memory_space=pltpu.VMEM
     )
+    # Clamp above-diagonal K/V fetches to the diagonal tile: the index
+    # repeats, so Pallas skips the copy (causal DMA elision).
     k_spec = pl.BlockSpec(
-        (1, block_k, D), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM
+        (1, block_k, D),
+        lambda bh, qi, kb: (
+            bh, jnp.minimum(kb, _last_kb(qi, block_q, block_k)), 0
+        ),
+        memory_space=pltpu.VMEM,
     )
     lse_spec = pl.BlockSpec(
         (1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0),
@@ -151,6 +216,7 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int):
         in_specs=[q_spec, k_spec, k_spec],
         out_specs=(q_spec, lse_spec),
         scratch_shapes=scratch,
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v)
 
@@ -170,10 +236,10 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(k_start <= q_start + block_q - 1)
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]  # [block_q] (lane-replicated storage)
         delta = delta_ref[0][:, 0]
         s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
@@ -184,7 +250,8 @@ def _flash_bwd_dq_kernel(
         )  # [block_q, block_k]
         ds = p * (dp - delta[:, None]) * scale
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(kb == pl.num_programs(2) - 1)
@@ -208,24 +275,26 @@ def _flash_bwd_dkv_kernel(
 
     @pl.when(q_start + block_q - 1 >= k_start)
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
         s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
         p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
         p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # pᵀ·dO → [block_k, D]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None]) * scale
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # dsᵀ·Q → [block_k, D]
 
     @pl.when(qi == pl.num_programs(2) - 1)
@@ -234,16 +303,21 @@ def _flash_bwd_dkv_kernel(
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, do, lse, delta, block_q: int, block_k: int):
+def _flash_bwd(q, k, v, do, lse, delta):
     """[BH, L, D] tensors → (dq, dk, dv)."""
     BH, L, D = q.shape
     scale = 1.0 / (D**0.5)
 
+    block_q, block_k = _fwd_blocks(L)  # dQ kernel: Q stationary, like fwd
     q_spec_q = pl.BlockSpec(
         (1, block_q, D), lambda bh, qi, kb: (bh, qi, 0), memory_space=pltpu.VMEM
     )
     k_spec_q = pl.BlockSpec(
-        (1, block_k, D), lambda bh, qi, kb: (bh, kb, 0), memory_space=pltpu.VMEM
+        (1, block_k, D),
+        lambda bh, qi, kb: (
+            bh, jnp.minimum(kb, _last_kb(qi, block_q, block_k)), 0
+        ),
+        memory_space=pltpu.VMEM,
     )
     row_spec_q = pl.BlockSpec(
         (1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0),
@@ -260,18 +334,28 @@ def _flash_bwd(q, k, v, do, lse, delta, block_q: int, block_k: int):
                   row_spec_q],
         out_specs=q_spec_q,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
-    # dK/dV: K blocks own the accumulators, Q innermost.
+    # dK/dV: K blocks own the accumulators, Q innermost.  Below-diagonal
+    # Q/dO fetches clamp to the first in-range tile (DMA elision).
+    block_q, block_k = _dkv_blocks(L)
     q_spec_k = pl.BlockSpec(
-        (1, block_q, D), lambda bh, kb, qi: (bh, qi, 0), memory_space=pltpu.VMEM
+        (1, block_q, D),
+        lambda bh, kb, qi: (
+            bh, jnp.maximum(qi, _first_qi(kb, block_q, block_k)), 0
+        ),
+        memory_space=pltpu.VMEM,
     )
     k_spec_k = pl.BlockSpec(
         (1, block_k, D), lambda bh, kb, qi: (bh, kb, 0), memory_space=pltpu.VMEM
     )
     row_spec_k = pl.BlockSpec(
-        (1, block_q, _LANES), lambda bh, kb, qi: (bh, qi, 0),
+        (1, block_q, _LANES),
+        lambda bh, kb, qi: (
+            bh, jnp.maximum(qi, _first_qi(kb, block_q, block_k)), 0
+        ),
         memory_space=pltpu.VMEM,
     )
     dk, dv = pl.pallas_call(
@@ -291,16 +375,10 @@ def _flash_bwd(q, k, v, do, lse, delta, block_q: int, block_k: int):
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
-
-
-def _pick_block(L: int, target: int = 128) -> int:
-    for b in (target, 64, 32, 16, 8, 4, 2, 1):
-        if b <= L and L % b == 0:
-            return b
-    return 1
 
 
 def _fold(a):
@@ -316,22 +394,21 @@ def _unfold(a, B, H):
 @jax.custom_vjp
 def _flash_core(q, k, v):
     B, L, H, D = q.shape
-    blk = _pick_block(L)
-    out, _ = _flash_fwd(_fold(q), _fold(k), _fold(v), blk, blk)
+    bq, bk = _fwd_blocks(L)
+    out, _ = _flash_fwd(_fold(q), _fold(k), _fold(v), bq, bk)
     return _unfold(out, B, H)
 
 
 def _flash_core_fwd(q, k, v):
     B, L, H, D = q.shape
-    blk = _pick_block(L)
-    out, lse = _flash_fwd(_fold(q), _fold(k), _fold(v), blk, blk)
+    bq, bk = _fwd_blocks(L)
+    out, lse = _flash_fwd(_fold(q), _fold(k), _fold(v), bq, bk)
     return _unfold(out, B, H), (q, k, v, out, lse)
 
 
 def _flash_core_bwd(res, g):
     q, k, v, out, lse = res  # out/lse already folded [BH, ...]
     B, L, H, D = q.shape
-    blk = _pick_block(L)
     do = _fold(g)
     # Δ = rowsum(dO ∘ O): O(L·D) elementwise — XLA fuses it; no kernel
     # needed.
@@ -339,9 +416,7 @@ def _flash_core_bwd(res, g):
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # [BH, L]
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
-    dq, dk, dv = _flash_bwd(
-        _fold(q), _fold(k), _fold(v), do, lse, delta, blk, blk
-    )
+    dq, dk, dv = _flash_bwd(_fold(q), _fold(k), _fold(v), do, lse, delta)
     return _unfold(dq, B, H), _unfold(dk, B, H), _unfold(dv, B, H)
 
 

@@ -8,7 +8,9 @@ import pytest
 
 from distributed_machine_learning_tpu.models.transformer import TransformerLM
 from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
-    _pick_block,
+    _dkv_blocks,
+    _fwd_blocks,
+    _pick,
     flash_self_attention,
 )
 from distributed_machine_learning_tpu.ops.ring_attention import (
@@ -35,9 +37,25 @@ def test_flash_matches_dense_forward(qkv):
     )
 
 
+def test_block_picker_rectangular():
+    # Powers of two dividing L, capped at the stationary/streamed targets.
+    assert _pick(48, 512) == 16
+    assert _fwd_blocks(4096) == (512, 256)
+    assert _dkv_blocks(4096) == (256, 512)
+    assert _fwd_blocks(64) == (64, 64)
+    assert _pick(17, 512) == 1  # prime-ish lengths degrade, don't crash
+
+
+def test_auto_attn_policy():
+    from distributed_machine_learning_tpu.models.transformer import _flash_wins
+
+    assert not _flash_wins(512)  # below the measured crossover
+    assert _flash_wins(1024) and _flash_wins(4096) and _flash_wins(16384)
+    assert not _flash_wins(1040)  # 16·65: blocks would degrade below 128
+
+
 def test_flash_odd_length(qkv):
     q, k, v = (a[:, :48] for a in qkv)  # L=48 → block 16
-    assert _pick_block(48) == 16
     np.testing.assert_allclose(
         np.asarray(flash_self_attention(q, k, v)),
         np.asarray(dense_self_attention(q, k, v)),
