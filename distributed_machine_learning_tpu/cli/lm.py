@@ -94,14 +94,24 @@ def make_parser():
                         "stream")
     p.add_argument("--eval-batches", dest="eval_batches", default=0, type=int,
                    help="after training, evaluate perplexity on this many "
-                        "held-out windows (dp/ring/ulysses, and fsdp on a "
-                        "single process; 0 skips)")
+                        "windows from a held-out corpus slice (the final "
+                        "10%% of tokens is reserved from training when "
+                        "--data-dir is set); dp/ring/ulysses/fsdp, "
+                        "single-process only; 0 skips)")
     p.add_argument("--fused-ce-chunks", dest="fused_ce_chunks", default=None,
                    type=int,
                    help="compute the loss fused with the lm_head in this "
                         "many vocab chunks (ops/fused_ce.py) — the "
                         "[B,L,vocab] logits are never materialized; "
                         "dp/ring/ulysses modes only")
+    p.add_argument("--attn", default="auto",
+                   choices=["auto", "dense", "flash"],
+                   help="attention kernel for the non-sequence-sharded "
+                        "modes (dp/fsdp/tp/pp/3d): 'auto' picks the "
+                        "Pallas flash kernel from 1k context up (the "
+                        "measured crossover, docs/PERF.md), 'dense' the "
+                        "XLA fused path; ring/ulysses modes own their "
+                        "attention and ignore this")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each transformer block: activation "
                         "memory drops ~n_layers-fold for ~33%% more FLOPs "
@@ -123,10 +133,20 @@ def build(args):
 
     n = jax.device_count()
     dtype = jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
+    attn = getattr(args, "attn", "auto")
+    if args.parallel in ("tp", "pp", "3d") and attn == "auto":
+        # The pipeline/tensor-parallel steps own their sharding and
+        # require the dense attention path (a Pallas call inside a
+        # GSPMD-partitioned or ppermute-pipelined program would need its
+        # own sharding rules); "auto" resolves to what they support.
+        # An EXPLICIT --attn flash still reaches their loud guards.
+        attn = "dense"
     common = dict(
         vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
         n_heads=args.n_heads, compute_dtype=dtype, remat=args.remat,
         n_kv_heads=args.n_kv_heads,
+        # ring/ulysses overwrite this below; all other modes honor it.
+        attn_impl=attn,
     )
     from distributed_machine_learning_tpu.train.optimizers import get_optimizer
 
@@ -164,7 +184,9 @@ def build(args):
                     "sequence)"
                 )
             mesh = make_mesh(n, ("batch", "seq"), (1, n))
-            model = TransformerLM(attn_impl=args.parallel, **common)
+            model = TransformerLM(
+                **{**common, "attn_impl": args.parallel}
+            )
         state = init_lm_state(model, seed=SEED, config=opt_config)
         step = make_lm_train_step(model, mesh=mesh,
                                   fused_ce_chunks=args.fused_ce_chunks)
@@ -274,7 +296,18 @@ def main(argv=None) -> None:
             f"d_model={args.d_model} layers={args.n_layers} "
             f"seq_len={args.seq_len} batch={args.batch_size}"
         )
+        # Decide up front whether eval will actually run: the eval step
+        # is a plain jit over host-local replicated params, so only the
+        # listed modes on a single process qualify — and the 10% corpus
+        # hold-out below must NOT shrink the training set for runs whose
+        # eval would then be skipped anyway.
+        will_eval = (
+            bool(args.eval_batches)
+            and args.parallel in ("dp", "ring", "ulysses", "fsdp")
+            and jax.process_count() == 1
+        )
         corpus = None
+        eval_corpus = None
         if args.data_dir is not None:
             from distributed_machine_learning_tpu.data.text import (
                 VOCAB_SIZE,
@@ -288,7 +321,36 @@ def main(argv=None) -> None:
                     f"{VOCAB_SIZE} (256 bytes + BOS)"
                 )
                 args.vocab = VOCAB_SIZE
-            rank0_print(f"corpus: {len(corpus)} tokens from {args.data_dir}")
+            if will_eval:
+                from distributed_machine_learning_tpu.data.text import (
+                    split_corpus,
+                )
+
+                corpus, eval_corpus = split_corpus(
+                    corpus, eval_frac=0.1,
+                    min_eval_tokens=args.seq_len + 1,
+                )
+                if len(eval_corpus) == len(corpus):
+                    # split_corpus's documented degrade path: don't let
+                    # training-set perplexity masquerade as held-out.
+                    rank0_print(
+                        "WARNING: corpus too small to hold out an eval "
+                        "slice — eval will run on in-distribution "
+                        "training windows"
+                    )
+                    rank0_print(
+                        f"corpus: {len(corpus)} tokens from {args.data_dir}"
+                    )
+                else:
+                    rank0_print(
+                        f"corpus: {len(corpus)} train tokens from "
+                        f"{args.data_dir}, {len(eval_corpus)} held-out "
+                        "eval tokens"
+                    )
+            else:
+                rank0_print(
+                    f"corpus: {len(corpus)} tokens from {args.data_dir}"
+                )
         step, state, place, model, params_fn = build(args)
         rng = np.random.default_rng(SEED)
 
@@ -321,15 +383,18 @@ def main(argv=None) -> None:
             max_iters=args.max_iters,
         )
         if args.eval_batches:
-            eval_ok = args.parallel in ("dp", "ring", "ulysses") or (
-                args.parallel == "fsdp" and jax.process_count() == 1
-            )
-            if not eval_ok:
+            # make_lm_eval_step is a plain jit fed replicated params plus
+            # host batches; on a multi-host run that mixes multi-host-
+            # committed arrays with default-device inputs and fails at
+            # dispatch — will_eval (computed before the corpus split)
+            # gates every path on a single process.
+            if not will_eval:
                 rank0_print(
-                    "WARNING: --eval-batches supports dp/ring/ulysses "
-                    "(and single-process fsdp, whose param gather is "
-                    "host-local); skipping eval for --parallel "
-                    f"{args.parallel}"
+                    "WARNING: --eval-batches supports dp/ring/ulysses/"
+                    "fsdp on a single process (the eval step is a plain "
+                    "jit over host-local arrays); skipping eval for "
+                    f"--parallel {args.parallel} with "
+                    f"{jax.process_count()} processes"
                 )
             else:
                 from distributed_machine_learning_tpu.data.text import (
@@ -343,8 +408,8 @@ def main(argv=None) -> None:
                 )
 
                 if corpus is not None:
-                    ev = eval_windows(corpus, args.batch_size, args.seq_len,
-                                      args.eval_batches)
+                    ev = eval_windows(eval_corpus, args.batch_size,
+                                      args.seq_len, args.eval_batches)
                 else:
                     ev_rng = np.random.default_rng(SEED + 1)
                     ev = (

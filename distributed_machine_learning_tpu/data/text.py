@@ -68,6 +68,30 @@ def load_corpus(root: str | os.PathLike, max_bytes: int | None = None,
     return corpus
 
 
+def split_corpus(
+    corpus: np.ndarray, eval_frac: float = 0.1, min_eval_tokens: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """(train, eval) split: the final ``eval_frac`` of tokens is reserved
+    for evaluation, so eval windows are genuinely held out from training
+    (the byte-stream analogue of CIFAR's fixed train/test file split).
+
+    ``min_eval_tokens`` (e.g. ``seq_len + 1``) bumps the eval slice up to
+    a usable size on tiny corpora; if the corpus cannot sustain both
+    slices the split degrades to (everything, everything) rather than
+    erroring — matching the loaders' own too-small-corpus behavior.
+    """
+    if not (0.0 < eval_frac < 1.0):
+        raise ValueError(f"eval_frac must be in (0, 1), got {eval_frac}")
+    n_eval = max(int(len(corpus) * eval_frac), min_eval_tokens)
+    n_train = len(corpus) - n_eval
+    # The TRAIN slice must also sustain a window (the loaders require
+    # min_eval_tokens = seq_len + 1 tokens) — otherwise enabling eval
+    # would make training crash on a corpus that trains fine without it.
+    if n_train < max(min_eval_tokens, 1) or n_eval <= 0:
+        return corpus, corpus
+    return corpus[:n_train], corpus[n_train:]
+
+
 def _gather_windows(corpus: np.ndarray, starts: np.ndarray,
                     seq_len: int) -> np.ndarray:
     return np.stack(
@@ -131,8 +155,10 @@ class TextWindowLoader:
 def eval_windows(corpus: np.ndarray, batch: int, seq_len: int,
                  num_batches: int, seed: int = 69143 + 1):
     """A fixed, finite eval set: ``num_batches`` deterministic windows
-    disjoint from nothing in particular (held out by seed, the same
-    convention the reference uses for its fixed test split)."""
+    drawn from ``corpus``.  For genuinely held-out perplexity, pass the
+    eval slice from ``split_corpus`` (the CLI does — ``cli/lm.py``);
+    windows drawn from the training slice measure in-distribution
+    training-set perplexity."""
     if len(corpus) < seq_len + 1:
         raise ValueError(
             f"corpus has {len(corpus)} tokens, need >= {seq_len + 1}"

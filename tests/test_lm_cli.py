@@ -18,10 +18,14 @@ TINY = [
     [
         ["--parallel", "dp"],
         ["--parallel", "ring"],
-        ["--parallel", "ulysses", "--n-heads", "8"],
-        ["--parallel", "tp", "--n-heads", "8"],
-        ["--parallel", "pp", "--n-layers", "8"],
-        ["--parallel", "3d", "--n-heads", "8", "--pp", "2", "--tp", "2"],
+        pytest.param(["--parallel", "ulysses", "--n-heads", "8"],
+                     marks=pytest.mark.slow),
+        pytest.param(["--parallel", "tp", "--n-heads", "8"],
+                     marks=pytest.mark.slow),
+        pytest.param(["--parallel", "pp", "--n-layers", "8"],
+                     marks=pytest.mark.slow),
+        pytest.param(["--parallel", "3d", "--n-heads", "8", "--pp", "2",
+                      "--tp", "2"], marks=pytest.mark.slow),
     ],
     ids=["dp", "ring", "ulysses", "tp", "pp", "3d"],
 )

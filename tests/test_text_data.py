@@ -14,7 +14,29 @@ from distributed_machine_learning_tpu.data.text import (
     TextWindowLoader,
     eval_windows,
     load_corpus,
+    split_corpus,
 )
+
+
+def test_split_corpus_holds_out_tail():
+    corpus = np.arange(100, dtype=np.uint16)
+    train, ev = split_corpus(corpus, eval_frac=0.1)
+    assert len(train) == 90 and len(ev) == 10
+    np.testing.assert_array_equal(np.concatenate([train, ev]), corpus)
+    # min_eval_tokens bumps a too-small slice up to a usable window.
+    train2, ev2 = split_corpus(corpus, eval_frac=0.1, min_eval_tokens=33)
+    assert len(ev2) == 33 and len(train2) == 67
+    # Degenerate corpus: degrade to (all, all) rather than error.
+    tiny = np.arange(4, dtype=np.uint16)
+    t3, e3 = split_corpus(tiny, eval_frac=0.1, min_eval_tokens=9)
+    assert len(t3) == len(tiny) and len(e3) == len(tiny)
+    # Train slice must sustain a window too: 300 tokens at seq 256 can
+    # train but not split — degrade, don't leave a 43-token train slice.
+    mid = np.arange(300, dtype=np.uint16)
+    t4, e4 = split_corpus(mid, eval_frac=0.1, min_eval_tokens=257)
+    assert len(t4) == 300 and len(e4) == 300
+    with pytest.raises(ValueError):
+        split_corpus(corpus, eval_frac=1.5)
 
 
 def _write_corpus(tmp_path):
