@@ -149,6 +149,17 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                              "blocked forever, SURVEY.md §5); 0 disables. "
                              "Set it above the first step's XLA compile "
                              "time (~20-40s cold)")
+    parser.add_argument("--local-loss", dest="local_loss", action="store_true",
+                        help="print each device's own shard loss instead of "
+                             "the global mean — the reference's per-rank "
+                             "print surface (part2/2a/main.py:58-61); "
+                             "distributed parts only")
+    parser.add_argument("--unsync-bn", dest="unsync_bn", action="store_true",
+                        help="per-device BatchNorm running stats (the "
+                             "reference part3's documented quirk: per-node "
+                             "stats, <1%% cross-node accuracy drift — "
+                             "part3/model.py:24, group25.pdf p.3-4); "
+                             "default axis-syncs the stats")
     parser.add_argument("--grad-accum", dest="grad_accum", default=1, type=int,
                         help="split each per-device batch into this many "
                              "sequential microbatches, accumulating "
@@ -262,6 +273,27 @@ def run_part(
 
         opt_config = get_optimizer(args.optimizer)[0]()
         state = init_model_and_state(model, config=opt_config)
+
+        # Unsynced-BN quirk mode (reference part3 parity: per-node running
+        # stats — part3/model.py:24, group25.pdf p.3-4).  Decided BEFORE
+        # --resume so the checkpoint-restore template carries the stacked
+        # [world, C] stats layout a quirk-mode checkpoint was saved with.
+        unsync_bn = bool(getattr(args, "unsync_bn", False))
+        if unsync_bn and mesh is None:
+            rank0_print("WARNING: --unsync-bn has no effect on the "
+                        "single-device part1 path (one device, one set of "
+                        "stats).")
+            unsync_bn = False
+        if unsync_bn and not state.batch_stats:
+            unsync_bn = False  # BN-free model: nothing to (un)sync
+        from distributed_machine_learning_tpu.train.step import (
+            broadcast_bn_stats,
+        )
+
+        def _maybe_stack(st):
+            return broadcast_bn_stats(st, world) if unsync_bn else st
+
+        state = _maybe_stack(state)
         if args.resume:
             from distributed_machine_learning_tpu.train.checkpoint import (
                 checkpoint_config,
@@ -283,9 +315,27 @@ def run_part(
                 abstract = (
                     state
                     if type(saved_cfg) is type(opt_config)
-                    else init_model_and_state(model, config=saved_cfg)
+                    else _maybe_stack(
+                        init_model_and_state(model, config=saved_cfg)
+                    )
                 )
-                state = restore_checkpoint(latest, abstract_state=abstract)
+                try:
+                    state = restore_checkpoint(latest, abstract_state=abstract)
+                except Exception:
+                    if not unsync_bn:
+                        raise
+                    # The checkpoint predates --unsync-bn (unstacked [C]
+                    # stats): restore against the plain template, then
+                    # enter quirk mode by stacking the restored stats.
+                    plain = init_model_and_state(
+                        model,
+                        config=saved_cfg
+                        if type(saved_cfg) is not type(opt_config)
+                        else opt_config,
+                    )
+                    state = _maybe_stack(
+                        restore_checkpoint(latest, abstract_state=plain)
+                    )
                 rank0_print(f"Resumed from {latest} (step "
                             f"{int(jax.device_get(state.step))})")
                 want = opt_config
@@ -331,6 +381,12 @@ def run_part(
                 "WARNING: --wire-dtype only applies to the ring strategy "
                 f"(part3); strategy {strategy_name!r} runs uncompressed."
             )
+        # Reference part1 prints a torchsummary table before training
+        # (part1/main.py:118; the ~9.2M-param total the report leans on).
+        from distributed_machine_learning_tpu.utils.summary import model_summary
+
+        rank0_print(model_summary(state.params, title=args.model))
+
         strategy = get_strategy(strategy_name, **strategy_kwargs)
         train_step = make_train_step(
             model, strategy, mesh=mesh,
@@ -341,8 +397,21 @@ def run_part(
             clip_norm=args.clip_norm,
             accum_steps=args.grad_accum,
             optimizer=args.optimizer,
+            sync_bn=not unsync_bn,
+            local_loss=bool(getattr(args, "local_loss", False))
+            and mesh is not None,
         )
         eval_step = make_eval_step(model)
+        if unsync_bn and state.batch_stats:
+            # Quirk-mode stats are [world, *S]-stacked; the single-device
+            # eval step can't consume them — evaluate with device 0's row
+            # (each reference node evaluates with its own stats; rank 0's
+            # is the one whose prints we surface).
+            base_eval = eval_step
+
+            def eval_step(params, stats, images, labels):
+                stats0 = jax.tree_util.tree_map(lambda s: s[0], stats)
+                return base_eval(params, stats0, images, labels)
         if args.dist_eval and mesh is None:
             rank0_print(
                 "WARNING: --dist-eval has no effect for the single-device "
@@ -354,7 +423,12 @@ def run_part(
             # device step covers the test set's short final batch (the
             # reference instead evaluates everything on every rank —
             # SURVEY.md §3.5).
-            dist_eval, single_eval = make_eval_step(model, mesh=mesh), eval_step
+            # sync_bn=False makes the sharded eval read each device's own
+            # row of quirk-mode stacked stats (make_eval_step docstring).
+            dist_eval, single_eval = (
+                make_eval_step(model, mesh=mesh, sync_bn=not unsync_bn),
+                eval_step,
+            )
 
             def eval_step(params, stats, images, labels):
                 fn = dist_eval if len(labels) % world == 0 else single_eval
@@ -414,6 +488,11 @@ def run_part(
                 batches = dist_loader_cls(train_set, per_rank_batch, world)
             else:
                 batches = loader_cls(train_set, per_rank_batch)
+            if watchdog is not None:
+                # Reset the timer at the epoch boundary so the first
+                # step's XLA compile gets the full timeout window instead
+                # of whatever is left from the setup phase above.
+                watchdog.beat()
             with trace(args.trace_dir):
                 state, _ = train_epoch(
                     train_step, state, batches, place_batch=place,
@@ -431,10 +510,14 @@ def run_part(
                     eval_batches = itertools.islice(
                         iter(eval_batches), args.eval_batches
                     )
+                if watchdog is not None:
+                    # Eval time is not step time — beat on the way IN so
+                    # a long eval (including its own compile) starts with
+                    # a full window, and again on the way out so the next
+                    # phase does too.
+                    watchdog.beat()
                 evaluate(eval_step, state, eval_batches)
                 if watchdog is not None:
-                    # Eval/checkpoint time is not step time — don't let a
-                    # long eval read as a hung collective.
                     watchdog.beat()
             if args.ckpt_dir:
                 from distributed_machine_learning_tpu.train.checkpoint import (
@@ -442,6 +525,10 @@ def run_part(
                     save_checkpoint,
                 )
 
+                if watchdog is not None:
+                    # Same on the way into the (possibly long, blocking)
+                    # checkpoint write as out of it.
+                    watchdog.beat()
                 if args.async_ckpt:
                     if ckpt_writer is None:
                         ckpt_writer = AsyncCheckpointWriter()

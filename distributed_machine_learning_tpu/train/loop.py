@@ -25,6 +25,28 @@ MAX_ITERS = 40
 LOSS_PRINT_EVERY = 20
 
 
+def _host_local_losses(loss) -> list[tuple[int, float]]:
+    """(global device index, loss) pairs addressable on this host.
+
+    The local-loss vector (``make_train_step(local_loss=True)``) is
+    sharded P(batch): on a multi-host run ``np.asarray`` on the global
+    array would raise (not fully addressable), and this host should
+    print only its own devices' losses anyway — reference semantics.
+    Scalars (the pmean path, fully replicated) report as device 0.
+    """
+    if not getattr(loss, "ndim", 0):
+        return [(0, float(loss))]
+    shards = getattr(loss, "addressable_shards", None)
+    if shards is None:
+        return [(d, float(v)) for d, v in enumerate(np.asarray(loss))]
+    out = []
+    for sh in shards:
+        start = sh.index[0].start or 0
+        for j, v in enumerate(np.asarray(sh.data).ravel()):
+            out.append((start + j, float(v)))
+    return sorted(out)
+
+
 def train_epoch(
     train_step,
     state: TrainState,
@@ -67,10 +89,29 @@ def train_epoch(
         if watchdog is not None:
             watchdog.beat()
         if metrics is not None:
-            metrics.log(step=int(state.step), loss=float(loss),
-                        iter_seconds=iter_time)
+            metrics.log(
+                step=int(state.step),
+                loss=float(np.mean(
+                    [lv for _, lv in _host_local_losses(loss)]
+                )),
+                iter_seconds=iter_time,
+            )
         if (batch_idx + 1) % loss_print_every == 0:  # part1/main.py:49-50
-            rank0_print(f"Loss at {batch_idx + 1}th batch is {float(loss)}")
+            if getattr(loss, "ndim", 0):
+                # local-loss mode (make_train_step(local_loss=True)): one
+                # line per THIS-HOST device — the reference's every-rank-
+                # prints-its-own-loss surface (part2/2a/main.py:58-61);
+                # printed unconditionally (not rank-0-gated) for the same
+                # reason.
+                for d, lv in _host_local_losses(loss):
+                    print(
+                        f"Loss at {batch_idx + 1}th batch is {lv} "
+                        f"(device {d})"
+                    )
+            else:
+                rank0_print(
+                    f"Loss at {batch_idx + 1}th batch is {float(loss)}"
+                )
     rank0_print(timer.summary())  # part1/main.py:57-58
     return state, timer
 

@@ -52,14 +52,16 @@ def get_optimizer(name: str):
 
 
 def _entry_for_config(config):
+    # Exact-type dispatch only: an unregistered SGDConfig *subclass* must
+    # raise, not silently train with plain-SGD semantics (a LARS-like
+    # config created without a registry entry would otherwise lose its
+    # intended update rule without any error).
     for cfg_cls, init_fn, update_fn in OPTIMIZERS.values():
         if type(config) is cfg_cls:
             return cfg_cls, init_fn, update_fn
-    if isinstance(config, SGDConfig):
-        # Unknown SGDConfig subclass: momentum layout is SGD's.
-        return SGDConfig, sgd_init, sgd_update
     raise ValueError(
-        f"no registered optimizer for config type {type(config).__name__}"
+        f"no registered optimizer for config type {type(config).__name__}; "
+        f"add it to OPTIMIZERS (registered: {optimizer_names()})"
     )
 
 

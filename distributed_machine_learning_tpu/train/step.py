@@ -51,7 +51,17 @@ def _train_step_impl(
     clip_norm: float | None = None,
     accum_steps: int = 1,
     update_fn=None,
+    local_loss: bool = False,
 ):
+    # Unsynced-BN quirk mode (reference part3: per-node running stats,
+    # part3/model.py:24 + group25.pdf p.3-4): the replicated state holds
+    # a [world, *S]-stacked stats tree; each device reads/writes its own
+    # row, and an all_gather of the (tiny) stats restores replication.
+    unsync_bn = axis_name is not None and not sync_bn
+    stats_in = state.batch_stats
+    if unsync_bn and stats_in:
+        dev_idx = lax.axis_index(axis_name)
+        stats_in = jax.tree_util.tree_map(lambda s: s[dev_idx], stats_in)
     if update_fn is None:
         # Dispatch on the state's (static) optimizer config at trace time.
         from distributed_machine_learning_tpu.train.optimizers import (
@@ -62,7 +72,7 @@ def _train_step_impl(
     rng = step_rng(state.rng, state.step, axis_name)
     if accum_steps == 1:
         x = augment_batch(rng, images_u8) if augment else normalize(images_u8)
-        loss_fn = make_loss_fn(model, state.batch_stats, x, labels, train=True)
+        loss_fn = make_loss_fn(model, stats_in, x, labels, train=True)
         (loss, (_, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
@@ -100,7 +110,7 @@ def _train_step_impl(
         zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
         (new_stats, grads, loss), _ = lax.scan(
             body,
-            (state.batch_stats, zeros, jnp.zeros((), jnp.float32)),
+            (stats_in, zeros, jnp.zeros((), jnp.float32)),
             (micro_imgs, micro_labels, micro_rngs),
         )
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
@@ -115,6 +125,13 @@ def _train_step_impl(
             # devices (the framework's cross-replica invariant).
             new_stats = jax.tree_util.tree_map(
                 lambda s: lax.pmean(s, axis_name), new_stats
+            )
+        elif new_stats and unsync_bn:
+            # Re-stack every device's locally-updated stats so the
+            # replicated out_spec stays truthful: all devices hold the
+            # identical [world, *S] array whose row d is device d's stats.
+            new_stats = jax.tree_util.tree_map(
+                lambda s: lax.all_gather(s, axis_name), new_stats
             )
 
     if clip_norm is not None:
@@ -139,9 +156,15 @@ def _train_step_impl(
         step=state.step + 1,
     )
     if axis_name is not None:
-        # Report the global mean loss (each reference rank prints its own
-        # local loss; SPMD has one print stream, so surface the mean).
-        loss = lax.pmean(loss, axis_name)
+        if local_loss:
+            # Reference print-surface parity mode: each rank prints its
+            # OWN shard's loss (part2/2a/main.py:58-61).  Out spec is
+            # P(axis), so the step returns the [world] per-device vector.
+            loss = loss[None]
+        else:
+            # Default: the global mean loss (SPMD has one print stream,
+            # so surface the mean).
+            loss = lax.pmean(loss, axis_name)
     return new_state, loss
 
 
@@ -157,6 +180,7 @@ def make_train_step(
     accum_steps: int = 1,
     jit: bool = True,
     optimizer: str | None = None,
+    local_loss: bool = False,
 ):
     """Build the jitted train step.
 
@@ -171,6 +195,16 @@ def make_train_step(
     (identical update for BN-free models, accum-fold lower activation
     memory).
 
+    ``sync_bn``: True (default) axis-means BN running stats so replicated
+    state stays bit-identical; False reproduces the reference part3's
+    per-node unsynced stats (part3/model.py:24) — pass state through
+    ``broadcast_bn_stats(state, mesh.shape[axis_name])`` first, and eval
+    with ``make_eval_step(..., sync_bn=False)``.
+
+    ``local_loss`` (mesh only): return the [world] vector of per-device
+    losses instead of the pmean — each reference rank prints its own
+    local loss (part2/2a/main.py:58-61); this is that print surface.
+
     ``optimizer``: None (default) dispatches on the TrainState's config
     type — SGDConfig → sgd (reference parity), LARSConfig → lars,
     AdamWConfig → adamw; an explicit registry name pins the update fn.
@@ -184,6 +218,9 @@ def make_train_step(
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if local_loss and mesh is None:
+        raise ValueError("local_loss requires a mesh (it is the per-device "
+                         "loss vector; the part1 path has one device)")
     from distributed_machine_learning_tpu.train.optimizers import get_optimizer
 
     # optimizer=None → dispatch from the TrainState's config at trace time
@@ -217,17 +254,11 @@ def make_train_step(
         return jax.jit(impl, donate_argnums=(0,)) if jit else impl
 
     axis_size = mesh.shape[axis_name]
-    if not sync_bn:
-        # The reference's part3 leaves BN running stats unsynced per node
-        # (SURVEY.md §7.3) — but under SPMD with replicated state that
-        # would silently desynchronize the replicas.  Supporting the quirk
-        # would need per-device stats sharding; until then, refuse loudly.
-        raise ValueError(
-            "sync_bn=False is not supported on a mesh: per-device BN "
-            "running stats would diverge while being declared replicated "
-            "(the reference's unsynced-BN quirk needs per-device state "
-            "sharding; stats are axis-synced here instead)"
-        )
+    # sync_bn=False is the reference part3 quirk mode: per-device BN
+    # running stats (part3/model.py:24, <1% cross-node accuracy drift —
+    # group25.pdf p.3-4).  State must carry [world, *S]-stacked stats —
+    # build it with ``broadcast_bn_stats(state, world)``; each device
+    # reads/writes its own row (see _train_step_impl).
     impl = partial(
         _train_step_impl,
         model,
@@ -240,6 +271,7 @@ def make_train_step(
         clip_norm=clip_norm,
         accum_steps=accum_steps,
         update_fn=update_fn,
+        local_loss=local_loss,
     )
     state_spec = P()  # replicated
     batch_spec = P(axis_name)  # sharded along the data axis
@@ -247,12 +279,29 @@ def make_train_step(
         impl,
         mesh=mesh,
         in_specs=(state_spec, batch_spec, batch_spec),
-        out_specs=(state_spec, P()),
+        out_specs=(state_spec, P(axis_name) if local_loss else P()),
     )
     return jax.jit(sharded, donate_argnums=(0,)) if jit else sharded
 
 
-def make_eval_step(model, mesh: Mesh | None = None, axis_name: str = BATCH_AXIS):
+def broadcast_bn_stats(state: TrainState, world: int) -> TrainState:
+    """Stack ``world`` copies of the BN running stats ([world, *S] per
+    leaf) — the state layout the unsynced-BN quirk mode
+    (``make_train_step(..., sync_bn=False)``) reads and writes.  The
+    stacked tree stays replicated across devices; row d is device d's
+    private running stats, the TPU encoding of the reference's per-node
+    BN state (part3/model.py:24)."""
+    if not state.batch_stats:
+        return state
+    stacked = jax.tree_util.tree_map(
+        lambda s: jnp.tile(s[None], (world,) + (1,) * s.ndim),
+        state.batch_stats,
+    )
+    return state.replace(batch_stats=stacked)
+
+
+def make_eval_step(model, mesh: Mesh | None = None, axis_name: str = BATCH_AXIS,
+                   sync_bn: bool = True):
     """Jitted eval step: (params, batch_stats, images_u8, labels) →
     (batch mean loss, correct count) — ``test_model`` parity
     (``part1/main.py:62-77``): normalize only (no augmentation), BN in
@@ -264,10 +313,20 @@ def make_eval_step(model, mesh: Mesh | None = None, axis_name: str = BATCH_AXIS)
     every-rank-evaluates-everything protocol (SURVEY.md §3.5) with
     identical results (equal shards ⇒ pmean of shard means == the global
     batch mean).
+
+    ``sync_bn=False`` (quirk-mode eval, mesh only): ``batch_stats`` is
+    the [world, *S]-stacked tree from the unsynced-BN train step; each
+    device scores its shard with its own stats row, so the reported
+    numbers mix per-device models exactly the way the reference's
+    per-node evals do.
     """
 
     def eval_impl(params, batch_stats, images_u8, labels, *, axis=None):
         x = normalize(images_u8)
+        if batch_stats and axis is not None and not sync_bn:
+            batch_stats = jax.tree_util.tree_map(
+                lambda s: s[lax.axis_index(axis)], batch_stats
+            )
         variables: dict[str, Any] = {"params": params}
         if batch_stats:
             variables["batch_stats"] = batch_stats

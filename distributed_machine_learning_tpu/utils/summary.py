@@ -1,0 +1,44 @@
+"""Model summary banner — parameter table + totals.
+
+The reference prints a torchsummary table for part1 (``part1/main.py:118``)
+whose ~9.2M-parameter total the report leans on (group25.pdf p.2).  This
+is the pytree-native equivalent: per-module parameter counts from the
+params tree itself, plus the totals line.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _count(tree) -> int:
+    import jax
+
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
+
+
+def model_summary(params, title: str = "Model") -> str:
+    """A torchsummary-style table: one row per top-level module with its
+    parameter shapes and count, then total params and fp32 size in MB."""
+    import jax
+
+    rows = []
+    width = 24
+    for name in sorted(params):
+        sub = params[name]
+        shapes = " ".join(
+            "x".join(str(d) for d in leaf.shape) or "scalar"
+            for leaf in jax.tree_util.tree_leaves(sub)
+        )
+        rows.append(f"  {name:<{width}} {_count(sub):>12,}  [{shapes}]")
+    total = _count(params)
+    lines = [
+        f"{title} summary",
+        "-" * 64,
+        *rows,
+        "-" * 64,
+        f"  {'Total params':<{width}} {total:>12,}",
+        f"  {'Size (fp32)':<{width}} {total * 4 / 2**20:>10.2f} MB",
+        "-" * 64,
+    ]
+    return "\n".join(lines)

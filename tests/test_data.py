@@ -89,25 +89,66 @@ def test_synthetic_cifar10_is_deterministic(tmp_path):
     np.testing.assert_array_equal(a.labels, b.labels)
 
 
-def test_cifar10_pickle_parser_roundtrip(tmp_path):
-    """Write a batch in the standard cifar-10-batches-py layout and parse it."""
+def _write_cifar_dir(tmp_path, n=20, seed=3):
+    """A handcrafted on-disk cifar-10-batches-py layout with a DISTINCT
+    payload per batch file (so concatenation order is proven), returning
+    the per-file CHW arrays/labels."""
     import pickle, os
 
-    n = 20
-    rng = np.random.default_rng(3)
-    imgs_chw = rng.integers(0, 256, (n, 3, 32, 32), dtype=np.uint8)
-    labels = rng.integers(0, 10, n).tolist()
+    rng = np.random.default_rng(seed)
     batch_dir = tmp_path / "cifar-10-batches-py"
-    os.makedirs(batch_dir)
-    payload = {b"data": imgs_chw.reshape(n, -1), b"labels": labels}
-    for name in [f"data_batch_{i}" for i in range(1, 6)]:
+    os.makedirs(batch_dir, exist_ok=True)
+    per_file = {}
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        imgs_chw = rng.integers(0, 256, (n, 3, 32, 32), dtype=np.uint8)
+        labels = rng.integers(0, 10, n).tolist()
         with open(batch_dir / name, "wb") as f:
-            pickle.dump(payload, f)
+            pickle.dump({b"data": imgs_chw.reshape(n, -1), b"labels": labels}, f)
+        per_file[name] = (imgs_chw, labels)
+    return per_file
+
+
+def test_cifar10_pickle_parser_roundtrip(tmp_path):
+    """Write batches in the standard cifar-10-batches-py layout and parse
+    them back: CHW→NHWC orientation, int32 labels, file concat order
+    (data/cifar10.py:_load_batches ≡ torchvision's unpickle path,
+    part1/main.py:96-97)."""
+    n = 20
+    per_file = _write_cifar_dir(tmp_path, n=n)
     ds = load_cifar10(root=str(tmp_path), train=True, download=False)
     assert not ds.synthetic
     assert ds.images.shape == (5 * n, 32, 32, 3)
-    np.testing.assert_array_equal(ds.images[:n], imgs_chw.transpose(0, 2, 3, 1))
-    np.testing.assert_array_equal(ds.labels[:n], labels)
+    assert ds.labels.dtype == np.int32
+    for i in range(5):
+        imgs_chw, labels = per_file[f"data_batch_{i + 1}"]
+        np.testing.assert_array_equal(
+            ds.images[i * n : (i + 1) * n], imgs_chw.transpose(0, 2, 3, 1)
+        )
+        np.testing.assert_array_equal(ds.labels[i * n : (i + 1) * n], labels)
+    # train=False reads only test_batch.
+    test_ds = load_cifar10(root=str(tmp_path), train=False, download=False)
+    imgs_chw, labels = per_file["test_batch"]
+    assert test_ds.images.shape == (n, 32, 32, 3)
+    np.testing.assert_array_equal(test_ds.images, imgs_chw.transpose(0, 2, 3, 1))
+    np.testing.assert_array_equal(test_ds.labels, labels)
+
+
+def test_cifar10_targz_extraction(tmp_path):
+    """The tar.gz on disk (what a real download leaves) is extracted and
+    parsed without re-downloading (data/cifar10.py:_maybe_extract)."""
+    import tarfile
+
+    src = tmp_path / "src"
+    src.mkdir()
+    per_file = _write_cifar_dir(src, n=4)
+    root = tmp_path / "root"
+    root.mkdir()
+    with tarfile.open(root / "cifar-10-python.tar.gz", "w:gz") as tar:
+        tar.add(src / "cifar-10-batches-py", arcname="cifar-10-batches-py")
+    ds = load_cifar10(root=str(root), train=True, download=False)
+    assert not ds.synthetic and len(ds) == 20
+    imgs_chw, _ = per_file["data_batch_1"]
+    np.testing.assert_array_equal(ds.images[:4], imgs_chw.transpose(0, 2, 3, 1))
 
 
 def test_normalize_and_augment_shapes():
