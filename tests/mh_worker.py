@@ -1,0 +1,96 @@
+"""Two-process worker for the multi-host test (tests/test_multihost.py).
+
+Run as a subprocess (NOT collected by pytest): each of two OS processes
+brings up the coordination service through the reference's exact flag
+path (``--master-ip``/``--rank``/``--num-nodes`` →
+``jax.distributed.initialize`` — runtime/distributed.py:46-59, the TPU
+analogue of ``dist.init_process_group`` at part2/2a/main.py:197), then
+runs lock-step psum training steps over a 2-process CPU mesh and agrees
+on a SIGTERM-triggered stop via ``agree_stop``'s process_allgather
+branch (runtime/resilience.py) — the code paths single-process tests
+can never exercise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--port", type=int, required=True)
+    args = ap.parse_args()
+
+    from distributed_machine_learning_tpu.runtime.distributed import (
+        initialize_from_flags,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import (
+        make_mesh,
+        shard_map_no_check,
+    )
+    from distributed_machine_learning_tpu.runtime.resilience import (
+        PreemptionHandler,
+        agree_stop,
+    )
+
+    ctx = initialize_from_flags(f"127.0.0.1:{args.port}", args.rank, 2)
+    assert jax.process_count() == 2, jax.process_count()
+    print(f"ready rank={jax.process_index()} devices={jax.device_count()}",
+          flush=True)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(2)  # one CPU device per process
+    sharding = NamedSharding(mesh, P("batch"))
+    # Each process contributes its own local shard — the per-host data
+    # path of a real multi-host run.
+    local = np.full((1, 8), float(jax.process_index() + 1), np.float32)
+    x = jax.make_array_from_process_local_data(sharding, local)
+    w = jax.device_put(
+        jnp.zeros((8,), jnp.float32), NamedSharding(mesh, P())
+    )
+
+    def local_step(w, xs):
+        # pmean over the cross-process axis: the part3 mean-gradient
+        # semantics, riding gloo instead of ICI on this CPU mesh.
+        g = jax.lax.pmean(xs[0], "batch")
+        return w - 0.1 * g
+
+    step = jax.jit(shard_map_no_check(
+        local_step, mesh=mesh, in_specs=(P(), P("batch")), out_specs=P()
+    ))
+
+    pre = PreemptionHandler().install()
+    stopped_at = -1
+    for i in range(200):
+        w = step(w, x)
+        jax.block_until_ready(w)
+        if args.rank == 0:
+            print(f"step {i}", flush=True)
+        # Collective agreement every step: both ranks must leave the loop
+        # at the same boundary even though only rank 0 gets the signal.
+        if agree_stop(pre.requested):
+            stopped_at = i
+            break
+        time.sleep(0.05)
+
+    # w is fully replicated, so np.asarray is legal on both hosts; the
+    # digest proves bit-identical final params across processes.
+    digest = hashlib.sha256(np.asarray(w).tobytes()).hexdigest()[:16]
+    print(f"stopped_at {stopped_at}", flush=True)
+    print(f"final {digest}", flush=True)
+    ctx.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Real two-process multi-host run (VERDICT r01 weak #6): the rendezvous
+(runtime/distributed.py::initialize_from_flags), cross-process gloo
+collectives, and agree_stop's process_allgather branch
+(runtime/resilience.py:224-244) exercised as two actual OS processes —
+the reference bar is the 4-node cluster bring-up at
+/root/reference/part2/2b/main.py:163-176."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_rendezvous_identical_params_and_agree_stop():
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # The workers get ONE cpu device each (no 8-way virtual split) so the
+    # 2-device mesh really spans the process boundary.
+    env.pop("XLA_FLAGS", None)
+    # A TPU-tunnel sitecustomize (if this host has one on PYTHONPATH)
+    # pre-initializes jax.distributed for its own single-process session,
+    # which would swallow the workers' 2-process rendezvous — keep only
+    # non-sitecustomize entries and drop its trigger env vars.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + keep)
+    cmd = [sys.executable, os.path.join(HERE, "mh_worker.py"),
+           "--port", str(port)]
+    p0 = subprocess.Popen(cmd + ["--rank", "0"], stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, env=env, text=True,
+                          cwd=REPO)
+    p1 = subprocess.Popen(cmd + ["--rank", "1"], stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, env=env, text=True,
+                          cwd=REPO)
+    try:
+        # Let rank 0 make progress, then preempt it mid-run: rank 1 must
+        # stop at the SAME step via the cross-host agreement.
+        lines0 = []
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            line = p0.stdout.readline()
+            if not line:
+                break
+            lines0.append(line)
+            if line.startswith("step 3"):
+                p0.send_signal(signal.SIGTERM)
+                break
+        rest0, _ = p0.communicate(timeout=180)
+        out1, _ = p1.communicate(timeout=180)
+        out0 = "".join(lines0) + rest0
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+
+    assert p0.returncode == 0, f"rank0 failed:\n{out0}"
+    assert p1.returncode == 0, f"rank1 failed:\n{out1}"
+
+    def field(out, key):
+        vals = [l.split(None, 1)[1] for l in out.splitlines()
+                if l.startswith(key)]
+        assert vals, f"no {key!r} line in:\n{out}"
+        return vals[-1]
+
+    # SIGTERM landed on rank 0 only; BOTH ranks agreed to stop at the
+    # same step boundary (a rank leaving early would hang the other in
+    # the next collective — the exact failure agree_stop prevents).
+    s0, s1 = field(out0, "stopped_at"), field(out1, "stopped_at")
+    assert s0 == s1 and int(s0) >= 3, (s0, s1)
+    # And the replicated params are bit-identical across processes.
+    assert field(out0, "final") == field(out1, "final")
