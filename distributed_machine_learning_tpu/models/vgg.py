@@ -38,6 +38,12 @@ from distributed_machine_learning_tpu.models.initializers import (
 # Reference cfg table (part1/model.py:3-8): ints are conv output channels,
 # 'M' is a 2×2 max-pool.
 _cfg: dict[str, Sequence] = {
+    # Narrow VGG-shaped net for the test suite: same depth-of-structure
+    # (conv/BN/relu blocks, 5 pools, flatten+fc) at ~1/1000 the params,
+    # so strategy-math tests (whose invariants are model-independent)
+    # compile in seconds on the 1-core test host instead of minutes.
+    # Not part of the reference cfg table (part1/model.py:3-8).
+    "VGGTEST": [8, "M", 16, "M", 16, "M", 16, "M", 16, "M"],
     "VGG11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
     "VGG13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
     "VGG16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
@@ -112,6 +118,11 @@ class VGG(nn.Module):
 def VGG11(**kw) -> VGG:
     """Factory matching the reference's only exposed model (part1/model.py:49-50)."""
     return VGG(name_cfg="VGG11", **kw)
+
+
+def VGGTest(**kw) -> VGG:
+    """Narrow VGG-shaped net for fast-compiling tests (see _cfg note)."""
+    return VGG(name_cfg="VGGTEST", **kw)
 
 
 def VGG13(**kw) -> VGG:

@@ -26,7 +26,10 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the suite is dominated by XLA compiles of
 # shard_map programs (single-core CPU here); caching them makes reruns
 # minutes instead of tens of minutes.  Harmless if the dir is wiped.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("DML_TEST_CACHE", "/tmp/jax_test_cache"),
+)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 

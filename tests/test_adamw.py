@@ -101,14 +101,14 @@ def test_train_step_dispatches_on_config(mesh4, rng):
     # A VGG train step built with optimizer=None honors AdamWConfig on
     # the state — including under shard_map with gradient sync.
     from distributed_machine_learning_tpu.cli.common import init_model_and_state
-    from distributed_machine_learning_tpu.models.vgg import VGG11
+    from distributed_machine_learning_tpu.models.vgg import VGGTest
     from distributed_machine_learning_tpu.parallel.strategies import get_strategy
     from distributed_machine_learning_tpu.train.step import (
         make_train_step,
         shard_batch,
     )
 
-    model = VGG11(use_bn=False)
+    model = VGGTest(use_bn=False)
     state = init_model_and_state(model, config=AdamWConfig(learning_rate=1e-3))
     assert set(state.momentum) == {"mu", "nu"}
     step = make_train_step(model, get_strategy("all_reduce"), mesh=mesh4,
@@ -130,6 +130,7 @@ def test_train_step_dispatches_on_config(mesh4, rng):
     )
 
 
+@pytest.mark.slow
 def test_adamw_under_tensor_parallel_and_pipeline(rng):
     # The {"mu","nu"} moment layout must flow through the GSPMD sharding
     # derivation (parallel/gspmd.py) and the pipeline's manual spec
@@ -173,12 +174,12 @@ def test_adamw_under_tensor_parallel_and_pipeline(rng):
 def test_zero_sharding_rejects_lars(mesh4):
     # Elementwise AdamW shards exactly; LARS (per-layer norms) cannot.
     from distributed_machine_learning_tpu.cli.common import init_model_and_state
-    from distributed_machine_learning_tpu.models.vgg import VGG11
+    from distributed_machine_learning_tpu.models.vgg import VGGTest
     from distributed_machine_learning_tpu.parallel.fsdp import shard_fsdp_state
     from distributed_machine_learning_tpu.parallel.zero1 import shard_zero1_state
     from distributed_machine_learning_tpu.train.lars import LARSConfig
 
-    state = init_model_and_state(VGG11(use_bn=False), config=LARSConfig())
+    state = init_model_and_state(VGGTest(use_bn=False), config=LARSConfig())
     with pytest.raises(ValueError, match="LARS"):
         shard_zero1_state(state, mesh4)
     with pytest.raises(ValueError, match="LARS"):
@@ -190,7 +191,7 @@ def test_zero_sharding_with_adamw_matches_replicated(mesh4, rng):
     # the replicated data-parallel AdamW step: same loss, same params
     # after the step — elementwise updates are exact on any slice.
     from distributed_machine_learning_tpu.cli.common import init_model_and_state
-    from distributed_machine_learning_tpu.models.vgg import VGG11
+    from distributed_machine_learning_tpu.models.vgg import VGGTest
     from distributed_machine_learning_tpu.parallel.fsdp import (
         gather_fsdp_params,
         make_fsdp_train_step,
@@ -207,7 +208,7 @@ def test_zero_sharding_with_adamw_matches_replicated(mesh4, rng):
         shard_batch,
     )
 
-    model = VGG11(use_bn=False)
+    model = VGGTest(use_bn=False)
     cfg = AdamWConfig(learning_rate=1e-3)
     images = rng.integers(0, 256, (8, 32, 32, 3)).astype(np.uint8)
     labels = rng.integers(0, 10, 8).astype(np.int32)

@@ -7,11 +7,11 @@ from distributed_machine_learning_tpu.bench.sweep import (
     run_point,
     weak_scaling_sweep,
 )
-from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.models.vgg import VGGTest
 
 
 def test_weak_scaling_sweep_structure():
-    model = VGG11()
+    model = VGGTest()
     points = weak_scaling_sweep(
         model, "ring", device_counts=[1, 2], per_device_batch=4, timed_iters=2
     )
@@ -31,7 +31,7 @@ def test_run_point_does_not_consume_shared_state():
     """run_point must deep-copy a provided init state (steps donate it)."""
     from distributed_machine_learning_tpu.cli.common import init_model_and_state
 
-    model = VGG11()
+    model = VGGTest()
     state = init_model_and_state(model)
     run_point(model, "all_reduce", 2, per_device_batch=4, timed_iters=1,
               init_state=state)

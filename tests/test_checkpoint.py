@@ -13,7 +13,7 @@ import numpy as np
 import pytest
 
 from distributed_machine_learning_tpu.cli.common import init_model_and_state
-from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.models.vgg import VGGTest
 from distributed_machine_learning_tpu.train.checkpoint import (
     latest_checkpoint,
     restore_checkpoint,
@@ -24,7 +24,7 @@ from distributed_machine_learning_tpu.train.step import make_train_step
 
 
 def _tiny_model():
-    return VGG11(use_bn=True)
+    return VGGTest(use_bn=True)
 
 
 def _batch(rng, n=4):
@@ -109,14 +109,14 @@ def test_async_checkpoint_roundtrip(tmp_path, rng):
     import numpy as np
 
     from distributed_machine_learning_tpu.cli.common import init_model_and_state
-    from distributed_machine_learning_tpu.models.vgg import VGG11
+    from distributed_machine_learning_tpu.models.vgg import VGGTest
     from distributed_machine_learning_tpu.train.checkpoint import (
         AsyncCheckpointWriter,
         latest_checkpoint,
         restore_checkpoint,
     )
 
-    state = init_model_and_state(VGG11(use_bn=False))
+    state = init_model_and_state(VGGTest(use_bn=False))
     with AsyncCheckpointWriter() as writer:
         path = writer.save(tmp_path, state)
         writer.wait()

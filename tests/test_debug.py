@@ -73,6 +73,7 @@ def test_remat_grads_bit_identical(rng):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_remat_pipeline_matches_no_remat(rng):
     from distributed_machine_learning_tpu.models.transformer import TransformerLM
     from distributed_machine_learning_tpu.parallel.pipeline import (

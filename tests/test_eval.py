@@ -6,14 +6,14 @@ import numpy as np
 import pytest
 
 from distributed_machine_learning_tpu.cli.common import init_model_and_state
-from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.models.vgg import VGGTest
 from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 from distributed_machine_learning_tpu.train.step import make_eval_step
 
 
 @pytest.mark.parametrize("use_bn", [False, True])
 def test_sharded_eval_matches_single_device(use_bn):
-    model = VGG11(use_bn=use_bn)
+    model = VGGTest(use_bn=use_bn)
     state = init_model_and_state(model)
     rng = np.random.default_rng(5)
     x = rng.integers(0, 256, (64, 32, 32, 3), dtype=np.uint8)
@@ -30,6 +30,7 @@ def test_sharded_eval_matches_single_device(use_bn):
     assert int(correct_m) == int(correct_s)
 
 
+@pytest.mark.slow
 def test_cli_dist_eval_flag_runs(capsys):
     """part2b with --dist-eval prints the same eval surface."""
     from distributed_machine_learning_tpu.cli.common import (

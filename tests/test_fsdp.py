@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.models.vgg import VGGTest
 from distributed_machine_learning_tpu.parallel.fsdp import (
     fsdp_memory_footprint,
     gather_fsdp_params,
@@ -48,7 +48,7 @@ def batch():
 
 
 def test_fsdp_shards_are_one_nth(mesh8):
-    state = _fresh_state(VGG11())
+    state = _fresh_state(VGGTest())
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
     fsdp_state, _, n_elems = shard_fsdp_state(state, mesh8)
     assert n_elems == n_params
@@ -61,10 +61,12 @@ def test_fsdp_shards_are_one_nth(mesh8):
         assert shard.data.shape == (padded // 8,)
 
 
-@pytest.mark.parametrize("use_bn", [False, True])
+@pytest.mark.parametrize(
+    "use_bn", [False, pytest.param(True, marks=pytest.mark.slow)]
+)
 def test_fsdp_matches_replicated_dp(batch, mesh8, use_bn):
     images, labels = batch
-    model = VGG11(use_bn=use_bn)
+    model = VGGTest(use_bn=use_bn)
 
     # Replicated DP, mean semantics (part3): the baseline.
     rep_state = _fresh_state(model)
@@ -102,7 +104,7 @@ def test_fsdp_matches_replicated_dp(batch, mesh8, use_bn):
 
 
 def test_fsdp_state_roundtrip(mesh8):
-    state = _fresh_state(VGG11())
+    state = _fresh_state(VGGTest())
     fsdp_state, unravel, n_elems = shard_fsdp_state(state, mesh8)
     got = gather_fsdp_params(fsdp_state, unravel, n_elems)
     for la, lb in zip(

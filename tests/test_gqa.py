@@ -76,6 +76,7 @@ def test_decode_cache_is_narrow(rng):
     assert cached_key.shape == (1, 8, 1, 4)  # [B, S, Hkv=1, Dh]
 
 
+@pytest.mark.slow
 def test_gqa_under_tensor_parallel(rng):
     from distributed_machine_learning_tpu.parallel.tensor_parallel import (
         make_tp_lm_train_step,
@@ -97,6 +98,7 @@ def test_gqa_under_tensor_parallel(rng):
         make_tp_lm_train_step(_gqa_model(1), mesh)  # 1 % 2 != 0
 
 
+@pytest.mark.slow
 def test_gqa_under_pipeline(rng):
     from distributed_machine_learning_tpu.parallel.pipeline import (
         init_pipeline_state,
@@ -116,6 +118,7 @@ def test_gqa_under_pipeline(rng):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_gqa_ring_matches_dense(rng):
     # Sequence-sharded ring attention with grouped K/V must equal the
     # unsharded dense forward (the exactness contract, now under GQA).

@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from distributed_machine_learning_tpu.cli.common import init_model_and_state
-from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.models.vgg import VGGTest
 from distributed_machine_learning_tpu.parallel.strategies import get_strategy
 from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 from distributed_machine_learning_tpu.train.step import (
@@ -33,7 +33,7 @@ def _params_close(a, b, **kw):
 @pytest.mark.parametrize("accum", [2, 4])
 def test_accum_matches_full_batch(data, accum):
     x, y = data
-    model = VGG11()
+    model = VGGTest()
 
     full = make_train_step(model, augment=False)
     s_full, loss_full = full(init_model_and_state(model), x, y)
@@ -49,7 +49,7 @@ def test_accum_on_mesh_matches(data):
     """accum composes with the distributed step: 8-way DP x 2-way accum
     equals the single-device full-batch step."""
     x, y = data
-    model = VGG11()
+    model = VGGTest()
     mesh = make_mesh(8)
 
     full = make_train_step(model, augment=False)
@@ -69,7 +69,7 @@ def test_accum_on_mesh_matches(data):
 def test_accum_with_bn_stays_finite(data):
     """BN models accumulate too (stats update per microbatch) — smoke."""
     x, y = data
-    model = VGG11(use_bn=True)
+    model = VGGTest(use_bn=True)
     step = make_train_step(model, augment=False, accum_steps=4)
     state, loss = step(init_model_and_state(model), x, y)
     assert np.isfinite(float(loss))
@@ -78,7 +78,7 @@ def test_accum_with_bn_stays_finite(data):
 
 
 def test_accum_validates():
-    model = VGG11()
+    model = VGGTest()
     with pytest.raises(ValueError, match="accum_steps"):
         make_train_step(model, accum_steps=0)
     step = make_train_step(model, augment=False, accum_steps=3)

@@ -12,7 +12,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from distributed_machine_learning_tpu.cli.common import init_model_and_state
-from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.models.vgg import VGGTest
 from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 from distributed_machine_learning_tpu.train.lars import LARSConfig, lars_update
 
@@ -52,7 +52,7 @@ def test_lars_zero_norm_fallback_is_plain_lr():
 def test_lars_train_step_runs():
     from distributed_machine_learning_tpu.train.step import make_train_step
 
-    model = VGG11()
+    model = VGGTest()
     state = init_model_and_state(model, config=LARSConfig())
     step = make_train_step(model, augment=False, optimizer="lars")
     rng = np.random.default_rng(0)
@@ -119,6 +119,7 @@ def test_ring_wire_compression_is_rank_identical():
         np.testing.assert_array_equal(per_rank[0], per_rank[r])
 
 
+@pytest.mark.slow
 def test_lars_checkpoint_roundtrip(tmp_path):
     """LARSConfig survives save/restore (the config class is recorded), and
     a cross-optimizer resume through the CLI path resets momentum instead
@@ -128,7 +129,7 @@ def test_lars_checkpoint_roundtrip(tmp_path):
         save_checkpoint,
     )
 
-    model = VGG11()
+    model = VGGTest()
     state = init_model_and_state(model, config=LARSConfig(trust_coefficient=2e-3))
     path = save_checkpoint(tmp_path, state)
     restored = restore_checkpoint(path, abstract_state=state)
@@ -155,6 +156,7 @@ def test_lars_checkpoint_roundtrip(tmp_path):
     run_part("none", 4, use_bn=False, args=args)
 
 
+@pytest.mark.slow
 def test_distributed_resume_places_state_on_mesh(tmp_path, capsys):
     """Resuming a DISTRIBUTED run must re-place the restored (device-0
     committed) state onto the mesh; regression for the device-mismatch

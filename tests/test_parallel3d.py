@@ -45,7 +45,13 @@ def dense_step_result(batch):
     return state, float(loss)
 
 
-@pytest.mark.parametrize("shape", [(2, 2, 2), (1, 4, 2), (2, 4, 1), (1, 2, 4)])
+@pytest.mark.parametrize(
+    "shape",
+    [(2, 2, 2),
+     pytest.param((1, 4, 2), marks=pytest.mark.slow),
+     pytest.param((2, 4, 1), marks=pytest.mark.slow),
+     pytest.param((1, 2, 4), marks=pytest.mark.slow)],
+)
 def test_3d_matches_dense_baseline(batch, dense_step_result, shape):
     dp, pp, tp = shape
     x, y = batch

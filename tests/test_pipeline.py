@@ -50,7 +50,12 @@ def test_stack_unstack_roundtrip():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-@pytest.mark.parametrize("stages,microbatches", [(2, 2), (4, 2), (4, 4)])
+@pytest.mark.parametrize(
+    "stages,microbatches",
+    [(2, 2),
+     pytest.param(4, 2, marks=pytest.mark.slow),
+     pytest.param(4, 4, marks=pytest.mark.slow)],
+)
 def test_pp_step_equals_single_device(batch, stages, microbatches):
     tokens, targets = batch
     model = tiny_lm()

@@ -61,11 +61,11 @@ def test_train_epoch_stops_at_boundary_and_beats_watchdog(rng):
     # A tiny real train loop: stop requested after the 3rd step must end
     # the epoch with exactly 3 updates applied and consistent state.
     from distributed_machine_learning_tpu.cli.common import init_model_and_state
-    from distributed_machine_learning_tpu.models.vgg import VGG11
+    from distributed_machine_learning_tpu.models.vgg import VGGTest
     from distributed_machine_learning_tpu.train.loop import train_epoch
     from distributed_machine_learning_tpu.train.step import make_train_step
 
-    model = VGG11(use_bn=False)
+    model = VGGTest(use_bn=False)
     state = init_model_and_state(model)
     step = make_train_step(model, augment=False)
 

@@ -31,6 +31,7 @@ def test_resnet18_cifar_shapes_and_params():
     assert "batch_stats" in variables
 
 
+@pytest.mark.slow
 def test_resnet50_imagenet_stem():
     model = ResNet50(cifar_stem=False, num_classes=1000)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)),

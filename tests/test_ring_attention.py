@@ -52,7 +52,12 @@ def test_dense_matches_naive(qkv):
     )
 
 
-@pytest.mark.parametrize("n_shards", [2, 4, 8])
+@pytest.mark.parametrize(
+    "n_shards",
+    [2,
+     pytest.param(4, marks=pytest.mark.slow),
+     pytest.param(8, marks=pytest.mark.slow)],
+)
 def test_ring_matches_dense(qkv, n_shards):
     from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 

@@ -123,10 +123,10 @@ def test_train_step_with_schedule_and_clip():
     """Integration: a scheduled step at lr=0 must not move params; clipping
     must bound the first-step update magnitude at clip_norm * lr."""
     from distributed_machine_learning_tpu.cli.common import init_model_and_state
-    from distributed_machine_learning_tpu.models.vgg import VGG11
+    from distributed_machine_learning_tpu.models.vgg import VGGTest
     from distributed_machine_learning_tpu.train.step import make_train_step
 
-    model = VGG11()
+    model = VGGTest()
     rng = np.random.default_rng(0)
     x = rng.integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
     y = rng.integers(0, 10, 4).astype(np.int32)

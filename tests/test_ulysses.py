@@ -31,7 +31,12 @@ def qkv():
     )
 
 
-@pytest.mark.parametrize("n_shards", [2, 4, 8])
+@pytest.mark.parametrize(
+    "n_shards",
+    [2,
+     pytest.param(4, marks=pytest.mark.slow),
+     pytest.param(8, marks=pytest.mark.slow)],
+)
 def test_ulysses_matches_dense(qkv, n_shards):
     q, k, v = qkv
     mesh = make_mesh(n_shards, axis_names=("seq",))

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from distributed_machine_learning_tpu.cli.common import init_model_and_state
-from distributed_machine_learning_tpu.models.vgg import VGG11
+from distributed_machine_learning_tpu.models.vgg import VGGTest
 from distributed_machine_learning_tpu.parallel.strategies import get_strategy
 from distributed_machine_learning_tpu.parallel.zero1 import (
     make_zero1_train_step,
@@ -29,12 +29,14 @@ def data():
     return x, y
 
 
-@pytest.mark.parametrize("use_bn", [False, True])
+@pytest.mark.parametrize(
+    "use_bn", [False, pytest.param(True, marks=pytest.mark.slow)]
+)
 def test_zero1_matches_replicated_ring(data, use_bn):
     """Two ZeRO-1 steps == two replicated ring (mean) steps: params track
     bitwise-ish, momentum shards reassemble to the replicated buffers."""
     x, y = data
-    model = VGG11(use_bn=use_bn)
+    model = VGGTest(use_bn=use_bn)
     mesh = make_mesh(8)
     mx, my = shard_batch(mesh, x, y)
 
@@ -77,7 +79,7 @@ def test_zero1_matches_replicated_ring(data, use_bn):
 
 def test_zero1_momentum_is_sharded(data):
     x, y = data
-    model = VGG11()
+    model = VGGTest()
     mesh = make_mesh(8)
     z1, unravel, n_elems = shard_zero1_state(init_model_and_state(model), mesh)
     # momentum: one shard per device; params: replicated everywhere
