@@ -198,9 +198,9 @@ class Attention(nn.Module):
                 flash_self_attention,
             )
 
-            out = flash_self_attention(
-                q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep)
-            )
+            # GQA stays narrow: the kernel's K/V index maps divide by the
+            # group factor, so no repeated K/V ever hits HBM.
+            out = flash_self_attention(q, k, v)
         else:
             out = dense_self_attention(
                 q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), positions

@@ -174,8 +174,16 @@ def _flash_fwd_kernel(
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, block_q: int, block_k: int):
-    """q/k/v: [BH, L, D] → (out [BH, L, D], lse [BH, L] fp32)."""
+def _flash_fwd(q, k, v, block_q: int, block_k: int, kv_groups: int = 1):
+    """q: [BHq, L, D], k/v: [BHq // kv_groups, L, D] →
+    (out [BHq, L, D], lse [BHq, L] fp32).
+
+    ``kv_groups > 1`` is grouped-query attention natively: the K/V tile
+    index maps divide the batch·head grid index by the group factor, so
+    the narrow K/V are streamed as-is — no [BHq, L, D] repeat ever hits
+    HBM, cutting K/V read traffic by the group factor.  (Folding puts
+    heads fastest-varying, so bh // kv_groups is exactly the query
+    head's KV group — the jnp.repeat(axis=2) convention.)"""
     BH, L, D = q.shape
     scale = 1.0 / (D**0.5)
     grid = (BH, L // block_q, L // block_k)
@@ -193,7 +201,7 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int):
     k_spec = pl.BlockSpec(
         (1, block_k, D),
         lambda bh, qi, kb: (
-            bh, jnp.minimum(kb, _last_kb(qi, block_q, block_k)), 0
+            bh // kv_groups, jnp.minimum(kb, _last_kb(qi, block_q, block_k)), 0
         ),
         memory_space=pltpu.VMEM,
     )
@@ -303,8 +311,11 @@ def _flash_bwd_dkv_kernel(
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, do, lse, delta):
-    """[BH, L, D] tensors → (dq, dk, dv)."""
+def _flash_bwd(q, k, v, do, lse, delta, kv_groups: int = 1):
+    """q/do/lse/delta: [BHq, ...], k/v: [BHq // kv_groups, L, D] →
+    (dq [BHq, L, D], dk, dv [BHq, L, D] — PER QUERY HEAD; the caller
+    group-sums dk/dv down to the narrow KV heads, one cheap XLA
+    reduction, while the kernels never materialize repeated K/V)."""
     BH, L, D = q.shape
     scale = 1.0 / (D**0.5)
 
@@ -315,7 +326,7 @@ def _flash_bwd(q, k, v, do, lse, delta):
     k_spec_q = pl.BlockSpec(
         (1, block_k, D),
         lambda bh, qi, kb: (
-            bh, jnp.minimum(kb, _last_kb(qi, block_q, block_k)), 0
+            bh // kv_groups, jnp.minimum(kb, _last_kb(qi, block_q, block_k)), 0
         ),
         memory_space=pltpu.VMEM,
     )
@@ -348,6 +359,14 @@ def _flash_bwd(q, k, v, do, lse, delta):
         ),
         memory_space=pltpu.VMEM,
     )
+    # K/V input tiles read the narrow heads; the dk/dv OUTPUTS stay per
+    # query head (out_specs use bh as-is) — accumulating across a group
+    # inside the kernel would serialize the bh grid axis, so the group
+    # sum happens outside in XLA instead.
+    kv_in_spec = pl.BlockSpec(
+        (1, block_k, D), lambda bh, kb, qi: (bh // kv_groups, kb, 0),
+        memory_space=pltpu.VMEM,
+    )
     k_spec_k = pl.BlockSpec(
         (1, block_k, D), lambda bh, kb, qi: (bh, kb, 0), memory_space=pltpu.VMEM
     )
@@ -368,7 +387,7 @@ def _flash_bwd(q, k, v, do, lse, delta):
             jax.ShapeDtypeStruct((BH, L, D), v.dtype),
         ),
         grid=(BH, L // block_k, L // block_q),
-        in_specs=[q_spec_k, k_spec_k, k_spec_k, q_spec_k, row_spec_k,
+        in_specs=[q_spec_k, kv_in_spec, kv_in_spec, q_spec_k, row_spec_k,
                   row_spec_k],
         out_specs=(k_spec_k, k_spec_k),
         scratch_shapes=[
@@ -391,24 +410,42 @@ def _unfold(a, B, H):
     return a.reshape(B, H, L, D).transpose(0, 2, 1, 3)
 
 
+def _kv_groups(q, k, v) -> int:
+    if k.shape != v.shape:
+        raise ValueError(
+            f"k and v must have identical shapes, got {k.shape} vs {v.shape}"
+        )
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % Hkv:
+        raise ValueError(
+            f"query heads {H} must be a multiple of K/V heads {Hkv}"
+        )
+    return H // Hkv
+
+
 @jax.custom_vjp
 def _flash_core(q, k, v):
     B, L, H, D = q.shape
     bq, bk = _fwd_blocks(L)
-    out, _ = _flash_fwd(_fold(q), _fold(k), _fold(v), bq, bk)
+    out, _ = _flash_fwd(
+        _fold(q), _fold(k), _fold(v), bq, bk, kv_groups=_kv_groups(q, k, v)
+    )
     return _unfold(out, B, H)
 
 
 def _flash_core_fwd(q, k, v):
     B, L, H, D = q.shape
     bq, bk = _fwd_blocks(L)
-    out, lse = _flash_fwd(_fold(q), _fold(k), _fold(v), bq, bk)
+    out, lse = _flash_fwd(
+        _fold(q), _fold(k), _fold(v), bq, bk, kv_groups=_kv_groups(q, k, v)
+    )
     return _unfold(out, B, H), (q, k, v, out, lse)
 
 
 def _flash_core_bwd(res, g):
     q, k, v, out, lse = res  # out/lse already folded [BH, ...]
     B, L, H, D = q.shape
+    groups = _kv_groups(q, k, v)
     do = _fold(g)
     # Δ = rowsum(dO ∘ O): O(L·D) elementwise — XLA fuses it; no kernel
     # needed.
@@ -416,19 +453,37 @@ def _flash_core_bwd(res, g):
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # [BH, L]
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
-    dq, dk, dv = _flash_bwd(_fold(q), _fold(k), _fold(v), do, lse, delta)
-    return _unfold(dq, B, H), _unfold(dk, B, H), _unfold(dv, B, H)
+    dq, dk, dv = _flash_bwd(
+        _fold(q), _fold(k), _fold(v), do, lse, delta, kv_groups=groups
+    )
+    dq = _unfold(dq, B, H)
+    dk = _unfold(dk, B, H)  # [B, L, H, D] — per query head
+    dv = _unfold(dv, B, H)
+    if groups > 1:
+        # Group-sum down to the narrow KV heads: query heads of one KV
+        # group are contiguous (h // groups == kv head), so a reshape
+        # exposes the group axis.
+        Hkv = H // groups
+        dk = dk.reshape(B, L, Hkv, groups, D).sum(axis=3)
+        dv = dv.reshape(B, L, Hkv, groups, D).sum(axis=3)
+    return dq, dk, dv
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_self_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """Causal flash attention: [B, L, H, D] in and out.
+    """Causal flash attention: q [B, L, H, D] in, [B, L, H, D] out.
 
     Drop-in for ``ops.ring_attention.dense_self_attention`` on contiguous
     (offset-0) sequences — the unsharded model path.  Both directions run
     as Pallas kernels (O(block) on-chip memory; the backward recomputes
     score blocks from the forward's saved logsumexp).
+
+    Grouped-query attention is native: pass k/v with Hkv < H heads
+    (Hkv | H, the ``jnp.repeat``-convention grouping) and the kernels
+    stream the narrow K/V directly — no repeated K/V is ever
+    materialized in HBM, so K/V read traffic drops by the group factor
+    (see ``models/transformer.py``'s flash branch).
     """
     return _flash_core(q, k, v)

@@ -89,6 +89,32 @@ def main() -> None:
     digest = hashlib.sha256(np.asarray(w).tobytes()).hexdigest()[:16]
     print(f"stopped_at {stopped_at}", flush=True)
     print(f"final {digest}", flush=True)
+
+    # Per-host strided data path (the loaders are otherwise only tested
+    # single-process): rank-major DistributedSampler batches, each host
+    # contributing ITS OWN rank's slice to the global array, summed by a
+    # cross-process psum — must equal the plain host-side global sum.
+    from distributed_machine_learning_tpu.data.cifar10 import Dataset
+    from distributed_machine_learning_tpu.data.distributed_loader import (
+        DistributedBatchLoader,
+    )
+
+    rng2 = np.random.default_rng(11)
+    ds = Dataset(
+        images=rng2.integers(0, 256, (32, 32, 32, 3), dtype=np.uint8),
+        labels=rng2.integers(0, 10, 32).astype(np.int32),
+        synthetic=True,
+    )
+    _, labels = next(iter(DistributedBatchLoader(ds, 4, 2)))
+    rows = labels.reshape(2, 4).astype(np.float32)  # row r = rank r's batch
+    gl = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("batch")), rows[jax.process_index()][None]
+    )
+    total = jax.jit(shard_map_no_check(
+        lambda xs: jax.lax.psum(xs.sum(), "batch"),
+        mesh=mesh, in_specs=(P("batch"),), out_specs=P(),
+    ))(gl)
+    print(f"data_sum {float(total)} {float(rows.sum())}", flush=True)
     ctx.shutdown()
 
 

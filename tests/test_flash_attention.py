@@ -100,6 +100,40 @@ def test_flash_model_matches_dense_model():
     )
 
 
+def test_flash_gqa_matches_repeated_dense(rng):
+    """GQA-native flash (narrow K/V streamed via divided index maps) ==
+    dense attention over explicitly repeated K/V — forward and all three
+    gradients (dk/dv group-summed down to the narrow heads)."""
+    B, L, H, Hkv, D = 2, 32, 8, 2, 8
+    n_rep = H // Hkv
+    q = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.float32)
+
+    def rep(t):
+        return jnp.repeat(t, n_rep, axis=2)
+
+    def dense_ref(q, k, v):
+        return dense_self_attention(q, rep(k), rep(v))
+
+    out, flash_vjp = jax.vjp(flash_self_attention, q, k, v)
+    ref, dense_vjp = jax.vjp(dense_ref, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    for got, want, name in zip(flash_vjp(g), dense_vjp(g), "qkv"):
+        assert got.shape == want.shape, name
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5,
+            err_msg=f"d{name} mismatch",
+        )
+    with pytest.raises(ValueError, match="identical shapes"):
+        flash_self_attention(q, k[:, :, :1], v)  # k/v head mismatch
+    bad_kv = k[:, :, :1][:, :, [0, 0, 0]]  # 3 heads: does not divide 8
+    with pytest.raises(ValueError, match="multiple of K/V heads"):
+        flash_self_attention(q, bad_kv, bad_kv)
+
+
 def test_flash_bf16_finite(qkv):
     q, k, v = (a.astype(jnp.bfloat16) for a in qkv)
     out = np.asarray(flash_self_attention(q, k, v), dtype=np.float32)

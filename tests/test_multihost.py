@@ -83,3 +83,8 @@ def test_two_process_rendezvous_identical_params_and_agree_stop():
     assert s0 == s1 and int(s0) >= 3, (s0, s1)
     # And the replicated params are bit-identical across processes.
     assert field(out0, "final") == field(out1, "final")
+    # Per-host strided loader slices, scattered cross-process and
+    # psum-reduced, equal the host-side global sum on both ranks.
+    for out in (out0, out1):
+        got, want = field(out, "data_sum").split()
+        assert float(got) == float(want), (got, want)
