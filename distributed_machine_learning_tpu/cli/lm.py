@@ -106,12 +106,15 @@ def make_parser():
                         "dp/ring/ulysses modes only")
     p.add_argument("--attn", default="auto",
                    choices=["auto", "dense", "flash"],
-                   help="attention kernel for the non-sequence-sharded "
-                        "modes (dp/fsdp/tp/pp/3d): 'auto' picks the "
+                   help="attention kernel: for dp/fsdp, 'auto' picks the "
                         "Pallas flash kernel from 1k context up (the "
-                        "measured crossover, docs/PERF.md), 'dense' the "
-                        "XLA fused path; ring/ulysses modes own their "
-                        "attention and ignore this")
+                        "measured crossover, docs/PERF.md) and 'dense' "
+                        "the XLA fused path; for --parallel ring, "
+                        "'auto'/'flash' upgrade the per-chunk math to "
+                        "the flash-kernel ring when the per-device chunk "
+                        "is big enough, 'dense' pins the einsum ring; "
+                        "tp/pp/3d resolve 'auto' to dense (their steps "
+                        "own their sharding), ulysses owns its attention")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each transformer block: activation "
                         "memory drops ~n_layers-fold for ~33%% more FLOPs "
@@ -184,9 +187,15 @@ def build(args):
                     "sequence)"
                 )
             mesh = make_mesh(n, ("batch", "seq"), (1, n))
-            model = TransformerLM(
-                **{**common, "attn_impl": args.parallel}
-            )
+            impl = args.parallel
+            if args.parallel == "ring" and args.attn in ("auto", "flash"):
+                from distributed_machine_learning_tpu.models.transformer import (
+                    _ring_flash_wins,
+                )
+
+                if args.attn == "flash" or _ring_flash_wins(args.seq_len // n):
+                    impl = "ring_flash"
+            model = TransformerLM(**{**common, "attn_impl": impl})
         state = init_lm_state(model, seed=SEED, config=opt_config)
         step = make_lm_train_step(model, mesh=mesh,
                                   fused_ce_chunks=args.fused_ce_chunks)

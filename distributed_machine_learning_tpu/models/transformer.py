@@ -97,15 +97,31 @@ def _flash_wins(L: int) -> bool:
     return L >= 1024 and _pick(L, 128) >= 128
 
 
+def _ring_flash_wins(chunk_len: int) -> bool:
+    """ring → ring_flash upgrade policy (one source of truth for the CLI
+    and programmatic callers): the einsum ring materializes an Lc×Lc
+    score tensor per step, so the flash-chunk crossover sits lower than
+    unsharded flash's 1k; below it — or when the chunk's largest
+    power-of-two divisor is under 128 — the einsum ring's fusion wins."""
+    from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
+        _pick,
+    )
+
+    return chunk_len >= 512 and _pick(chunk_len, 128) >= 128
+
+
 class Attention(nn.Module):
     """Multi-head causal self-attention.
 
     ``attn_impl``: "dense" (full XLA attention), "ring" (sequence sharded
-    over ``seq_axis`` — ``ops/ring_attention.py``), "ulysses" (sequence
-    sharded via all-to-all head re-sharding — ``ops/ulysses.py``),
-    "flash" (the Pallas kernel — ``ops/pallas/flash_attention.py``), or
-    "auto" (flash from 1k context up, dense below — the measured
-    crossover, see ``_flash_wins``).
+    over ``seq_axis``, einsum chunk pairs — ``ops/ring_attention.py``),
+    "ring_flash" (sequence sharded, flash-kernel chunk pairs —
+    ``ops/pallas/ring_flash_attention.py``), "ulysses" (sequence sharded
+    via all-to-all head re-sharding — ``ops/ulysses.py``), "flash" (the
+    Pallas kernel — ``ops/pallas/flash_attention.py``), or "auto" (flash
+    from 1k context up, dense below — the measured crossover, see
+    ``_flash_wins``; for the sharded ring the analogous policy is
+    ``_ring_flash_wins``).
 
     ``decode=True`` switches to KV-cached autoregressive inference: K/V
     land in a ``"cache"`` variable collection sized by the init-time
@@ -179,6 +195,15 @@ class Attention(nn.Module):
                 )
         elif self.attn_impl == "ring":
             out = ring_self_attention(
+                q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
+                self.seq_axis, lax.axis_size(self.seq_axis)
+            )
+        elif self.attn_impl == "ring_flash":
+            from distributed_machine_learning_tpu.ops.pallas.ring_flash_attention import (
+                ring_flash_self_attention,
+            )
+
+            out = ring_flash_self_attention(
                 q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
                 self.seq_axis, lax.axis_size(self.seq_axis)
             )
@@ -298,7 +323,7 @@ class TransformerLM(nn.Module):
             if not self.is_initializing():
                 idx.value = start + L
         else:
-            if self.attn_impl in ("ring", "ulysses"):
+            if self.attn_impl in ("ring", "ring_flash", "ulysses"):
                 offset = lax.axis_index(self.seq_axis) * L
             else:
                 offset = 0

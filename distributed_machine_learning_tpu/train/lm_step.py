@@ -124,13 +124,17 @@ def make_lm_train_step(
             f"Ulysses needs n_heads divisible by the sequence-axis size: "
             f"{model.n_heads} heads over {mesh.shape[seq_axis]} devices"
         )
-    if model.attn_impl not in ("ring", "ulysses") and mesh.shape[seq_axis] > 1:
+    if (
+        model.attn_impl not in ("ring", "ring_flash", "ulysses")
+        and mesh.shape[seq_axis] > 1
+    ):
         # Dense attention only sees its local chunk with offset-0 positions:
         # sharding the sequence under it would be silently wrong, not slow.
         raise ValueError(
             f"dense-attention model cannot shard the sequence: mesh axis "
             f"{seq_axis!r} has size {mesh.shape[seq_axis]} > 1; use "
-            'attn_impl="ring"/"ulysses" or an axis_shape with seq size 1'
+            'attn_impl="ring"/"ring_flash"/"ulysses" or an axis_shape '
+            "with seq size 1"
         )
     impl = partial(_lm_step_impl, model, axis_names=axis_names,
                    fused_ce_chunks=fused_ce_chunks)
