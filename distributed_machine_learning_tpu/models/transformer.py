@@ -84,17 +84,13 @@ def _cached_attention(q, k_cache, v_cache, q_positions):
 
 
 def _flash_wins(L: int) -> bool:
-    """attn_impl="auto" policy: the Pallas flash kernels beat XLA dense
-    from 1k context up on the measured chip (docs/PERF.md r02 table:
-    243k vs 171k tok/s @1k) and are the only option past ~8-16k where
-    dense's L² program stops compiling; below 1k — or at lengths whose
-    largest power-of-two divisor is under 128, which would degrade the
-    kernel's blocks — the dense path's fusion wins."""
+    """attn_impl="auto" policy — delegates to the kernel module's shared
+    ``flash_wins`` length rule (docs/PERF.md r02 crossover table)."""
     from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
-        _pick,
+        flash_wins,
     )
 
-    return L >= 1024 and _pick(L, 128) >= 128
+    return flash_wins(L)
 
 
 def _ring_flash_wins(chunk_len: int) -> bool:
@@ -203,9 +199,10 @@ class Attention(nn.Module):
                 ring_flash_self_attention,
             )
 
+            # GQA rotates the NARROW K/V chunks around the ring (ICI and
+            # traveling-gradient traffic shrink by the group factor).
             out = ring_flash_self_attention(
-                q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
-                self.seq_axis, lax.axis_size(self.seq_axis)
+                q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
             )
         elif self.attn_impl == "ulysses":
             from distributed_machine_learning_tpu.ops.ulysses import (

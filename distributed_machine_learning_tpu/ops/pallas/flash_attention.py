@@ -65,6 +65,19 @@ _LANES = 128  # VMEM lane width: m/l scratch is (block_q, _LANES)
 
 
 def _interpret() -> bool:
+    # An explicitly configured default device wins: a process whose
+    # highest-priority backend is a TPU can still route computations to
+    # virtual CPU devices (the multi-chip dryrun does exactly that), and
+    # Mosaic can't compile for CPU — interpret there.  The config also
+    # accepts plain strings ("cpu", "tpu:0"), so parse those too.
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        platform = (
+            dev.platform
+            if hasattr(dev, "platform")
+            else str(dev).split(":")[0]
+        )
+        return platform != "tpu"
     return jax.default_backend() != "tpu"
 
 
@@ -84,6 +97,16 @@ def _pick(L: int, target: int) -> int:
         if c <= target and c <= L and L % c == 0:
             b = c
     return b
+
+
+def flash_wins(L: int) -> bool:
+    """Length policy shared by every "auto" dispatch: the flash kernels
+    beat XLA dense attention from 1k context up on the measured chip
+    (docs/PERF.md r02 table) and are the only option past ~8-16k where
+    dense's L² program stops compiling; below 1k — or at lengths whose
+    largest power-of-two divisor is under 128, which would degrade the
+    blocks — the dense path's fusion wins."""
+    return L >= 1024 and _pick(L, 128) >= 128
 
 
 def _fwd_blocks(L: int) -> tuple[int, int]:
@@ -125,6 +148,82 @@ def _block_scores(q, k, q_start, k_start, block_q, block_k, scale):
     return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
 
+def _full_scores(q, k, scale):
+    """Unmasked scaled scores (ring steps where every key precedes every
+    query)."""
+    return jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+
+
+# --- Shared per-tile math (single source of truth for the subtle kernel
+# --- arithmetic; the flash kernels here and the ring-flash chunk kernels
+# --- in ring_flash_attention.py all call these).
+
+
+def _tile_scores(q, k, q_start, k_start, block_q, block_k, scale,
+                 causal: bool):
+    if causal:
+        return _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
+    return _full_scores(q, k, scale)
+
+
+def _online_update(s, m, l, acc, v, causal: bool):
+    """One online-softmax block update of the (m, l, acc) running triple.
+    ``s`` fp32 scores [bq, bk]; m/l [bq]; acc [bq, D] fp32."""
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    if causal:
+        # Masked entries must contribute 0 even in a fully-masked row
+        # (there s == m_new == NEG_INF and the exp above gives 1, not 0).
+        p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
+    l_new = l * alpha + p.sum(axis=-1)
+    acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_new
+
+
+def _p_from_lse(s, lse, causal: bool):
+    p = jnp.exp(s - lse[:, None])
+    if causal:
+        p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
+    return p
+
+
+def _dq_contrib(s, k, v, do, lse, delta, scale, causal: bool):
+    """dq += ds·K for one tile (backward recompute from the saved lse)."""
+    p = _p_from_lse(s, lse, causal)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta[:, None]) * scale
+    return jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _dkv_contrib(s, q, v, do, lse, delta, scale, causal: bool):
+    """(dv += pᵀ·dO, dk += dsᵀ·Q) for one tile."""
+    p = _p_from_lse(s, lse, causal)
+    dv_c = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta[:, None]) * scale
+    dk_c = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return dk_c, dv_c
+
+
 def _flash_fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, block_q, block_k, scale,
@@ -148,19 +247,12 @@ def _flash_fwd_kernel(
         q = q_ref[0]  # [block_q, D], input dtype
         k = k_ref[0]  # [block_k, D]
         v = v_ref[0]
-        s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
-
-        m = m_ref[:, 0]  # [block_q]
-        l = l_ref[:, 0]
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
-        l_new = l * alpha + p.sum(axis=-1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        s = _tile_scores(q, k, q_start, k_start, block_q, block_k, scale,
+                         causal=True)
+        m_new, l_new, acc_new = _online_update(
+            s, m_ref[:, 0], l_ref[:, 0], acc_ref[:], v, causal=True
         )
+        acc_ref[:] = acc_new
         m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
@@ -244,22 +336,13 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(k_start <= q_start + block_q - 1)
     def _update():
-        q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, 0]  # [block_q] (lane-replicated storage)
-        delta = delta_ref[0][:, 0]
-        s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
-        p = jnp.exp(s - lse[:, None])
-        p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, block_k]
-        ds = p * (dp - delta[:, None]) * scale
-        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        s = _tile_scores(q_ref[0], k, q_start, k_start, block_q, block_k,
+                         scale, causal=True)
+        dq_acc[:] = dq_acc[:] + _dq_contrib(
+            s, k, v, do_ref[0], lse_ref[0][:, 0], delta_ref[0][:, 0],
+            scale, causal=True,
         )
 
     @pl.when(kb == pl.num_programs(2) - 1)
@@ -284,26 +367,15 @@ def _flash_bwd_dkv_kernel(
     @pl.when(q_start + block_q - 1 >= k_start)
     def _update():
         q = q_ref[0]
-        k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, 0]
-        delta = delta_ref[0][:, 0]
-        s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
-        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
-        p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
-        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # pᵀ·dO → [block_k, D]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        s = _tile_scores(q, k_ref[0], q_start, k_start, block_q, block_k,
+                         scale, causal=True)
+        dk_c, dv_c = _dkv_contrib(
+            s, q, v, do_ref[0], lse_ref[0][:, 0], delta_ref[0][:, 0],
+            scale, causal=True,
         )
-        ds = p * (dp - delta[:, None]) * scale
-        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # dsᵀ·Q → [block_k, D]
+        dk_acc[:] = dk_acc[:] + dk_c
+        dv_acc[:] = dv_acc[:] + dv_c
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _finalize():

@@ -11,12 +11,18 @@ step runs one ``pallas_call`` whose score blocks never leave VMEM, and
 per-device attention memory drops from O(Lc²) to O(block) — on top of
 the O(L/n) sharding win the ring already provides.
 
+The per-tile arithmetic (scores, the online-softmax update, the
+backward's ``p``/``ds`` recompute) is imported from
+``flash_attention.py`` — ONE source of truth shared with the
+single-chunk kernels; only the carry scaffolding (load/store of the
+running state across pallas_calls) lives here.
+
 Chunk relationships are resolved OUTSIDE the kernels with ``lax.cond``
 on the (dynamic, per-device) visiting rank, so each branch stays a
 statically-shaped kernel:
 
-- visiting chunk == own chunk → the diagonal: the standard causal
-  kernels (relative positions equal absolute here);
+- visiting chunk == own chunk → the diagonal: causal masking, with the
+  same DMA-eliding clamped index maps as single-chunk flash;
 - visiting chunk strictly earlier → full attention, mask-free variants;
 - visiting chunk strictly later → identity on the carry (no kernel).
 
@@ -24,8 +30,14 @@ Backward is the standard ring-flash second pass: Δ = rowsum(dO∘O) and
 the forward's per-row logsumexp stay resident with Q; K/V rotate again,
 each step adding this device's contribution to the VISITING chunk's
 dK/dV (which travel the ring alongside K/V and arrive home after n
-steps) and accumulating local dQ.  The per-step kernels are the flash
-dQ/dKV kernels (diagonal) and their mask-free variants (full).
+steps) and accumulating local dQ.
+
+Grouped-query attention is native end to end: pass k/v with Hkv < H
+heads and the NARROW chunks rotate on the ring (ICI traffic and the
+traveling dK/dV both shrink by the group factor); the kernels' K/V tile
+index maps divide by the group factor exactly like single-chunk flash,
+and each step's per-query-head dK/dV contribution is group-summed
+before joining the traveling narrow accumulators.
 
 Runs in interpreter mode off-TPU, so the CPU-mesh tests exercise the
 exact code path the TPU compiles.  Reference baseline: the einsum ring
@@ -46,14 +58,18 @@ from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
     _HAS_PLTPU,
     _LANES,
     NEG_INF,
-    _block_scores,
     _compiler_params,
     _dkv_blocks,
+    _dkv_contrib,
+    _dq_contrib,
     _first_qi,
     _fold,
     _fwd_blocks,
     _interpret,
+    _kv_groups,
     _last_kb,
+    _online_update,
+    _tile_scores,
     _unfold,
 )
 
@@ -61,12 +77,12 @@ if _HAS_PLTPU:
     from jax.experimental.pallas import tpu as pltpu
 
 
-def _full_scores(q, k, scale):
-    """Unmasked scaled scores for one tile (off-diagonal ring steps:
-    every key is causally visible to every query)."""
-    return jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+def _require_pltpu():
+    if not _HAS_PLTPU:  # pragma: no cover — pltpu ships with jax cpu/tpu
+        raise RuntimeError(
+            "pallas TPU support (jax.experimental.pallas.tpu) is "
+            "unavailable; use attn_impl='ring'"
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -101,25 +117,13 @@ def _chunk_fwd_kernel(
 
     @pl.when(active)
     def _update():
-        q = q_ref[0]
-        k = k_ref[0]
         v = v_ref[0]
-        if causal:
-            s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
-        else:
-            s = _full_scores(q, k, scale)
-        m = m_s[:, 0]
-        l = l_s[:, 0]
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        if causal:
-            p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
-        l_new = l * alpha + p.sum(axis=-1)
-        acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        s = _tile_scores(q_ref[0], k_ref[0], q_start, k_start, block_q,
+                         block_k, scale, causal=causal)
+        m_new, l_new, acc_new = _online_update(
+            s, m_s[:, 0], l_s[:, 0], acc_s[:], v, causal=causal
         )
+        acc_s[:] = acc_new
         m_s[:] = jnp.broadcast_to(m_new[:, None], m_s.shape)
         l_s[:] = jnp.broadcast_to(l_new[:, None], l_s.shape)
 
@@ -130,9 +134,11 @@ def _chunk_fwd_kernel(
         acc_out[0] = acc_s[:]
 
 
-def _chunk_fwd(q, k, v, carry, *, causal: bool):
-    """One ring step over folded [BH, Lc, D] chunks; carry = (m, l, acc)
-    with m/l [BH, Lc, _LANES] f32 and acc [BH, Lc, D] f32."""
+def _chunk_fwd(q, k, v, carry, *, causal: bool, kv_groups: int = 1):
+    """One ring step over folded chunks (q [BHq, Lc, D], k/v
+    [BHq // kv_groups, Lc, D]); carry = (m, l, acc) with m/l
+    [BHq, Lc, _LANES] f32 and acc [BHq, Lc, D] f32."""
+    _require_pltpu()
     m, l, acc = carry
     BH, Lc, D = q.shape
     scale = 1.0 / (D**0.5)
@@ -147,13 +153,15 @@ def _chunk_fwd(q, k, v, carry, *, causal: bool):
         k_spec = pl.BlockSpec(
             (1, block_k, D),
             lambda bh, qi, kb: (
-                bh, jnp.minimum(kb, _last_kb(qi, block_q, block_k)), 0
+                bh // kv_groups,
+                jnp.minimum(kb, _last_kb(qi, block_q, block_k)), 0,
             ),
             memory_space=pltpu.VMEM,
         )
     else:
         k_spec = pl.BlockSpec(
-            (1, block_k, D), lambda bh, qi, kb: (bh, kb, 0),
+            (1, block_k, D),
+            lambda bh, qi, kb: (bh // kv_groups, kb, 0),
             memory_space=pltpu.VMEM,
         )
     row_spec = pl.BlockSpec(
@@ -210,26 +218,12 @@ def _chunk_dq_kernel(
 
     @pl.when(active)
     def _update():
-        q = q_ref[0]
         k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, 0]
-        delta = delta_ref[0][:, 0]
-        if causal:
-            s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
-        else:
-            s = _full_scores(q, k, scale)
-        p = jnp.exp(s - lse[:, None])
-        if causal:
-            p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None]) * scale
-        dq_s[:] = dq_s[:] + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        s = _tile_scores(q_ref[0], k, q_start, k_start, block_q, block_k,
+                         scale, causal=causal)
+        dq_s[:] = dq_s[:] + _dq_contrib(
+            s, k, v_ref[0], do_ref[0], lse_ref[0][:, 0],
+            delta_ref[0][:, 0], scale, causal=causal,
         )
 
     @pl.when(kb == pl.num_programs(2) - 1)
@@ -256,30 +250,14 @@ def _chunk_dkv_kernel(
     @pl.when(active)
     def _update():
         q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, 0]
-        delta = delta_ref[0][:, 0]
-        if causal:
-            s = _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
-        else:
-            s = _full_scores(q, k, scale)
-        p = jnp.exp(s - lse[:, None])
-        if causal:
-            p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
-        dv_s[:] = dv_s[:] + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        s = _tile_scores(q, k_ref[0], q_start, k_start, block_q, block_k,
+                         scale, causal=causal)
+        dk_c, dv_c = _dkv_contrib(
+            s, q, v_ref[0], do_ref[0], lse_ref[0][:, 0],
+            delta_ref[0][:, 0], scale, causal=causal,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None]) * scale
-        dk_s[:] = dk_s[:] + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        dk_s[:] = dk_s[:] + dk_c
+        dv_s[:] = dv_s[:] + dv_c
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _store():
@@ -287,7 +265,9 @@ def _chunk_dkv_kernel(
         dv_out[0] = dv_s[:]
 
 
-def _chunk_dq(q, k, v, do, lse, delta, dq, *, causal: bool):
+def _chunk_dq(q, k, v, do, lse, delta, dq, *, causal: bool,
+              kv_groups: int = 1):
+    _require_pltpu()
     BH, Lc, D = q.shape
     scale = 1.0 / (D**0.5)
     block_q, block_k = _fwd_blocks(Lc)
@@ -298,13 +278,15 @@ def _chunk_dq(q, k, v, do, lse, delta, dq, *, causal: bool):
         k_spec = pl.BlockSpec(
             (1, block_k, D),
             lambda bh, qi, kb: (
-                bh, jnp.minimum(kb, _last_kb(qi, block_q, block_k)), 0
+                bh // kv_groups,
+                jnp.minimum(kb, _last_kb(qi, block_q, block_k)), 0,
             ),
             memory_space=pltpu.VMEM,
         )
     else:
         k_spec = pl.BlockSpec(
-            (1, block_k, D), lambda bh, qi, kb: (bh, kb, 0),
+            (1, block_k, D),
+            lambda bh, qi, kb: (bh // kv_groups, kb, 0),
             memory_space=pltpu.VMEM,
         )
     row_spec = pl.BlockSpec(
@@ -328,7 +310,16 @@ def _chunk_dq(q, k, v, do, lse, delta, dq, *, causal: bool):
     )(q, k, v, do, lse, delta, dq)
 
 
-def _chunk_dkv(q, k, v, do, lse, delta, dk, dv, *, causal: bool):
+def _chunk_dkv(q, k, v, do, lse, delta, dk, dv, *, causal: bool,
+               kv_groups: int = 1):
+    """dK/dV contributions of this device's Q block to one K/V chunk.
+
+    With ``kv_groups == 1`` the in/out dk/dv are the full-width chunk
+    accumulators (in-place).  With groups > 1, dk/dv must be PER QUERY
+    HEAD zero buffers [BHq, Lc, D]; the caller group-sums them down to
+    the narrow heads before merging into the traveling accumulators.
+    """
+    _require_pltpu()
     BH, Lc, D = q.shape
     scale = 1.0 / (D**0.5)
     block_q, block_k = _dkv_blocks(Lc)
@@ -341,8 +332,13 @@ def _chunk_dkv(q, k, v, do, lse, delta, dk, dv, *, causal: bool):
     q_spec = pl.BlockSpec(
         (1, block_q, D), _qi_map, memory_space=pltpu.VMEM
     )
-    k_spec = pl.BlockSpec(
-        (1, block_k, D), lambda bh, kb, qi: (bh, kb, 0), memory_space=pltpu.VMEM
+    kv_in_spec = pl.BlockSpec(
+        (1, block_k, D), lambda bh, kb, qi: (bh // kv_groups, kb, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out_spec = pl.BlockSpec(
+        (1, block_k, D), lambda bh, kb, qi: (bh, kb, 0),
+        memory_space=pltpu.VMEM,
     )
     row_spec = pl.BlockSpec(
         (1, block_q, _LANES), _qi_map, memory_space=pltpu.VMEM
@@ -357,9 +353,9 @@ def _chunk_dkv(q, k, v, do, lse, delta, dk, dv, *, causal: bool):
             jax.ShapeDtypeStruct((BH, Lc, D), jnp.float32),
         ),
         grid=(BH, Lc // block_k, Lc // block_q),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
-                  k_spec, k_spec],
-        out_specs=(k_spec, k_spec),
+        in_specs=[q_spec, kv_in_spec, kv_in_spec, q_spec, row_spec,
+                  row_spec, out_spec, out_spec],
+        out_specs=(out_spec, out_spec),
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -380,9 +376,10 @@ def ring_flash_self_attention(q, k, v, axis_name: str, axis_size: int):
     """Exact causal attention over sequence chunks sharded on
     ``axis_name`` — the flash-kernel ring (see module docstring).
 
-    Must run inside ``shard_map``; q/k/v are the local [B, Lc, H, D]
-    chunks, global order following the mesh axis.  Per-device attention
-    memory is O(block); HBM state between ring steps is O(Lc).
+    Must run inside ``shard_map``; q [B, Lc, H, D] and k/v [B, Lc, Hkv,
+    D] (Hkv | H — GQA rotates the narrow chunks) are the local chunks,
+    global order following the mesh axis.  Per-device attention memory
+    is O(block); HBM state between ring steps is O(Lc).
     """
     out, _ = _ring_fwd_impl(q, k, v, axis_name, axis_size)
     return out
@@ -391,6 +388,7 @@ def ring_flash_self_attention(q, k, v, axis_name: str, axis_size: int):
 def _ring_fwd_impl(q, k, v, axis_name, axis_size):
     n = axis_size
     B, Lc, H, D = q.shape
+    groups = _kv_groups(q, k, v)
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     BH = qf.shape[0]
     rank = lax.axis_index(axis_name)
@@ -406,10 +404,14 @@ def _ring_fwd_impl(q, k, v, axis_name, axis_size):
         kc, vc = kv
         carry = lax.cond(
             kv_rank == rank,
-            lambda c, kc=kc, vc=vc: _chunk_fwd(qf, kc, vc, c, causal=True),
+            lambda c, kc=kc, vc=vc: _chunk_fwd(
+                qf, kc, vc, c, causal=True, kv_groups=groups
+            ),
             lambda c, kc=kc, vc=vc: lax.cond(
                 kv_rank < rank,
-                lambda c2: _chunk_fwd(qf, kc, vc, c2, causal=False),
+                lambda c2: _chunk_fwd(
+                    qf, kc, vc, c2, causal=False, kv_groups=groups
+                ),
                 lambda c2: c2,
                 c,
             ),
@@ -430,10 +432,21 @@ def _ring_fwd_vjp(q, k, v, axis_name, axis_size):
     return out, res
 
 
+def _group_sum(t, B, H, groups):
+    """[B·H, Lc, D] per-query-head grads → [B·Hkv, Lc, D] narrow grads
+    (query heads of one KV group are contiguous after folding)."""
+    BH, Lc, D = t.shape
+    Hkv = H // groups
+    return (
+        t.reshape(B, Hkv, groups, Lc, D).sum(axis=2).reshape(B * Hkv, Lc, D)
+    )
+
+
 def _ring_bwd_vjp(axis_name, axis_size, res, g):
     q, k, v, out_f, lse = res  # out_f/lse already folded [BH, Lc, ...]
     n = axis_size
     B, Lc, H, D = q.shape
+    groups = _kv_groups(q, k, v)
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     do = _fold(g)
     rank = lax.axis_index(axis_name)
@@ -443,26 +456,39 @@ def _ring_bwd_vjp(axis_name, axis_size, res, g):
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
 
     dq = jnp.zeros(qf.shape, jnp.float32)
-    # dK/dV travel WITH their K/V chunk: after n ring steps (rotating at
-    # every step including the last) the accumulated grads land back on
-    # the chunk's home device.
+    # dK/dV travel WITH their (narrow, under GQA) K/V chunk: after n ring
+    # steps (rotating at every step including the last) the accumulated
+    # grads land back on the chunk's home device.
     payload = (kf, vf, jnp.zeros(kf.shape, jnp.float32),
                jnp.zeros(vf.shape, jnp.float32))
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step_dkv(kc, vc, dkc, dvc, causal):
+        if groups == 1:
+            return _chunk_dkv(qf, kc, vc, do, lse, delta, dkc, dvc,
+                              causal=causal)
+        # GQA: per-query-head contributions into zero buffers, then one
+        # cheap group-sum before joining the narrow traveling grads.
+        z = jnp.zeros(qf.shape, jnp.float32)
+        dk_q, dv_q = _chunk_dkv(qf, kc, vc, do, lse, delta, z, z,
+                                causal=causal, kv_groups=groups)
+        return (dkc + _group_sum(dk_q, B, H, groups),
+                dvc + _group_sum(dv_q, B, H, groups))
+
     for s in range(n):
         kv_rank = (rank - s) % n
         kc, vc, dkc, dvc = payload
 
         def diag(dq, dkc, dvc, kc=kc, vc=vc):
-            dq2 = _chunk_dq(qf, kc, vc, do, lse, delta, dq, causal=True)
-            dk2, dv2 = _chunk_dkv(qf, kc, vc, do, lse, delta, dkc, dvc,
-                                  causal=True)
+            dq2 = _chunk_dq(qf, kc, vc, do, lse, delta, dq, causal=True,
+                            kv_groups=groups)
+            dk2, dv2 = step_dkv(kc, vc, dkc, dvc, causal=True)
             return dq2, dk2, dv2
 
         def full(dq, dkc, dvc, kc=kc, vc=vc):
-            dq2 = _chunk_dq(qf, kc, vc, do, lse, delta, dq, causal=False)
-            dk2, dv2 = _chunk_dkv(qf, kc, vc, do, lse, delta, dkc, dvc,
-                                  causal=False)
+            dq2 = _chunk_dq(qf, kc, vc, do, lse, delta, dq, causal=False,
+                            kv_groups=groups)
+            dk2, dv2 = step_dkv(kc, vc, dkc, dvc, causal=False)
             return dq2, dk2, dv2
 
         dq, dkc, dvc = lax.cond(
@@ -479,10 +505,11 @@ def _ring_bwd_vjp(axis_name, axis_size, res, g):
         payload = lax.ppermute((kc, vc, dkc, dvc), axis_name, perm)
 
     _, _, dk, dv = payload
+    Hkv = H // groups
     return (
         _unfold(dq, B, H).astype(q.dtype),
-        _unfold(dk, B, H).astype(k.dtype),
-        _unfold(dv, B, H).astype(v.dtype),
+        _unfold(dk, B, Hkv).astype(k.dtype),
+        _unfold(dv, B, Hkv).astype(v.dtype),
     )
 
 

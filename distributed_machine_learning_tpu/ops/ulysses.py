@@ -32,11 +32,18 @@ def ulysses_self_attention(
     v: jax.Array,
     axis_name: str,
     axis_size: int,
+    local_attn: str = "auto",
 ) -> jax.Array:
     """Exact causal attention over sequence chunks sharded on ``axis_name``.
 
     Must run inside ``shard_map``.  ``q``/``k``/``v``: local chunks
     [B, L/n, H, D] in mesh-axis order; returns the local output chunk.
+
+    ``local_attn``: the kernel for the per-device full-sequence attention
+    after the head re-shard — "dense" (XLA), "flash" (the Pallas kernel,
+    the big win here: Ulysses holds full-L scores per head slice, exactly
+    the regime flash exists for), or "auto" (flash from the measured 1k
+    crossover up — ``flash_wins``).
     """
     n = axis_size
     if n == 1:
@@ -47,6 +54,15 @@ def ulysses_self_attention(
             f"Ulysses needs n_heads divisible by the sequence-axis size: "
             f"{H} heads over {n} devices (use the ring instead)"
         )
+    L = q.shape[1] * n
+    from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
+        flash_self_attention,
+        flash_wins,
+    )
+
+    use_flash = local_attn == "flash" or (
+        local_attn == "auto" and flash_wins(L)
+    )
     # seq-sharded → head-sharded: each device keeps heads [r·H/n,(r+1)·H/n)
     # for the FULL sequence (all_to_all concatenates chunks in axis order,
     # so global sequence order is preserved).  Q/K/V ride ONE stacked
@@ -55,6 +71,7 @@ def ulysses_self_attention(
     qkv = lax.all_to_all(
         qkv, axis_name, split_axis=3, concat_axis=1, tiled=True
     )  # [B, L, 3, H/n, D]
-    out = dense_self_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    local = flash_self_attention if use_flash else dense_self_attention
+    out = local(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
     # head-sharded → seq-sharded.
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)

@@ -54,6 +54,51 @@ def test_ulysses_matches_dense(qkv, n_shards):
     )
 
 
+def test_ulysses_flash_local_attention_matches_dense(qkv):
+    """local_attn='flash': the per-device full-sequence attention runs
+    the Pallas kernel — forward and all three gradients must still match
+    single-device dense."""
+    from distributed_machine_learning_tpu.runtime.mesh import (
+        shard_map_no_check,
+    )
+
+    q, k, v = qkv
+    n_shards = 2
+    mesh = make_mesh(n_shards, axis_names=("seq",))
+    # shard_map_no_check: pallas_call outputs carry no varying-mesh-axis
+    # annotation, so the replication checker must be off (same reason the
+    # LM train step uses it).
+    uly = jax.jit(shard_map_no_check(
+        lambda a, b, c: ulysses_self_attention(
+            a, b, c, "seq", n_shards, local_attn="flash"
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"),
+    ))
+    np.testing.assert_allclose(
+        np.asarray(uly(q, k, v)),
+        np.asarray(dense_self_attention(q, k, v)),
+        rtol=2e-5,
+        atol=2e-6,
+    )
+    cot = jnp.asarray(
+        np.random.default_rng(2).standard_normal((B, L, H, D),
+                                                 dtype=np.float32)
+    )
+    g_u = jax.grad(lambda *a: jnp.sum(uly(*a) * cot), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    g_d = jax.grad(
+        lambda *a: jnp.sum(dense_self_attention(*a) * cot), argnums=(0, 1, 2)
+    )(q, k, v)
+    for got, want, name in zip(g_u, g_d, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5,
+            err_msg=f"d{name} mismatch",
+        )
+
+
 def test_ulysses_rejects_indivisible_heads(qkv):
     """H=8 over 8 devices is the limit; a 3-head tensor must be refused."""
     q, k, v = (a[:, :, :3] for a in qkv)
