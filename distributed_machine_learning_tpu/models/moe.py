@@ -90,6 +90,11 @@ class MoEMLP(nn.Module):
         return y.reshape(B, T, D)
 
 
+# Attention impls that need no sequence mesh axis — the set both the
+# model's guard and make_ep_train_step's guard accept.
+SEQ_LOCAL_ATTN_IMPLS = ("dense", "flash", "auto")
+
+
 def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
     """A transformer Block whose MLP is the routed expert mixture — the
     shared ``models.transformer.Block`` wiring, not a copy."""
@@ -98,7 +103,7 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
     return Block(
         n_heads=model.n_heads,
         d_ff=model.d_ff or 4 * model.d_model,
-        attn_impl="dense",
+        attn_impl=model.attn_impl,
         seq_axis="seq",
         compute_dtype=model.compute_dtype,
         mlp_factory=lambda: MoEMLP(
@@ -124,15 +129,19 @@ class MoETransformerLM(nn.Module):
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     compute_dtype: Any = jnp.float32
-    attn_impl: str = "dense"  # shared train-step interface
+    # dense / flash / auto (sequence-local kernels); the sequence-SHARDED
+    # impls (ring/ring_flash/ulysses) stay unsupported — the EP mesh has
+    # no seq axis to shard over.
+    attn_impl: str = "dense"
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
         del train
-        if self.attn_impl != "dense":
+        if self.attn_impl not in SEQ_LOCAL_ATTN_IMPLS:
             raise NotImplementedError(
-                "MoETransformerLM only supports attn_impl='dense' (blocks "
-                "run dense attention); ring attention + MoE is not wired up"
+                "MoETransformerLM supports the sequence-local attention "
+                "kernels only (dense/flash/auto); ring/ulysses + MoE is "
+                "not wired up"
             )
         B, L = tokens.shape
         positions = jnp.arange(L)

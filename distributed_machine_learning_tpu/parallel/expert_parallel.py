@@ -91,10 +91,15 @@ def make_ep_train_step(
     (state, ce_loss)``.  Without a mesh: plain jit (the single-device
     reference).  With a mesh: state placed via ``shard_ep_state``,
     tokens/targets sharded over ``data_axis`` (``shard_tp_batch`` works)."""
-    if model.attn_impl != "dense":
+    from distributed_machine_learning_tpu.models.moe import (
+        SEQ_LOCAL_ATTN_IMPLS,
+    )
+
+    if model.attn_impl not in SEQ_LOCAL_ATTN_IMPLS:
         raise ValueError(
-            "expert-parallel step requires attn_impl='dense' "
-            "(MoEBlock runs dense attention; the sequence is not sharded here)"
+            "expert-parallel step requires a sequence-LOCAL attention "
+            "(dense/flash/auto): the sequence is not sharded here, so the "
+            "ring/ulysses impls have no axis to run over"
         )
     impl = partial(_moe_step_impl, model)
     if mesh is None:

@@ -106,3 +106,51 @@ def test_ep_spec_rules():
     assert ep_spec_for(("block_0", "moe", "b_out"), 2)[0] == "expert"
     assert ep_spec_for(("block_0", "moe", "router", "kernel"), 2) == (None, None)
     assert ep_spec_for(("block_0", "attn", "qkv", "kernel"), 4)[0] is None
+
+
+def test_moe_flash_attention_matches_dense(batch):
+    """attn_impl='flash' in the MoE blocks (sequence-local kernel, so it
+    composes with expert parallelism) == the dense MoE forward."""
+    x, _ = batch
+    dense = tiny_moe()
+    flash = tiny_moe(attn_impl="flash")
+    params = dense.init(jax.random.PRNGKey(0), jnp.asarray(x))["params"]
+    ref = dense.apply({"params": params}, jnp.asarray(x))
+    out = flash.apply({"params": params}, jnp.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+    with pytest.raises(NotImplementedError, match="sequence-local"):
+        tiny_moe(attn_impl="ring").apply({"params": params}, jnp.asarray(x))
+
+
+def test_ep_step_flash_matches_dense(batch, mesh8):
+    """flash attention composes with the jit-sharded EP step: one step on
+    the (batch × expert) mesh matches the dense-attention EP step."""
+    from distributed_machine_learning_tpu.parallel.expert_parallel import (
+        init_moe_state,
+        make_ep_train_step,
+        shard_ep_state,
+    )
+    from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+        shard_tp_batch,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh(8, ("batch", "expert"), (2, 4))
+    x, y = batch
+
+    def run(attn):
+        model = tiny_moe(attn_impl=attn)
+        state = shard_ep_state(init_moe_state(model), mesh)
+        sx, sy = shard_tp_batch(mesh, x, y)
+        state, loss = make_ep_train_step(model, mesh)(state, sx, sy)
+        return float(loss), state
+
+    loss_f, state_f = run("flash")
+    loss_d, state_d = run("dense")
+    np.testing.assert_allclose(loss_f, loss_d, rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state_f.params),
+                    jax.tree_util.tree_leaves(state_d.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
