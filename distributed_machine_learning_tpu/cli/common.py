@@ -70,6 +70,11 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                         help="override the part's per-worker batch size")
     parser.add_argument("--eval-batches", default=None, type=int,
                         help="cap eval batches (default: full test set)")
+    parser.add_argument("--eval-batch-size", dest="eval_batch_size",
+                        default=EVAL_BATCH, type=int,
+                        help="eval batch size (default 256; the compile "
+                             "cost of the eval program scales with it on "
+                             "CPU hosts, so short smoke runs want it small)")
     parser.add_argument("--ckpt-dir", default=None, type=str,
                         help="checkpoint directory; saves TrainState after "
                              "each epoch (off by default — reference parity)")
@@ -503,7 +508,9 @@ def run_part(
             # checkpoint, and loop exit must diverge on NO host.
             stopping = agree_stop(preemption.requested)
             if not stopping:
-                eval_batches = BatchLoader(test_set, EVAL_BATCH)
+                eval_batches = BatchLoader(
+                    test_set, getattr(args, "eval_batch_size", EVAL_BATCH)
+                )
                 if args.eval_batches is not None:
                     import itertools
 

@@ -17,7 +17,10 @@ from functools import partial
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_machine_learning_tpu.models.moe import MoETransformerLM
+from distributed_machine_learning_tpu.models.moe import (
+    SEQ_LOCAL_ATTN_IMPLS,
+    MoETransformerLM,
+)
 from distributed_machine_learning_tpu.parallel.gspmd import (
     make_cached_sharded_step,
     shard_state,
@@ -91,10 +94,6 @@ def make_ep_train_step(
     (state, ce_loss)``.  Without a mesh: plain jit (the single-device
     reference).  With a mesh: state placed via ``shard_ep_state``,
     tokens/targets sharded over ``data_axis`` (``shard_tp_batch`` works)."""
-    from distributed_machine_learning_tpu.models.moe import (
-        SEQ_LOCAL_ATTN_IMPLS,
-    )
-
     if model.attn_impl not in SEQ_LOCAL_ATTN_IMPLS:
         raise ValueError(
             "expert-parallel step requires a sequence-LOCAL attention "

@@ -30,7 +30,6 @@ def test_sharded_eval_matches_single_device(use_bn):
     assert int(correct_m) == int(correct_s)
 
 
-@pytest.mark.slow
 def test_cli_dist_eval_flag_runs(capsys):
     """part2b with --dist-eval prints the same eval surface."""
     from distributed_machine_learning_tpu.cli.common import (
@@ -43,7 +42,7 @@ def test_cli_dist_eval_flag_runs(capsys):
     args = parse_flags(
         parser,
         ["--batch-size", "4", "--max-iters", "2", "--eval-batches", "2",
-         "--dist-eval"],
+         "--model", "vggtest", "--eval-batch-size", "16", "--dist-eval"],
     )
     run_part("all_reduce", 4, use_bn=False, args=args)
     out = capsys.readouterr().out

@@ -119,7 +119,6 @@ def test_ring_wire_compression_is_rank_identical():
         np.testing.assert_array_equal(per_rank[0], per_rank[r])
 
 
-@pytest.mark.slow
 def test_lars_checkpoint_roundtrip(tmp_path):
     """LARSConfig survives save/restore (the config class is recorded), and
     a cross-optimizer resume through the CLI path resets momentum instead
@@ -150,6 +149,7 @@ def test_lars_checkpoint_roundtrip(tmp_path):
     args = parse_flags(
         parser,
         ["--batch-size", "4", "--max-iters", "2", "--eval-batches", "1",
+         "--model", "vggtest", "--eval-batch-size", "16",
          "--optimizer", "lars", "--resume", "--ckpt-dir",
          str(tmp_path / "sgd_ckpt")],
     )
@@ -168,6 +168,7 @@ def test_distributed_resume_places_state_on_mesh(tmp_path, capsys):
     )
 
     base = ["--batch-size", "4", "--max-iters", "2", "--eval-batches", "1",
+            "--model", "vggtest", "--eval-batch-size", "16",
             "--ckpt-dir", str(tmp_path)]
     parser = make_flag_parser("t")
     run_part("all_reduce", 4, use_bn=False, args=parse_flags(parser, base))

@@ -124,7 +124,7 @@ def test_moe_flash_attention_matches_dense(batch):
         tiny_moe(attn_impl="ring").apply({"params": params}, jnp.asarray(x))
 
 
-def test_ep_step_flash_matches_dense(batch, mesh8):
+def test_ep_step_flash_matches_dense(batch):
     """flash attention composes with the jit-sharded EP step: one step on
     the (batch × expert) mesh matches the dense-attention EP step."""
     from distributed_machine_learning_tpu.parallel.expert_parallel import (
