@@ -32,10 +32,11 @@ a single MXU pass, where an f32×f32 matmul costs several (XLA's own
 attention runs bf16 too, so anything else loses to dense by
 construction).  The online-softmax state (m, l, acc) stays f32.
 
-Blocks are rectangular and picked per (L, D): the stationary operand's
-block (Q for forward/dQ, K for dK/dV) is made large — arithmetic
-intensity of the streaming phase is proportional to the stationary
-block's rows — while the streamed block stays at MXU width.
+Blocks are picked per L from an on-chip sweep: 512×512 squares for
+both kernels (see ``_fwd_blocks``) — large stationary blocks buy
+arithmetic intensity, and the sweep showed the streamed block also
+wants to be large (fewer grid steps, bigger MXU tiles) rather than
+held at MXU width; smaller powers of two engage only when L demands.
 
 Total backward traffic is O(L·D) per tensor plus the recomputed block
 matmuls — the memory profile that lets long-context training fit, where
@@ -102,22 +103,26 @@ def _pick(L: int, target: int) -> int:
 def flash_wins(L: int) -> bool:
     """Length policy shared by every "auto" dispatch: the flash kernels
     beat XLA dense attention from 1k context up on the measured chip
-    (docs/PERF.md r02 table) and are the only option past ~8-16k where
-    dense's L² program stops compiling; below 1k — or at lengths whose
-    largest power-of-two divisor is under 128, which would degrade the
-    blocks — the dense path's fusion wins."""
+    (docs/PERF.md r02 table: 1.6× @1k, ~3× @4-8k) and are the only
+    option past ~8-16k where dense's L² program stops compiling; below
+    1k — or at lengths whose largest power-of-two divisor is under 128,
+    which would degrade the blocks — the dense path's fusion wins."""
     return L >= 1024 and _pick(L, 128) >= 128
 
 
 def _fwd_blocks(L: int) -> tuple[int, int]:
-    # Q is stationary across the streamed K steps: big block_q buys
-    # arithmetic intensity (FLOPs/byte of streamed K/V ∝ block_q).
-    return _pick(L, 512), _pick(L, 256)
+    # Measured sweep on the attached chip (d_model 512, D=64, seq 4k):
+    # square 512×512 beats every rectangular candidate — 283k tok/s vs
+    # 237k for (512,256), 185k for (512,128) — the bigger streamed block
+    # amortizes per-grid-step overhead and the MXU prefers the larger
+    # contraction tiles; VMEM stays ~1 MB/core at D=64.
+    return _pick(L, 512), _pick(L, 512)
 
 
 def _dkv_blocks(L: int) -> tuple[int, int]:
-    # K/V stationary, Q/dO streamed: mirror image.
-    return _pick(L, 256), _pick(L, 512)
+    # Same sweep for the dK/dV kernel: (512,512) gives 301k tok/s vs
+    # 284k for the old (256,512) and 235k for (256,256).
+    return _pick(L, 512), _pick(L, 512)
 
 
 def _last_kb(qi, block_q: int, block_k: int):

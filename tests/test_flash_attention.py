@@ -37,11 +37,12 @@ def test_flash_matches_dense_forward(qkv):
     )
 
 
-def test_block_picker_rectangular():
-    # Powers of two dividing L, capped at the stationary/streamed targets.
+def test_block_picker():
+    # Powers of two dividing L, capped at the measured-optimal 512 square
+    # (see the sweep notes in _fwd_blocks/_dkv_blocks).
     assert _pick(48, 512) == 16
-    assert _fwd_blocks(4096) == (512, 256)
-    assert _dkv_blocks(4096) == (256, 512)
+    assert _fwd_blocks(4096) == (512, 512)
+    assert _dkv_blocks(4096) == (512, 512)
     assert _fwd_blocks(64) == (64, 64)
     assert _pick(17, 512) == 1  # prime-ish lengths degrade, don't crash
 
