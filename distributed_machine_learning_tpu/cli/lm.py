@@ -107,7 +107,7 @@ def make_parser():
     p.add_argument("--attn", default="auto",
                    choices=["auto", "dense", "flash"],
                    help="attention kernel: for dp/fsdp, 'auto' picks the "
-                        "Pallas flash kernel from 1k context up (the "
+                        "Pallas flash kernel from 512 context up (the "
                         "measured crossover, docs/PERF.md) and 'dense' "
                         "the XLA fused path; for --parallel ring, "
                         "'auto'/'flash' upgrade the per-chunk math to "

@@ -95,15 +95,14 @@ def _flash_wins(L: int) -> bool:
 
 def _ring_flash_wins(chunk_len: int) -> bool:
     """ring → ring_flash upgrade policy (one source of truth for the CLI
-    and programmatic callers): the einsum ring materializes an Lc×Lc
-    score tensor per step, so the flash-chunk crossover sits lower than
-    unsharded flash's 1k; below it — or when the chunk's largest
-    power-of-two divisor is under 128 — the einsum ring's fusion wins."""
+    and programmatic callers): the per-chunk math is exactly the
+    unsharded-flash regime applied to the LOCAL chunk, so the same
+    measured length policy decides — delegate to ``flash_wins``."""
     from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
-        _pick,
+        flash_wins,
     )
 
-    return chunk_len >= 512 and _pick(chunk_len, 128) >= 128
+    return flash_wins(chunk_len)
 
 
 class Attention(nn.Module):

@@ -101,13 +101,18 @@ def _pick(L: int, target: int) -> int:
 
 
 def flash_wins(L: int) -> bool:
-    """Length policy shared by every "auto" dispatch: the flash kernels
-    beat XLA dense attention from 1k context up on the measured chip
-    (docs/PERF.md r02 table: 1.6× @1k, ~3× @4-8k) and are the only
-    option past ~8-16k where dense's L² program stops compiling; below
-    1k — or at lengths whose largest power-of-two divisor is under 128,
-    which would degrade the blocks — the dense path's fusion wins."""
-    return L >= 1024 and _pick(L, 128) >= 128
+    """Length policy shared by every "auto" dispatch: after the 512×512
+    block retune the flash kernels beat XLA dense attention from 512
+    context up on the measured chip (512k vs 421k tok/s @512; 1.6× @1k;
+    ~3× @4-8k — docs/PERF.md) and are the only option past ~8-16k where
+    dense's L² program stops compiling.  Dense still wins at 256 (584k
+    vs 479k), at sub-1k lengths NOT divisible by 512 (640/768/896
+    degrade the blocks to 128-256 wide, and the @512 margin was only
+    1.2× with FULL blocks), and at lengths whose largest power-of-two
+    divisor is under 128."""
+    if L >= 1024:
+        return _pick(L, 128) >= 128
+    return L >= 512 and _pick(L, 512) == 512
 
 
 def _fwd_blocks(L: int) -> tuple[int, int]:

@@ -50,8 +50,11 @@ def test_block_picker():
 def test_auto_attn_policy():
     from distributed_machine_learning_tpu.models.transformer import _flash_wins
 
-    assert not _flash_wins(512)  # below the measured crossover
-    assert _flash_wins(1024) and _flash_wins(4096) and _flash_wins(16384)
+    assert not _flash_wins(256)  # below the measured crossover
+    assert _flash_wins(512) and _flash_wins(4096) and _flash_wins(16384)
+    # Sub-1k lengths not divisible by 512 degrade the blocks past the
+    # thin @512 margin — dense keeps them.
+    assert not _flash_wins(640) and not _flash_wins(768)
     assert not _flash_wins(1040)  # 16·65: blocks would degrade below 128
 
 
