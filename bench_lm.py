@@ -45,6 +45,7 @@ def bench_one(attn: str, args) -> tuple[float, int]:
         n_kv_heads=args.n_kv_heads,
         attn_impl=attn,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        remat=args.remat,
     )
     state = init_lm_state(model)
     rng = np.random.default_rng(0)
@@ -99,6 +100,12 @@ def bench_one(attn: str, args) -> tuple[float, int]:
     n_params = sum(
         int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(state.params)
     )
+    # The input embedding is a gather, not a matmul — drop it from the
+    # 6P matmul-FLOPs term (at 32k vocab × d2048 it would otherwise
+    # inflate MFU ~12%).  The lm_head IS a matmul and stays counted.
+    # Derived from the model dims rather than a params-tree path so a
+    # renamed/tied embedding degrades to the old accounting, not a crash.
+    n_params -= args.vocab * args.d_model
     return tokens / best, n_params
 
 
@@ -120,6 +127,11 @@ def main() -> None:
                         "the constant tunnel round-trip")
     p.add_argument("--fused-ce-chunks", dest="fused_ce_chunks",
                    default=None, type=int)
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each block — lets realistic-width "
+                        "long-context configs fit the chip; reported MFU "
+                        "still counts model FLOPs only (not recompute), "
+                        "i.e. it is MFU not HFU")
     p.add_argument("--fp32", dest="bf16", action="store_false",
                    help="run the trunk in fp32 (default bfloat16)")
     args = p.parse_args()
@@ -131,15 +143,28 @@ def main() -> None:
 
     for attn in args.attn.split(","):
         tps, n_params = bench_one(attn.strip(), args)
+        # Two FLOPs conventions (utils/flops.py): "causal" counts the
+        # attention term at the work a causal kernel performs (T/2);
+        # "full" is the PaLM-style full-score-matrix convention most
+        # published MFU tables use.  Report both — they differ by up to
+        # 2× on the attention term at long context.
         fpt = transformer_train_flops_per_token(
-            n_params, args.n_layers, args.d_model, args.seq_len
+            n_params, args.n_layers, args.d_model, args.seq_len, causal=True
+        )
+        fpt_full = transformer_train_flops_per_token(
+            n_params, args.n_layers, args.d_model, args.seq_len, causal=False
         )
         print(json.dumps({
             "metric": f"lm_{attn.strip()}_train_tokens_per_sec",
             "value": round(tps, 1),
             "unit": "tokens/sec",
-            "tflops_per_sec": round(tps * fpt / 1e12, 1),
-            "mfu": round(mfu(tps * fpt), 3),
+            # Keyed by convention (like mfu_*) — the r02 artifacts'
+            # "tflops_per_sec" used the full convention WITH embedding
+            # params, so neither new key is silently comparable to it.
+            "tflops_causal": round(tps * fpt / 1e12, 1),
+            "tflops_full": round(tps * fpt_full / 1e12, 1),
+            "mfu_causal": round(mfu(tps * fpt), 3),
+            "mfu_full": round(mfu(tps * fpt_full), 3),
             "config": {
                 "d_model": args.d_model, "n_layers": args.n_layers,
                 "seq_len": args.seq_len, "batch": args.batch,

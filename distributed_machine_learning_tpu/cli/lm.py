@@ -192,8 +192,19 @@ def build(args):
                 from distributed_machine_learning_tpu.models.transformer import (
                     _ring_flash_wins,
                 )
+                from distributed_machine_learning_tpu.ops.pallas.flash_attention import (  # noqa: E501
+                    _needs_pad,
+                )
 
-                if args.attn == "flash" or _ring_flash_wins(args.seq_len // n):
+                # Explicit --attn flash still requires a natively
+                # tileable chunk: the ring kernels have no pad/slice
+                # wrapper, so an untileable chunk (largest power-of-two
+                # divisor < 128) stays on the einsum ring rather than
+                # handing Mosaic a block it must reject.
+                chunk = args.seq_len // n
+                if (args.attn == "flash" and not _needs_pad(chunk)) or (
+                    args.attn == "auto" and _ring_flash_wins(chunk)
+                ):
                     impl = "ring_flash"
             model = TransformerLM(**{**common, "attn_impl": impl})
         state = init_lm_state(model, seed=SEED, config=opt_config)

@@ -97,12 +97,16 @@ def _ring_flash_wins(chunk_len: int) -> bool:
     """ring → ring_flash upgrade policy (one source of truth for the CLI
     and programmatic callers): the per-chunk math is exactly the
     unsharded-flash regime applied to the LOCAL chunk, so the same
-    measured length policy decides — delegate to ``flash_wins``."""
+    measured length policy decides — delegate to ``flash_wins``, minus
+    the lengths the single-chunk path handles by padding: the ring
+    kernels operate on fixed chunk grids with no pad/slice wrapper, so
+    a chunk Mosaic cannot tile natively stays on the einsum ring."""
     from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
+        _needs_pad,
         flash_wins,
     )
 
-    return flash_wins(chunk_len)
+    return flash_wins(chunk_len) and not _needs_pad(chunk_len)
 
 
 class Attention(nn.Module):
@@ -114,9 +118,10 @@ class Attention(nn.Module):
     ``ops/pallas/ring_flash_attention.py``), "ulysses" (sequence sharded
     via all-to-all head re-sharding — ``ops/ulysses.py``), "flash" (the
     Pallas kernel — ``ops/pallas/flash_attention.py``), or "auto" (flash
-    from 1k context up, dense below — the measured crossover, see
-    ``_flash_wins``; for the sharded ring the analogous policy is
-    ``_ring_flash_wins``).
+    from the measured 512-context crossover up when the length tiles
+    natively, always from 2048 up via the kernel's pad-and-slice path,
+    dense below — see ``flash_wins``; for the sharded ring the analogous
+    policy is ``_ring_flash_wins``).
 
     ``decode=True`` switches to KV-cached autoregressive inference: K/V
     land in a ``"cache"`` variable collection sized by the init-time

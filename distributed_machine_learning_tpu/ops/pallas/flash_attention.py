@@ -63,6 +63,21 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = -1e30
 _LANES = 128  # VMEM lane width: m/l scratch is (block_q, _LANES)
+# The kernels run the softmax in BASE 2: scores are pre-scaled by
+# log2(e) so every exp becomes a bare exp2.  m, l's log-offset, and the
+# saved lse therefore live in log2 space; probabilities and outputs are
+# unchanged because exp2((s·log2e) − m2) == exp(s − m).
+#
+# Measured context (8k ablation at constant FLOPs): the exp over the
+# score tile IS the kernel's critical path — per-tile time is ~2.2 µs
+# regardless of head dim, i.e. one exp per score element at the VPU's
+# ~118 Gelem/s transcendental rate, with the MXU work hidden under it.
+# That makes the performed-FLOPs roofline exp-bound at 4·D FLOPs per
+# exp: 30 TF/s at D=64, 60 TF/s at D=128 — this kernel reaches ~90%
+# and ~94% of those ceilings.  (exp2 itself measured neutral vs exp
+# under Mosaic — its exp is already pow2-based — but base-2 keeps the
+# kernel at the floor of what the lowering can emit.)
+LOG2E = 1.4426950408889634
 
 
 def _interpret() -> bool:
@@ -100,18 +115,37 @@ def _pick(L: int, target: int) -> int:
     return b
 
 
+def _needs_pad(L: int) -> bool:
+    """True when L cannot be tiled legally as-is: Mosaic requires the
+    residuals' lane-dim block (== block_q) to be a multiple of 128 or
+    the full array dim, so a length whose largest power-of-two divisor
+    is <128 (and which isn't itself that divisor) must be padded."""
+    bq = _pick(L, 512)
+    return not (bq % 128 == 0 or bq == L)
+
+
+def _padded_len(L: int) -> int:
+    """Smallest multiple of 512 (the tuned block size) >= L."""
+    return -(-L // 512) * 512
+
+
 def flash_wins(L: int) -> bool:
     """Length policy shared by every "auto" dispatch: after the 512×512
     block retune the flash kernels beat XLA dense attention from 512
     context up on the measured chip (512k vs 421k tok/s @512; 1.6× @1k;
     ~3× @4-8k — docs/PERF.md) and are the only option past ~8-16k where
     dense's L² program stops compiling.  Dense still wins at 256 (584k
-    vs 479k), at sub-1k lengths NOT divisible by 512 (640/768/896
-    degrade the blocks to 128-256 wide, and the @512 margin was only
-    1.2× with FULL blocks), and at lengths whose largest power-of-two
-    divisor is under 128."""
+    vs 479k) and at sub-2k lengths with degraded blocks: sub-1k lengths
+    not divisible by 512 forfeit the thin @512 margin, and 1-2k lengths
+    whose largest power-of-two divisor is under 128 would pay the pad-
+    to-512-multiple overhead (up to (L+511)²/L² ≈ 1.5× at 1k) against
+    only a ~1.6× dense deficit.  From 2048 up flash wins for EVERY
+    length — padded if needed — because dense is ≥2× behind (and soon
+    uncompilable) while the pad overhead shrinks quadratically."""
+    if L >= 2048:
+        return True
     if L >= 1024:
-        return _pick(L, 128) >= 128
+        return not _needs_pad(L)
     return L >= 512 and _pick(L, 512) == 512
 
 
@@ -138,6 +172,40 @@ def _last_kb(qi, block_q: int, block_k: int):
 def _first_qi(kb, block_q: int, block_k: int):
     """First Q block index intersecting the causal triangle of K block kb."""
     return (kb * block_k) // block_q
+
+
+def _tile_classes(q_start, k_start, block_q: int, block_k: int):
+    """(interior, on_diag) predicates for one (Q, K) tile of a causal
+    kernel.  ``interior``: every (q_pos, k_pos) pair satisfies
+    k_pos <= q_pos — the tile needs NO mask.  ``on_diag``: the tile
+    straddles the diagonal and must mask.  Tiles above the diagonal
+    match neither and are skipped entirely."""
+    interior = k_start + block_k - 1 <= q_start
+    active = k_start <= q_start + block_q - 1
+    return interior, active & jnp.logical_not(interior)
+
+
+def _dispatch_tiles(do_update, q_start, k_start, block_q: int, block_k: int,
+                    causal: bool):
+    """Shared tile dispatch for every flash/ring kernel: causal kernels
+    run the mask-free variant on tiles fully below the diagonal (the
+    per-tile iota/compare/select mask is VPU work rivaling the tile's
+    MXU time, and only diagonal-straddling tiles need it), the masked
+    variant on the diagonal, and skip above-diagonal tiles; non-causal
+    kernels run every tile mask-free.  ``do_update(tile_causal)`` is the
+    kernel-specific tile body."""
+    if not causal:
+        do_update(False)
+        return
+    interior, on_diag = _tile_classes(q_start, k_start, block_q, block_k)
+
+    @pl.when(interior)
+    def _update_full():
+        do_update(False)
+
+    @pl.when(on_diag)
+    def _update_diag():
+        do_update(True)
 
 
 def _block_scores(q, k, q_start, k_start, block_q, block_k, scale):
@@ -173,6 +241,8 @@ def _full_scores(q, k, scale):
 
 def _tile_scores(q, k, q_start, k_start, block_q, block_k, scale,
                  causal: bool):
+    """Scores for one tile; callers on the log2-softmax path pass
+    ``scale * LOG2E`` so the downstream exps become exp2."""
     if causal:
         return _block_scores(q, k, q_start, k_start, block_q, block_k, scale)
     return _full_scores(q, k, scale)
@@ -180,10 +250,11 @@ def _tile_scores(q, k, q_start, k_start, block_q, block_k, scale,
 
 def _online_update(s, m, l, acc, v, causal: bool):
     """One online-softmax block update of the (m, l, acc) running triple.
-    ``s`` fp32 scores [bq, bk]; m/l [bq]; acc [bq, D] fp32."""
+    ``s`` fp32 scores [bq, bk] in LOG2 space (pre-scaled by log2e);
+    m [bq] log2-space running max; l [bq]; acc [bq, D] fp32."""
     m_new = jnp.maximum(m, s.max(axis=-1))
-    alpha = jnp.exp(m - m_new)
-    p = jnp.exp(s - m_new[:, None])
+    alpha = jnp.exp2(m - m_new)
+    p = jnp.exp2(s - m_new[:, None])
     if causal:
         # Masked entries must contribute 0 even in a fully-masked row
         # (there s == m_new == NEG_INF and the exp above gives 1, not 0).
@@ -197,7 +268,8 @@ def _online_update(s, m, l, acc, v, causal: bool):
 
 
 def _p_from_lse(s, lse, causal: bool):
-    p = jnp.exp(s - lse[:, None])
+    """``s`` and ``lse`` both in log2 space."""
+    p = jnp.exp2(s - lse[:, None])
     if causal:
         p = jnp.where(s > 0.5 * NEG_INF, p, 0.0)
     return p
@@ -250,35 +322,47 @@ def _flash_fwd_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Skip blocks entirely above the causal diagonal (their DMA is
-    # already elided by the clamped index map).
-    @pl.when(k_start <= q_start + block_q - 1)
-    def _update():
+    def _do_update(causal):
         q = q_ref[0]  # [block_q, D], input dtype
         k = k_ref[0]  # [block_k, D]
         v = v_ref[0]
-        s = _tile_scores(q, k, q_start, k_start, block_q, block_k, scale,
-                         causal=True)
+        s = _tile_scores(q, k, q_start, k_start, block_q, block_k, scale * LOG2E,
+                         causal=causal)
         m_new, l_new, acc_new = _online_update(
-            s, m_ref[:, 0], l_ref[:, 0], acc_ref[:], v, causal=True
+            s, m_ref[:, 0], l_ref[:, 0], acc_ref[:], v, causal=causal
         )
         acc_ref[:] = acc_new
         m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
+    # Blocks entirely above the causal diagonal are skipped (their DMA
+    # is already elided by the clamped index map).  Blocks entirely
+    # BELOW it — the vast majority at long L — run the mask-free
+    # variant: the per-tile iota/compare/select mask is pure VPU work
+    # that rivals the tile's MXU time, and only tiles straddling the
+    # diagonal need it.
+    _dispatch_tiles(_do_update, q_start, k_start, block_q, block_k,
+                    causal=True)
+
     @pl.when(kb == pl.num_programs(2) - 1)
     def _finalize():
         l = jnp.maximum(l_ref[:, 0], 1e-30)
         o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
-        # Lane-replicated logsumexp (TPU tiling wants a 128-lane minor
-        # dim — same layout the reference TPU flash kernel uses).
-        lse = m_ref[:, 0] + jnp.log(l)
-        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+        # Exact [block_q] logsumexp row — sequence in the LANE dim, one
+        # sublane (the splash-attention residual layout).  The r2 kernels
+        # stored this 128-lane-replicated; since the backward kernels
+        # re-fetch the lse/Δ tiles on every grid step whose block index
+        # changes, that replication multiplied the O(L) residual reads
+        # by 128× (~17 GB per dK/dV pass at 32k).  The sublane→lane
+        # relayout here costs one in-register transpose per Q block.
+        # Stored in LOG2 space, matching the kernels' base-2 softmax.
+        lse_ref[0] = m_ref[:, 0] + jnp.log2(l)
 
 
 def _flash_fwd(q, k, v, block_q: int, block_k: int, kv_groups: int = 1):
     """q: [BHq, L, D], k/v: [BHq // kv_groups, L, D] →
-    (out [BHq, L, D], lse [BHq, L] fp32).
+    (out [BHq, L, D], lse [BHq, 1, L] fp32 — exact rows, not
+    lane-replicated).
 
     ``kv_groups > 1`` is grouped-query attention natively: the K/V tile
     index maps divide the batch·head grid index by the group factor, so
@@ -307,8 +391,12 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, kv_groups: int = 1):
         ),
         memory_space=pltpu.VMEM,
     )
+    # (None, 1, block_q) block of a [BH, 1, L] array: the singleton
+    # middle dim satisfies Mosaic's block-shape rule (last two dims
+    # (1, block_q) — 1 equals the array dim, block_q % 128 == 0) while
+    # keeping the stored residual exact.  Same trick as splash attention.
     lse_spec = pl.BlockSpec(
-        (1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0),
+        (None, 1, block_q), lambda bh, qi, kb: (bh, 0, qi),
         memory_space=pltpu.VMEM,
     )
     scratch = [
@@ -320,7 +408,7 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, kv_groups: int = 1):
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((BH, L, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, L, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, L), jnp.float32),
         ),
         grid=grid,
         in_specs=[q_spec, k_spec, k_spec],
@@ -344,16 +432,18 @@ def _flash_bwd_dq_kernel(
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    @pl.when(k_start <= q_start + block_q - 1)
-    def _update():
+    def _do_update(causal):
         k = k_ref[0]
         v = v_ref[0]
         s = _tile_scores(q_ref[0], k, q_start, k_start, block_q, block_k,
-                         scale, causal=True)
+                         scale * LOG2E, causal=causal)
         dq_acc[:] = dq_acc[:] + _dq_contrib(
-            s, k, v, do_ref[0], lse_ref[0][:, 0], delta_ref[0][:, 0],
-            scale, causal=True,
+            s, k, v, do_ref[0], lse_ref[0], delta_ref[0],
+            scale, causal=causal,
         )
+
+    _dispatch_tiles(_do_update, q_start, k_start, block_q, block_k,
+                    causal=True)
 
     @pl.when(kb == pl.num_programs(2) - 1)
     def _finalize():
@@ -374,18 +464,20 @@ def _flash_bwd_dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(q_start + block_q - 1 >= k_start)
-    def _update():
+    def _do_update(causal):
         q = q_ref[0]
         v = v_ref[0]
         s = _tile_scores(q, k_ref[0], q_start, k_start, block_q, block_k,
-                         scale, causal=True)
+                         scale * LOG2E, causal=causal)
         dk_c, dv_c = _dkv_contrib(
-            s, q, v, do_ref[0], lse_ref[0][:, 0], delta_ref[0][:, 0],
-            scale, causal=True,
+            s, q, v, do_ref[0], lse_ref[0], delta_ref[0],
+            scale, causal=causal,
         )
         dk_acc[:] = dk_acc[:] + dk_c
         dv_acc[:] = dv_acc[:] + dv_c
+
+    _dispatch_tiles(_do_update, q_start, k_start, block_q, block_k,
+                    causal=True)
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _finalize():
@@ -412,8 +504,11 @@ def _flash_bwd(q, k, v, do, lse, delta, kv_groups: int = 1):
         ),
         memory_space=pltpu.VMEM,
     )
+    # lse/Δ ride as exact (1, block_q) rows of [BH, 1, L] — sequence in
+    # lanes, no replication; in-kernel use pays one lane→sublane
+    # relayout per tile.
     row_spec_q = pl.BlockSpec(
-        (1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0),
+        (None, 1, block_q), lambda bh, qi, kb: (bh, 0, qi),
         memory_space=pltpu.VMEM,
     )
     dq = pl.pallas_call(
@@ -453,9 +548,9 @@ def _flash_bwd(q, k, v, do, lse, delta, kv_groups: int = 1):
         (1, block_k, D), lambda bh, kb, qi: (bh, kb, 0), memory_space=pltpu.VMEM
     )
     row_spec_k = pl.BlockSpec(
-        (1, block_q, _LANES),
+        (None, 1, block_q),
         lambda bh, kb, qi: (
-            bh, jnp.maximum(qi, _first_qi(kb, block_q, block_k)), 0
+            bh, 0, jnp.maximum(qi, _first_qi(kb, block_q, block_k))
         ),
         memory_space=pltpu.VMEM,
     )
@@ -533,8 +628,7 @@ def _flash_core_bwd(res, g):
     # needed.
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # [BH, L]
-    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
+    )[:, None, :]  # [BH, 1, L] — exact, same layout as the saved lse
     dq, dk, dv = _flash_bwd(
         _fold(q), _fold(k), _fold(v), do, lse, delta, kv_groups=groups
     )
@@ -567,5 +661,20 @@ def flash_self_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     stream the narrow K/V directly — no repeated K/V is ever
     materialized in HBM, so K/V read traffic drops by the group factor
     (see ``models/transformer.py``'s flash branch).
+
+    Total over every L: lengths Mosaic cannot tile natively (largest
+    power-of-two divisor < 128) are zero-padded up to the next 512
+    multiple and the output sliced back.  Zero padding is exact for
+    causal attention — padded KEYS sit after every real query (their
+    tiles are entirely above the diagonal: skipped), and padded QUERY
+    rows are discarded by the slice while contributing zero to dK/dV in
+    the backward (their dO rows are zero).  The pad/slice sits OUTSIDE
+    the custom_vjp, so JAX's pad/slice VJPs route gradients correctly.
     """
-    return _flash_core(q, k, v)
+    L = q.shape[1]
+    if not _needs_pad(L):
+        return _flash_core(q, k, v)
+    pad = ((0, 0), (0, _padded_len(L) - L), (0, 0), (0, 0))
+    return _flash_core(
+        jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
+    )[:, :L]

@@ -57,9 +57,11 @@ from jax.experimental import pallas as pl
 from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
     _HAS_PLTPU,
     _LANES,
+    LOG2E,
     NEG_INF,
     _compiler_params,
     _dkv_blocks,
+    _dispatch_tiles,
     _dkv_contrib,
     _dq_contrib,
     _first_qi,
@@ -107,37 +109,39 @@ def _chunk_fwd_kernel(
 
     @pl.when(kb == 0)
     def _load():
-        m_s[:] = m_in[0]
-        l_s[:] = l_in[0]
+        # The HBM carry keeps m/l as exact [Lc] rows (sequence in lanes);
+        # expand to the lane-replicated VMEM scratch the online update
+        # wants — one relayout per Q block per ring step, in exchange for
+        # 128× less carry traffic through HBM between steps.
+        m_s[:] = jnp.broadcast_to(m_in[0][:, None], m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_in[0][:, None], l_s.shape)
         acc_s[:] = acc_in[0]
 
-    active = (
-        k_start <= q_start + block_q - 1 if causal else kb >= 0
-    )
-
-    @pl.when(active)
-    def _update():
+    def _do_update(tile_causal):
         v = v_ref[0]
         s = _tile_scores(q_ref[0], k_ref[0], q_start, k_start, block_q,
-                         block_k, scale, causal=causal)
+                         block_k, scale * LOG2E, causal=tile_causal)
         m_new, l_new, acc_new = _online_update(
-            s, m_s[:, 0], l_s[:, 0], acc_s[:], v, causal=causal
+            s, m_s[:, 0], l_s[:, 0], acc_s[:], v, causal=tile_causal
         )
         acc_s[:] = acc_new
         m_s[:] = jnp.broadcast_to(m_new[:, None], m_s.shape)
         l_s[:] = jnp.broadcast_to(l_new[:, None], l_s.shape)
 
+    _dispatch_tiles(_do_update, q_start, k_start, block_q, block_k,
+                    causal=causal)
+
     @pl.when(kb == pl.num_programs(2) - 1)
     def _store():
-        m_out[0] = m_s[:]
-        l_out[0] = l_s[:]
+        m_out[0] = m_s[:, 0]
+        l_out[0] = l_s[:, 0]
         acc_out[0] = acc_s[:]
 
 
 def _chunk_fwd(q, k, v, carry, *, causal: bool, kv_groups: int = 1):
     """One ring step over folded chunks (q [BHq, Lc, D], k/v
     [BHq // kv_groups, Lc, D]); carry = (m, l, acc) with m/l
-    [BHq, Lc, _LANES] f32 and acc [BHq, Lc, D] f32."""
+    [BHq, 1, Lc] f32 (exact rows) and acc [BHq, Lc, D] f32."""
     _require_pltpu()
     m, l, acc = carry
     BH, Lc, D = q.shape
@@ -165,7 +169,7 @@ def _chunk_fwd(q, k, v, carry, *, causal: bool, kv_groups: int = 1):
             memory_space=pltpu.VMEM,
         )
     row_spec = pl.BlockSpec(
-        (1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0),
+        (None, 1, block_q), lambda bh, qi, kb: (bh, 0, qi),
         memory_space=pltpu.VMEM,
     )
     acc_spec = pl.BlockSpec(
@@ -214,17 +218,17 @@ def _chunk_dq_kernel(
     def _load():
         dq_s[:] = dq_in[0]
 
-    active = k_start <= q_start + block_q - 1 if causal else kb >= 0
-
-    @pl.when(active)
-    def _update():
+    def _do_update(tile_causal):
         k = k_ref[0]
         s = _tile_scores(q_ref[0], k, q_start, k_start, block_q, block_k,
-                         scale, causal=causal)
+                         scale * LOG2E, causal=tile_causal)
         dq_s[:] = dq_s[:] + _dq_contrib(
-            s, k, v_ref[0], do_ref[0], lse_ref[0][:, 0],
-            delta_ref[0][:, 0], scale, causal=causal,
+            s, k, v_ref[0], do_ref[0], lse_ref[0],
+            delta_ref[0], scale, causal=tile_causal,
         )
+
+    _dispatch_tiles(_do_update, q_start, k_start, block_q, block_k,
+                    causal=causal)
 
     @pl.when(kb == pl.num_programs(2) - 1)
     def _store():
@@ -245,19 +249,19 @@ def _chunk_dkv_kernel(
         dk_s[:] = dk_in[0]
         dv_s[:] = dv_in[0]
 
-    active = q_start + block_q - 1 >= k_start if causal else qi >= 0
-
-    @pl.when(active)
-    def _update():
+    def _do_update(tile_causal):
         q = q_ref[0]
         s = _tile_scores(q, k_ref[0], q_start, k_start, block_q, block_k,
-                         scale, causal=causal)
+                         scale * LOG2E, causal=tile_causal)
         dk_c, dv_c = _dkv_contrib(
-            s, q, v_ref[0], do_ref[0], lse_ref[0][:, 0],
-            delta_ref[0][:, 0], scale, causal=causal,
+            s, q, v_ref[0], do_ref[0], lse_ref[0],
+            delta_ref[0], scale, causal=tile_causal,
         )
         dk_s[:] = dk_s[:] + dk_c
         dv_s[:] = dv_s[:] + dv_c
+
+    _dispatch_tiles(_do_update, q_start, k_start, block_q, block_k,
+                    causal=causal)
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _store():
@@ -290,7 +294,7 @@ def _chunk_dq(q, k, v, do, lse, delta, dq, *, causal: bool,
             memory_space=pltpu.VMEM,
         )
     row_spec = pl.BlockSpec(
-        (1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0),
+        (None, 1, block_q), lambda bh, qi, kb: (bh, 0, qi),
         memory_space=pltpu.VMEM,
     )
     return pl.pallas_call(
@@ -326,9 +330,15 @@ def _chunk_dkv(q, k, v, do, lse, delta, dk, dv, *, causal: bool,
     if causal:
         def _qi_map(bh, kb, qi):
             return bh, jnp.maximum(qi, _first_qi(kb, block_q, block_k)), 0
+
+        def _qi_row_map(bh, kb, qi):
+            return bh, 0, jnp.maximum(qi, _first_qi(kb, block_q, block_k))
     else:
         def _qi_map(bh, kb, qi):
             return bh, qi, 0
+
+        def _qi_row_map(bh, kb, qi):
+            return bh, 0, qi
     q_spec = pl.BlockSpec(
         (1, block_q, D), _qi_map, memory_space=pltpu.VMEM
     )
@@ -341,7 +351,7 @@ def _chunk_dkv(q, k, v, do, lse, delta, dk, dv, *, causal: bool,
         memory_space=pltpu.VMEM,
     )
     row_spec = pl.BlockSpec(
-        (1, block_q, _LANES), _qi_map, memory_space=pltpu.VMEM
+        (None, 1, block_q), _qi_row_map, memory_space=pltpu.VMEM
     )
     return pl.pallas_call(
         functools.partial(
@@ -393,8 +403,8 @@ def _ring_fwd_impl(q, k, v, axis_name, axis_size):
     BH = qf.shape[0]
     rank = lax.axis_index(axis_name)
     carry = (
-        jnp.full((BH, Lc, _LANES), NEG_INF, jnp.float32),
-        jnp.zeros((BH, Lc, _LANES), jnp.float32),
+        jnp.full((BH, 1, Lc), NEG_INF, jnp.float32),
+        jnp.zeros((BH, 1, Lc), jnp.float32),
         jnp.zeros((BH, Lc, D), jnp.float32),
     )
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -420,10 +430,9 @@ def _ring_fwd_impl(q, k, v, axis_name, axis_size):
         if s < n - 1:
             kv = lax.ppermute(kv, axis_name, perm)
     m, l, acc = carry
-    l1 = jnp.maximum(l[:, :, 0], 1e-30)
-    out = (acc / l1[:, :, None]).astype(q.dtype)
-    lse = m[:, :, :1] + jnp.log(l1)[:, :, None]  # [BH, Lc, 1]
-    lse = jnp.broadcast_to(lse, (BH, Lc, _LANES))
+    l1 = jnp.maximum(l, 1e-30)  # [BH, 1, Lc]
+    out = (acc / l1[:, 0, :, None]).astype(q.dtype)
+    lse = m + jnp.log2(l1)  # [BH, 1, Lc] — exact rows, log2 space
     return _unfold(out, B, H), (q, k, v, out, lse)
 
 
@@ -452,8 +461,7 @@ def _ring_bwd_vjp(axis_name, axis_size, res, g):
     rank = lax.axis_index(axis_name)
     delta = jnp.sum(
         do.astype(jnp.float32) * out_f.astype(jnp.float32), axis=-1
-    )
-    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
+    )[:, None, :]  # [BH, 1, Lc] — exact, same layout as the carried lse
 
     dq = jnp.zeros(qf.shape, jnp.float32)
     # dK/dV travel WITH their (narrow, under GQA) K/V chunk: after n ring

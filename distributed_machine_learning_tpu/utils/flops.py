@@ -40,12 +40,24 @@ def vgg_train_flops_per_image(cfg: list, **kw) -> float:
 
 
 def transformer_train_flops_per_token(
-    n_params: int, n_layers: int, d_model: int, seq_len: int
+    n_params: int, n_layers: int, d_model: int, seq_len: int,
+    causal: bool = True,
 ) -> float:
     """~6·P per token for the matmuls (fwd 2P + bwd 4P) plus the
     attention score/value matmuls: 12·L·d·T per token fwd+bwd
-    (2 matmuls × 2 FLOPs × T·d each, × 3 for training)."""
-    return 6.0 * n_params + 12.0 * n_layers * d_model * seq_len
+    (2 matmuls × 2 FLOPs × T·d each, × 3 for training).
+
+    ``causal=True`` (the default, matching every model in this repo)
+    counts the attention term at T/2 — the work a causal kernel actually
+    performs, since the flash kernels skip above-diagonal blocks
+    entirely (compute AND DMA).  Set ``causal=False`` for the PaLM-style
+    full-score-matrix convention; at long context the two differ by up
+    to 2× on the attention term, so MFU tables must say which they use
+    (docs/PERF.md reports the causal/performed convention)."""
+    attn = 12.0 * n_layers * d_model * seq_len
+    if causal:
+        attn /= 2.0
+    return 6.0 * n_params + attn
 
 
 def mfu(

@@ -55,17 +55,43 @@ def test_auto_attn_policy():
     # Sub-1k lengths not divisible by 512 degrade the blocks past the
     # thin @512 margin — dense keeps them.
     assert not _flash_wins(640) and not _flash_wins(768)
-    assert not _flash_wins(1040)  # 16·65: blocks would degrade below 128
+    assert not _flash_wins(1040)  # 16·65: pad overhead beats dense's 1.6×
+    # From 2048 up the policy is TOTAL: every length dispatches flash
+    # (padded when needed) because dense is ≥2× behind or uncompilable.
+    assert _flash_wins(2050) and _flash_wins(16640) and _flash_wins(30000)
+    # The ring upgrade stays native-tileable only (no pad path there).
+    from distributed_machine_learning_tpu.models.transformer import (
+        _ring_flash_wins,
+    )
+
+    assert _ring_flash_wins(4096) and not _ring_flash_wins(2050)
 
 
 def test_flash_odd_length(qkv):
-    q, k, v = (a[:, :48] for a in qkv)  # L=48 → block 16
+    # L=48: largest power-of-two divisor 16 < 128 → the kernel pads to
+    # the next 512 multiple and slices back (Mosaic cannot tile a
+    # 16-lane residual block).  Padding must be invisible: exact dense
+    # parity, forward and backward.
+    q, k, v = (a[:, :48] for a in qkv)
+    from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
+        _needs_pad,
+    )
+
+    assert _needs_pad(48) and not _needs_pad(64) and not _needs_pad(16640)
     np.testing.assert_allclose(
         np.asarray(flash_self_attention(q, k, v)),
         np.asarray(dense_self_attention(q, k, v)),
         rtol=1e-5,
         atol=1e-6,
     )
+    g = jnp.ones_like(q)
+    _, flash_vjp = jax.vjp(flash_self_attention, q, k, v)
+    _, dense_vjp = jax.vjp(dense_self_attention, q, k, v)
+    for got, want, name in zip(flash_vjp(g), dense_vjp(g), "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5,
+            err_msg=f"d{name} mismatch through the padded path",
+        )
 
 
 def test_flash_backward_matches_dense(qkv):
