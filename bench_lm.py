@@ -103,8 +103,9 @@ def bench_one(attn: str, args) -> tuple[float, int]:
     # The input embedding is a gather, not a matmul — drop it from the
     # 6P matmul-FLOPs term (at 32k vocab × d2048 it would otherwise
     # inflate MFU ~12%).  The lm_head IS a matmul and stays counted.
-    # Derived from the model dims rather than a params-tree path so a
-    # renamed/tied embedding degrades to the old accounting, not a crash.
+    # Assumes the UNTIED embed + lm_head layout TransformerLM uses; if
+    # weight tying is ever added, this subtraction must become
+    # conditional or it would remove the (real) lm_head matmul instead.
     n_params -= args.vocab * args.d_model
     return tokens / best, n_params
 

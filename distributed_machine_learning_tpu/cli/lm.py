@@ -6,9 +6,14 @@ entrypoint gives the transformer stack the same driveable surface, with
 ``--parallel`` selecting how the step distributes over the mesh:
 
   dp       data parallelism (replicated params, pmean grads)
-  fsdp     ZeRO-3 sharded data parallelism — params + optimizer state
-           1/N per device (parallel/fsdp.py); pair with adamw, whose
-           fp32 moments are the memory ZeRO shards
+  fsdp     ZeRO-3 sharded data parallelism, flat-vector layout — params
+           + optimizer state 1/N per device, one whole-model all-gather
+           up front (parallel/fsdp.py); pair with adamw, whose fp32
+           moments are the memory ZeRO shards
+  fsdp_pl  ZeRO-3, per-layer GSPMD layout — each leaf sharded over the
+           data axis; XLA gathers weights at their use site and
+           overlaps layer i+1's gather with layer i's compute
+           (parallel/fsdp_perlayer.py)
   ring     context parallelism — ppermute ring attention over the
            sequence axis (ops/ring_attention.py)
   ulysses  context parallelism — all-to-all head re-sharding
@@ -51,8 +56,8 @@ def make_parser():
     p = argparse.ArgumentParser(description=__doc__)
     add_node_flags(p)
     p.add_argument("--parallel", default="dp",
-                   choices=["dp", "ring", "ulysses", "fsdp", "tp", "pp",
-                            "3d"])
+                   choices=["dp", "ring", "ulysses", "fsdp", "fsdp_pl",
+                            "tp", "pp", "3d"])
     p.add_argument("--d-model", dest="d_model", default=256, type=int)
     p.add_argument("--n-layers", dest="n_layers", default=4, type=int)
     p.add_argument("--n-heads", dest="n_heads", default=8, type=int)
@@ -137,7 +142,7 @@ def build(args):
     n = jax.device_count()
     dtype = jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
     attn = getattr(args, "attn", "auto")
-    if args.parallel in ("tp", "pp", "3d") and attn == "auto":
+    if args.parallel in ("tp", "pp", "3d", "fsdp_pl") and attn == "auto":
         # The pipeline/tensor-parallel steps own their sharding and
         # require the dense attention path (a Pallas call inside a
         # GSPMD-partitioned or ppermute-pipelined program would need its
@@ -156,12 +161,12 @@ def build(args):
     cfg_cls = get_optimizer(args.optimizer)[0]
     opt_config = cfg_cls() if args.lr is None else cfg_cls(learning_rate=args.lr)
     if args.fused_ce_chunks and args.parallel not in (
-        "dp", "ring", "ulysses", "fsdp"
+        "dp", "ring", "ulysses", "fsdp", "fsdp_pl"
     ):
         raise ValueError(
-            "--fused-ce-chunks applies to the dp/ring/ulysses/fsdp steps "
-            "only (tp shards the lm_head, pp computes the loss on the "
-            "last stage)"
+            "--fused-ce-chunks applies to the dp/ring/ulysses/fsdp/"
+            "fsdp_pl steps only (tp shards the lm_head, pp computes the "
+            "loss on the last stage)"
         )
 
     if args.parallel in ("dp", "ring", "ulysses"):
@@ -206,6 +211,14 @@ def build(args):
                     args.attn == "auto" and _ring_flash_wins(chunk)
                 ):
                     impl = "ring_flash"
+                elif args.attn == "flash":
+                    rank0_print(
+                        f"WARNING: --attn flash with --parallel ring: "
+                        f"per-device chunk {chunk} is not natively "
+                        "tileable (largest power-of-two divisor < 128) "
+                        "and the ring kernels have no pad path — "
+                        "falling back to the einsum ring"
+                    )
             model = TransformerLM(**{**common, "attn_impl": impl})
         state = init_lm_state(model, seed=SEED, config=opt_config)
         step = make_lm_train_step(model, mesh=mesh,
@@ -243,6 +256,32 @@ def build(args):
         )
         params_fn = lambda st: gather_fsdp_params(st, unravel, n_elems)
         return step, fstate, place, model, params_fn
+
+    if args.parallel == "fsdp_pl":
+        from distributed_machine_learning_tpu.parallel.fsdp_perlayer import (
+            make_fsdp_pl_lm_train_step,
+            shard_fsdp_pl_state,
+        )
+        from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+            shard_tp_batch,
+        )
+        from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+        if args.batch_size % n:
+            raise ValueError(
+                f"--batch-size {args.batch_size} must be divisible by "
+                f"the {n}-device data axis"
+            )
+        mesh = make_mesh(n)
+        model = TransformerLM(**common)
+        step = make_fsdp_pl_lm_train_step(
+            model, mesh, fused_ce_chunks=args.fused_ce_chunks
+        )
+        state = shard_fsdp_pl_state(
+            init_lm_state(model, seed=SEED, config=opt_config), mesh
+        )
+        place = lambda x, y: shard_tp_batch(mesh, x, y)
+        return step, state, place, model, lambda st: st.params
 
     if args.parallel == "tp":
         from distributed_machine_learning_tpu.parallel.tensor_parallel import (
@@ -316,16 +355,13 @@ def main(argv=None) -> None:
             f"d_model={args.d_model} layers={args.n_layers} "
             f"seq_len={args.seq_len} batch={args.batch_size}"
         )
-        # Decide up front whether eval will actually run: the eval step
-        # is a plain jit over host-local replicated params, so only the
-        # listed modes on a single process qualify — and the 10% corpus
-        # hold-out below must NOT shrink the training set for runs whose
-        # eval would then be skipped anyway.
-        will_eval = (
-            bool(args.eval_batches)
-            and args.parallel in ("dp", "ring", "ulysses", "fsdp")
-            and jax.process_count() == 1
-        )
+        # Eval runs for EVERY scheme and process count: params are
+        # materialized to host numpy first (a cross-process all-gather
+        # on multi-host runs), then every process runs the plain-jit
+        # eval step over the identical held-out stream independently —
+        # the reference's every-rank eval semantics
+        # (``part1/main.py:62-77``).
+        will_eval = bool(args.eval_batches)
         corpus = None
         eval_corpus = None
         if args.data_dir is not None:
@@ -403,44 +439,57 @@ def main(argv=None) -> None:
             max_iters=args.max_iters,
         )
         if args.eval_batches:
-            # make_lm_eval_step is a plain jit fed replicated params plus
-            # host batches; on a multi-host run that mixes multi-host-
-            # committed arrays with default-device inputs and fails at
-            # dispatch — will_eval (computed before the corpus split)
-            # gates every path on a single process.
-            if not will_eval:
-                rank0_print(
-                    "WARNING: --eval-batches supports dp/ring/ulysses/"
-                    "fsdp on a single process (the eval step is a plain "
-                    "jit over host-local arrays); skipping eval for "
-                    f"--parallel {args.parallel} with "
-                    f"{jax.process_count()} processes"
-                )
+            from distributed_machine_learning_tpu.data.text import (
+                eval_windows,
+            )
+            from distributed_machine_learning_tpu.train.lm_step import (
+                make_lm_eval_step,
+            )
+            from distributed_machine_learning_tpu.train.loop import (
+                evaluate_lm,
+            )
+
+            if corpus is not None:
+                ev = eval_windows(eval_corpus, args.batch_size,
+                                  args.seq_len, args.eval_batches)
             else:
-                from distributed_machine_learning_tpu.data.text import (
-                    eval_windows,
+                ev_rng = np.random.default_rng(SEED + 1)
+                ev = (
+                    (b[:, :-1], b[:, 1:])
+                    for b in (
+                        synthetic_tokens(ev_rng, args.batch_size,
+                                         args.seq_len, args.vocab)
+                        for _ in range(args.eval_batches)
+                    )
                 )
-                from distributed_machine_learning_tpu.train.lm_step import (
-                    make_lm_eval_step,
-                )
-                from distributed_machine_learning_tpu.train.loop import (
-                    evaluate_lm,
+            params = params_fn(state)
+            if args.parallel in ("pp", "3d"):
+                # Pipeline layouts stack the blocks along a leading
+                # layer dim; restore the per-layer tree the plain model
+                # apply expects.
+                from distributed_machine_learning_tpu.parallel.pipeline import (  # noqa: E501
+                    unstack_lm_params,
                 )
 
-                if corpus is not None:
-                    ev = eval_windows(eval_corpus, args.batch_size,
-                                      args.seq_len, args.eval_batches)
-                else:
-                    ev_rng = np.random.default_rng(SEED + 1)
-                    ev = (
-                        (b[:, :-1], b[:, 1:])
-                        for b in (
-                            synthetic_tokens(ev_rng, args.batch_size,
-                                             args.seq_len, args.vocab)
-                            for _ in range(args.eval_batches)
-                        )
-                    )
-                evaluate_lm(make_lm_eval_step(model), params_fn(state), ev)
+                params = unstack_lm_params(params, args.n_layers)
+            # Materialize params on the host so the eval jit owns its
+            # own placement: sharded leaves (fsdp_pl/tp) assemble, and
+            # on multi-host runs the cross-process all-gather replaces
+            # the old single-process gate — every process then runs the
+            # identical eval stream independently, per the reference's
+            # every-rank eval loop (``part1/main.py:62-77``).
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                # tiled=True is the required mode for global (non-fully-
+                # addressable) arrays: it re-jits each leaf to a fully
+                # replicated sharding and returns the whole value as
+                # host numpy on every process.
+                params = multihost_utils.process_allgather(params,
+                                                           tiled=True)
+            else:
+                params = jax.device_get(params)
+            evaluate_lm(make_lm_eval_step(model), params, ev)
     finally:
         ctx.shutdown()
 

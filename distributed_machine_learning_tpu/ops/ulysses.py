@@ -42,8 +42,9 @@ def ulysses_self_attention(
     ``local_attn``: the kernel for the per-device full-sequence attention
     after the head re-shard — "dense" (XLA), "flash" (the Pallas kernel,
     the big win here: Ulysses holds full-L scores per head slice, exactly
-    the regime flash exists for), or "auto" (flash from the measured 1k
-    crossover up — ``flash_wins``).
+    the regime flash exists for), or "auto" (flash from the measured 512
+    crossover up for natively-tileable lengths, always from 2048 via the
+    kernel's pad-and-slice path — ``flash_wins``).
     """
     n = axis_size
     if n == 1:

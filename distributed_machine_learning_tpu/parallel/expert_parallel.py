@@ -42,7 +42,8 @@ def ep_spec_for(path: tuple[str, ...], ndim: int, expert_axis: str = EXPERT_AXIS
 
 
 def _spec_for(expert_axis: str):
-    return lambda path, ndim: ep_spec_for(path, ndim, expert_axis)
+    # gspmd.SpecFor passes the leaf shape; the EP rule only needs rank.
+    return lambda path, shape: ep_spec_for(path, len(shape), expert_axis)
 
 
 def ep_state_shardings(state: TrainState, mesh: Mesh, expert_axis: str = EXPERT_AXIS):

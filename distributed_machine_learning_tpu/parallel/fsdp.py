@@ -25,6 +25,16 @@ Flat-vector sharding (rather than per-tensor) keeps every collective a
 single static-shape op on one contiguous buffer — the layout XLA/ICI
 likes — and sidesteps uneven-tensor bookkeeping: one pad to a multiple of
 N covers the whole model.
+
+What the flat layout GIVES UP: the single up-front all-gather is a
+serial ICI prelude the forward must wait out, and the full parameter
+vector stays resident in HBM for the whole step — there is no
+gather/compute overlap and no per-layer liveness.  The per-layer GSPMD
+scheme (``parallel/fsdp_perlayer.py``) trades the flat layout's
+simplicity for exactly those two properties (weights gathered at their
+use site, layer i+1's gather overlapped with layer i's compute by
+XLA's latency-hiding scheduler); prefer it for deep models at scale
+and this one as the simplest correct baseline and for the CNN path.
 """
 
 from __future__ import annotations

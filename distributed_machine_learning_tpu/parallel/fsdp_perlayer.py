@@ -1,0 +1,152 @@
+"""Per-layer (GSPMD) FSDP — ZeRO-3 with gather/compute overlap.
+
+The flat-vector scheme (``parallel/fsdp.py``) all-gathers the ENTIRE
+parameter vector in one collective before any forward work starts: one
+serial ICI prelude on the critical path, and the full parameter vector
+resident in HBM for the whole step.  That is the simplest correct
+ZeRO-3, but it forfeits the overlap that makes FSDP scale — the
+reference's own DDP gets its gradient comm overlapped with backward
+compute via hooks (``/root/reference/part3/main.py:137``, group25.pdf
+p.6), and a sharded-parameter scheme should earn the same on the
+forward side.
+
+This module is the TPU-native way to get that overlap: declare WHERE
+each parameter lives — every leaf sharded 1/N along its largest
+N-divisible dimension over the data axis — and ``jit`` the unmodified
+train step with those in/out shardings.  XLA's SPMD partitioner then
+inserts one all-gather per parameter AT ITS USE SITE (layer i's weights
+are gathered when layer i runs, not before the step), keeps the
+gradient w.r.t. each leaf in the sharded layout (a reduce-scatter, not
+an all-reduce, since the update consumes the shard), and runs the
+sharded optimizer update leaf-by-leaf.  The latency-hiding scheduler
+overlaps layer i+1's gather with layer i's compute — the prefetch
+pipeline hand-written FSDP implementations build manually, obtained
+from the compiler.  The full parameter set is never resident as one
+buffer: gathered weights live only across their use (and the backward's
+re-use, scheduler-controlled), so peak parameter HBM is O(layer working
+set), not O(P).
+
+Versus the flat scheme (kept for the CNN path and as the simplest
+correct baseline):
+
+- flat: 1 gather + 1 reduce-scatter of one contiguous buffer; zero
+  overlap; full params resident all step.  Trivially model-agnostic.
+- per-layer: one gather per leaf, overlapped; params resident one
+  layer at a time; same total bytes on the wire (all-gather + reduce-
+  scatter of P elements each).
+
+Both pair naturally with AdamW, whose two fp32 moment vectors are the
+memory ZeRO exists to shard; the moments inherit their parameter's
+spec.  Elementwise optimizers only (SGD/AdamW) — per-leaf sharding
+keeps every leaf's slices aligned, but LARS's per-layer norms would
+still need a per-leaf psum; excluded for parity with the flat scheme.
+
+Like TP/EP (and for the same reason), the step requires dense
+attention: a Pallas call inside a GSPMD-partitioned program needs its
+own sharding rules (see ``cli/lm.py``'s resolve of auto→dense for
+tp/pp/3d).  attn_impl="auto" resolves to dense here.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu.parallel.gspmd import (
+    make_cached_sharded_step,
+    shard_state,
+)
+from distributed_machine_learning_tpu.runtime.mesh import BATCH_AXIS
+from distributed_machine_learning_tpu.train.lars import LARSConfig
+from distributed_machine_learning_tpu.train.lm_step import _lm_step_impl
+from distributed_machine_learning_tpu.train.state import TrainState
+
+
+def fsdp_pl_spec_for(n: int, data_axis: str = BATCH_AXIS):
+    """Shape-keyed ZeRO-3 rule: shard each leaf's largest N-divisible
+    dimension over the data axis; leaves with no divisible dim (biases
+    of odd width, scalars) replicate — they are the O(d) minority.
+
+    Unlike the TP rules this is deliberately semantics-free: ZeRO
+    shards for MEMORY, and any dim slicing is valid because the leaf is
+    gathered whole before use.  Picking the largest dim minimizes the
+    replicated remainder and keeps gather messages big (ICI likes fat
+    transfers)."""
+
+    def spec_for(path, shape):
+        del path
+        best = None
+        for i, d in enumerate(shape):
+            if d % n == 0 and d >= n and (best is None or d > shape[best]):
+                best = i
+        if best is None:
+            return P(*(None,) * len(shape))
+        axes = [None] * len(shape)
+        axes[best] = data_axis
+        return P(*axes)
+
+    return spec_for
+
+
+def shard_fsdp_pl_state(
+    state: TrainState, mesh: Mesh, data_axis: str = BATCH_AXIS
+) -> TrainState:
+    """Place a replicated TrainState into the per-layer ZeRO-3 layout
+    (params + moments sharded per ``fsdp_pl_spec_for``)."""
+    if isinstance(state.config, LARSConfig):
+        raise ValueError(
+            "per-layer FSDP cannot shard LARS (per-layer norms need a "
+            "cross-shard reduction); use sgd or adamw"
+        )
+    return shard_state(state, mesh, fsdp_pl_spec_for(mesh.shape[data_axis],
+                                                     data_axis))
+
+
+def make_fsdp_pl_lm_train_step(
+    model,
+    mesh: Mesh,
+    data_axis: str = BATCH_AXIS,
+    fused_ce_chunks: int | None = None,
+):
+    """Build the per-layer-FSDP LM train step.
+
+    ``state`` must be placed via :func:`shard_fsdp_pl_state`;
+    tokens/targets sharded over ``data_axis``
+    (``tensor_parallel.shard_tp_batch`` works).  Returns
+    ``step(state, tokens, targets) -> (state, loss)``.
+    """
+    if model.attn_impl != "dense":
+        raise ValueError(
+            "per-layer FSDP requires attn_impl='dense' (a Pallas call "
+            "inside the GSPMD-partitioned step has no sharding rules; "
+            "same restriction as tp/pp/3d)"
+        )
+    if data_axis not in mesh.axis_names:
+        raise ValueError(f"mesh is missing axis {data_axis!r}: "
+                         f"{mesh.axis_names}")
+    batch_sharding = NamedSharding(mesh, P(data_axis, None))
+    impl = partial(_lm_step_impl, model, axis_names=(),
+                   fused_ce_chunks=fused_ce_chunks)
+    return make_cached_sharded_step(
+        impl, mesh, fsdp_pl_spec_for(mesh.shape[data_axis], data_axis),
+        batch_sharding,
+    )
+
+
+def fsdp_pl_sharded_fraction(state: TrainState, mesh: Mesh,
+                             data_axis: str = BATCH_AXIS) -> float:
+    """Fraction of parameter elements the rule actually shards —
+    diagnostic for tests and sizing (biases of non-divisible width
+    replicate; everything else shards)."""
+    n = mesh.shape[data_axis]
+    rule = fsdp_pl_spec_for(n, data_axis)
+    total = sharded = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state.params):
+        keys = tuple(getattr(k, "key", str(k)) for k in path)
+        size = leaf.size
+        total += size
+        if any(a is not None for a in rule(keys, tuple(leaf.shape))):
+            sharded += size
+    return sharded / max(total, 1)

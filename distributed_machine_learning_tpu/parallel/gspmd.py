@@ -1,11 +1,12 @@
-"""Shared GSPMD machinery for the sharded-parameter strategies (TP / EP).
+"""Shared GSPMD machinery for the sharded-parameter strategies
+(TP / EP / per-layer FSDP).
 
-Both tensor and expert parallelism follow the same recipe — a
-``spec_for(path, ndim)`` rule table mapped over the param tree, a
-TrainState-shaped sharding pytree, and a jit cache keyed by the state's
-tree structure (SGDConfig is *static* pytree metadata, so differently
-configured states need distinct jitted signatures).  This module is that
-recipe, written once.
+All three follow the same recipe — a ``spec_for(path, shape)`` rule
+table mapped over the param tree (TP/EP rules key on the path, the
+per-layer FSDP rule on the shape), a TrainState-shaped sharding pytree,
+and a jit cache keyed by the state's tree structure (SGDConfig is
+*static* pytree metadata, so differently configured states need
+distinct jitted signatures).  This module is that recipe, written once.
 """
 
 from __future__ import annotations
@@ -17,15 +18,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_machine_learning_tpu.train.state import TrainState
 
-SpecFor = Callable[[tuple[str, ...], int], P]
+SpecFor = Callable[[tuple[str, ...], tuple[int, ...]], P]
 
 
 def param_specs(params, spec_for: SpecFor):
-    """Map a path→PartitionSpec rule over a param tree."""
+    """Map a (path, shape)→PartitionSpec rule over a param tree.
+    TP/EP rules key on the path; the per-layer FSDP rule keys on the
+    shape (which dim is divisible) — both get both."""
 
     def spec(path, leaf):
         keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
-        return spec_for(keys, leaf.ndim)
+        return spec_for(keys, tuple(leaf.shape))
 
     return jax.tree_util.tree_map_with_path(spec, params)
 

@@ -80,7 +80,8 @@ def tp_spec_for(path: tuple[str, ...], ndim: int, model_axis: str = MODEL_AXIS) 
 
 
 def _spec_for(model_axis: str):
-    return lambda path, ndim: tp_spec_for(path, ndim, model_axis)
+    # gspmd.SpecFor passes the leaf shape; the TP rules only need rank.
+    return lambda path, shape: tp_spec_for(path, len(shape), model_axis)
 
 
 def tp_state_shardings(

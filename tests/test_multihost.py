@@ -88,3 +88,48 @@ def test_two_process_rendezvous_identical_params_and_agree_stop():
     for out in (out0, out1):
         got, want = field(out, "data_sum").split()
         assert float(got) == float(want), (got, want)
+
+
+import pytest
+
+
+@pytest.mark.slow
+def test_two_process_lm_eval_runs():
+    """The LM eval path on a REAL two-process run (VERDICT r02 item 8):
+    params are all-gathered across processes to host numpy and every
+    rank runs the plain-jit eval independently — the run must finish
+    rc=0 on both ranks WITH an Eval line (the r02 code skipped eval on
+    multi-process runs with a warning; before that it crashed mixing
+    multi-host-committed params with host-local eval batches)."""
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + keep)
+    cmd = [sys.executable, "-m", "distributed_machine_learning_tpu.cli.lm",
+           "--master-ip", f"127.0.0.1:{port}", "--num-nodes", "2",
+           "--parallel", "dp", "--d-model", "16", "--n-layers", "1",
+           "--n-heads", "2", "--vocab", "64", "--seq-len", "16",
+           "--batch-size", "2", "--max-iters", "2", "--eval-batches", "1"]
+    p0 = subprocess.Popen(cmd + ["--rank", "0"], stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, env=env, text=True,
+                          cwd=REPO)
+    p1 = subprocess.Popen(cmd + ["--rank", "1"], stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, env=env, text=True,
+                          cwd=REPO)
+    try:
+        out0, _ = p0.communicate(timeout=240)
+        out1, _ = p1.communicate(timeout=240)
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+    assert p0.returncode == 0, f"rank0 failed:\n{out0}"
+    assert p1.returncode == 0, f"rank1 failed:\n{out1}"
+    # rank0_print gates output to rank 0; the Eval line proves the
+    # eval step ran (both ranks executed it — a dispatch error on
+    # either would have failed that rank's exit code).
+    assert "Eval: nll/token" in out0, out0
+    assert "skipping eval" not in out0 + out1
