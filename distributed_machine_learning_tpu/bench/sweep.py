@@ -84,6 +84,19 @@ def run_point(
     if num_devices == 1:
         mesh = None
         step = make_train_step(model, mesh=None, jit=False)
+    elif strategy_name == "fsdp":
+        # ZeRO-3 is a different step builder, not a grad-strategy: the
+        # state becomes flat 1/N shards and the step gathers/scatters
+        # around the forward/backward (parallel/fsdp.py).
+        from distributed_machine_learning_tpu.parallel.fsdp import (
+            make_fsdp_train_step,
+            shard_fsdp_state,
+        )
+
+        mesh = make_mesh(num_devices, devices=devices)
+        state, unravel, n_elems = shard_fsdp_state(state, mesh)
+        step = make_fsdp_train_step(model, mesh, unravel, n_elems,
+                                    jit=False)
     else:
         mesh = make_mesh(num_devices, devices=devices)
         step = make_train_step(
@@ -167,7 +180,8 @@ def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model", default="vgg11", choices=list_models())
     parser.add_argument("--strategy", default="ring",
-                        choices=["gather_scatter", "all_reduce", "ring"])
+                        choices=["gather_scatter", "all_reduce", "ring",
+                                 "fsdp"])
     parser.add_argument("--devices", default=None, type=str,
                         help="comma-separated device counts, e.g. 1,2,4,8 "
                              "(default: powers of two up to the device count)")

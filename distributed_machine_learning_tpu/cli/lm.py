@@ -20,8 +20,10 @@ entrypoint gives the transformer stack the same driveable surface, with
            (ops/ulysses.py)
   tp       tensor parallelism — Megatron layout via GSPMD
            (parallel/tensor_parallel.py)
-  pp       pipeline parallelism — GPipe ppermute pipeline
-           (parallel/pipeline.py)
+  pp       pipeline parallelism — ppermute pipeline; --pp-schedule
+           picks 1f1b (default: one backward interleaved per forward,
+           O(P) activation memory, parallel/pipeline_1f1b.py) or
+           gpipe (all-forward-then-all-backward, parallel/pipeline.py)
   3d       data × pipeline × tensor composed
            (parallel/parallel3d.py)
 
@@ -73,6 +75,13 @@ def make_parser():
     p.add_argument("--max-iters", dest="max_iters", default=40, type=int)
     p.add_argument("--microbatches", default=2, type=int,
                    help="pipeline microbatches (pp/3d)")
+    p.add_argument("--pp-schedule", dest="pp_schedule", default="1f1b",
+                   choices=["1f1b", "gpipe"],
+                   help="pipeline schedule (pp only): 1f1b interleaves "
+                        "one backward with one forward per tick — O(P) "
+                        "activation memory instead of GPipe's O(M) "
+                        "(parallel/pipeline_1f1b.py); gpipe is "
+                        "all-forward-then-all-backward")
     p.add_argument("--dp", default=None, type=int,
                    help="data-axis size for --parallel 3d "
                         "(default: devices // (pp*tp))")
@@ -310,7 +319,14 @@ def build(args):
 
         mesh = make_mesh(n, ("pipe",))
         model = TransformerLM(**common)
-        step = make_pp_lm_train_step(model, mesh, args.microbatches)
+        if args.pp_schedule == "1f1b":
+            from distributed_machine_learning_tpu.parallel.pipeline_1f1b import (  # noqa: E501
+                make_pp_1f1b_lm_train_step,
+            )
+
+            step = make_pp_1f1b_lm_train_step(model, mesh, args.microbatches)
+        else:
+            step = make_pp_lm_train_step(model, mesh, args.microbatches)
         state = shard_pp_state(init_pipeline_state(model, seed=SEED, config=opt_config), mesh)
         place = lambda x, y: microbatch(x, y, args.microbatches)
         return step, state, place, model, lambda st: st.params

@@ -182,6 +182,7 @@ def make_fsdp_train_step(
     n_elems: int,
     axis_name: str = BATCH_AXIS,
     augment: bool = True,
+    jit: bool = True,
 ):
     """Build the jitted ZeRO-3 train step.
 
@@ -190,7 +191,11 @@ def make_fsdp_train_step(
     scheme whose comparison point is DDP-style replicated DP).
 
     Returns ``step(fsdp_state, images_u8, labels) -> (fsdp_state, loss)``
-    with the batch sharded along the data axis.
+    with the batch sharded along the data axis.  ``jit=False`` returns
+    the traceable step for callers that compile it inside a larger
+    program (the bench harness's scan epoch — same convention as
+    ``make_train_step``); the donate-argnums buffer reuse only applies
+    to the jitted form.
     """
     n = mesh.shape[axis_name]
 
@@ -251,7 +256,7 @@ def make_fsdp_train_step(
         )
         return new_state, loss
 
-    return jax.jit(step, donate_argnums=(0,))
+    return jax.jit(step, donate_argnums=(0,)) if jit else step
 
 
 def make_fsdp_lm_train_step(

@@ -15,6 +15,14 @@ The backward pass needs no hand-written schedule: the transpose of
 the reverse pipeline, with XLA free to overlap the per-tick collective
 with the neighboring stage compute.
 
+What grad-of-scan FIXES, though, is the schedule: all forwards complete
+before any backward starts (GPipe), so a stage holds (or remats) every
+microbatch's activations at once — O(M) memory that caps how many
+microbatches can amortize the (P−1)/(M+P−1) bubble.  The 1F1B schedule
+(``parallel/pipeline_1f1b.py``, the CLI default) hand-writes the
+interleaved backward to cut that to O(P); this module remains the
+jax.grad-schedule reference the 1F1B step is property-tested against.
+
 Parameter layout inside ``shard_map``:
   - ``blocks``: every Block param stacked to ``[n_layers, ...]``, sharded
     ``P("pipe", ...)`` → local ``[n_layers/P, ...]``, consumed by
@@ -269,18 +277,18 @@ def _state_specs(
     )
 
 
-def make_pp_lm_train_step(
+def make_pipeline_step(
+    step_impl,
     model: TransformerLM,
     mesh: Mesh,
     num_microbatches: int,
     pipe_axis: str = PIPE_AXIS,
 ):
-    """Build ``step(state, tokens_mb, targets_mb) -> (state, loss)``.
-
-    ``tokens_mb``/``targets_mb``: [num_microbatches, mb, L] (see
-    ``microbatch``).  ``state`` from ``init_pipeline_state`` +
-    ``shard_pp_state``.  Requires ``n_layers % P == 0``.
-    """
+    """Shared pipeline step builder (GPipe and 1F1B): validation, the
+    tree-structure-keyed jit cache, and the shard_map/donate wrapper
+    around ``step_impl(model, state, tokens_mb, targets_mb, *,
+    pipe_axis, num_stages)`` — one copy so the schedules cannot drift
+    on anything but the schedule itself."""
     if model.attn_impl != "dense":
         raise ValueError("pipeline step requires attn_impl='dense'")
     if pipe_axis not in mesh.axis_names:
@@ -295,7 +303,7 @@ def make_pp_lm_train_step(
         raise ValueError("num_microbatches must be >= 1")
 
     impl = partial(
-        _pp_step_impl, model, pipe_axis=pipe_axis, num_stages=num_stages
+        step_impl, model, pipe_axis=pipe_axis, num_stages=num_stages
     )
 
     jitted: dict = {}
@@ -325,6 +333,24 @@ def make_pp_lm_train_step(
         return fn(state, tokens_mb, targets_mb)
 
     return step
+
+
+def make_pp_lm_train_step(
+    model: TransformerLM,
+    mesh: Mesh,
+    num_microbatches: int,
+    pipe_axis: str = PIPE_AXIS,
+):
+    """Build the GPipe ``step(state, tokens_mb, targets_mb) ->
+    (state, loss)``.
+
+    ``tokens_mb``/``targets_mb``: [num_microbatches, mb, L] (see
+    ``microbatch``).  ``state`` from ``init_pipeline_state`` +
+    ``shard_pp_state``.  Requires ``n_layers % P == 0``.
+    """
+    return make_pipeline_step(
+        _pp_step_impl, model, mesh, num_microbatches, pipe_axis
+    )
 
 
 def shard_pp_state(

@@ -1,0 +1,276 @@
+"""1F1B pipeline schedule — hand-written backward, O(P) activation memory.
+
+The GPipe step (``parallel/pipeline.py``) runs ALL forwards then all
+backwards: ``jax.grad`` of the forward scan fixes that schedule, and a
+stage must keep every microbatch's span activations (or remat them)
+until the backward sweep returns — activation memory O(M).  1F1B
+(PipeDream-flush — the schedule production pipelines actually use)
+interleaves: after a short warmup, every tick each stage runs ONE
+backward then ONE forward, so a stage never holds more than ~P
+in-flight microbatches regardless of M.  The bubble fraction is the
+same (P−1)/(M+P−1) as GPipe — 1F1B's win is MEMORY, which is what lets
+M grow large enough to make the bubble small.
+
+``jax.grad`` cannot express an interleaved schedule, so this module
+writes the backward by hand:
+
+- **Warmup** (P−1 ticks): forward-only GPipe ticks.  Stage s forwards
+  microbatches 0..P−2−s, storing each SPAN INPUT in a ring buffer.
+- **Steady** (M+P−1 ticks): each tick, stage s
+  1. *forwards* microbatch f = u+P−1−s (stage 0 embeds + injects;
+     masked once f ≥ M), stores its input, ppermutes the output
+     downstream;
+  2. *backwards* microbatch b = u−(P−1−s) (masked until b ≥ 0):
+     recomputes its span from the stored input under ``jax.vjp`` —
+     the recompute-from-input memory profile remat gives GPipe, but
+     scheduled per-microbatch — seeds the cotangent from the loss head
+     on the last stage or from the downstream-arrived cotangent
+     elsewhere, accumulates local param grads, and ppermutes the input
+     cotangent upstream.  Stage 0 routes its input cotangent into the
+     embedding gradient instead.
+
+Both sub-ticks live in ONE ``lax.scan`` body (masked on the tick
+index), so program size is independent of M and P — the same
+trace-once discipline as the GPipe loop.  The ring buffer holds 2P
+microbatch inputs: in-flight ids at a stage span at most 2(P−1)−2s+1,
+so id mod 2P never collides (P slots would collide for P=2 and odd P).
+
+The single vjp per tick covers every stage uniformly: it differentiates
+``(blocks, ln_f, lm_head, act) → (span_out, head_loss(span_out))`` and
+seeds ``(g_y, g_loss)`` — last stage ``(0, valid/M)``, others
+``(g_from_downstream, 0)`` — so boundary-module grads fall out masked
+without a second transpose.
+
+Update-equivalence to the GPipe step (same grads, same loss, any M, P)
+is property-tested in ``tests/test_pipeline_1f1b.py``; the state
+layout, flags, and helpers are shared with ``parallel/pipeline.py``
+(``init_pipeline_state`` / ``shard_pp_state`` / ``microbatch``).
+Beyond-parity capability: the reference has no pipeline parallelism at
+all (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from distributed_machine_learning_tpu.models.transformer import TransformerLM
+from distributed_machine_learning_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    _apply_local_span,
+    _block_module,
+)
+from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
+from distributed_machine_learning_tpu.train.optimizers import update_fn_for_config
+from distributed_machine_learning_tpu.train.state import TrainState
+
+
+def _1f1b_loss_and_grads(
+    model: TransformerLM,
+    params: dict,
+    tokens_mb,  # [M, mb, L] int32 (replicated)
+    targets_mb,  # [M, mb, L] int32
+    *,
+    pipe_axis: str,
+    num_stages: int,
+):
+    """(mean loss, grads pytree) via the hand-scheduled 1F1B pipeline."""
+    import flax.linen as nn
+
+    block = _block_module(model)
+    M, mb, L = tokens_mb.shape
+    E = model.d_model
+    S = num_stages
+    rank = lax.axis_index(pipe_axis)
+    positions = jnp.arange(L)
+    is_first = rank == 0
+    is_last = rank == S - 1
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+    BUF = 2 * S  # ring-buffer slots (see module docstring)
+
+    embed_mod = nn.Embed(model.vocab_size, E, dtype=model.compute_dtype)
+    ln_f_mod = nn.LayerNorm(dtype=model.compute_dtype)
+    head_mod = nn.Dense(model.vocab_size, dtype=model.compute_dtype)
+
+    def embed_apply(embed_params, tok):
+        return embed_mod.apply({"params": embed_params}, tok)
+
+    def span_and_loss(blocks_p, ln_f_p, head_p, act, tgt):
+        """The uniform per-stage differentiated region: span forward plus
+        the loss head on its output.  Cotangent seeding picks which of
+        the two outputs actually drives the backward on this stage.
+        ``model.remat`` checkpoints each layer inside the vjp, so the
+        recompute holds one layer's activations at a time (the same
+        knob the GPipe step honors)."""
+        y = _apply_local_span(block, blocks_p, act, positions,
+                              remat=model.remat)
+        h = ln_f_mod.apply({"params": ln_f_p}, y)
+        logits = head_mod.apply({"params": head_p}, h)
+        loss = lm_cross_entropy(logits.astype(jnp.float32), tgt)
+        return y, loss
+
+    def fwd_sub_tick(act_in, act_buf, f_id):
+        """Forward microbatch ``f_id`` (traced; masked by validity):
+        inject on stage 0, store the span input, return the span output
+        for the downstream permute and the updated buffer."""
+        f_valid = (f_id >= 0) & (f_id < M)
+        tok = lax.dynamic_index_in_dim(
+            tokens_mb, jnp.clip(f_id, 0, M - 1), keepdims=False
+        )
+        x = jnp.where(is_first & f_valid, embed_apply(params["embed"], tok),
+                      act_in)
+        act_buf = lax.dynamic_update_index_in_dim(
+            act_buf, x, f_id % BUF, axis=0
+        )
+        y = _apply_local_span(block, params["blocks"], x, positions)
+        return y, act_buf
+
+    def bwd_sub_tick(g_in, act_buf, b_id, grads, loss_acc):
+        """Backward microbatch ``b_id``: recompute the span from its
+        stored input under vjp, seed (g_y, g_loss), accumulate local
+        grads, return the upstream cotangent."""
+        b_valid = ((b_id >= 0) & (b_id < M))
+        bf = b_valid.astype(jnp.float32)
+        act = lax.dynamic_index_in_dim(act_buf, b_id % BUF, axis=0,
+                                       keepdims=False)
+        tgt = lax.dynamic_index_in_dim(
+            targets_mb, jnp.clip(b_id, 0, M - 1), keepdims=False
+        )
+        (y, loss), vjp = jax.vjp(
+            span_and_loss, params["blocks"], params["ln_f"],
+            params["lm_head"], act, tgt,
+        )
+        g_y = jnp.where(is_last | ~b_valid, jnp.zeros_like(y), g_in)
+        g_loss = jnp.where(is_last & b_valid, 1.0 / M, 0.0)
+        g_blocks, g_lnf, g_head, g_act, _ = vjp(
+            (g_y.astype(y.dtype), g_loss)
+        )
+        # Stage 0's input cotangent belongs to the embedding, not the
+        # ring: route it (masked) through the embed vjp — a scatter-add.
+        # The raw g_act still rides the wrap-around hop to the last
+        # stage, which discards it (``is_last`` seeds from the loss
+        # cotangent instead), so no extra masking is needed on the wire.
+        tok_b = lax.dynamic_index_in_dim(
+            tokens_mb, jnp.clip(b_id, 0, M - 1), keepdims=False
+        )
+        _, embed_vjp = jax.vjp(
+            lambda ep: embed_apply(ep, tok_b), params["embed"]
+        )
+        (g_embed,) = embed_vjp(
+            jnp.where(is_first & b_valid, g_act, jnp.zeros_like(g_act))
+        )
+        grads = {
+            "embed": jax.tree_util.tree_map(
+                lambda a, g: a + g, grads["embed"], g_embed
+            ),
+            "blocks": jax.tree_util.tree_map(
+                lambda a, g: a + bf * g, grads["blocks"], g_blocks
+            ),
+            "ln_f": jax.tree_util.tree_map(
+                lambda a, g: a + bf * g, grads["ln_f"], g_lnf
+            ),
+            "lm_head": jax.tree_util.tree_map(
+                lambda a, g: a + bf * g, grads["lm_head"], g_head
+            ),
+        }
+        loss_acc = loss_acc + jnp.where(is_last & b_valid, loss, 0.0)
+        return g_act, grads, loss_acc
+
+    # --- Warmup: P−1 forward-only GPipe ticks (stage s sees mb t−s). ---
+    act0 = jnp.zeros((mb, L, E), model.compute_dtype)
+    act_buf0 = jnp.zeros((BUF, mb, L, E), model.compute_dtype)
+
+    def warmup_tick(carry, t):
+        act_in, act_buf = carry
+        y, act_buf = fwd_sub_tick(act_in, act_buf, t - rank)
+        return (lax.ppermute(y, pipe_axis, perm_fwd), act_buf), None
+
+    (act_in, act_buf), _ = lax.scan(
+        warmup_tick, (act0, act_buf0), jnp.arange(S - 1)
+    )
+
+    # --- Steady: M+P−1 ticks of one forward + one backward each. ---
+    grads0 = {
+        "embed": jax.tree_util.tree_map(jnp.zeros_like, params["embed"]),
+        "blocks": jax.tree_util.tree_map(jnp.zeros_like, params["blocks"]),
+        "ln_f": jax.tree_util.tree_map(jnp.zeros_like, params["ln_f"]),
+        "lm_head": jax.tree_util.tree_map(jnp.zeros_like, params["lm_head"]),
+    }
+
+    def steady_tick(carry, u):
+        act_in, g_in, act_buf, grads, loss_acc = carry
+        # Forward first: on the last stage, microbatch u is forwarded
+        # and backwarded in the SAME tick, so its input must be stored
+        # before the backward reads it.
+        y, act_buf = fwd_sub_tick(act_in, act_buf, u + (S - 1) - rank)
+        g_act, grads, loss_acc = bwd_sub_tick(
+            g_in, act_buf, u - (S - 1) + rank, grads, loss_acc
+        )
+        return (
+            lax.ppermute(y, pipe_axis, perm_fwd),
+            lax.ppermute(g_act, pipe_axis, perm_bwd),
+            act_buf,
+            grads,
+            loss_acc,
+        ), None
+
+    g0 = jnp.zeros((mb, L, E), model.compute_dtype)
+    (_, _, _, grads, loss_acc), _ = lax.scan(
+        steady_tick,
+        (act_in, g0, act_buf, grads0, jnp.zeros((), jnp.float32)),
+        jnp.arange(M + S - 1),
+    )
+    return loss_acc / M, grads
+
+
+def _pp1f1b_step_impl(
+    model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis, num_stages
+):
+    from distributed_machine_learning_tpu.train.lars import LARSConfig
+
+    if type(state.config) is LARSConfig:
+        raise ValueError(
+            "LARS is not supported under pipeline parallelism: per-leaf "
+            "norms would be stage-local (see parallel/pipeline.py); use "
+            "sgd or adamw"
+        )
+    loss, grads = _1f1b_loss_and_grads(
+        model, state.params, tokens_mb, targets_mb,
+        pipe_axis=pipe_axis, num_stages=num_stages,
+    )
+    loss = lax.psum(loss, pipe_axis)
+    # Boundary-module grads are non-zero on one stage each — share them
+    # (identical to the GPipe step's reduction).
+    for name in ("embed", "ln_f", "lm_head"):
+        grads[name] = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, pipe_axis), grads[name]
+        )
+    new_params, new_momentum = update_fn_for_config(state.config)(
+        state.params, state.momentum, grads, state.config, step=state.step
+    )
+    new_state = state.replace(
+        params=new_params, momentum=new_momentum, step=state.step + 1
+    )
+    return new_state, loss
+
+
+def make_pp_1f1b_lm_train_step(
+    model: TransformerLM,
+    mesh: Mesh,
+    num_microbatches: int,
+    pipe_axis: str = PIPE_AXIS,
+):
+    """Build the 1F1B ``step(state, tokens_mb, targets_mb)`` — drop-in
+    for ``make_pp_lm_train_step`` (same state layout, same input
+    layout, update-equivalent; O(P) activation memory instead of O(M)).
+    """
+    from distributed_machine_learning_tpu.parallel.pipeline import (
+        make_pipeline_step,
+    )
+
+    return make_pipeline_step(
+        _pp1f1b_step_impl, model, mesh, num_microbatches, pipe_axis
+    )
