@@ -110,6 +110,96 @@ def bench_one(attn: str, args) -> tuple[float, int]:
     return tokens / best, n_params
 
 
+def bench_decode(args) -> None:
+    """Decode-path benchmark: prefill vs steady-state tokens/sec.
+
+    Methodology: build two generate fns differing only in
+    ``max_new_tokens`` (N_small, N_big); each is timed with the same
+    two-point dispatch fit as the train benches (cancelling the tunnel
+    RTT), and the per-token steady-state time is the slope
+    ``(T_big − T_small) / (N_big − N_small)`` — prefill, sampling setup,
+    and any constant overhead cancel in the subtraction.  Prefill time
+    is then ``T_small − N_small·t_tok``.  Weights are cast to the
+    compute dtype first (serving configuration: decode is bound by HBM
+    reads of weights + KV cache, so fp32 master params would halve
+    throughput).
+    """
+    from distributed_machine_learning_tpu.inference.generate import (
+        make_generate_fn,
+    )
+
+    model = TransformerLM(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        kv_cache_dtype=(
+            jnp.dtype(args.kv_cache_dtype) if args.kv_cache_dtype else None
+        ),
+    )
+    state = init_lm_state(model)
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p,
+        state.params,
+    )
+    rng = np.random.default_rng(0)
+    prompt = jax.device_put(jnp.asarray(
+        rng.integers(0, args.vocab, (args.batch, args.prompt_len)),
+        jnp.int32,
+    ))
+    key = jax.random.PRNGKey(0)
+
+    n_small, n_big = 32, args.gen_tokens
+    if n_big <= n_small:
+        raise ValueError(f"--gen-tokens must exceed {n_small}")
+    from distributed_machine_learning_tpu.bench.harness import two_point_fit
+
+    def timed_for(n_tokens):
+        fn = make_generate_fn(model, n_tokens, temperature=0.0)
+        out = fn(params, prompt, key)
+        jax.block_until_ready(out)
+
+        def timed(n_dispatches):
+            best = float("inf")
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                for _ in range(n_dispatches):
+                    out = fn(params, prompt, key)
+                np.asarray(out[0, -1])  # host fetch drains the queue
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        return two_point_fit(timed, args.chain)
+
+    t_small = timed_for(n_small)
+    t_big = timed_for(n_big)
+    t_tok = (t_big - t_small) / (n_big - n_small)
+    # An n-token generate runs n−1 scanned decode steps (token 0 comes
+    # from the prefill logits), so prefill = T − (n−1)·t_tok.
+    t_prefill = max(t_small - (n_small - 1) * t_tok, 0.0)
+    print(json.dumps({
+        "metric": "lm_decode_tokens_per_sec",
+        "value": round(args.batch / t_tok, 1),
+        "unit": "tokens/sec",
+        "per_sequence_tokens_per_sec": round(1.0 / t_tok, 1),
+        "prefill_tokens_per_sec": round(
+            args.batch * args.prompt_len / t_prefill, 1
+        ) if t_prefill > 0 else None,
+        "prefill_ms": round(t_prefill * 1e3, 2),
+        "ms_per_decode_step": round(t_tok * 1e3, 3),
+        "config": {
+            "d_model": args.d_model, "n_layers": args.n_layers,
+            "n_heads": args.n_heads, "n_kv_heads": args.n_kv_heads,
+            "vocab": args.vocab, "batch": args.batch,
+            "prompt_len": args.prompt_len, "gen_tokens": args.gen_tokens,
+            "bf16": args.bf16, "kv_cache_dtype": args.kv_cache_dtype,
+        },
+    }))
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--attn", default="dense",
@@ -135,7 +225,19 @@ def main() -> None:
                         "i.e. it is MFU not HFU")
     p.add_argument("--fp32", dest="bf16", action="store_false",
                    help="run the trunk in fp32 (default bfloat16)")
+    p.add_argument("--decode", action="store_true",
+                   help="benchmark the KV-cached decode path instead of "
+                        "the train step (prefill vs steady-state tok/s)")
+    p.add_argument("--prompt-len", dest="prompt_len", default=2048, type=int)
+    p.add_argument("--gen-tokens", dest="gen_tokens", default=160, type=int)
+    p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype", default=None,
+                   help="decode KV-cache storage dtype ablation "
+                        "(e.g. float32; default = compute dtype)")
     args = p.parse_args()
+
+    if args.decode:
+        bench_decode(args)
+        return
 
     from distributed_machine_learning_tpu.utils.flops import (
         mfu,

@@ -57,30 +57,40 @@ def _repeat_kv(t: jax.Array, n_rep: int) -> jax.Array:
 
 
 def _cached_attention(q, k_cache, v_cache, q_positions):
-    """Attention of fresh queries against the full K/V cache.
+    """Attention of fresh queries against the full K/V cache, GQA-native.
 
     ``q``: [B, Lq, H, D] at absolute positions ``q_positions`` ([Lq]);
-    ``k_cache``/``v_cache``: [B, S, H, D] where slot j holds position j
-    (zeros beyond the write frontier — masked out by causality, since
-    unwritten slots all have j > max(q_positions)).  fp32 softmax, dtype
-    preserved — matching :func:`dense_self_attention`.
+    ``k_cache``/``v_cache``: [B, S, Hkv, D] (Hkv | H) where slot j holds
+    position j (zeros beyond the write frontier — masked out by
+    causality, since unwritten slots all have j > max(q_positions)).
+    fp32 softmax, dtype preserved — matching
+    :func:`dense_self_attention`.
+
+    The query heads are RESHAPED into [Hkv, rep] groups and contracted
+    against the narrow cache directly — no widened K/V is ever
+    materialized.  Decode is bound by HBM reads of weights + cache, and
+    a ``jnp.repeat`` of the cache every step would re-write (and
+    re-read) rep× the cache bytes, forfeiting exactly the bandwidth GQA
+    buys.
     """
     B, Lq, H, D = q.shape
-    S = k_cache.shape[1]
+    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    rep = H // Hkv
+    qg = q.astype(jnp.float32).reshape(B, Lq, Hkv, rep, D)
     s = jnp.einsum(
-        "bqhd,bkhd->bhqk",
-        q.astype(jnp.float32),
+        "bqhrd,bkhd->bhrqk",
+        qg,
         k_cache.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     ) * (1.0 / (D**0.5))
     mask = jnp.arange(S)[None, :] <= q_positions[:, None]  # [Lq, S]
-    s = jnp.where(mask[None, None], s, -jnp.inf)
+    s = jnp.where(mask[None, None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v_cache.astype(jnp.float32),
+        "bhrqk,bkhd->bqhrd", p, v_cache.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
-    return out.astype(q.dtype)
+    return out.reshape(B, Lq, H, D).astype(q.dtype)
 
 
 def _flash_wins(L: int) -> bool:
@@ -140,6 +150,11 @@ class Attention(nn.Module):
     # keeps classic MHA with the fused qkv projection (and its param
     # layout — existing checkpoints are untouched).
     n_kv_heads: int | None = None
+    # Decode KV-cache storage dtype (None = the K/V compute dtype).
+    # Decode is bound by HBM reads of the cache, so a narrower cache
+    # dtype is a direct bandwidth lever; attention math stays fp32
+    # either way (_cached_attention upcasts).
+    kv_cache_dtype: Any = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -177,18 +192,46 @@ class Attention(nn.Module):
             # (init runs with a [B, max_len] input — generate.py).  Keys
             # are RoPE-rotated at their absolute position before being
             # written, so cached entries never need re-rotation.
-            ck = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            cache_dtype = self.kv_cache_dtype or k.dtype
+            ck = self.variable(
+                "cache", "cached_key", jnp.zeros, k.shape, cache_dtype
+            )
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros, v.shape, cache_dtype
+            )
             if not self.is_initializing():
                 start = positions[0]
-                ck.value = lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
-                cv.value = lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
-                out = _cached_attention(
-                    q,
-                    _repeat_kv(ck.value, n_rep),
-                    _repeat_kv(cv.value, n_rep),
-                    positions,
+                ck.value = lax.dynamic_update_slice(
+                    ck.value, k.astype(cache_dtype), (0, start, 0, 0)
                 )
+                cv.value = lax.dynamic_update_slice(
+                    cv.value, v.astype(cache_dtype), (0, start, 0, 0)
+                )
+                if L > 1:
+                    # PREFILL (the one multi-token call, at start == 0 —
+                    # generate.py's contract): the cache was empty, so
+                    # attention over the prompt is plain causal
+                    # self-attention over the fresh K/V.  Routing it
+                    # through the training kernels instead of
+                    # _cached_attention avoids materializing the f32
+                    # [B, H, L, S] score tensor against the whole cache
+                    # (34 GB at an 8k prompt) — flash when the length
+                    # qualifies, dense below.
+                    if _flash_wins(L):
+                        from distributed_machine_learning_tpu.ops.pallas.flash_attention import (  # noqa: E501
+                            flash_self_attention,
+                        )
+
+                        out = flash_self_attention(q, k, v)
+                    else:
+                        out = dense_self_attention(
+                            q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
+                            positions,
+                        )
+                else:
+                    # Narrow cache straight into the GQA-native cached
+                    # attention — no repeat, no widened materialization.
+                    out = _cached_attention(q, ck.value, cv.value, positions)
             else:
                 out = dense_self_attention(
                     q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), positions
@@ -249,6 +292,7 @@ class Block(nn.Module):
     mlp_factory: Any = None  # () -> nn.Module, or None for the dense MLP
     decode: bool = False
     n_kv_heads: int | None = None
+    kv_cache_dtype: Any = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -260,6 +304,7 @@ class Block(nn.Module):
             compute_dtype=self.compute_dtype,
             decode=self.decode,
             n_kv_heads=self.n_kv_heads,
+            kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
         )(h, positions)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
@@ -294,6 +339,9 @@ class TransformerLM(nn.Module):
     # query heads (1 = MQA) — the decode KV cache shrinks by the group
     # factor.  None = classic MHA (fused qkv param layout).
     n_kv_heads: int | None = None
+    # Decode KV-cache storage dtype (None = compute dtype); see
+    # ``Attention.kv_cache_dtype``.
+    kv_cache_dtype: Any = None
     remat: bool = False  # jax.checkpoint each block: activation memory
     # drops from O(L·E) per layer to per-block boundaries, recomputing the
     # block in backward — the HBM-for-FLOPs trade that lets long-context
@@ -347,6 +395,7 @@ class TransformerLM(nn.Module):
                 compute_dtype=self.compute_dtype,
                 decode=self.decode,
                 n_kv_heads=self.n_kv_heads,
+                kv_cache_dtype=self.kv_cache_dtype,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)

@@ -204,22 +204,27 @@ def _pipeline_forward_loss(
     return loss_acc / M
 
 
-def _pp_step_impl(
-    model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis, num_stages
-):
+def _reject_lars(config) -> None:
+    """Shared guard for every pipeline schedule: inside the shard_map
+    each device's "blocks" leaves are only its stage's slice, so LARS's
+    per-leaf norms would be stage-local and the trust ratios would
+    change with the stage count — the same flat-slice inexactness
+    ZeRO-1/FSDP refuse (zero1.py / fsdp.py)."""
     from distributed_machine_learning_tpu.train.lars import LARSConfig
 
-    if type(state.config) is LARSConfig:
-        # Inside this shard_map each device's "blocks" leaves are only its
-        # stage's slice, so LARS's per-leaf norms would be stage-local and
-        # the trust ratios would change with the stage count — the same
-        # flat-slice inexactness ZeRO-1/FSDP refuse (zero1.py / fsdp.py).
+    if type(config) is LARSConfig:
         raise ValueError(
             "LARS is not supported under pipeline/3-D parallelism: "
             "per-leaf weight/grad norms would be computed on per-stage "
             "slices; use sgd or adamw (elementwise updates are exact on "
             "any slice)"
         )
+
+
+def _pp_step_impl(
+    model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis, num_stages
+):
+    _reject_lars(state.config)
     loss_fn = partial(
         _pipeline_forward_loss,
         model,

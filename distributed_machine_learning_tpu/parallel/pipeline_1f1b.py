@@ -229,14 +229,11 @@ def _1f1b_loss_and_grads(
 def _pp1f1b_step_impl(
     model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis, num_stages
 ):
-    from distributed_machine_learning_tpu.train.lars import LARSConfig
+    from distributed_machine_learning_tpu.parallel.pipeline import (
+        _reject_lars,
+    )
 
-    if type(state.config) is LARSConfig:
-        raise ValueError(
-            "LARS is not supported under pipeline parallelism: per-leaf "
-            "norms would be stage-local (see parallel/pipeline.py); use "
-            "sgd or adamw"
-        )
+    _reject_lars(state.config)
     loss, grads = _1f1b_loss_and_grads(
         model, state.params, tokens_mb, targets_mb,
         pipe_axis=pipe_axis, num_stages=num_stages,
