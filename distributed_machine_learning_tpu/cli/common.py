@@ -324,23 +324,47 @@ def run_part(
                         init_model_and_state(model, config=saved_cfg)
                     )
                 )
-                try:
-                    state = restore_checkpoint(latest, abstract_state=abstract)
-                except Exception:
-                    if not unsync_bn:
-                        raise
-                    # The checkpoint predates --unsync-bn (unstacked [C]
-                    # stats): restore against the plain template, then
-                    # enter quirk mode by stacking the restored stats.
-                    plain = init_model_and_state(
-                        model,
-                        config=saved_cfg
-                        if type(saved_cfg) is not type(opt_config)
-                        else opt_config,
+                # In quirk mode, pick the restore template by the SAVED
+                # stats layout — a metadata read (no array IO) — rather
+                # than retrying on a blanket except, which would also
+                # mask unrelated restore failures (corrupt checkpoint,
+                # dtype/optimizer mismatch) behind a second confusing
+                # error.
+                restore_against = abstract
+                stack_after = False
+                if unsync_bn:
+                    from distributed_machine_learning_tpu.train.checkpoint import (  # noqa: E501
+                        checkpoint_array_shapes,
                     )
-                    state = _maybe_stack(
-                        restore_checkpoint(latest, abstract_state=plain)
+
+                    saved_stats = checkpoint_array_shapes(latest).get(
+                        "batch_stats"
+                    ) or {}
+                    saved_leaves = jax.tree_util.tree_leaves(
+                        saved_stats, is_leaf=lambda x: isinstance(x, tuple)
                     )
+                    want_leaves = jax.tree_util.tree_leaves(
+                        abstract.batch_stats
+                    )
+                    if (saved_leaves and want_leaves
+                            and len(saved_leaves[0])
+                            < want_leaves[0].ndim):
+                        # The checkpoint predates --unsync-bn (plain [C]
+                        # stats): restore against the plain template,
+                        # then enter quirk mode by stacking the restored
+                        # stats.
+                        restore_against = init_model_and_state(
+                            model,
+                            config=saved_cfg
+                            if type(saved_cfg) is not type(opt_config)
+                            else opt_config,
+                        )
+                        stack_after = True
+                state = restore_checkpoint(
+                    latest, abstract_state=restore_against
+                )
+                if stack_after:
+                    state = _maybe_stack(state)
                 rank0_print(f"Resumed from {latest} (step "
                             f"{int(jax.device_get(state.step))})")
                 want = opt_config

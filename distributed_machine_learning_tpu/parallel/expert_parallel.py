@@ -101,9 +101,34 @@ def make_ep_train_step(
             "(dense/flash/auto): the sequence is not sharded here, so the "
             "ring/ulysses impls have no axis to run over"
         )
-    impl = partial(_moe_step_impl, model)
     if mesh is None:
-        return jax.jit(impl, donate_argnums=(0,))
+        return jax.jit(partial(_moe_step_impl, model), donate_argnums=(0,))
+    if model.attn_impl in ("flash", "auto"):
+        from distributed_machine_learning_tpu.ops.pallas.flash_attention import (  # noqa: E501
+            _interpret,
+        )
+
+        if model.attn_impl == "auto" and not _interpret():
+            # "auto" picks flash at >=512 context inside the model, which
+            # would hit the same unpartitionable-custom-call problem as
+            # explicit flash below — resolve to dense on TPU meshes (the
+            # tp/pp precedent; parameter structure is identical).
+            model = model.clone(attn_impl="dense")
+        elif model.attn_impl == "flash" and not _interpret():
+            # A Pallas (Mosaic) custom call inside this GSPMD-partitioned
+            # jit has no sharding rules: on a real TPU mesh the
+            # partitioner may reject it or silently replicate the
+            # attention — neither is acceptable for a scheme whose point
+            # is sharding.  Flash-in-EP is verified in interpreter mode
+            # only (the CPU-mesh tests lower the kernel to plain XLA
+            # ops); on TPU use dense, or wrap the kernel in shard_map
+            # with explicit specs before lifting this.
+            raise ValueError(
+                "expert-parallel + flash attention is interpret-verified "
+                "only; on a TPU mesh use attn_impl='dense' (or 'auto', "
+                "which resolves to dense here)"
+            )
+    impl = partial(_moe_step_impl, model)
     for a in (data_axis, expert_axis):
         if a not in mesh.axis_names:
             raise ValueError(f"mesh is missing axis {a!r}: {mesh.axis_names}")

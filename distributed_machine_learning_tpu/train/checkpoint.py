@@ -164,6 +164,20 @@ def checkpoint_config(path: str | os.PathLike):
     )
 
 
+def checkpoint_array_shapes(path: str | os.PathLike) -> dict:
+    """Shapes of the arrays a checkpoint holds — a pure metadata read
+    (no array IO).  For callers that must pick a restore template by the
+    SAVED layout (e.g. ``--unsync-bn``'s stacked ``[world, C]`` BN stats
+    vs a pre-quirk checkpoint's plain ``[C]``) instead of fishing
+    structure mismatches out of a blanket except."""
+    path = os.path.abspath(os.fspath(path))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        meta = ckptr.metadata(os.path.join(path, _STATE_DIR))
+    tree = meta.item_metadata
+    tree = tree.tree if hasattr(tree, "tree") else tree
+    return jax.tree_util.tree_map(lambda m: tuple(m.shape), tree)
+
+
 def restore_checkpoint(
     path: str | os.PathLike, abstract_state: TrainState | None = None
 ) -> TrainState:

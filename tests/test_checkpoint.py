@@ -128,3 +128,31 @@ def test_async_checkpoint_roundtrip(tmp_path, rng):
                     jax.tree_util.tree_leaves(restored.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert type(restored.config) is type(state.config)
+
+
+@pytest.mark.slow
+def test_resume_plain_checkpoint_into_unsync_bn_quirk(tmp_path):
+    """Cross-layout resume: a checkpoint saved with plain synced-BN [C]
+    stats restores into --unsync-bn quirk mode (stacked [world, C]) via
+    the metadata-inspected template pick in cli/common.py — no blanket
+    except, and a corrupt checkpoint would surface its real error."""
+    from distributed_machine_learning_tpu.cli import part3
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        checkpoint_array_shapes,
+        latest_checkpoint,
+    )
+
+    common = ["--batch-size", "4", "--max-iters", "2", "--model", "vggtest",
+              "--eval-batches", "0", "--eval-batch-size", "16",
+              "--data-root", str(tmp_path), "--ckpt-dir", str(tmp_path / "ck")]
+    part3.main(common)  # plain synced-BN run writes the checkpoint
+    latest = latest_checkpoint(tmp_path / "ck")
+    assert latest is not None
+    stats_shapes = checkpoint_array_shapes(latest)["batch_stats"]
+    first = jax.tree_util.tree_leaves(
+        stats_shapes, is_leaf=lambda x: isinstance(x, tuple)
+    )[0]
+    assert len(first) == 1  # plain [C] layout on disk
+    # Resume the same run in quirk mode: restore must go through the
+    # plain template then stack per-device stats rows.
+    part3.main(common + ["--resume", "--unsync-bn"])

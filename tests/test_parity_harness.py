@@ -1,0 +1,44 @@
+"""The real-data parity harness (cli/parity.py), smoke-tested on the
+synthetic stand-in: the one-command runner must drive a part through
+the reference protocol, parse its print surface, and emit the
+side-by-side rows — so the harness is proven now and real numbers land
+whenever a host with cifar-10-batches-py exists (VERDICT r02 item 6).
+"""
+
+import json
+
+import pytest
+
+
+def test_parity_harness_part1(tmp_path, capsys):
+    from distributed_machine_learning_tpu.cli.parity import main
+
+    out_json = tmp_path / "parity.json"
+    main([
+        "--parts", "part1", "--max-iters", "3", "--batch-size", "4",
+        "--eval-batches", "1", "--eval-batch-size", "16",
+        "--model", "vggtest", "--data-root", str(tmp_path),
+        "--json", str(out_json),
+    ])
+    out = capsys.readouterr().out
+    assert "part1" in out and "ref/ours" in out
+    assert "synthetic" in out  # no dataset in this environment
+    rows = json.loads(out_json.read_text())
+    assert rows[0]["part"] == "part1"
+    got = rows[0]["measured"]
+    # The protocol surface parsed: times AND the part1 eval numbers.
+    assert {"total_s", "avg_iter_s", "avg_test_loss", "accuracy_pct"} <= set(got)
+    assert rows[0]["reference"]["avg_test_loss"] == 2.3031
+
+
+def test_parity_harness_rejects_unknown_part(tmp_path):
+    from distributed_machine_learning_tpu.cli.parity import (
+        make_parser,
+        run_parity,
+    )
+
+    args = make_parser().parse_args(
+        ["--parts", "part9", "--data-root", str(tmp_path)]
+    )
+    with pytest.raises(ValueError, match="part9"):
+        run_parity(args)
