@@ -1,0 +1,151 @@
+"""Text generation entrypoint — serve a checkpoint trained by ``cli.lm``.
+
+The reference has no inference surface at all (SURVEY.md §2 — its
+``test_model`` is classification eval); this CLI completes the LM
+serving loop the framework adds: restore a ``cli.lm --ckpt-dir``
+checkpoint, encode the prompt with the same byte-level scheme the
+trainer's ``--data-dir`` corpora use (``data/text.py``: vocab 256 bytes
++ BOS), and run the KV-cached jitted generate loop
+(``inference/generate.py`` — flash prefill, GQA-native narrow-cache
+decode).
+
+Usage::
+
+    python -m distributed_machine_learning_tpu.cli.generate \
+        --ckpt-dir runs/lm --prompt "The " --max-new-tokens 128 \
+        --d-model 256 --n-layers 4 --n-heads 8   # match the training run
+
+Model flags must match the training run (the checkpoint stores arrays,
+not architecture).  Pipeline-layout checkpoints (``--parallel pp/3d``)
+are detected by their stacked ``blocks`` tree and unstacked
+automatically.  ``--random-init`` serves an untrained model (demo /
+smoke path — no checkpoint needed).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="directory written by cli.lm --ckpt-dir")
+    p.add_argument("--random-init", action="store_true",
+                   help="serve freshly initialized weights (no checkpoint)")
+    p.add_argument("--prompt", default="The ")
+    p.add_argument("--max-new-tokens", dest="max_new_tokens", default=128,
+                   type=int)
+    p.add_argument("--temperature", default=1.0, type=float,
+                   help="0 = greedy decoding")
+    p.add_argument("--top-k", dest="top_k", default=None, type=int)
+    p.add_argument("--seed", default=0, type=int)
+    # Architecture flags — must match the training run.
+    p.add_argument("--d-model", dest="d_model", default=256, type=int)
+    p.add_argument("--n-layers", dest="n_layers", default=4, type=int)
+    p.add_argument("--n-heads", dest="n_heads", default=8, type=int)
+    p.add_argument("--n-kv-heads", dest="n_kv_heads", default=None, type=int)
+    p.add_argument("--vocab", default=None, type=int,
+                   help="default: byte-level 257 (data/text.py)")
+    p.add_argument("--compute-dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype", default=None,
+                   help="decode cache storage dtype (default: compute "
+                        "dtype)")
+    return p
+
+
+def main(argv=None) -> None:
+    args = make_parser().parse_args(argv)
+    if not args.ckpt_dir and not args.random_init:
+        raise ValueError("pass --ckpt-dir (a cli.lm checkpoint) or "
+                         "--random-init")
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.data.text import BOS, VOCAB_SIZE
+    from distributed_machine_learning_tpu.inference.generate import (
+        make_generate_fn,
+    )
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+
+    vocab = args.vocab or VOCAB_SIZE
+    dtype = (jnp.bfloat16 if args.compute_dtype == "bfloat16"
+             else jnp.float32)
+    model = TransformerLM(
+        vocab_size=vocab,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
+        compute_dtype=dtype,
+        kv_cache_dtype=(
+            jnp.dtype(args.kv_cache_dtype) if args.kv_cache_dtype else None
+        ),
+    )
+
+    if args.ckpt_dir:
+        from distributed_machine_learning_tpu.train.checkpoint import (
+            latest_checkpoint,
+            restore_checkpoint,
+        )
+
+        latest = latest_checkpoint(args.ckpt_dir)
+        if latest is None:
+            raise FileNotFoundError(
+                f"no complete checkpoint under {args.ckpt_dir}"
+            )
+        params = restore_checkpoint(latest).params
+        if "blocks" in params:
+            # Pipeline-layout checkpoint: blocks stacked on a leading
+            # layer axis — restore the per-layer tree the plain apply
+            # expects.
+            from distributed_machine_learning_tpu.parallel.pipeline import (
+                unstack_lm_params,
+            )
+
+            params = unstack_lm_params(params, args.n_layers)
+        print(f"restored {latest}")
+    else:
+        from distributed_machine_learning_tpu.train.lm_step import (
+            init_lm_state,
+        )
+
+        params = init_lm_state(model).params
+        print("WARNING: --random-init weights (untrained output)")
+    # Serving configuration: cast fp32 master params to the compute
+    # dtype (decode is bound by HBM weight reads).
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params
+    )
+
+    # Byte-level prompt encoding, BOS-prefixed like every corpus
+    # document (data/text.py::load_corpus).
+    prompt_bytes = args.prompt.encode("utf-8")
+    if vocab == VOCAB_SIZE:
+        toks = [BOS] + list(prompt_bytes)
+    else:
+        toks = [b % vocab for b in prompt_bytes] or [0]
+    prompt = jnp.asarray(np.asarray(toks, np.int32)[None, :])
+
+    fn = make_generate_fn(model, args.max_new_tokens,
+                          temperature=args.temperature, top_k=args.top_k)
+    out = np.asarray(
+        fn(params, prompt, jax.random.PRNGKey(args.seed))
+    )[0, prompt.shape[1]:]
+    if vocab == VOCAB_SIZE:
+        text = bytes(t for t in out.tolist() if t < 256).decode(
+            "utf-8", errors="replace"
+        )
+    else:
+        text = " ".join(str(t) for t in out.tolist())
+    print(args.prompt + text)
+
+
+if __name__ == "__main__":
+    main()

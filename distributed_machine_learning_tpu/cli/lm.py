@@ -75,6 +75,16 @@ def make_parser():
     p.add_argument("--max-iters", dest="max_iters", default=40, type=int)
     p.add_argument("--microbatches", default=2, type=int,
                    help="pipeline microbatches (pp/3d)")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="save the trained state here (orbax, sharded "
+                        "global arrays as-is); restores with --resume. "
+                        "All schemes except the flat-vector fsdp (whose "
+                        "FSDPState is not a TrainState; use fsdp_pl for "
+                        "checkpointable ZeRO-3)")
+    p.add_argument("--resume", action="store_true",
+                   help="restore the latest checkpoint in --ckpt-dir "
+                        "before training (same scheme + optimizer as "
+                        "the save)")
     p.add_argument("--pp-schedule", dest="pp_schedule", default="1f1b",
                    choices=["1f1b", "gpipe"],
                    help="pipeline schedule (pp only): 1f1b interleaves "
@@ -151,7 +161,7 @@ def build(args):
     n = jax.device_count()
     dtype = jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
     attn = getattr(args, "attn", "auto")
-    if args.parallel in ("tp", "pp", "3d", "fsdp_pl") and attn == "auto":
+    if args.parallel in ("tp", "pp", "3d", "fsdp", "fsdp_pl") and attn == "auto":
         # The pipeline/tensor-parallel steps own their sharding and
         # require the dense attention path (a Pallas call inside a
         # GSPMD-partitioned or ppermute-pipelined program would need its
@@ -448,12 +458,81 @@ def main(argv=None) -> None:
                     )
                     yield block[:, :-1], block[:, 1:]
 
+        if args.ckpt_dir and args.parallel == "fsdp":
+            raise ValueError(
+                "--ckpt-dir does not support the flat-vector fsdp state "
+                "(FSDPState is not a TrainState); use --parallel fsdp_pl "
+                "for checkpointable ZeRO-3"
+            )
+        if args.resume:
+            from distributed_machine_learning_tpu.train.checkpoint import (
+                checkpoint_config,
+                latest_checkpoint,
+                restore_checkpoint,
+            )
+
+            if not args.ckpt_dir:
+                raise ValueError("--resume requires --ckpt-dir")
+            latest = latest_checkpoint(args.ckpt_dir)
+            if latest is None:
+                rank0_print(f"No checkpoint under {args.ckpt_dir}; "
+                            "starting from scratch.")
+            else:
+                saved_cfg = checkpoint_config(latest)
+                if type(saved_cfg) is not type(state.config):
+                    raise ValueError(
+                        f"checkpoint was trained with "
+                        f"{type(saved_cfg).__name__} but this run uses "
+                        f"--optimizer {args.optimizer}; the LM resume "
+                        "path requires a matching optimizer (the CNN "
+                        "parts' cross-optimizer reset lives in "
+                        "cli/common.py)"
+                    )
+                # The placed state doubles as the abstract template, so
+                # sharded leaves (fsdp_pl/tp/pp) restore straight into
+                # their shardings.  Leaves the scheme keeps UNCOMMITTED
+                # (dp/ring's replicated state under shard_map) must stay
+                # uncommitted — a restore pins them to one device, which
+                # then conflicts with the mesh-sharded batch at dispatch
+                # — so those take a host round-trip back to plain
+                # relocatable arrays.
+                import jax.numpy as _jnp
+
+                restored = restore_checkpoint(latest, abstract_state=state)
+                # This run's hyperparameters win (same semantics as the
+                # CNN path): carrying the current config also keeps the
+                # static config leaves identical for the tree_map below,
+                # which would otherwise reject two TrainStates whose
+                # configs differ in any field (e.g. a routine --lr
+                # adjustment on resume).
+                restored = restored.replace(config=state.config)
+
+                def _match_commitment(orig, new):
+                    if getattr(orig, "committed", True):
+                        return new
+                    return _jnp.asarray(jax.device_get(new))
+
+                state = jax.tree_util.tree_map(
+                    _match_commitment, state, restored
+                )
+                rank0_print(
+                    f"Resumed from {latest} (step "
+                    f"{int(jax.device_get(state.step))})"
+                )
+
         # The shared driver owns the measurement protocol (iter-0-excluded
         # timing, loss cadence, summary format) — one copy for CNN and LM.
         state, _ = train_epoch(
             step, state, batches(), place_batch=place,
             max_iters=args.max_iters,
         )
+        if args.ckpt_dir:
+            from distributed_machine_learning_tpu.train.checkpoint import (
+                save_checkpoint,
+            )
+
+            path = save_checkpoint(args.ckpt_dir, state)
+            rank0_print(f"Saved checkpoint to {path}")
         if args.eval_batches:
             from distributed_machine_learning_tpu.data.text import (
                 eval_windows,

@@ -1,0 +1,79 @@
+"""LM checkpoint/resume through cli.lm and serving through cli.generate
+— the train → save → resume → generate loop a user of the framework
+actually runs (the LM-side analogue of the CNN parts' --ckpt-dir
+coverage in test_checkpoint.py)."""
+
+import os
+
+import pytest
+
+
+def _corpus(tmp_path):
+    d = tmp_path / "corpus"
+    d.mkdir()
+    (d / "a.txt").write_text("hello tpu world. " * 200)
+    return str(d)
+
+
+def test_lm_train_save_resume_generate(tmp_path, capsys):
+    from distributed_machine_learning_tpu.cli import generate, lm
+
+    ck = str(tmp_path / "ck")
+    common = ["--parallel", "dp", "--d-model", "32", "--n-layers", "1",
+              "--n-heads", "2", "--seq-len", "32", "--batch-size", "8",
+              "--max-iters", "2", "--data-dir", _corpus(tmp_path),
+              "--ckpt-dir", ck]
+    lm.main(common)
+    out = capsys.readouterr().out
+    assert "Saved checkpoint to" in out
+    assert os.path.isdir(ck)
+
+    lm.main(common + ["--resume"])
+    out = capsys.readouterr().out
+    assert "Resumed from" in out and "step 2" in out
+
+    generate.main([
+        "--ckpt-dir", ck, "--prompt", "hel", "--max-new-tokens", "8",
+        "--temperature", "0", "--d-model", "32", "--n-layers", "1",
+        "--n-heads", "2", "--compute-dtype", "float32",
+    ])
+    out = capsys.readouterr().out
+    assert "restored" in out
+    # The untrained-ish model may emit line-break bytes; assert the
+    # prompt-prefixed output line exists rather than parsing the tail.
+    assert any(line.startswith("hel") for line in out.splitlines())
+
+
+def test_lm_resume_optimizer_mismatch_raises(tmp_path):
+    from distributed_machine_learning_tpu.cli import lm
+
+    ck = str(tmp_path / "ck")
+    base = ["--parallel", "dp", "--d-model", "32", "--n-layers", "1",
+            "--n-heads", "2", "--seq-len", "16", "--batch-size", "8",
+            "--max-iters", "2", "--ckpt-dir", ck]
+    lm.main(base + ["--optimizer", "adamw"])
+    with pytest.raises(ValueError, match="matching optimizer"):
+        lm.main(base + ["--optimizer", "sgd", "--resume"])
+
+
+def test_lm_flat_fsdp_ckpt_refused(tmp_path):
+    from distributed_machine_learning_tpu.cli import lm
+
+    with pytest.raises(ValueError, match="fsdp_pl"):
+        lm.main(["--parallel", "fsdp", "--d-model", "32", "--n-layers", "1",
+                 "--n-heads", "2", "--seq-len", "16", "--batch-size", "8",
+                 "--max-iters", "2", "--ckpt-dir", str(tmp_path / "ck")])
+
+
+def test_lm_resume_with_adjusted_lr(tmp_path):
+    """Resuming with a different learning rate (same optimizer) is a
+    routine operation — the static config must not poison the
+    restored-state tree_map."""
+    from distributed_machine_learning_tpu.cli import lm
+
+    ck = str(tmp_path / "ck")
+    base = ["--parallel", "dp", "--d-model", "32", "--n-layers", "1",
+            "--n-heads", "2", "--seq-len", "16", "--batch-size", "8",
+            "--max-iters", "2", "--ckpt-dir", ck]
+    lm.main(base)
+    lm.main(base + ["--resume", "--lr", "0.05"])
