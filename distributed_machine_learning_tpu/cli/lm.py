@@ -161,7 +161,7 @@ def build(args):
     n = jax.device_count()
     dtype = jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
     attn = getattr(args, "attn", "auto")
-    if args.parallel in ("tp", "pp", "3d", "fsdp", "fsdp_pl") and attn == "auto":
+    if args.parallel in ("tp", "pp", "3d", "fsdp") and attn == "auto":
         # The pipeline/tensor-parallel steps own their sharding and
         # require the dense attention path (a Pallas call inside a
         # GSPMD-partitioned or ppermute-pipelined program would need its

@@ -106,6 +106,8 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
         attn_impl=model.attn_impl,
         seq_axis="seq",
         compute_dtype=model.compute_dtype,
+        flash_mesh=model.flash_mesh,
+        flash_batch_axis=model.flash_batch_axis,
         mlp_factory=lambda: MoEMLP(
             n_experts=model.n_experts,
             d_ff=model.d_ff or 4 * model.d_model,
@@ -133,6 +135,9 @@ class MoETransformerLM(nn.Module):
     # impls (ring/ring_flash/ulysses) stay unsupported — the EP mesh has
     # no seq axis to shard over.
     attn_impl: str = "dense"
+    # Flash-under-GSPMD composition; see ``transformer.Attention``.
+    flash_mesh: Any = None
+    flash_batch_axis: str = "batch"
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):

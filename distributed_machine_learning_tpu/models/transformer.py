@@ -155,6 +155,15 @@ class Attention(nn.Module):
     # dtype is a direct bandwidth lever; attention math stays fp32
     # either way (_cached_attention upcasts).
     kv_cache_dtype: Any = None
+    # When set (a jax.sharding.Mesh), the flash kernel runs inside a
+    # partial-manual shard_map with the batch dim sharded over
+    # ``flash_batch_axis`` — how flash composes with the
+    # GSPMD-partitioned steps (fsdp_pl / expert parallel), whose jit
+    # could not otherwise partition the Mosaic custom call.  The
+    # activations must really be batch-sharded over that axis (the
+    # shard_map constrains them if the partitioner chose otherwise).
+    flash_mesh: Any = None
+    flash_batch_axis: str = "batch"
 
     @nn.compact
     def __call__(self, x, positions):
@@ -269,7 +278,33 @@ class Attention(nn.Module):
 
             # GQA stays narrow: the kernel's K/V index maps divide by the
             # group factor, so no repeated K/V ever hits HBM.
-            out = flash_self_attention(q, k, v)
+            if self.flash_mesh is not None:
+                # Inside a GSPMD-partitioned step (fsdp_pl / EP) the
+                # Mosaic custom call has no sharding rules — so run it
+                # under a FULLY-manual shard_map over the whole mesh:
+                # the kernel then sees LOCAL per-device shapes and never
+                # meets the partitioner on ANY axis.  Batch is the only
+                # sharded dim; activations are replicated over every
+                # other mesh axis (e.g. EP's expert axis), which the
+                # unmentioned-axis convention expresses as-is.  (Manual
+                # over just the batch axis would leave the custom call
+                # under automatic propagation for the remaining axes —
+                # the hazard this wrap exists to remove.)
+                from jax.sharding import PartitionSpec as _P
+
+                from distributed_machine_learning_tpu.runtime.mesh import (
+                    shard_map_no_check,
+                )
+
+                spec = _P(self.flash_batch_axis, None, None, None)
+                out = shard_map_no_check(
+                    flash_self_attention,
+                    mesh=self.flash_mesh,
+                    in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                )(q, k, v)
+            else:
+                out = flash_self_attention(q, k, v)
         else:
             out = dense_self_attention(
                 q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), positions
@@ -293,6 +328,8 @@ class Block(nn.Module):
     decode: bool = False
     n_kv_heads: int | None = None
     kv_cache_dtype: Any = None
+    flash_mesh: Any = None
+    flash_batch_axis: str = "batch"
 
     @nn.compact
     def __call__(self, x, positions):
@@ -305,6 +342,8 @@ class Block(nn.Module):
             decode=self.decode,
             n_kv_heads=self.n_kv_heads,
             kv_cache_dtype=self.kv_cache_dtype,
+            flash_mesh=self.flash_mesh,
+            flash_batch_axis=self.flash_batch_axis,
             name="attn",
         )(h, positions)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
@@ -342,6 +381,9 @@ class TransformerLM(nn.Module):
     # Decode KV-cache storage dtype (None = compute dtype); see
     # ``Attention.kv_cache_dtype``.
     kv_cache_dtype: Any = None
+    # Flash-under-GSPMD composition; see ``Attention.flash_mesh``.
+    flash_mesh: Any = None
+    flash_batch_axis: str = "batch"
     remat: bool = False  # jax.checkpoint each block: activation memory
     # drops from O(L·E) per layer to per-block boundaries, recomputing the
     # block in backward — the HBM-for-FLOPs trade that lets long-context
@@ -396,6 +438,8 @@ class TransformerLM(nn.Module):
                 decode=self.decode,
                 n_kv_heads=self.n_kv_heads,
                 kv_cache_dtype=self.kv_cache_dtype,
+                flash_mesh=self.flash_mesh,
+                flash_batch_axis=self.flash_batch_axis,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)

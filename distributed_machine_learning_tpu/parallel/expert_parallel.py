@@ -103,31 +103,14 @@ def make_ep_train_step(
         )
     if mesh is None:
         return jax.jit(partial(_moe_step_impl, model), donate_argnums=(0,))
-    if model.attn_impl in ("flash", "auto"):
-        from distributed_machine_learning_tpu.ops.pallas.flash_attention import (  # noqa: E501
-            _interpret,
-        )
-
-        if model.attn_impl == "auto" and not _interpret():
-            # "auto" picks flash at >=512 context inside the model, which
-            # would hit the same unpartitionable-custom-call problem as
-            # explicit flash below — resolve to dense on TPU meshes (the
-            # tp/pp precedent; parameter structure is identical).
-            model = model.clone(attn_impl="dense")
-        elif model.attn_impl == "flash" and not _interpret():
-            # A Pallas (Mosaic) custom call inside this GSPMD-partitioned
-            # jit has no sharding rules: on a real TPU mesh the
-            # partitioner may reject it or silently replicate the
-            # attention — neither is acceptable for a scheme whose point
-            # is sharding.  Flash-in-EP is verified in interpreter mode
-            # only (the CPU-mesh tests lower the kernel to plain XLA
-            # ops); on TPU use dense, or wrap the kernel in shard_map
-            # with explicit specs before lifting this.
-            raise ValueError(
-                "expert-parallel + flash attention is interpret-verified "
-                "only; on a TPU mesh use attn_impl='dense' (or 'auto', "
-                "which resolves to dense here)"
-            )
+    if model.attn_impl in ("flash", "auto") and model.flash_mesh is None:
+        # A bare Pallas (Mosaic) custom call inside this GSPMD-
+        # partitioned jit has no sharding rules, so flash runs through
+        # the model's partial-manual shard_map wrap over the batch axis
+        # instead (models/transformer.py::Attention.flash_mesh): the
+        # kernel sees local per-device shapes and never meets the
+        # partitioner — valid on CPU interpret AND real TPU meshes.
+        model = model.clone(flash_mesh=mesh, flash_batch_axis=data_axis)
     impl = partial(_moe_step_impl, model)
     for a in (data_axis, expert_axis):
         if a not in mesh.axis_names:

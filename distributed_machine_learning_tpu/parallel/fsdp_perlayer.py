@@ -41,10 +41,13 @@ spec.  Elementwise optimizers only (SGD/AdamW) — per-leaf sharding
 keeps every leaf's slices aligned, but LARS's per-layer norms would
 still need a per-leaf psum; excluded for parity with the flat scheme.
 
-Like TP/EP (and for the same reason), the step requires dense
-attention: a Pallas call inside a GSPMD-partitioned program needs its
-own sharding rules (see ``cli/lm.py``'s resolve of auto→dense for
-tp/pp/3d).  attn_impl="auto" resolves to dense here.
+Flash attention composes: the builder clones the model with
+``flash_mesh`` set, which routes the kernel through a partial-manual
+``shard_map`` over the batch axis (``models/transformer.py``) — the
+Mosaic custom call then operates on local per-device shapes and never
+meets the GSPMD partitioner, on any backend.  Sequence-sharded
+attention (ring/ulysses) still needs a second mesh axis and stays
+unsupported here.
 """
 
 from __future__ import annotations
@@ -117,11 +120,17 @@ def make_fsdp_pl_lm_train_step(
     (``tensor_parallel.shard_tp_batch`` works).  Returns
     ``step(state, tokens, targets) -> (state, loss)``.
     """
-    if model.attn_impl != "dense":
+    if model.attn_impl in ("flash", "auto") and model.flash_mesh is None:
+        # Flash composes with this GSPMD step via the model's
+        # partial-manual shard_map wrap (transformer.Attention.flash_mesh)
+        # — the Mosaic custom call then sees local shapes and never
+        # meets the partitioner.  Parameter structure is attn-agnostic,
+        # so cloning here leaves the caller's init/state untouched.
+        model = model.clone(flash_mesh=mesh, flash_batch_axis=data_axis)
+    elif model.attn_impl not in ("dense", "flash", "auto"):
         raise ValueError(
-            "per-layer FSDP requires attn_impl='dense' (a Pallas call "
-            "inside the GSPMD-partitioned step has no sharding rules; "
-            "same restriction as tp/pp/3d)"
+            "per-layer FSDP supports dense/flash/auto attention "
+            "(sequence-sharded ring/ulysses need a second mesh axis)"
         )
     if data_axis not in mesh.axis_names:
         raise ValueError(f"mesh is missing axis {data_axis!r}: "
