@@ -161,12 +161,13 @@ def build(args):
     n = jax.device_count()
     dtype = jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
     attn = getattr(args, "attn", "auto")
-    if args.parallel in ("tp", "pp", "3d", "fsdp") and attn == "auto":
-        # The pipeline/tensor-parallel steps own their sharding and
-        # require the dense attention path (a Pallas call inside a
-        # GSPMD-partitioned or ppermute-pipelined program would need its
-        # own sharding rules); "auto" resolves to what they support.
-        # An EXPLICIT --attn flash still reaches their loud guards.
+    if args.parallel in ("pp", "3d", "fsdp") and attn == "auto":
+        # These steps resolve "auto" to the dense path they default to
+        # (pp accepts an EXPLICIT --attn flash — its pipe-axis shard_map
+        # is fully manual; 3d is partial-manual and flat-fsdp's step is
+        # dense-only, so both keep loud guards for explicit flash).
+        # tp/fsdp_pl/ep honor auto themselves via the model's
+        # flash_mesh shard_map wrap.
         attn = "dense"
     common = dict(
         vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,

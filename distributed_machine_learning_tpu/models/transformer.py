@@ -156,14 +156,18 @@ class Attention(nn.Module):
     # either way (_cached_attention upcasts).
     kv_cache_dtype: Any = None
     # When set (a jax.sharding.Mesh), the flash kernel runs inside a
-    # partial-manual shard_map with the batch dim sharded over
-    # ``flash_batch_axis`` — how flash composes with the
-    # GSPMD-partitioned steps (fsdp_pl / expert parallel), whose jit
-    # could not otherwise partition the Mosaic custom call.  The
-    # activations must really be batch-sharded over that axis (the
-    # shard_map constrains them if the partitioner chose otherwise).
+    # fully-manual shard_map with the batch dim sharded over
+    # ``flash_batch_axis`` (and, when ``flash_head_axis`` is set, the
+    # head dim sharded over it — the Megatron TP layout; heads are
+    # independent in flash and GQA groups stay aligned because
+    # H_local = groups · Hkv_local on every shard).  This is how flash
+    # composes with the GSPMD-partitioned steps (fsdp_pl / EP / TP),
+    # whose jit could not otherwise partition the Mosaic custom call.
+    # The activations must really be sharded that way (the shard_map
+    # constrains them if the partitioner chose otherwise).
     flash_mesh: Any = None
     flash_batch_axis: str = "batch"
+    flash_head_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -296,7 +300,8 @@ class Attention(nn.Module):
                     shard_map_no_check,
                 )
 
-                spec = _P(self.flash_batch_axis, None, None, None)
+                spec = _P(self.flash_batch_axis, None,
+                          self.flash_head_axis, None)
                 out = shard_map_no_check(
                     flash_self_attention,
                     mesh=self.flash_mesh,
@@ -330,6 +335,7 @@ class Block(nn.Module):
     kv_cache_dtype: Any = None
     flash_mesh: Any = None
     flash_batch_axis: str = "batch"
+    flash_head_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -344,6 +350,7 @@ class Block(nn.Module):
             kv_cache_dtype=self.kv_cache_dtype,
             flash_mesh=self.flash_mesh,
             flash_batch_axis=self.flash_batch_axis,
+            flash_head_axis=self.flash_head_axis,
             name="attn",
         )(h, positions)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
@@ -384,6 +391,7 @@ class TransformerLM(nn.Module):
     # Flash-under-GSPMD composition; see ``Attention.flash_mesh``.
     flash_mesh: Any = None
     flash_batch_axis: str = "batch"
+    flash_head_axis: str | None = None
     remat: bool = False  # jax.checkpoint each block: activation memory
     # drops from O(L·E) per layer to per-block boundaries, recomputing the
     # block in backward — the HBM-for-FLOPs trade that lets long-context
@@ -440,6 +448,7 @@ class TransformerLM(nn.Module):
                 kv_cache_dtype=self.kv_cache_dtype,
                 flash_mesh=self.flash_mesh,
                 flash_batch_axis=self.flash_batch_axis,
+                flash_head_axis=self.flash_head_axis,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)

@@ -106,7 +106,7 @@ def make_ep_train_step(
     if model.attn_impl in ("flash", "auto") and model.flash_mesh is None:
         # A bare Pallas (Mosaic) custom call inside this GSPMD-
         # partitioned jit has no sharding rules, so flash runs through
-        # the model's partial-manual shard_map wrap over the batch axis
+        # the model's fully-manual shard_map wrap (batch dim sharded)
         # instead (models/transformer.py::Attention.flash_mesh): the
         # kernel sees local per-device shapes and never meets the
         # partitioner — valid on CPU interpret AND real TPU meshes.

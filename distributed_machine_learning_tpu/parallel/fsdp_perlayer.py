@@ -42,8 +42,8 @@ keeps every leaf's slices aligned, but LARS's per-layer norms would
 still need a per-leaf psum; excluded for parity with the flat scheme.
 
 Flash attention composes: the builder clones the model with
-``flash_mesh`` set, which routes the kernel through a partial-manual
-``shard_map`` over the batch axis (``models/transformer.py``) — the
+``flash_mesh`` set, which routes the kernel through a fully-manual
+``shard_map`` with the batch dim sharded (``models/transformer.py``) — the
 Mosaic custom call then operates on local per-device shapes and never
 meets the GSPMD partitioner, on any backend.  Sequence-sharded
 attention (ring/ulysses) still needs a second mesh axis and stays
@@ -122,7 +122,7 @@ def make_fsdp_pl_lm_train_step(
     """
     if model.attn_impl in ("flash", "auto") and model.flash_mesh is None:
         # Flash composes with this GSPMD step via the model's
-        # partial-manual shard_map wrap (transformer.Attention.flash_mesh)
+        # fully-manual shard_map wrap (transformer.Attention.flash_mesh)
         # — the Mosaic custom call then sees local shapes and never
         # meets the partitioner.  Parameter structure is attn-agnostic,
         # so cloning here leaves the caller's init/state untouched.

@@ -56,10 +56,16 @@ PIPE_AXIS = "pipe"
 
 
 def _block_module(model: TransformerLM) -> Block:
+    # Flash passes through for the pure-pipeline steps: their shard_map
+    # is FULLY manual over the pipe axis, so the Pallas call sees local
+    # [mb, L] shapes and never meets the partitioner.  The 3-D step
+    # (partial-manual: batch/model stay automatic) keeps its own
+    # dense-only guard (parallel3d.py) and resolves auto to dense, so
+    # only "dense" reaches here from that path.
     return Block(
         n_heads=model.n_heads,
         d_ff=model.d_ff or 4 * model.d_model,
-        attn_impl="dense",
+        attn_impl="flash" if model.attn_impl == "flash" else "dense",
         seq_axis=model.seq_axis,
         compute_dtype=model.compute_dtype,
         n_kv_heads=model.n_kv_heads,
@@ -294,8 +300,13 @@ def make_pipeline_step(
     around ``step_impl(model, state, tokens_mb, targets_mb, *,
     pipe_axis, num_stages)`` — one copy so the schedules cannot drift
     on anything but the schedule itself."""
-    if model.attn_impl != "dense":
-        raise ValueError("pipeline step requires attn_impl='dense'")
+    if model.attn_impl not in ("dense", "flash"):
+        raise ValueError(
+            "pipeline step supports attn_impl='dense' or 'flash' (the "
+            "pipe-axis shard_map is fully manual, so the flash kernel "
+            "runs on local shapes); sequence-sharded impls need a "
+            "second mesh axis"
+        )
     if pipe_axis not in mesh.axis_names:
         raise ValueError(f"mesh is missing axis {pipe_axis!r}: {mesh.axis_names}")
     num_stages = mesh.shape[pipe_axis]

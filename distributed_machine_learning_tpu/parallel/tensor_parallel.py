@@ -107,8 +107,10 @@ def make_tp_lm_train_step(
 ):
     """Build the TP(+DP) LM train step.
 
-    ``model`` must use dense attention (TP shards heads; sequence stays
-    whole — combining TP with ring attention is the 3-D mesh step's job).
+    ``model`` may use dense, flash, or auto attention (flash runs
+    head-sharded inside the model's fully-manual shard_map wrap — see
+    ``Attention.flash_head_axis``; sequence stays whole — combining TP
+    with ring attention is the 3-D mesh step's job).
     The returned ``step(state, tokens, targets)`` expects ``state`` already
     placed via ``shard_tp_state`` and tokens/targets sharded over
     ``data_axis`` (see ``shard_tp_batch``).
@@ -117,14 +119,27 @@ def make_tp_lm_train_step(
     (and cached per tree structure), so custom SGDConfig values — static
     pytree metadata on TrainState — never mismatch the jitted signature.
     """
-    if model.attn_impl != "dense":
-        raise ValueError(
-            "tensor-parallel step requires attn_impl='dense'; ring attention "
-            "composes with TP via the 3-D mesh step"
-        )
     for a in (data_axis, model_axis):
         if a not in mesh.axis_names:
             raise ValueError(f"mesh is missing axis {a!r}: {mesh.axis_names}")
+    if model.attn_impl in ("flash", "auto") and model.flash_mesh is None:
+        # Flash composes with TP through the model's fully-manual
+        # shard_map wrap with the HEAD dim sharded over the model axis:
+        # heads are independent in flash, and each shard's local GQA
+        # grouping stays aligned because H_local = groups · Hkv_local
+        # (the divisibility checks below enforce both).  The Mosaic
+        # custom call then sees local head counts and never meets the
+        # partitioner.
+        model = model.clone(
+            flash_mesh=mesh,
+            flash_batch_axis=data_axis,
+            flash_head_axis=model_axis,
+        )
+    elif model.attn_impl not in ("dense", "flash", "auto"):
+        raise ValueError(
+            "tensor-parallel step supports dense/flash/auto attention; "
+            "ring attention composes with TP via the 3-D mesh step"
+        )
     n_model = mesh.shape[model_axis]
     if model.n_heads % n_model:
         raise ValueError(

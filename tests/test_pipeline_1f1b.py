@@ -90,7 +90,7 @@ def test_1f1b_guards():
     with pytest.raises(ValueError, match="dense"):
         make_pp_1f1b_lm_train_step(
             TransformerLM(vocab_size=64, d_model=16, n_layers=8, n_heads=2,
-                          attn_impl="flash"),
+                          attn_impl="ring"),
             _pipe_mesh(), 2,
         )
     with pytest.raises(ValueError, match="divide evenly"):
