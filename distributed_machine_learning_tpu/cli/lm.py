@@ -26,6 +26,9 @@ entrypoint gives the transformer stack the same driveable surface, with
            gpipe (all-forward-then-all-backward, parallel/pipeline.py)
   3d       data × pipeline × tensor composed
            (parallel/parallel3d.py)
+  ep       expert parallelism — Switch-routed MoE transformer, experts
+           sharded over an expert axis, batch over the rest
+           (parallel/expert_parallel.py, models/moe.py)
 
 Data is a deterministic synthetic byte stream (seeded from the shared
 69143) — the reference's CIFAR runs are likewise about the training
@@ -59,7 +62,15 @@ def make_parser():
     add_node_flags(p)
     p.add_argument("--parallel", default="dp",
                    choices=["dp", "ring", "ulysses", "fsdp", "fsdp_pl",
-                            "tp", "pp", "3d"])
+                            "tp", "pp", "3d", "ep"])
+    p.add_argument("--n-experts", dest="n_experts", default=8, type=int,
+                   help="MoE experts (--parallel ep only)")
+    p.add_argument("--capacity-factor", dest="capacity_factor", default=1.25,
+                   type=float, help="MoE expert capacity factor (ep only)")
+    p.add_argument("--ep", default=None, type=int,
+                   help="expert-axis size for --parallel ep (default: "
+                        "min(devices, n_experts)); the remaining "
+                        "devices/ep factor becomes the data axis")
     p.add_argument("--d-model", dest="d_model", default=256, type=int)
     p.add_argument("--n-layers", dest="n_layers", default=4, type=int)
     p.add_argument("--n-heads", dest="n_heads", default=8, type=int)
@@ -137,8 +148,10 @@ def make_parser():
                         "'auto'/'flash' upgrade the per-chunk math to "
                         "the flash-kernel ring when the per-device chunk "
                         "is big enough, 'dense' pins the einsum ring; "
-                        "tp/pp/3d resolve 'auto' to dense (their steps "
-                        "own their sharding), ulysses owns its attention")
+                        "tp/fsdp_pl/ep honor 'auto'/'flash' via the "
+                        "shard_map-wrapped kernel, pp takes explicit "
+                        "'flash', 3d and flat fsdp resolve 'auto' to "
+                        "dense, ulysses owns its attention")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each transformer block: activation "
                         "memory drops ~n_layers-fold for ~33%% more FLOPs "
@@ -276,6 +289,61 @@ def build(args):
         )
         params_fn = lambda st: gather_fsdp_params(st, unravel, n_elems)
         return step, fstate, place, model, params_fn
+
+    if args.parallel == "ep":
+        from distributed_machine_learning_tpu.models.moe import (
+            MoETransformerLM,
+        )
+        from distributed_machine_learning_tpu.parallel.expert_parallel import (
+            init_moe_state,
+            make_ep_train_step,
+            shard_ep_state,
+        )
+        from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+            shard_tp_batch,
+        )
+
+        if args.n_kv_heads is not None or args.remat:
+            raise ValueError(
+                "--n-kv-heads / --remat are not supported with "
+                "--parallel ep (MoETransformerLM has neither knob)"
+            )
+        if args.ep is None:
+            # Largest axis size dividing BOTH the device count and the
+            # expert count — the biggest valid default on any host.
+            ep = max(d for d in range(1, n + 1)
+                     if n % d == 0 and args.n_experts % d == 0)
+        else:
+            ep = args.ep
+        if ep < 1 or n % ep:
+            raise ValueError(
+                f"--ep {ep} must be a positive divisor of the device "
+                f"count {n}"
+            )
+        if args.n_experts % ep:
+            raise ValueError(
+                f"--n-experts {args.n_experts} must be divisible by "
+                f"--ep {ep}"
+            )
+        dp = n // ep
+        if args.batch_size % dp:
+            raise ValueError(
+                f"--batch-size {args.batch_size} must be divisible by "
+                f"the {dp}-device data axis (devices/ep)"
+            )
+        mesh = make_mesh(n, ("batch", "expert"), (dp, ep))
+        model = MoETransformerLM(
+            vocab_size=args.vocab, d_model=args.d_model,
+            n_layers=args.n_layers, n_heads=args.n_heads,
+            n_experts=args.n_experts, capacity_factor=args.capacity_factor,
+            compute_dtype=dtype, attn_impl=attn,
+        )
+        step = make_ep_train_step(model, mesh)
+        state = shard_ep_state(
+            init_moe_state(model, seed=SEED, config=opt_config), mesh
+        )
+        place = lambda x, y: shard_tp_batch(mesh, x, y)
+        return step, state, place, model, lambda st: st.params
 
     if args.parallel == "fsdp_pl":
         from distributed_machine_learning_tpu.parallel.fsdp_perlayer import (

@@ -283,16 +283,17 @@ class Attention(nn.Module):
             # GQA stays narrow: the kernel's K/V index maps divide by the
             # group factor, so no repeated K/V ever hits HBM.
             if self.flash_mesh is not None:
-                # Inside a GSPMD-partitioned step (fsdp_pl / EP) the
-                # Mosaic custom call has no sharding rules — so run it
-                # under a FULLY-manual shard_map over the whole mesh:
+                # Inside a GSPMD-partitioned step (fsdp_pl / EP / TP)
+                # the Mosaic custom call has no sharding rules — so run
+                # it under a FULLY-manual shard_map over the whole mesh:
                 # the kernel then sees LOCAL per-device shapes and never
-                # meets the partitioner on ANY axis.  Batch is the only
-                # sharded dim; activations are replicated over every
-                # other mesh axis (e.g. EP's expert axis), which the
-                # unmentioned-axis convention expresses as-is.  (Manual
-                # over just the batch axis would leave the custom call
-                # under automatic propagation for the remaining axes —
+                # meets the partitioner on ANY axis.  The batch dim
+                # shards over flash_batch_axis and (under TP) the head
+                # dim over flash_head_axis; activations are replicated
+                # over every remaining mesh axis (e.g. EP's expert
+                # axis), which the unmentioned-axis convention expresses
+                # as-is.  (Manual over a subset of axes would leave the
+                # custom call under automatic propagation for the rest —
                 # the hazard this wrap exists to remove.)
                 from jax.sharding import PartitionSpec as _P
 

@@ -145,8 +145,10 @@ def test_fsdp_pl_flash_matches_plain_flash(mesh8):
 
 
 def test_tp_flash_matches_plain_flash():
-    """Head-sharded flash under TP (shard_map-wrapped kernel, GQA heads
-    split over the model axis) must equal the plain flash step."""
+    """Head-sharded flash under TP (shard_map-wrapped kernel) must equal
+    the plain flash step — with genuinely GROUPED K/V (Hkv < H), so the
+    claim that each model-axis shard keeps its GQA groups aligned
+    (H_local = groups · Hkv_local) is what the test exercises."""
     from distributed_machine_learning_tpu.parallel.tensor_parallel import (
         make_tp_lm_train_step,
         shard_tp_batch,
@@ -155,13 +157,14 @@ def test_tp_flash_matches_plain_flash():
     from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 
     model = TransformerLM(vocab_size=64, d_model=32, n_layers=2, n_heads=8,
-                          n_kv_heads=8, attn_impl="flash")
+                          n_kv_heads=4, attn_impl="flash")
     xs, ys = _tokens(steps=2)
 
     ref_state = init_lm_state(model)
     ref_step = make_lm_train_step(model, mesh=None)
 
-    mesh = make_mesh(8, ("batch", "model"), (1, 8))
+    # dp 2 × tp 4: narrow K/V (1 head/shard) shared by 2 query heads.
+    mesh = make_mesh(8, ("batch", "model"), (2, 4))
     tp_step = make_tp_lm_train_step(model, mesh)
     tp_state = shard_tp_state(init_lm_state(model), mesh)
 

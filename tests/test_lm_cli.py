@@ -26,8 +26,11 @@ TINY = [
                      marks=pytest.mark.slow),
         pytest.param(["--parallel", "3d", "--n-heads", "8", "--pp", "2",
                       "--tp", "2"], marks=pytest.mark.slow),
+        pytest.param(["--parallel", "ep", "--n-experts", "4", "--ep", "4",
+                      "--batch-size", "4"], marks=pytest.mark.slow),
+        pytest.param(["--parallel", "fsdp_pl"], marks=pytest.mark.slow),
     ],
-    ids=["dp", "ring", "ulysses", "tp", "pp", "3d"],
+    ids=["dp", "ring", "ulysses", "tp", "pp", "3d", "ep", "fsdp_pl"],
 )
 def test_lm_cli_runs(extra, capsys):
     main(TINY + extra)
