@@ -68,9 +68,10 @@ def make_parser():
     p.add_argument("--capacity-factor", dest="capacity_factor", default=1.25,
                    type=float, help="MoE expert capacity factor (ep only)")
     p.add_argument("--ep", default=None, type=int,
-                   help="expert-axis size for --parallel ep (default: "
-                        "min(devices, n_experts)); the remaining "
-                        "devices/ep factor becomes the data axis")
+                   help="expert-axis size for --parallel ep (default: the "
+                        "greatest common divisor of the device count and "
+                        "--n-experts); the remaining devices/ep factor "
+                        "becomes the data axis")
     p.add_argument("--d-model", dest="d_model", default=256, type=int)
     p.add_argument("--n-layers", dest="n_layers", default=4, type=int)
     p.add_argument("--n-heads", dest="n_heads", default=8, type=int)
@@ -308,11 +309,15 @@ def build(args):
                 "--n-kv-heads / --remat are not supported with "
                 "--parallel ep (MoETransformerLM has neither knob)"
             )
+        if args.n_experts < 1:
+            raise ValueError(f"--n-experts must be >= 1, got "
+                             f"{args.n_experts}")
         if args.ep is None:
             # Largest axis size dividing BOTH the device count and the
             # expert count — the biggest valid default on any host.
-            ep = max(d for d in range(1, n + 1)
-                     if n % d == 0 and args.n_experts % d == 0)
+            import math
+
+            ep = math.gcd(n, args.n_experts)
         else:
             ep = args.ep
         if ep < 1 or n % ep:

@@ -5,10 +5,12 @@ brings up the coordination service through the reference's exact flag
 path (``--master-ip``/``--rank``/``--num-nodes`` →
 ``jax.distributed.initialize`` — runtime/distributed.py:46-59, the TPU
 analogue of ``dist.init_process_group`` at part2/2a/main.py:197), then
-runs lock-step psum training steps over a 2-process CPU mesh and agrees
+runs lock-step psum training steps over a 2-process CPU mesh, agrees
 on a SIGTERM-triggered stop via ``agree_stop``'s process_allgather
-branch (runtime/resilience.py) — the code paths single-process tests
-can never exercise.
+branch (runtime/resilience.py), and finishes with a cross-process
+GSPMD step (per-layer-FSDP leaves sharded over the two processes by
+jit in_shardings alone) — the code paths single-process tests can
+never exercise.
 """
 
 from __future__ import annotations
@@ -115,6 +117,48 @@ def main() -> None:
         mesh=mesh, in_specs=(P("batch"),), out_specs=P(),
     ))(gl)
     print(f"data_sum {float(total)} {float(rows.sum())}", flush=True)
+
+    # Cross-process GSPMD: one per-layer-FSDP LM step whose parameter
+    # leaves are sharded ACROSS THE TWO PROCESSES by the jit's
+    # in_shardings (no shard_map — the partitioner derives the
+    # gathers/reduce-scatters over the gloo backend).  The single-
+    # process suite can only shard across local devices; this is the
+    # real multi-host layout.  Both ranks must agree bit-for-bit on the
+    # updated (all-gathered) params.
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from distributed_machine_learning_tpu.parallel.fsdp_perlayer import (
+        make_fsdp_pl_lm_train_step,
+        shard_fsdp_pl_state,
+    )
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+    from jax.experimental import multihost_utils
+
+    lm = TransformerLM(vocab_size=64, d_model=16, n_layers=1, n_heads=2,
+                       attn_impl="dense")
+    lm_state = shard_fsdp_pl_state(init_lm_state(lm), mesh)
+    lm_step = make_fsdp_pl_lm_train_step(lm, mesh)
+    rng3 = np.random.default_rng(5)
+    toks = rng3.integers(0, 64, (2, 17)).astype(np.int32)  # same both ranks
+    tok_sharding = NamedSharding(mesh, P("batch", None))
+    gx = jax.make_array_from_process_local_data(
+        tok_sharding, toks[jax.process_index()][None, :-1]
+    )
+    gy = jax.make_array_from_process_local_data(
+        tok_sharding, toks[jax.process_index()][None, 1:]
+    )
+    lm_state, lm_loss = lm_step(lm_state, gx, gy)
+    host_loss = multihost_utils.process_allgather(lm_loss, tiled=True)
+    host_params = multihost_utils.process_allgather(lm_state.params,
+                                                    tiled=True)
+    pdigest = hashlib.sha256(
+        b"".join(np.asarray(leaf).tobytes()
+                 for leaf in jax.tree_util.tree_leaves(host_params))
+    ).hexdigest()[:16]
+    print(f"gspmd_loss {float(np.asarray(host_loss).reshape(-1)[0]):.6f}",
+          flush=True)
+    print(f"gspmd_params {pdigest}", flush=True)
     ctx.shutdown()
 
 

@@ -88,6 +88,11 @@ def test_two_process_rendezvous_identical_params_and_agree_stop():
     for out in (out0, out1):
         got, want = field(out, "data_sum").split()
         assert float(got) == float(want), (got, want)
+    # Cross-process GSPMD (per-layer FSDP leaves sharded over the two
+    # processes): both ranks agree on the loss and bit-for-bit on the
+    # all-gathered updated params.
+    assert field(out0, "gspmd_loss") == field(out1, "gspmd_loss")
+    assert field(out0, "gspmd_params") == field(out1, "gspmd_params")
 
 
 import pytest
