@@ -47,7 +47,11 @@ def bench_one(attn: str, args) -> tuple[float, int]:
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         remat=args.remat,
     )
-    state = init_lm_state(model)
+    from distributed_machine_learning_tpu.train.sgd import SGDConfig
+
+    state = init_lm_state(
+        model, config=SGDConfig(momentum_dtype=args.momentum_dtype)
+    )
     rng = np.random.default_rng(0)
     toks = rng.integers(
         0, args.vocab, (TIMED_ITERS, args.batch, args.seq_len + 1)
@@ -218,6 +222,10 @@ def main() -> None:
                         "the constant tunnel round-trip")
     p.add_argument("--fused-ce-chunks", dest="fused_ce_chunks",
                    default=None, type=int)
+    p.add_argument("--momentum-dtype", dest="momentum_dtype", default=None,
+                   help="SGD momentum-buffer storage dtype (e.g. bfloat16) "
+                        "— optimizer-state memory is what bounds depth at "
+                        "realistic width on one chip (train/sgd.py)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block — lets realistic-width "
                         "long-context configs fit the chip; reported MFU "

@@ -122,6 +122,11 @@ def make_parser():
                         "the reference's torch-semantics update")
     p.add_argument("--lr", default=None, type=float,
                    help="override the optimizer config's learning rate")
+    p.add_argument("--momentum-dtype", dest="momentum_dtype", default=None,
+                   help="SGD momentum-buffer storage dtype (e.g. "
+                        "bfloat16): halves optimizer-state memory, the "
+                        "term that bounds model depth on one chip; "
+                        "update math stays f32 (train/sgd.py; sgd only)")
     p.add_argument("--data-dir", dest="data_dir", default=None, type=str,
                    help="train on real text: every text file under this "
                         "directory becomes a byte-level corpus "
@@ -193,7 +198,18 @@ def build(args):
     from distributed_machine_learning_tpu.train.optimizers import get_optimizer
 
     cfg_cls = get_optimizer(args.optimizer)[0]
-    opt_config = cfg_cls() if args.lr is None else cfg_cls(learning_rate=args.lr)
+    cfg_kwargs = {}
+    if args.lr is not None:
+        cfg_kwargs["learning_rate"] = args.lr
+    if args.momentum_dtype is not None:
+        if args.optimizer != "sgd":
+            raise ValueError(
+                "--momentum-dtype applies to --optimizer sgd only "
+                "(AdamW keeps fp32 moments; LARS accumulates in the "
+                "buffer dtype and refuses narrowing)"
+            )
+        cfg_kwargs["momentum_dtype"] = args.momentum_dtype
+    opt_config = cfg_cls(**cfg_kwargs)
     if args.fused_ce_chunks and args.parallel not in (
         "dp", "ring", "ulysses", "fsdp", "fsdp_pl"
     ):

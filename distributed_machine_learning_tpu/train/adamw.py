@@ -47,8 +47,11 @@ class AdamWConfig:
     weight_decay: float = 0.01
 
 
-def adamw_init(params):
-    """First/second-moment buffers — fp32 zeros, one pair per leaf."""
+def adamw_init(params, config=None):
+    """First/second-moment buffers — fp32 zeros, one pair per leaf.
+    ``config`` accepted for the registry's uniform (params, config)
+    init signature; AdamW's moments are always fp32."""
+    del config
     zeros32 = lambda p: jnp.zeros(jnp.shape(p), jnp.float32)
     return {
         "mu": jax.tree_util.tree_map(zeros32, params),

@@ -42,6 +42,17 @@ class LARSConfig(SGDConfig):
     trust_coefficient: float = 1e-3
     eps: float = 1e-9
 
+    def __post_init__(self):
+        # Inherited from SGDConfig, but lars_update has no f32-upcast
+        # path for a narrowed carry — refuse rather than silently run
+        # the whole momentum accumulation in the narrow dtype.
+        if self.momentum_dtype is not None:
+            raise ValueError(
+                "LARSConfig does not support momentum_dtype (the LARS "
+                "update accumulates in the buffer dtype); use sgd for "
+                "narrowed optimizer state"
+            )
+
 
 def lars_update(params, momentum_buf, grads, config: LARSConfig, lr=None,
                 step=None):

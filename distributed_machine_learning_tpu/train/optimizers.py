@@ -67,8 +67,12 @@ def _entry_for_config(config):
 
 def init_for_config(config):
     """Momentum/moments init fn matching a config instance — how
-    ``TrainState.create`` builds the right buffer layout."""
-    return _entry_for_config(config)[1]
+    ``TrainState.create`` builds the right buffer layout.  Every
+    registry init fn takes the uniform ``(params, config)`` signature,
+    and the config is bound in so dtype-bearing configs
+    (SGDConfig.momentum_dtype) shape their buffers."""
+    init = _entry_for_config(config)[1]
+    return lambda params: init(params, config)
 
 
 def update_fn_for_config(config):

@@ -33,12 +33,34 @@ class SGDConfig:
     learning_rate: float = 0.1
     momentum: float = 0.9
     weight_decay: float = 1e-4
+    # Momentum-buffer STORAGE dtype name ("bfloat16"), or None for the
+    # parameter dtype.  Optimizer-state memory is the difference between
+    # fitting and not at realistic LM width on one chip (the buffer is a
+    # full parameter-sized f32 tree); the update math still runs in f32
+    # and only the carried buffer narrows — a standard mixed-precision
+    # optimizer-state trade (slightly lossy accumulation, opt-in).
+    momentum_dtype: str | None = None
 
 
-def sgd_init(params):
+def _momentum_dtype(config, param):
+    return jnp.dtype(config.momentum_dtype) if config.momentum_dtype \
+        else param.dtype
+
+
+def sgd_init(params, config: SGDConfig | None = None):
     """Momentum buffers, zero-initialized (torch lazily inits to the first
-    gradient; zeros + the update rule below produce the identical result)."""
-    return jax.tree_util.tree_map(jnp.zeros_like, params)
+    gradient; zeros + the update rule below produce the identical result).
+    ``config.momentum_dtype`` narrows the stored buffer.  (getattr: LARS
+    shares this init; LARSConfig rejects a set momentum_dtype at
+    construction — lars.py — so the narrow path never reaches it.)
+    """
+    dtype_name = getattr(config, "momentum_dtype", None)
+    if dtype_name is None:
+        return jax.tree_util.tree_map(jnp.zeros_like, params)
+    dt = jnp.dtype(dtype_name)
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, dt), params
+    )
 
 
 def apply_update(update, params, momentum_buf, grads):
@@ -66,8 +88,10 @@ def sgd_update(params, momentum_buf, grads, config: SGDConfig, lr=None,
 
     def _update(p, m, g):
         g = g + config.weight_decay * p
-        m = config.momentum * m + g
-        p = p - lr * m
-        return p, m
+        # Math in the gradient dtype (f32 on the training paths); only
+        # the CARRIED buffer narrows under momentum_dtype.
+        m_new = config.momentum * m.astype(g.dtype) + g
+        p = p - lr * m_new
+        return p, m_new.astype(_momentum_dtype(config, p))
 
     return apply_update(_update, params, momentum_buf, grads)

@@ -56,3 +56,40 @@ def test_first_step_equals_lazy_torch_buffer(rng):
     np.testing.assert_allclose(
         np.asarray(new_p[0]), np.asarray(p - cfg.learning_rate * g), rtol=1e-6
     )
+
+
+def test_bf16_momentum_buffer():
+    """momentum_dtype narrows the CARRIED buffer while the update math
+    stays f32 — the trajectory must track full-precision SGD closely
+    (bitwise for the first step, where buf == g)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_machine_learning_tpu.train.sgd import (
+        SGDConfig,
+        sgd_init,
+        sgd_update,
+    )
+
+    params = {"w": jnp.linspace(-1.0, 1.0, 64, dtype=jnp.float32)}
+    grads = {"w": jnp.cos(params["w"]) * 0.1}
+    cfg16 = SGDConfig(momentum_dtype="bfloat16")
+    cfg32 = SGDConfig()
+    m16 = sgd_init(params, cfg16)
+    m32 = sgd_init(params, cfg32)
+    assert m16["w"].dtype == jnp.bfloat16
+    assert m32["w"].dtype == jnp.float32
+    p16, m16 = sgd_update(params, m16, grads, cfg16)
+    p32, m32 = sgd_update(params, m32, grads, cfg32)
+    # First step: buffers start at zero so both compute buf = g in f32;
+    # params update before the buffer narrows -> identical params.
+    np.testing.assert_array_equal(np.asarray(p16["w"]), np.asarray(p32["w"]))
+    assert m16["w"].dtype == jnp.bfloat16
+    # Subsequent steps accumulate in f32 from the narrowed carry: close,
+    # not bitwise.
+    for _ in range(5):
+        p16, m16 = sgd_update(p16, m16, grads, cfg16)
+        p32, m32 = sgd_update(p32, m32, grads, cfg32)
+    np.testing.assert_allclose(np.asarray(p16["w"]), np.asarray(p32["w"]),
+                               rtol=0, atol=5e-3)
