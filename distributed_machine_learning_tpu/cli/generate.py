@@ -104,12 +104,29 @@ def main(argv=None) -> None:
         if "blocks" in params:
             # Pipeline-layout checkpoint: blocks stacked on a leading
             # layer axis — restore the per-layer tree the plain apply
-            # expects.
-            from distributed_machine_learning_tpu.parallel.pipeline import (
-                unstack_lm_params,
+            # expects.  The layout tag distinguishes the interleaved
+            # schedule's permuted stacking (which carries its P and v)
+            # from the contiguous gpipe/1f1b order.
+            from distributed_machine_learning_tpu.train.checkpoint import (
+                checkpoint_layout,
             )
 
-            params = unstack_lm_params(params, args.n_layers)
+            layout = checkpoint_layout(latest)
+            if layout and layout.startswith("pp-interleaved-"):
+                from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
+                    unstack_interleaved,
+                )
+
+                p_tag, v_tag = layout.split("-P")[1].split("-v")
+                params = unstack_interleaved(
+                    params, args.n_layers, int(p_tag), int(v_tag)
+                )
+            else:
+                from distributed_machine_learning_tpu.parallel.pipeline import (  # noqa: E501
+                    unstack_lm_params,
+                )
+
+                params = unstack_lm_params(params, args.n_layers)
         print(f"restored {latest}")
     else:
         from distributed_machine_learning_tpu.train.lm_step import (

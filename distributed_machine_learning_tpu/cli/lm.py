@@ -21,8 +21,10 @@ entrypoint gives the transformer stack the same driveable surface, with
   tp       tensor parallelism — Megatron layout via GSPMD
            (parallel/tensor_parallel.py)
   pp       pipeline parallelism — ppermute pipeline; --pp-schedule
-           picks 1f1b (default: one backward interleaved per forward,
-           O(P) activation memory, parallel/pipeline_1f1b.py) or
+           picks 1f1b (default: one backward per forward, O(P)
+           activation memory, parallel/pipeline_1f1b.py), interleaved
+           (--pp-chunks virtual stages per device, bubble
+           (P-1)/(v*M+P-1), parallel/pipeline_interleaved.py), or
            gpipe (all-forward-then-all-backward, parallel/pipeline.py)
   3d       data × pipeline × tensor composed
            (parallel/parallel3d.py)
@@ -98,12 +100,19 @@ def make_parser():
                         "before training (same scheme + optimizer as "
                         "the save)")
     p.add_argument("--pp-schedule", dest="pp_schedule", default="1f1b",
-                   choices=["1f1b", "gpipe"],
+                   choices=["1f1b", "gpipe", "interleaved"],
                    help="pipeline schedule (pp only): 1f1b interleaves "
                         "one backward with one forward per tick — O(P) "
                         "activation memory instead of GPipe's O(M) "
                         "(parallel/pipeline_1f1b.py); gpipe is "
-                        "all-forward-then-all-backward")
+                        "all-forward-then-all-backward; interleaved "
+                        "gives each device --pp-chunks virtual stages, "
+                        "cutting the bubble to (P-1)/(v*M+P-1) "
+                        "(parallel/pipeline_interleaved.py)")
+    p.add_argument("--pp-chunks", dest="pp_chunks", default=None, type=int,
+                   help="virtual stages per device for "
+                        "--pp-schedule interleaved (v, default 2); "
+                        "n_layers must divide by devices x v")
     p.add_argument("--dp", default=None, type=int,
                    help="data-axis size for --parallel 3d "
                         "(default: devices // (pp*tp))")
@@ -417,17 +426,41 @@ def build(args):
             shard_pp_state,
         )
 
+        if args.pp_chunks is not None and args.pp_schedule != "interleaved":
+            raise ValueError(
+                "--pp-chunks applies to --pp-schedule interleaved only "
+                f"(got --pp-schedule {args.pp_schedule})"
+            )
         mesh = make_mesh(n, ("pipe",))
         model = TransformerLM(**common)
+        # Each schedule picks its step builder and (for interleaved, whose
+        # block stacking is permuted) its state init; the placement and
+        # return tail are shared.
         if args.pp_schedule == "1f1b":
             from distributed_machine_learning_tpu.parallel.pipeline_1f1b import (  # noqa: E501
                 make_pp_1f1b_lm_train_step,
             )
 
             step = make_pp_1f1b_lm_train_step(model, mesh, args.microbatches)
+            raw_state = init_pipeline_state(model, seed=SEED,
+                                            config=opt_config)
+        elif args.pp_schedule == "interleaved":
+            from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
+                init_interleaved_state,
+                make_pp_interleaved_lm_train_step,
+            )
+
+            v = args.pp_chunks or 2
+            step = make_pp_interleaved_lm_train_step(
+                model, mesh, args.microbatches, v
+            )
+            raw_state = init_interleaved_state(model, n, v, seed=SEED,
+                                               config=opt_config)
         else:
             step = make_pp_lm_train_step(model, mesh, args.microbatches)
-        state = shard_pp_state(init_pipeline_state(model, seed=SEED, config=opt_config), mesh)
+            raw_state = init_pipeline_state(model, seed=SEED,
+                                            config=opt_config)
+        state = shard_pp_state(raw_state, mesh)
         place = lambda x, y: microbatch(x, y, args.microbatches)
         return step, state, place, model, lambda st: st.params
 
@@ -554,9 +587,21 @@ def main(argv=None) -> None:
                 "(FSDPState is not a TrainState); use --parallel fsdp_pl "
                 "for checkpointable ZeRO-3"
             )
+        # The pipeline schedules permute the stacked block layout but
+        # share one tree structure — a resume under the wrong layout
+        # would silently load permuted layers, so the layout is tagged
+        # into the checkpoint and checked here.
+        if args.parallel == "pp" and args.pp_schedule == "interleaved":
+            run_layout = (f"pp-interleaved-P{jax.device_count()}"
+                          f"-v{args.pp_chunks or 2}")
+        elif args.parallel in ("pp", "3d"):
+            run_layout = "pp-contiguous"
+        else:
+            run_layout = None
         if args.resume:
             from distributed_machine_learning_tpu.train.checkpoint import (
                 checkpoint_config,
+                checkpoint_layout,
                 latest_checkpoint,
                 restore_checkpoint,
             )
@@ -568,6 +613,15 @@ def main(argv=None) -> None:
                 rank0_print(f"No checkpoint under {args.ckpt_dir}; "
                             "starting from scratch.")
             else:
+                saved_layout = checkpoint_layout(latest)
+                if saved_layout != run_layout:
+                    raise ValueError(
+                        f"checkpoint parameter layout {saved_layout!r} "
+                        f"does not match this run's {run_layout!r} "
+                        "(same tree structure, permuted layers — "
+                        "resume with the schedule/chunks/device-count "
+                        "it was saved under)"
+                    )
                 saved_cfg = checkpoint_config(latest)
                 if type(saved_cfg) is not type(state.config):
                     raise ValueError(
@@ -621,7 +675,7 @@ def main(argv=None) -> None:
                 save_checkpoint,
             )
 
-            path = save_checkpoint(args.ckpt_dir, state)
+            path = save_checkpoint(args.ckpt_dir, state, layout=run_layout)
             rank0_print(f"Saved checkpoint to {path}")
         if args.eval_batches:
             from distributed_machine_learning_tpu.data.text import (
@@ -651,12 +705,24 @@ def main(argv=None) -> None:
             if args.parallel in ("pp", "3d"):
                 # Pipeline layouts stack the blocks along a leading
                 # layer dim; restore the per-layer tree the plain model
-                # apply expects.
-                from distributed_machine_learning_tpu.parallel.pipeline import (  # noqa: E501
-                    unstack_lm_params,
-                )
+                # apply expects.  The interleaved schedule stacks in its
+                # chunk-major device order, so it has its own inverse.
+                if (args.parallel == "pp"
+                        and args.pp_schedule == "interleaved"):
+                    from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
+                        unstack_interleaved,
+                    )
 
-                params = unstack_lm_params(params, args.n_layers)
+                    params = unstack_interleaved(
+                        params, args.n_layers, jax.device_count(),
+                        args.pp_chunks or 2,
+                    )
+                else:
+                    from distributed_machine_learning_tpu.parallel.pipeline import (  # noqa: E501
+                        unstack_lm_params,
+                    )
+
+                    params = unstack_lm_params(params, args.n_layers)
             # Materialize params on the host so the eval jit owns its
             # own placement: sharded leaves (fsdp_pl/tp) assemble, and
             # on multi-host runs the cross-process all-gather replaces

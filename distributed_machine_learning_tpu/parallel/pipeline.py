@@ -18,10 +18,14 @@ with the neighboring stage compute.
 What grad-of-scan FIXES, though, is the schedule: all forwards complete
 before any backward starts (GPipe), so a stage holds (or remats) every
 microbatch's activations at once — O(M) memory that caps how many
-microbatches can amortize the (P−1)/(M+P−1) bubble.  The 1F1B schedule
+microbatches can amortize the (P−1)/(M+P−1) bubble.  Two sibling
+schedules attack the two costs separately: 1F1B
 (``parallel/pipeline_1f1b.py``, the CLI default) hand-writes the
-interleaved backward to cut that to O(P); this module remains the
-jax.grad-schedule reference the 1F1B step is property-tested against.
+one-backward-per-forward tick order to cut activation memory to O(P),
+and the interleaved schedule (``parallel/pipeline_interleaved.py``,
+``--pp-schedule interleaved``) gives each device v virtual stages to
+cut the bubble itself to (P−1)/(v·M+P−1).  This module remains the
+jax.grad-schedule reference both are property-tested against.
 
 Parameter layout inside ``shard_map``:
   - ``blocks``: every Block param stacked to ``[n_layers, ...]``, sharded

@@ -1,0 +1,274 @@
+"""Interleaved pipeline schedule — virtual stages cut the bubble by v.
+
+GPipe and 1F1B give each device ONE contiguous span of layers, so a
+microbatch crosses the machine in P hops and the pipeline idles for
+(P−1)/(M+P−1) of the time.  The interleaved schedule (Megatron's
+"virtual pipeline stages") gives each device v NON-contiguous chunks —
+chunk c on device s holds global span c·P+s — so the layer order
+visits every device v times.  Work per hop shrinks v-fold while the
+number of in-flight hops stays P−1, and the bubble drops to
+
+    (P−1) / (v·M + P−1)
+
+at the cost of v× as many (v-fold smaller) ppermute hops.
+
+The schedule reduces to startlingly little code because of a clean
+arithmetic fact.  Process microbatches in groups of P and let device s
+at tick t decode its work from u = t − s:
+
+    i = u mod P          (microbatch within the group)
+    c = (u div P) mod v  (which of this device's chunks)
+    g = u div (v·P)      (group index)  →  microbatch m = g·P + i
+
+The decomposition is unique, every device does exactly one span-step
+per tick, and the single activation ppermuted along the ring each tick
+is EXACTLY the one the next device's own (u = t − s) decomposition
+expects — including the wrap from device P−1 back to device 0 at chunk
+boundaries, which needs no special case at all.  Injection happens on
+device 0 when c == 0; the loss peels on device P−1 when c == v−1.
+Total ticks: v·M + P − 1 (M padded up to a multiple of P by masking).
+
+The backward needs no hand-written schedule: like GPipe, ``jax.grad``
+of the tick scan IS the reverse interleaved pipeline (the transpose of
+``ppermute`` is the reverse ring).  Activation memory is O(v·M) per
+device like GPipe — the memory-lean interleaved-1F1B hybrid is the
+known next rung; this module contributes the BUBBLE lever, 1F1B
+(``parallel/pipeline_1f1b.py``) the memory lever.
+
+Parameter layout: blocks are stacked so the ``pipe``-sharded leading
+axis hands device s its v chunks contiguously (chunk-major within the
+device) — ``stack_interleaved`` / ``unstack_interleaved`` convert from
+and to the plain per-layer tree.  Inside the step the local stack
+``[v·Lc, ...]`` is sliced per tick at chunk c (``dynamic_slice``, Lc
+layers) and applied with the same ``_apply_local_span`` scan the other
+schedules use.
+
+Update-equivalence to GPipe (same loss, same grads, any M, P, v) is
+property-tested in ``tests/test_pipeline_interleaved.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from distributed_machine_learning_tpu.models.transformer import TransformerLM
+from distributed_machine_learning_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    _apply_local_span,
+    _block_module,
+    make_pipeline_step,
+)
+from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
+from distributed_machine_learning_tpu.train.optimizers import update_fn_for_config
+from distributed_machine_learning_tpu.train.state import TrainState
+
+
+def _interleaved_order(n_layers: int, num_stages: int, v: int) -> list[int]:
+    """Global layer indices in the interleaved stacking order: for each
+    device s, its v chunks (span c·P+s) in chunk order — the ONE
+    definition ``stack_interleaved``/``unstack_interleaved`` must agree
+    on to stay mutually inverse."""
+    lc = n_layers // (num_stages * v)
+    return [
+        layer
+        for s in range(num_stages)
+        for c in range(v)
+        for layer in range((c * num_stages + s) * lc,
+                           (c * num_stages + s + 1) * lc)
+    ]
+
+
+def stack_interleaved(params: dict, n_layers: int, num_stages: int,
+                      v: int) -> dict:
+    """Plain per-layer params → interleaved pipeline layout: a ``P(pipe)``
+    sharding of the stacked axis hands every device exactly its chunks."""
+    order = _interleaved_order(n_layers, num_stages, v)
+    blocks = [params[f"block_{i}"] for i in order]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    return {
+        "embed": params["embed"],
+        "blocks": stacked,
+        "ln_f": params["ln_f"],
+        "lm_head": params["lm_head"],
+    }
+
+
+def unstack_interleaved(pipeline_params: dict, n_layers: int,
+                        num_stages: int, v: int) -> dict:
+    """Inverse of ``stack_interleaved`` (checkpoint interop/eval)."""
+    order = _interleaved_order(n_layers, num_stages, v)
+    out = {
+        "embed": pipeline_params["embed"],
+        "ln_f": pipeline_params["ln_f"],
+        "lm_head": pipeline_params["lm_head"],
+    }
+    for pos, layer in enumerate(order):
+        out[f"block_{layer}"] = jax.tree_util.tree_map(
+            lambda x, pos=pos: x[pos], pipeline_params["blocks"]
+        )
+    return out
+
+
+def init_interleaved_state(model: TransformerLM, num_stages: int, v: int,
+                           seed: int = 69143, config=None) -> TrainState:
+    """Initialize TransformerLM params (dense path) and restack them in
+    the interleaved order for a P-stage, v-chunk pipeline."""
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+    if model.n_layers % (num_stages * v):
+        raise ValueError(
+            f"n_layers={model.n_layers} must divide evenly into "
+            f"{num_stages} stages x {v} chunks"
+        )
+    state = init_lm_state(model, seed=seed, config=config)
+    return TrainState.create(
+        params=stack_interleaved(state.params, model.n_layers, num_stages, v),
+        rng=state.rng,
+        config=state.config,
+    )
+
+
+def _interleaved_forward_loss(
+    model: TransformerLM,
+    params: dict,
+    tokens_mb,  # [M, mb, L] int32 (replicated)
+    targets_mb,  # [M, mb, L] int32
+    *,
+    pipe_axis: str,
+    num_stages: int,
+    v: int,
+):
+    import flax.linen as nn
+
+    block = _block_module(model)
+    M, mb, L = tokens_mb.shape
+    E = model.d_model
+    P_ = num_stages
+    lc = model.n_layers // (P_ * v)
+    rank = lax.axis_index(pipe_axis)
+    positions = jnp.arange(L)
+    is_first = rank == 0
+    is_last = rank == P_ - 1
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+    groups = -(-M // P_)  # groups of P microbatches, padded by masking
+    T = v * groups * P_ + P_ - 1
+
+    embed_mod = nn.Embed(model.vocab_size, E, dtype=model.compute_dtype)
+    ln_f_mod = nn.LayerNorm(dtype=model.compute_dtype)
+    head_mod = nn.Dense(model.vocab_size, dtype=model.compute_dtype)
+
+    def embed(tok):
+        return embed_mod.apply({"params": params["embed"]}, tok)
+
+    def head_loss(x, tgt):
+        h = ln_f_mod.apply({"params": params["ln_f"]}, x)
+        logits = head_mod.apply({"params": params["lm_head"]}, h)
+        return lm_cross_entropy(logits.astype(jnp.float32), tgt)
+
+    def chunk_params(c):
+        """This device's chunk c: Lc layers dynamically sliced from the
+        local [v·Lc, ...] stack."""
+        return jax.tree_util.tree_map(
+            lambda x: lax.dynamic_slice_in_dim(x, c * lc, lc, axis=0),
+            params["blocks"],
+        )
+
+    def tick_core(act, loss_acc, t):
+        u = t - rank
+        i = jnp.where(u >= 0, u, 0)
+        mb_i = i % P_
+        c = (i // P_) % v
+        g = i // (v * P_)
+        m = g * P_ + mb_i
+        valid = (u >= 0) & (u < v * groups * P_) & (m < M)
+
+        inject = embed(
+            lax.dynamic_index_in_dim(tokens_mb, jnp.clip(m, 0, M - 1),
+                                     keepdims=False)
+        )
+        x = jnp.where(is_first & (c == 0) & valid, inject, act)
+        y = _apply_local_span(block, chunk_params(c), x, positions,
+                              remat=model.remat)
+        tgt = lax.dynamic_index_in_dim(
+            targets_mb, jnp.clip(m, 0, M - 1), keepdims=False
+        )
+        peel = (is_last & (c == v - 1) & valid).astype(jnp.float32)
+        return y, loss_acc + peel * head_loss(y, tgt)
+
+    def tick(carry, t):
+        act, loss_acc = carry
+        y, loss_acc = tick_core(act, loss_acc, t)
+        return (lax.ppermute(y, pipe_axis, perm), loss_acc), None
+
+    act = jnp.zeros((mb, L, E), model.compute_dtype)
+    loss_acc = jnp.zeros((), jnp.float32)
+    (act, loss_acc), _ = lax.scan(tick, (act, loss_acc), jnp.arange(T - 1))
+    _, loss_acc = tick_core(act, loss_acc, jnp.asarray(T - 1))
+    return loss_acc / M
+
+
+def _ppi_step_impl(
+    model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis,
+    num_stages, v,
+):
+    from distributed_machine_learning_tpu.parallel.pipeline import _reject_lars
+
+    _reject_lars(state.config)
+    loss_fn = partial(
+        _interleaved_forward_loss,
+        model,
+        tokens_mb=tokens_mb,
+        targets_mb=targets_mb,
+        pipe_axis=pipe_axis,
+        num_stages=num_stages,
+        v=v,
+    )
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    loss = lax.psum(loss, pipe_axis)
+    for name in ("embed", "ln_f", "lm_head"):
+        grads[name] = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, pipe_axis), grads[name]
+        )
+    new_params, new_momentum = update_fn_for_config(state.config)(
+        state.params, state.momentum, grads, state.config, step=state.step
+    )
+    new_state = state.replace(
+        params=new_params, momentum=new_momentum, step=state.step + 1
+    )
+    return new_state, loss
+
+
+def make_pp_interleaved_lm_train_step(
+    model: TransformerLM,
+    mesh: Mesh,
+    num_microbatches: int,
+    v: int,
+    pipe_axis: str = PIPE_AXIS,
+):
+    """Build the interleaved ``step(state, tokens_mb, targets_mb)`` —
+    state from ``init_interleaved_state(model, P, v)`` + the shared
+    ``shard_pp_state``.  ``v`` is the virtual-stage (chunk) count per
+    device; ``v == 1`` degenerates to GPipe's schedule exactly.
+    Requires ``n_layers % (P·v) == 0``.
+    """
+    num_stages = mesh.shape[pipe_axis]
+    if v < 1:
+        raise ValueError(f"v (virtual stages per device) must be >= 1, "
+                         f"got {v}")
+    if model.n_layers % (num_stages * v):
+        raise ValueError(
+            f"n_layers={model.n_layers} must divide evenly into "
+            f"{num_stages} stages x {v} chunks"
+        )
+
+    def step_impl(m, state, x, y, *, pipe_axis, num_stages):
+        return _ppi_step_impl(m, state, x, y, pipe_axis=pipe_axis,
+                              num_stages=num_stages, v=v)
+
+    return make_pipeline_step(step_impl, model, mesh, num_microbatches,
+                              pipe_axis)

@@ -41,11 +41,18 @@ def _state_pytree(state: TrainState) -> dict:
     }
 
 
-def save_checkpoint(directory: str | os.PathLike, state: TrainState) -> str:
+def save_checkpoint(directory: str | os.PathLike, state: TrainState,
+                    layout: str | None = None) -> str:
     """Write `state` under `directory/step_<n>/`; returns the path written.
 
     Only process 0's metadata file is written once; array shards are saved
     by every host (orbax handles the multi-host coordination).
+
+    ``layout``: optional tag naming the PARAMETER layout (e.g. the
+    pipeline schedules' block-stacking orders, which share one tree
+    structure but permute the layers) — recorded so a resume under a
+    different layout can be rejected instead of silently loading
+    permuted weights (``checkpoint_layout``).
     """
     directory = os.path.abspath(os.fspath(directory))
     step = int(jax.device_get(state.step))
@@ -60,11 +67,11 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState) -> str:
             # Record the config class so restore rebuilds the right
             # optimizer config (LARSConfig carries extra fields that
             # SGDConfig(**...) would reject).
-            json.dump(
-                {"__class__": type(state.config).__name__,
-                 **dataclasses.asdict(state.config)},
-                f,
-            )
+            payload = {"__class__": type(state.config).__name__,
+                       **dataclasses.asdict(state.config)}
+            if layout is not None:
+                payload["__layout__"] = layout
+            json.dump(payload, f)
     return path
 
 
@@ -159,9 +166,17 @@ def checkpoint_config(path: str | os.PathLike):
     )
 
     # "SGDConfig" default: checkpoints written before the class tag existed.
+    payload.pop("__layout__", None)  # layout tag is checkpoint_layout's
     return config_class_by_name(payload.pop("__class__", "SGDConfig"))(
         **payload
     )
+
+
+def checkpoint_layout(path: str | os.PathLike) -> str | None:
+    """The parameter-layout tag a checkpoint was saved with (see
+    ``save_checkpoint``); None for plain layouts or pre-tag checkpoints."""
+    with open(os.path.join(os.fspath(path), _CONFIG_FILE)) as f:
+        return json.load(f).get("__layout__")
 
 
 def checkpoint_array_shapes(path: str | os.PathLike) -> dict:

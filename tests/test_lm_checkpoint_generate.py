@@ -77,3 +77,30 @@ def test_lm_resume_with_adjusted_lr(tmp_path):
             "--max-iters", "2", "--ckpt-dir", ck]
     lm.main(base)
     lm.main(base + ["--resume", "--lr", "0.05"])
+
+
+def test_lm_pp_layout_mismatch_refused(tmp_path):
+    """A pipeline checkpoint's block stacking is schedule-dependent but
+    structurally identical — resuming under a different layout must be
+    refused, not silently load permuted layers."""
+    from distributed_machine_learning_tpu.cli import lm
+
+    ck = str(tmp_path / "ck")
+    base = ["--parallel", "pp", "--d-model", "32", "--n-layers", "16",
+            "--n-heads", "2", "--seq-len", "16", "--batch-size", "8",
+            "--microbatches", "2", "--max-iters", "2", "--ckpt-dir", ck]
+    lm.main(base + ["--pp-schedule", "interleaved"])
+    with pytest.raises(ValueError, match="layout"):
+        lm.main(base + ["--pp-schedule", "1f1b", "--resume"])
+    # Same layout resumes fine.
+    lm.main(base + ["--pp-schedule", "interleaved", "--resume"])
+
+
+def test_pp_chunks_guarded(tmp_path):
+    from distributed_machine_learning_tpu.cli import lm
+
+    with pytest.raises(ValueError, match="pp-chunks"):
+        lm.main(["--parallel", "pp", "--pp-schedule", "1f1b",
+                 "--pp-chunks", "4", "--d-model", "32", "--n-layers", "8",
+                 "--n-heads", "2", "--seq-len", "16", "--batch-size", "8",
+                 "--max-iters", "2"])
