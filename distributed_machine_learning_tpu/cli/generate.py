@@ -111,15 +111,21 @@ def main(argv=None) -> None:
                 checkpoint_layout,
             )
 
-            layout = checkpoint_layout(latest)
-            if layout and layout.startswith("pp-interleaved-"):
+            from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
+                parse_interleaved_layout,
+            )
+
+            interleaved = parse_interleaved_layout(
+                checkpoint_layout(latest)
+            )
+            if interleaved is not None:
                 from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
                     unstack_interleaved,
                 )
 
-                p_tag, v_tag = layout.split("-P")[1].split("-v")
+                p_saved, v_saved = interleaved
                 params = unstack_interleaved(
-                    params, args.n_layers, int(p_tag), int(v_tag)
+                    params, args.n_layers, p_saved, v_saved
                 )
             else:
                 from distributed_machine_learning_tpu.parallel.pipeline import (  # noqa: E501

@@ -189,13 +189,12 @@ def build(args):
     n = jax.device_count()
     dtype = jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
     attn = getattr(args, "attn", "auto")
-    if args.parallel in ("pp", "3d", "fsdp") and attn == "auto":
+    if args.parallel in ("pp", "fsdp") and attn == "auto":
         # These steps resolve "auto" to the dense path they default to
         # (pp accepts an EXPLICIT --attn flash — its pipe-axis shard_map
-        # is fully manual; 3d is partial-manual and flat-fsdp's step is
-        # dense-only, so both keep loud guards for explicit flash).
-        # tp/fsdp_pl/ep honor auto themselves via the model's
-        # flash_mesh shard_map wrap.
+        # is fully manual; flat-fsdp's step is dense-only and keeps a
+        # loud guard for explicit flash).  tp/fsdp_pl/ep/3d honor auto
+        # themselves via the model's flash_mesh shard_map wrap.
         attn = "dense"
     common = dict(
         vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
@@ -207,6 +206,16 @@ def build(args):
     from distributed_machine_learning_tpu.train.optimizers import get_optimizer
 
     cfg_cls = get_optimizer(args.optimizer)[0]
+    if args.pp_chunks is not None and not (
+        args.parallel == "pp" and args.pp_schedule == "interleaved"
+    ):
+        # Checked before the scheme dispatch so the flag cannot be
+        # silently ignored under any --parallel value.
+        raise ValueError(
+            "--pp-chunks applies to --parallel pp with --pp-schedule "
+            f"interleaved only (got --parallel {args.parallel}, "
+            f"--pp-schedule {args.pp_schedule})"
+        )
     cfg_kwargs = {}
     if args.lr is not None:
         cfg_kwargs["learning_rate"] = args.lr
@@ -426,11 +435,6 @@ def build(args):
             shard_pp_state,
         )
 
-        if args.pp_chunks is not None and args.pp_schedule != "interleaved":
-            raise ValueError(
-                "--pp-chunks applies to --pp-schedule interleaved only "
-                f"(got --pp-schedule {args.pp_schedule})"
-            )
         mesh = make_mesh(n, ("pipe",))
         model = TransformerLM(**common)
         # Each schedule picks its step builder and (for interleaved, whose
@@ -592,8 +596,12 @@ def main(argv=None) -> None:
         # would silently load permuted layers, so the layout is tagged
         # into the checkpoint and checked here.
         if args.parallel == "pp" and args.pp_schedule == "interleaved":
-            run_layout = (f"pp-interleaved-P{jax.device_count()}"
-                          f"-v{args.pp_chunks or 2}")
+            from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
+                interleaved_layout_tag,
+            )
+
+            run_layout = interleaved_layout_tag(jax.device_count(),
+                                                args.pp_chunks or 2)
         elif args.parallel in ("pp", "3d"):
             run_layout = "pp-contiguous"
         else:
@@ -614,7 +622,16 @@ def main(argv=None) -> None:
                             "starting from scratch.")
             else:
                 saved_layout = checkpoint_layout(latest)
-                if saved_layout != run_layout:
+                # Pre-tag checkpoints (saved before the layout field
+                # existed) are all contiguous stackings — interleaved
+                # postdates the tag — so None is compatible with the
+                # contiguous layouts (including plain, non-pipeline
+                # ones, whose run_layout is None too).
+                compatible = saved_layout == run_layout or (
+                    saved_layout is None and run_layout in
+                    (None, "pp-contiguous")
+                )
+                if not compatible:
                     raise ValueError(
                         f"checkpoint parameter layout {saved_layout!r} "
                         f"does not match this run's {run_layout!r} "

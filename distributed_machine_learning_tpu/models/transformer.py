@@ -168,6 +168,11 @@ class Attention(nn.Module):
     flash_mesh: Any = None
     flash_batch_axis: str = "batch"
     flash_head_axis: str | None = None
+    # None = manualize the WHOLE mesh (the GSPMD steps).  The 3-D step
+    # calls from inside a region already manual over its pipe axis, so
+    # it restricts the wrap to the remaining (batch, model) axes — the
+    # union is still every axis, keeping the kernel fully local.
+    flash_manual_axes: tuple | None = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -303,11 +308,19 @@ class Attention(nn.Module):
 
                 spec = _P(self.flash_batch_axis, None,
                           self.flash_head_axis, None)
+                # Nested inside another shard_map (the 3-D step's
+                # pipe-manual region), jax requires the CONTEXT abstract
+                # mesh — whose axis types record what is already manual
+                # — rather than the all-Auto concrete mesh.
+                ctx_mesh = jax.sharding.get_abstract_mesh()
+                wrap_mesh = (ctx_mesh if getattr(ctx_mesh, "axis_names", ())
+                             else self.flash_mesh)
                 out = shard_map_no_check(
                     flash_self_attention,
-                    mesh=self.flash_mesh,
+                    mesh=wrap_mesh,
                     in_specs=(spec, spec, spec),
                     out_specs=spec,
+                    manual_axes=self.flash_manual_axes,
                 )(q, k, v)
             else:
                 out = flash_self_attention(q, k, v)
@@ -337,6 +350,7 @@ class Block(nn.Module):
     flash_mesh: Any = None
     flash_batch_axis: str = "batch"
     flash_head_axis: str | None = None
+    flash_manual_axes: tuple | None = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -352,6 +366,7 @@ class Block(nn.Module):
             flash_mesh=self.flash_mesh,
             flash_batch_axis=self.flash_batch_axis,
             flash_head_axis=self.flash_head_axis,
+            flash_manual_axes=self.flash_manual_axes,
             name="attn",
         )(h, positions)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
@@ -393,6 +408,7 @@ class TransformerLM(nn.Module):
     flash_mesh: Any = None
     flash_batch_axis: str = "batch"
     flash_head_axis: str | None = None
+    flash_manual_axes: tuple | None = None
     remat: bool = False  # jax.checkpoint each block: activation memory
     # drops from O(L·E) per layer to per-block boundaries, recomputing the
     # block in backward — the HBM-for-FLOPs trade that lets long-context
@@ -450,6 +466,7 @@ class TransformerLM(nn.Module):
                 flash_mesh=self.flash_mesh,
                 flash_batch_axis=self.flash_batch_axis,
                 flash_head_axis=self.flash_head_axis,
+                flash_manual_axes=self.flash_manual_axes,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)

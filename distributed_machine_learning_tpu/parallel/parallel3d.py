@@ -168,8 +168,24 @@ def make_3d_lm_train_step(
     shard_map becomes partial-manual and the jit shardings add the
     batch/model dimensions.
     """
-    if model.attn_impl != "dense":
-        raise ValueError("3-D step requires attn_impl='dense'")
+    if model.attn_impl in ("flash", "auto") and model.flash_mesh is None:
+        # Flash inside the 3-D step: the outer shard_map is manual over
+        # PIPE only, so the model's wrap manualizes the REMAINING
+        # (batch, model) axes — a nested partial-manual shard_map whose
+        # union covers the whole mesh, leaving the Mosaic custom call
+        # fully local (batch sharded over dp, heads over tp, and the
+        # pipe axis already manual in the enclosing region).
+        model = model.clone(
+            flash_mesh=mesh,
+            flash_batch_axis=DATA_AXIS,
+            flash_head_axis=MODEL_AXIS,
+            flash_manual_axes=(DATA_AXIS, MODEL_AXIS),
+        )
+    elif model.attn_impl != "dense":
+        raise ValueError(
+            "3-D step supports attn_impl dense/flash/auto (sequence-"
+            "sharded impls have no axis here)"
+        )
     missing = [a for a in MESH_AXES if a not in mesh.axis_names]
     if missing:
         raise ValueError(f"3-D mesh is missing axes {missing}: {mesh.axis_names}")

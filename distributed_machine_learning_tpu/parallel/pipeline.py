@@ -60,12 +60,12 @@ PIPE_AXIS = "pipe"
 
 
 def _block_module(model: TransformerLM) -> Block:
-    # Flash passes through for the pure-pipeline steps: their shard_map
-    # is FULLY manual over the pipe axis, so the Pallas call sees local
-    # [mb, L] shapes and never meets the partitioner.  The 3-D step
-    # (partial-manual: batch/model stay automatic) keeps its own
-    # dense-only guard (parallel3d.py) and resolves auto to dense, so
-    # only "dense" reaches here from that path.
+    # Flash passes through for the pipeline steps.  Pure pipeline: the
+    # shard_map is FULLY manual over the pipe axis, so the Pallas call
+    # sees local [mb, L] shapes natively (flash_mesh stays None).  The
+    # 3-D step (partial-manual: batch/model automatic) sets flash_mesh +
+    # flash_manual_axes on its model clone, and the wrap manualizes the
+    # remaining axes from inside the pipe-manual region (parallel3d.py).
     return Block(
         n_heads=model.n_heads,
         d_ff=model.d_ff or 4 * model.d_model,
@@ -73,6 +73,10 @@ def _block_module(model: TransformerLM) -> Block:
         seq_axis=model.seq_axis,
         compute_dtype=model.compute_dtype,
         n_kv_heads=model.n_kv_heads,
+        flash_mesh=model.flash_mesh,
+        flash_batch_axis=model.flash_batch_axis,
+        flash_head_axis=model.flash_head_axis,
+        flash_manual_axes=model.flash_manual_axes,
     )
 
 
@@ -231,24 +235,21 @@ def _reject_lars(config) -> None:
         )
 
 
-def _pp_step_impl(
-    model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis, num_stages
-):
+def pp_grads_and_update(state: TrainState, loss_fn, pipe_axis):
+    """Shared back half of every jax.grad-scheduled pipeline step (GPipe
+    and interleaved): differentiate the forward-loss, share the
+    last-stage loss, psum the boundary-module grads, update.
+
+    Invariants that must hold for ANY schedule using this: the psums
+    stay OUTSIDE value_and_grad (a psum inside the differentiated region
+    would inflate cotangents by the axis size under shard_map with
+    replication-checking off), and every replicated (non-"blocks") param
+    — each stage holds a share that is zero unless it used the param —
+    is summed here; stage-sharded blocks grads are already exact
+    locally."""
     _reject_lars(state.config)
-    loss_fn = partial(
-        _pipeline_forward_loss,
-        model,
-        tokens_mb=tokens_mb,
-        targets_mb=targets_mb,
-        pipe_axis=pipe_axis,
-        num_stages=num_stages,
-    )
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
-    # The local loss lives on the last stage only — share it.
     loss = lax.psum(loss, pipe_axis)
-    # Replicated (non-"blocks") params: each stage holds a share that is
-    # zero unless it used the param — sum them.  Stage-sharded blocks grads
-    # are already exact locally.
     for name in ("embed", "ln_f", "lm_head"):
         grads[name] = jax.tree_util.tree_map(
             lambda g: lax.psum(g, pipe_axis), grads[name]
@@ -260,6 +261,20 @@ def _pp_step_impl(
         params=new_params, momentum=new_momentum, step=state.step + 1
     )
     return new_state, loss
+
+
+def _pp_step_impl(
+    model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis, num_stages
+):
+    loss_fn = partial(
+        _pipeline_forward_loss,
+        model,
+        tokens_mb=tokens_mb,
+        targets_mb=targets_mb,
+        pipe_axis=pipe_axis,
+        num_stages=num_stages,
+    )
+    return pp_grads_and_update(state, loss_fn, pipe_axis)
 
 
 def _state_specs(

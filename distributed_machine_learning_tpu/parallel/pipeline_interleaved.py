@@ -68,6 +68,22 @@ from distributed_machine_learning_tpu.train.optimizers import update_fn_for_conf
 from distributed_machine_learning_tpu.train.state import TrainState
 
 
+def interleaved_layout_tag(num_stages: int, v: int) -> str:
+    """Checkpoint layout tag for this stacking (see
+    ``train/checkpoint.py::save_checkpoint``) — the ONE encoder
+    ``parse_interleaved_layout`` inverts."""
+    return f"pp-interleaved-P{num_stages}-v{v}"
+
+
+def parse_interleaved_layout(tag: str) -> tuple[int, int] | None:
+    """(num_stages, v) from an interleaved layout tag; None if the tag
+    names a different layout."""
+    import re
+
+    m = re.fullmatch(r"pp-interleaved-P(\d+)-v(\d+)", tag or "")
+    return (int(m.group(1)), int(m.group(2))) if m else None
+
+
 def _interleaved_order(n_layers: int, num_stages: int, v: int) -> list[int]:
     """Global layer indices in the interleaved stacking order: for each
     device s, its v chunks (span c·P+s) in chunk order — the ONE
@@ -216,9 +232,10 @@ def _ppi_step_impl(
     model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis,
     num_stages, v,
 ):
-    from distributed_machine_learning_tpu.parallel.pipeline import _reject_lars
+    from distributed_machine_learning_tpu.parallel.pipeline import (
+        pp_grads_and_update,
+    )
 
-    _reject_lars(state.config)
     loss_fn = partial(
         _interleaved_forward_loss,
         model,
@@ -228,19 +245,7 @@ def _ppi_step_impl(
         num_stages=num_stages,
         v=v,
     )
-    loss, grads = jax.value_and_grad(loss_fn)(state.params)
-    loss = lax.psum(loss, pipe_axis)
-    for name in ("embed", "ln_f", "lm_head"):
-        grads[name] = jax.tree_util.tree_map(
-            lambda g: lax.psum(g, pipe_axis), grads[name]
-        )
-    new_params, new_momentum = update_fn_for_config(state.config)(
-        state.params, state.momentum, grads, state.config, step=state.step
-    )
-    new_state = state.replace(
-        params=new_params, momentum=new_momentum, step=state.step + 1
-    )
-    return new_state, loss
+    return pp_grads_and_update(state, loss_fn, pipe_axis)
 
 
 def make_pp_interleaved_lm_train_step(
