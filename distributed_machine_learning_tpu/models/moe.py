@@ -28,15 +28,32 @@ import jax
 import jax.numpy as jnp
 
 class MoEMLP(nn.Module):
-    """Top-1 routed expert MLP over [B, T, D] activations."""
+    """Top-1 routed expert MLP over [B, T, D] activations.
+
+    Two compute paths behind one routing front-end (``moe_impl``):
+
+    - ``"einsum"`` (default): Switch-style capacity + overflow drops via
+      static one-hot dispatch/combine einsums — the GSPMD-shardable form
+      whose E axis ``parallel/expert_parallel.py`` shards to get the
+      token all-to-all.
+    - ``"grouped"``: dropless sort + ``lax.ragged_dot`` grouped matmuls
+      (``ops/grouped.py``) — no capacity, no O(N²·D) dispatch FLOPs;
+      the fast path on a single device or under shard_map DP, where no
+      expert-axis partitioning is in play.
+    """
 
     n_experts: int
     d_ff: int
     capacity_factor: float = 1.25
     compute_dtype: Any = jnp.float32
+    moe_impl: str = "einsum"
 
     @nn.compact
     def __call__(self, x):
+        if self.moe_impl not in ("einsum", "grouped"):
+            raise ValueError(
+                f"moe_impl must be 'einsum' or 'grouped', got {self.moe_impl!r}"
+            )
         B, T, D = x.shape
         N = B * T
         E = self.n_experts
@@ -57,14 +74,6 @@ class MoEMLP(nn.Module):
         mean_prob = probs.mean(axis=0)
         self.sow("losses", "load_balancing", E * jnp.sum(frac * mean_prob))
 
-        # Position of each token within its expert's queue; drop overflow.
-        pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based where routed
-        within = (pos > 0) & (pos <= capacity)
-        slot = jax.nn.one_hot(
-            (pos - 1).clip(0).astype(jnp.int32), capacity, dtype=jnp.float32
-        )  # [N, E, C]
-        dmask = slot * within.astype(jnp.float32)[..., None]  # [N, E, C]
-
         dt = self.compute_dtype
         w_in = self.param(
             "w_in", nn.initializers.lecun_normal(), (E, D, self.d_ff)
@@ -74,6 +83,25 @@ class MoEMLP(nn.Module):
             "w_out", nn.initializers.lecun_normal(), (E, self.d_ff, D)
         )
         b_out = self.param("b_out", nn.initializers.zeros, (E, D))
+
+        if self.moe_impl == "grouped":
+            from distributed_machine_learning_tpu.ops.grouped import (
+                grouped_expert_mlp,
+            )
+
+            y = grouped_expert_mlp(
+                tokens.astype(dt), expert_idx, w_in, b_in, w_out, b_out
+            )
+            y = y * expert_prob[:, None].astype(dt)
+            return y.reshape(B, T, D)
+
+        # Position of each token within its expert's queue; drop overflow.
+        pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based where routed
+        within = (pos > 0) & (pos <= capacity)
+        slot = jax.nn.one_hot(
+            (pos - 1).clip(0).astype(jnp.int32), capacity, dtype=jnp.float32
+        )  # [N, E, C]
+        dmask = slot * within.astype(jnp.float32)[..., None]  # [N, E, C]
 
         # Dispatch → expert FFN → combine: three static einsums whose E axis
         # shards over the mesh (the all_to_all lives inside the first/last).
@@ -113,6 +141,7 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
             d_ff=model.d_ff or 4 * model.d_model,
             capacity_factor=model.capacity_factor,
             compute_dtype=model.compute_dtype,
+            moe_impl=model.moe_impl,
             name="moe",
         ),
         name=name,
@@ -131,6 +160,9 @@ class MoETransformerLM(nn.Module):
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     compute_dtype: Any = jnp.float32
+    # "einsum" (capacity + drops, EP-shardable) or "grouped" (dropless
+    # ragged_dot — single-device / shard_map-DP only; see MoEMLP).
+    moe_impl: str = "einsum"
     # dense / flash / auto (sequence-local kernels); the sequence-SHARDED
     # impls (ring/ring_flash/ulysses) stay unsupported — the EP mesh has
     # no seq axis to shard over.

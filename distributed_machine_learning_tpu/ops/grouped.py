@@ -1,0 +1,125 @@
+"""Sort-based grouped expert MLP — the dropless MoE compute path.
+
+The einsum dispatch in ``models/moe.py`` is the right shape for GSPMD
+expert parallelism (the one-hot dispatch/combine einsums are what the
+partitioner turns into the token all-to-all), but on a single device it
+pays O(N·E·C·D) = O(1.25·N²·D) FLOPs of pure data movement per
+dispatch/combine pair — quadratic in tokens and all of it off the MXU's
+useful-work path.  The grouped path here is the TPU-idiomatic
+alternative (the design MegaBlocks argues for on GPUs, mapped onto
+XLA's native ragged matmul): sort token rows by their routed expert,
+run one ``lax.ragged_dot`` per projection over the contiguous groups,
+and unsort.  Dispatch cost falls to O(N·D) gather/scatter bandwidth,
+and the expert matmuls run at dense-matmul MFU (measured on this
+repo's chip: 134 TF/s ragged vs 94 TF/s effective for the einsum
+fragment at N=8k, D=2k, F=8k — before counting the combine einsum).
+
+It is also **dropless**: every token reaches its expert, with no
+capacity rounding — group sizes are data-dependent *values*, which
+``ragged_dot`` consumes without shape dynamism (output shape stays
+[N, F]).  Capacity/overflow semantics (Switch's) remain available via
+the einsum path; parity between the two holds whenever capacity is
+ample enough that nothing drops (tested).
+
+Scope: single-device and shard_map-style data parallelism (each device
+runs this on its local tokens).  The GSPMD expert-sharded step keeps
+the einsum path — ``ragged_dot`` has no partitioning rule that would
+recover the all-to-all (guarded in ``parallel/expert_parallel.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sort_by_expert(expert_idx: jax.Array, n_experts: int):
+    """Permutation that groups token rows by expert, plus group sizes.
+
+    Returns ``(order, inv_order, group_sizes)``: ``order`` sorts rows so
+    expert 0's tokens come first, ``inv_order`` undoes it, and
+    ``group_sizes[e]`` counts expert e's tokens (int32, as
+    ``lax.ragged_dot`` requires).
+
+    Counting sort, not ``argsort``: a bitonic sort of N int keys costs
+    ~log²N full-array passes on the VPU (measured ~2 ms at N=8k on this
+    chip — comparable to one of the expert matmuls it feeds).  With E
+    experts the permutation is cheaper to *construct*: one [N, E] cumsum
+    over the routing one-hot gives each token its rank within its
+    expert's group, an exclusive-sum of group sizes gives each group's
+    base offset, and rank + offset IS the token's destination slot —
+    stable, total, and O(N·E) elementwise work.
+    """
+    n = expert_idx.shape[0]
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [N, E]
+    ranks = jnp.cumsum(onehot, axis=0)  # rank-within-expert, 1-based at own row
+    group_sizes = ranks[-1]  # [E] — totals; int32 already
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes)[:-1]]
+    )  # exclusive prefix: group e starts at offsets[e]
+    # Destination slot of each token = its group's base + its 0-based rank.
+    dest = offsets[expert_idx] + (
+        jnp.sum(ranks * onehot, axis=1, dtype=jnp.int32) - 1
+    )
+    inv_order = dest  # sorted[dest[i]] = tokens[i]  ⇒  dest inverts order
+    order = jnp.zeros((n,), jnp.int32).at[dest].set(
+        jnp.arange(n, dtype=jnp.int32)
+    )
+    return order, inv_order, group_sizes
+
+
+@jax.custom_vjp
+def _permute_rows(x: jax.Array, perm: jax.Array, inv_perm: jax.Array):
+    """``x[perm]`` with a permutation-aware VJP.
+
+    ``jnp.take``'s generic transpose is a scatter-add (indices could
+    repeat), which TPUs execute row-at-a-time — profiled at ~22 GB/s on
+    this chip, ~3 ms per [8k, 2k] un-permute in the MoE backward.  A
+    permutation is bijective, so its cotangent is just the gather by the
+    inverse permutation: both directions run at gather (HBM) speed.
+    """
+    return jnp.take(x, perm, axis=0)
+
+
+def _permute_rows_fwd(x, perm, inv_perm):
+    return jnp.take(x, perm, axis=0), (perm, inv_perm)
+
+
+def _permute_rows_bwd(res, ct):
+    perm, inv_perm = res
+    return jnp.take(ct, inv_perm, axis=0), None, None
+
+
+_permute_rows.defvjp(_permute_rows_fwd, _permute_rows_bwd)
+
+
+def grouped_expert_mlp(
+    tokens: jax.Array,
+    expert_idx: jax.Array,
+    w_in: jax.Array,
+    b_in: jax.Array,
+    w_out: jax.Array,
+    b_out: jax.Array,
+    *,
+    activation=jax.nn.gelu,
+) -> jax.Array:
+    """Dropless routed expert MLP over ``[N, D]`` token rows.
+
+    ``tokens``: [N, D] (already cast to the compute dtype);
+    ``expert_idx``: [N] int routed expert per token; weights carry the
+    leading [E, ...] expert axis.  Returns [N, D] in ``tokens.dtype`` —
+    the caller applies router-prob scaling.  Gradients flow to tokens
+    and all four weight leaves through ``ragged_dot``'s VJP; the integer
+    routing path is non-differentiable exactly as the one-hot path is.
+    """
+    n_experts = w_in.shape[0]
+    order, inv_order, group_sizes = sort_by_expert(expert_idx, n_experts)
+    xs = _permute_rows(tokens, order, inv_order)
+    eids = jnp.take(expert_idx, order, axis=0)
+    dt = tokens.dtype
+    h = lax.ragged_dot(xs, w_in.astype(dt), group_sizes)
+    h = activation(h + jnp.take(b_in.astype(dt), eids, axis=0))
+    ys = lax.ragged_dot(h, w_out.astype(dt), group_sizes)
+    ys = ys + jnp.take(b_out.astype(dt), eids, axis=0)
+    return _permute_rows(ys, inv_order, order)

@@ -103,6 +103,17 @@ def make_ep_train_step(
         )
     if mesh is None:
         return jax.jit(partial(_moe_step_impl, model), donate_argnums=(0,))
+    if model.moe_impl != "einsum":
+        # ragged_dot has no GSPMD partitioning rule that would recover the
+        # token all-to-all from an expert-sharded leading axis; only the
+        # one-hot einsum form shards over the expert axis.  The grouped
+        # path stays single-device / shard_map-DP (ops/grouped.py).
+        raise ValueError(
+            "the expert-sharded GSPMD step requires moe_impl='einsum' "
+            f"(got {model.moe_impl!r}): the dispatch/combine einsums are "
+            "what XLA partitions into the all-to-all; the grouped "
+            "ragged_dot path does not shard over the expert axis"
+        )
     if model.attn_impl in ("flash", "auto") and model.flash_mesh is None:
         # A bare Pallas (Mosaic) custom call inside this GSPMD-
         # partitioned jit has no sharding rules, so flash runs through

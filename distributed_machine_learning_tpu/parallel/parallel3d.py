@@ -168,7 +168,13 @@ def make_3d_lm_train_step(
     shard_map becomes partial-manual and the jit shardings add the
     batch/model dimensions.
     """
-    if model.attn_impl in ("flash", "auto") and model.flash_mesh is None:
+    if model.attn_impl in ("flash", "auto"):
+        if model.flash_mesh is not None:
+            raise ValueError(
+                "make_3d_lm_train_step configures the model's flash "
+                "shard_map wrap itself (it must match this step's mesh "
+                "and axes); pass a model with flash_mesh unset"
+            )
         # Flash inside the 3-D step: the outer shard_map is manual over
         # PIPE only, so the model's wrap manualizes the REMAINING
         # (batch, model) axes — a nested partial-manual shard_map whose

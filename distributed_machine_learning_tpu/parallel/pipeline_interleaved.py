@@ -64,7 +64,6 @@ from distributed_machine_learning_tpu.parallel.pipeline import (
     make_pipeline_step,
 )
 from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
-from distributed_machine_learning_tpu.train.optimizers import update_fn_for_config
 from distributed_machine_learning_tpu.train.state import TrainState
 
 
@@ -77,11 +76,26 @@ def interleaved_layout_tag(num_stages: int, v: int) -> str:
 
 def parse_interleaved_layout(tag: str) -> tuple[int, int] | None:
     """(num_stages, v) from an interleaved layout tag; None if the tag
-    names a different layout."""
+    names a different layout.
+
+    A tag that *claims* to be interleaved (``pp-interleaved-`` prefix)
+    but does not parse raises instead of returning None: falling through
+    to a contiguous-unstack would silently load permuted layer weights.
+    """
     import re
 
-    m = re.fullmatch(r"pp-interleaved-P(\d+)-v(\d+)", tag or "")
-    return (int(m.group(1)), int(m.group(2))) if m else None
+    tag = tag or ""
+    m = re.fullmatch(r"pp-interleaved-P(\d+)-v(\d+)", tag)
+    if m:
+        return int(m.group(1)), int(m.group(2))
+    if tag.startswith("pp-interleaved-"):
+        raise ValueError(
+            f"unrecognized interleaved pipeline layout tag {tag!r} "
+            "(expected 'pp-interleaved-P<stages>-v<chunks>'); refusing "
+            "to fall back to a contiguous unstack, which would permute "
+            "layer weights"
+        )
+    return None
 
 
 def _interleaved_order(n_layers: int, num_stages: int, v: int) -> list[int]:

@@ -69,6 +69,10 @@ def test_lm_cli_bad_config_fails_fast():
         main(TINY + ["--parallel", "3d", "--pp", "0", "--tp", "2"])
     with pytest.raises(ValueError, match="divisible"):
         main(TINY + ["--parallel", "dp", "--batch-size", "12"])
+    # the dropless grouped MoE path refuses a multi-device run loudly
+    with pytest.raises(ValueError, match="single-device"):
+        main(TINY + ["--parallel", "ep", "--n-experts", "4",
+                     "--moe-impl", "grouped"])
     with pytest.raises(ValueError, match="sequence axis"):
         main(TINY + ["--parallel", "ring", "--seq-len", "100"])
     with pytest.raises(ValueError, match="data axis"):

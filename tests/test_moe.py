@@ -108,6 +108,75 @@ def test_ep_spec_rules():
     assert ep_spec_for(("block_0", "attn", "qkv", "kernel"), 4)[0] is None
 
 
+def test_grouped_impl_matches_einsum_when_nothing_drops():
+    """With capacity ample enough that the einsum path drops nothing, the
+    dropless grouped (ragged_dot) path computes the same mixture."""
+    x = jnp.asarray(
+        np.random.default_rng(2).standard_normal((2, 8, 16)), jnp.float32
+    )
+    ein = MoEMLP(n_experts=4, d_ff=32, capacity_factor=8.0)
+    grp = MoEMLP(n_experts=4, d_ff=32, capacity_factor=8.0, moe_impl="grouped")
+    variables = ein.init(jax.random.PRNGKey(0), x)
+    ye, _ = ein.apply(variables, x, mutable=["losses"])
+    yg, _ = grp.apply(variables, x, mutable=["losses"])
+    np.testing.assert_allclose(
+        np.asarray(yg), np.asarray(ye), rtol=2e-3, atol=2e-3
+    )
+
+    # Gradients agree too (routing is non-differentiable on both paths;
+    # token/weight grads flow through ragged_dot's VJP).
+    def loss(params, mod):
+        y, _ = mod.apply({"params": params}, x, mutable=["losses"])
+        return jnp.sum(y * y)
+
+    ge = jax.grad(loss)(variables["params"], ein)
+    gg = jax.grad(loss)(variables["params"], grp)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ge), jax.tree_util.tree_leaves(gg)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=2e-2, atol=2e-2
+        )
+
+
+def test_grouped_impl_is_dropless():
+    """Starved capacity drops tokens on the einsum path; the grouped path
+    processes every token regardless of capacity_factor."""
+    x = jnp.asarray(
+        np.random.default_rng(3).standard_normal((1, 16, 8)), jnp.float32
+    )
+    ein = MoEMLP(n_experts=2, d_ff=16, capacity_factor=0.01)
+    grp = MoEMLP(n_experts=2, d_ff=16, capacity_factor=0.01, moe_impl="grouped")
+    variables = ein.init(jax.random.PRNGKey(0), x)
+    ye, _ = ein.apply(variables, x, mutable=["losses"])
+    yg, _ = grp.apply(variables, x, mutable=["losses"])
+    ein_rows = np.abs(np.asarray(ye).reshape(16, 8)).sum(-1) > 1e-7
+    grp_rows = np.abs(np.asarray(yg).reshape(16, 8)).sum(-1) > 1e-7
+    assert ein_rows.sum() <= 2  # capacity 1 per expert: nearly all dropped
+    assert grp_rows.all()  # dropless: every token reaches its expert
+
+    # And capacity_factor is a no-op for the grouped path.
+    grp_hi = MoEMLP(n_experts=2, d_ff=16, capacity_factor=4.0, moe_impl="grouped")
+    yh, _ = grp_hi.apply(variables, x, mutable=["losses"])
+    np.testing.assert_array_equal(np.asarray(yg), np.asarray(yh))
+
+
+def test_grouped_lm_trains_and_ep_mesh_rejects_it(batch):
+    tokens, targets = batch
+    model = tiny_moe(moe_impl="grouped")
+    state = init_moe_state(model)
+    step = make_ep_train_step(model, mesh=None)
+    x, y = jnp.asarray(tokens), jnp.asarray(targets)
+    state, first = step(state, x, y)
+    for _ in range(5):
+        state, loss = step(state, x, y)
+    assert float(loss) < float(first)
+
+    mesh = make_mesh(8, axis_names=("batch", "expert"), axis_shape=(2, 4))
+    with pytest.raises(ValueError, match="einsum"):
+        make_ep_train_step(model, mesh)
+
+
 def test_moe_flash_attention_matches_dense(batch):
     """attn_impl='flash' in the MoE blocks (sequence-local kernel, so it
     composes with expert parallelism) == the dense MoE forward."""
