@@ -145,10 +145,18 @@ def bench_decode(args) -> None:
     )
     state = init_lm_state(model)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
-    params = jax.tree_util.tree_map(
-        lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p,
-        state.params,
-    )
+    if args.quant:
+        # Weight-only int8 serving: quantize from the f32 master params.
+        from distributed_machine_learning_tpu.ops.quant import (
+            quantize_lm_params,
+        )
+
+        params = quantize_lm_params(state.params)
+    else:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p,
+            state.params,
+        )
     rng = np.random.default_rng(0)
     prompt = jax.device_put(jnp.asarray(
         rng.integers(0, args.vocab, (args.batch, args.prompt_len)),
@@ -162,7 +170,8 @@ def bench_decode(args) -> None:
     from distributed_machine_learning_tpu.bench.harness import two_point_fit
 
     def timed_for(n_tokens):
-        fn = make_generate_fn(model, n_tokens, temperature=0.0)
+        fn = make_generate_fn(model, n_tokens, temperature=0.0,
+                              quantize="int8" if args.quant else None)
         out = fn(params, prompt, key)
         jax.block_until_ready(out)
 
@@ -200,6 +209,7 @@ def bench_decode(args) -> None:
             "vocab": args.vocab, "batch": args.batch,
             "prompt_len": args.prompt_len, "gen_tokens": args.gen_tokens,
             "bf16": args.bf16, "kv_cache_dtype": args.kv_cache_dtype,
+            "quant": "int8" if args.quant else None,
         },
     }))
 
@@ -233,6 +243,9 @@ def main() -> None:
                         "i.e. it is MFU not HFU")
     p.add_argument("--fp32", dest="bf16", action="store_false",
                    help="run the trunk in fp32 (default bfloat16)")
+    p.add_argument("--quant", action="store_true",
+                   help="with --decode: weight-only int8 serving (the "
+                        "Pallas int8 matmul kernel, ops/quant.py)")
     p.add_argument("--decode", action="store_true",
                    help="benchmark the KV-cached decode path instead of "
                         "the train step (prefill vs steady-state tok/s)")
@@ -243,6 +256,11 @@ def main() -> None:
                         "(e.g. float32; default = compute dtype)")
     args = p.parse_args()
 
+    if args.quant and not args.decode:
+        raise ValueError(
+            "--quant is a decode-path option (weight-only int8 serving); "
+            "pass --decode with it — the train benches run full precision"
+        )
     if args.decode:
         bench_decode(args)
         return

@@ -54,6 +54,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype", default=None,
                    help="decode cache storage dtype (default: compute "
                         "dtype)")
+    p.add_argument("--quant", default=None, choices=["int8"],
+                   help="weight-only quantized serving: projections read "
+                        "int8 weights through the Pallas kernel "
+                        "(ops/quant.py) — decode is weight-bandwidth-"
+                        "bound, measured 1.3-1.8x tokens/s (docs/PERF.md)")
     return p
 
 
@@ -141,11 +146,19 @@ def main(argv=None) -> None:
 
         params = init_lm_state(model).params
         print("WARNING: --random-init weights (untrained output)")
-    # Serving configuration: cast fp32 master params to the compute
-    # dtype (decode is bound by HBM weight reads).
-    params = jax.tree_util.tree_map(
-        lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params
-    )
+    # Serving configuration: quantize (from the fp32 master params) or
+    # cast to the compute dtype (decode is bound by HBM weight reads).
+    if args.quant == "int8":
+        from distributed_machine_learning_tpu.ops.quant import (
+            quantize_lm_params,
+        )
+
+        params = quantize_lm_params(params)
+    else:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p,
+            params,
+        )
 
     # Byte-level prompt encoding, BOS-prefixed like every corpus
     # document (data/text.py::load_corpus).
@@ -157,7 +170,8 @@ def main(argv=None) -> None:
     prompt = jnp.asarray(np.asarray(toks, np.int32)[None, :])
 
     fn = make_generate_fn(model, args.max_new_tokens,
-                          temperature=args.temperature, top_k=args.top_k)
+                          temperature=args.temperature, top_k=args.top_k,
+                          quantize=args.quant)
     out = np.asarray(
         fn(params, prompt, jax.random.PRNGKey(args.seed))
     )[0, prompt.shape[1]:]

@@ -48,6 +48,7 @@ def make_generate_fn(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: int | None = None,
+    quantize: str | None = None,
 ):
     """Build a jitted ``fn(params, prompt, rng) -> tokens``.
 
@@ -57,10 +58,17 @@ def make_generate_fn(
     logits.  The model is cloned to dense cached attention — parameters
     from any training-time ``attn_impl`` (ring/ulysses/flash share the
     exact same parameter structure) drop in unchanged.
+
+    ``quantize="int8"`` serves weight-only int8: pass params already
+    converted by ``ops.quant.quantize_lm_params`` (the ``generate``
+    wrapper converts for you) — decode is weight-bandwidth-bound, so
+    halving the weight bytes is ~the step-time divisor (docs/PERF.md).
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    dm = model.clone(attn_impl="dense", decode=True)
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
+    dm = model.clone(attn_impl="dense", decode=True, weight_quant=quantize)
     sample = partial(_sample, temperature=temperature, top_k=top_k)
 
     @jax.jit
@@ -116,13 +124,22 @@ def generate(
     temperature: float = 0.0,
     top_k: int | None = None,
     rng=None,
+    quantize: str | None = None,
 ):
     """One-shot convenience wrapper around :func:`make_generate_fn`.
 
     For repeated generation at fixed shapes, build the fn once instead —
-    this wrapper retraces on every call.
+    this wrapper retraces on every call.  ``quantize="int8"`` converts
+    the (full-precision) params with ``quantize_lm_params`` here.
     """
-    fn = make_generate_fn(model, max_new_tokens, temperature, top_k)
+    fn = make_generate_fn(model, max_new_tokens, temperature, top_k,
+                          quantize=quantize)
+    if quantize == "int8":
+        from distributed_machine_learning_tpu.ops.quant import (
+            quantize_lm_params,
+        )
+
+        params = quantize_lm_params(params)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return fn(params, jnp.asarray(prompt, jnp.int32), rng)

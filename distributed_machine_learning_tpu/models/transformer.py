@@ -173,19 +173,39 @@ class Attention(nn.Module):
     # it restricts the wrap to the remaining (batch, model) axes — the
     # union is still every axis, keeping the kernel fully local.
     flash_manual_axes: tuple | None = None
+    # "int8" = weight-only quantized projections for serving decode
+    # (ops/quant.py); None = full-precision nn.DenseGeneral.
+    weight_quant: str | None = None
 
     @nn.compact
     def __call__(self, x, positions):
         B, L, E = x.shape
         assert E % self.n_heads == 0, "n_heads must divide d_model"
         head_dim = E // self.n_heads
+
+        def proj(features, axis, name):
+            """nn.DenseGeneral, or its int8 twin when weight_quant is on
+            (serving decode — ops/quant.py); same name → the quantized
+            params from quantize_lm_params land in the same scope."""
+            if self.weight_quant == "int8":
+                from distributed_machine_learning_tpu.ops.quant import (
+                    QuantDenseGeneral,
+                )
+
+                feats = features if isinstance(features, tuple) else (features,)
+                return QuantDenseGeneral(
+                    out_features=feats,
+                    n_in_axes=len(axis) if isinstance(axis, tuple) else 1,
+                    compute_dtype=self.compute_dtype,
+                    name=name,
+                )
+            return nn.DenseGeneral(
+                features=features, axis=axis, dtype=self.compute_dtype,
+                name=name,
+            )
+
         if self.n_kv_heads is None or self.n_kv_heads == self.n_heads:
-            qkv = nn.DenseGeneral(
-                features=(3, self.n_heads, head_dim),
-                axis=-1,
-                dtype=self.compute_dtype,
-                name="qkv",
-            )(x)
+            qkv = proj((3, self.n_heads, head_dim), -1, "qkv")(x)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,L,H,Dh]
         else:
             if self.n_heads % self.n_kv_heads:
@@ -193,14 +213,8 @@ class Attention(nn.Module):
                     f"n_kv_heads={self.n_kv_heads} must divide "
                     f"n_heads={self.n_heads}"
                 )
-            q = nn.DenseGeneral(
-                features=(self.n_heads, head_dim), axis=-1,
-                dtype=self.compute_dtype, name="q",
-            )(x)
-            kv = nn.DenseGeneral(
-                features=(2, self.n_kv_heads, head_dim), axis=-1,
-                dtype=self.compute_dtype, name="kv",
-            )(x)
+            q = proj((self.n_heads, head_dim), -1, "q")(x)
+            kv = proj((2, self.n_kv_heads, head_dim), -1, "kv")(x)
             k, v = kv[:, :, 0], kv[:, :, 1]  # [B, L, Hkv, Dh]
         q = apply_rope(q, positions)
         k = apply_rope(k, positions)
@@ -328,9 +342,7 @@ class Attention(nn.Module):
             out = dense_self_attention(
                 q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), positions
             )
-        return nn.DenseGeneral(
-            features=E, axis=(-2, -1), dtype=self.compute_dtype, name="out"
-        )(out)
+        return proj(E, (-2, -1), "out")(out)
 
 
 class Block(nn.Module):
@@ -351,6 +363,7 @@ class Block(nn.Module):
     flash_batch_axis: str = "batch"
     flash_head_axis: str | None = None
     flash_manual_axes: tuple | None = None
+    weight_quant: str | None = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -367,11 +380,27 @@ class Block(nn.Module):
             flash_batch_axis=self.flash_batch_axis,
             flash_head_axis=self.flash_head_axis,
             flash_manual_axes=self.flash_manual_axes,
+            weight_quant=self.weight_quant,
             name="attn",
         )(h, positions)
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
         if self.mlp_factory is not None:
             return x + self.mlp_factory()(h)
+        if self.weight_quant == "int8":
+            from distributed_machine_learning_tpu.ops.quant import (
+                QuantDenseGeneral,
+            )
+
+            h = QuantDenseGeneral(
+                out_features=(self.d_ff,), compute_dtype=self.compute_dtype,
+                name="fc_in",
+            )(h)
+            h = nn.gelu(h)
+            h = QuantDenseGeneral(
+                out_features=(x.shape[-1],),
+                compute_dtype=self.compute_dtype, name="fc_out",
+            )(h)
+            return x + h
         h = nn.Dense(self.d_ff, dtype=self.compute_dtype, name="fc_in")(h)
         h = nn.gelu(h)
         h = nn.Dense(x.shape[-1], dtype=self.compute_dtype, name="fc_out")(h)
@@ -409,6 +438,11 @@ class TransformerLM(nn.Module):
     flash_batch_axis: str = "batch"
     flash_head_axis: str | None = None
     flash_manual_axes: tuple | None = None
+    # "int8" = weight-only quantized serving (decode mode only): every
+    # kernel-bearing projection reads int8 weights through the Pallas
+    # kernel (ops/quant.py; params from quantize_lm_params).  Embeddings
+    # stay full precision (a gather).
+    weight_quant: str | None = None
     remat: bool = False  # jax.checkpoint each block: activation memory
     # drops from O(L·E) per layer to per-block boundaries, recomputing the
     # block in backward — the HBM-for-FLOPs trade that lets long-context
@@ -423,6 +457,12 @@ class TransformerLM(nn.Module):
         which never materializes [B, L, vocab]."""
         del train  # no dropout/BN — kept for the shared train-step interface
         B, L = tokens.shape
+        if self.weight_quant is not None and not self.decode:
+            raise ValueError(
+                "weight_quant is a serving-decode feature (int8 weights "
+                "are not trainable); clone with decode=True — "
+                "inference/generate.py does this"
+            )
         if self.decode:
             if self.attn_impl != "dense":
                 raise ValueError(
@@ -467,10 +507,23 @@ class TransformerLM(nn.Module):
                 flash_batch_axis=self.flash_batch_axis,
                 flash_head_axis=self.flash_head_axis,
                 flash_manual_axes=self.flash_manual_axes,
+                weight_quant=self.weight_quant,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)
         if return_hidden:
             return x
-        logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype, name="lm_head")(x)
+        if self.weight_quant == "int8":
+            from distributed_machine_learning_tpu.ops.quant import (
+                QuantDenseGeneral,
+            )
+
+            logits = QuantDenseGeneral(
+                out_features=(self.vocab_size,),
+                compute_dtype=self.compute_dtype, name="lm_head",
+            )(x)
+        else:
+            logits = nn.Dense(
+                self.vocab_size, dtype=self.compute_dtype, name="lm_head"
+            )(x)
         return logits.astype(jnp.float32)

@@ -1,0 +1,135 @@
+"""Weight-only int8 serving: kernel parity, converter structure, and
+token-exact generation vs the dequantized reference (ops/quant.py,
+ops/pallas/quant_matmul.py — interpret mode on the CPU harness)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.inference.generate import (
+    generate,
+    make_generate_fn,
+)
+from distributed_machine_learning_tpu.models.transformer import TransformerLM
+from distributed_machine_learning_tpu.ops.pallas.quant_matmul import (
+    int8_matmul,
+    quantize_int8,
+)
+from distributed_machine_learning_tpu.ops.quant import quantize_lm_params
+
+
+def test_quantize_int8_roundtrip_error_bound():
+    w = jnp.asarray(
+        np.random.default_rng(0).standard_normal((64, 96)), jnp.float32
+    ) * 0.02
+    q, s = quantize_int8(w)
+    assert q.dtype == jnp.int8 and s.shape == (96,)
+    back = q.astype(jnp.float32) * s[None, :]
+    # Symmetric 8-bit: error <= scale/2 per element, elementwise.
+    assert float(jnp.abs(back - w).max()) <= float(s.max()) / 2 + 1e-8
+    # All-zero columns quantize cleanly (scale 1, values 0).
+    q0, s0 = quantize_int8(jnp.zeros((8, 4)))
+    assert float(jnp.abs(q0).max()) == 0 and float(s0.min()) == 1.0
+
+
+def test_int8_matmul_matches_dequant_reference():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((24, 64)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64, 256)), jnp.float32) * 0.05
+    q, s = quantize_int8(w)
+    ref = x.astype(jnp.bfloat16) @ (
+        q.astype(jnp.bfloat16) * s[None, :].astype(jnp.bfloat16)
+    )
+    out = int8_matmul(x, q, s)
+    assert out.dtype == x.dtype
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_int8_matmul_pads_awkward_row_counts():
+    """An odd prefill row count (> 8, no multiple-of-8 divisor) is
+    zero-padded to tile rather than falling back to one whole-array
+    tile (the VMEM blowup the caps exist to prevent)."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((13, 64)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32) * 0.05
+    q, s = quantize_int8(w)
+    out = int8_matmul(x, q, s)
+    assert out.shape == (13, 128)
+    ref = x.astype(jnp.bfloat16) @ (
+        q.astype(jnp.bfloat16) * s[None, :].astype(jnp.bfloat16)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_int8_matmul_shape_guards():
+    # 1000 > the block cap with no 128-multiple divisor → must refuse
+    # (a K smaller than the cap, e.g. 200, runs as one full-dim block).
+    q, s = quantize_int8(jnp.ones((64, 1000)))
+    with pytest.raises(ValueError, match="tile"):
+        int8_matmul(jnp.ones((8, 64)), q, s)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        int8_matmul(jnp.ones((8, 32)), *quantize_int8(jnp.ones((64, 128))))
+
+
+def _dequant_tree(params, qparams):
+    """Quantized tree → kernel-shaped full-precision tree (the reference
+    a correct int8 path must reproduce through the kernel)."""
+
+    def walk(ref, node):
+        if isinstance(ref, dict):
+            if "w_q" in node:
+                w = node["w_q"].astype(jnp.float32) * node["scale"][None, :]
+                out = {"kernel": w.reshape(ref["kernel"].shape)}
+                if "bias" in node:
+                    out["bias"] = node["bias"]
+                return out
+            return {k: walk(ref[k], node[k]) for k in ref}
+        return node
+
+    return walk(params, qparams)
+
+
+@pytest.mark.parametrize("kv", [None, 2], ids=["mha", "gqa"])
+def test_quantized_generate_token_exact_vs_dequant(kv):
+    model = TransformerLM(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=kv
+    )
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    qparams = quantize_lm_params(params)
+    # Converter structure: every projection quantized, embed untouched.
+    blk = qparams["block_0"]["attn"]
+    assert ("qkv" if kv is None else "q") in blk
+    for leaf in jax.tree_util.tree_leaves(
+        blk[("qkv" if kv is None else "q")]["w_q"]
+    ):
+        assert leaf.dtype == jnp.int8
+    assert "embedding" in qparams["embed"]
+
+    prompt = np.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], np.int32)
+    ref = generate(model, _dequant_tree(params, qparams), prompt, 12)
+    fn = make_generate_fn(model, 12, quantize="int8")
+    out = fn(qparams, jnp.asarray(prompt), jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_weight_quant_requires_decode():
+    model = TransformerLM(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=4, weight_quant="int8"
+    )
+    with pytest.raises(ValueError, match="decode"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(ValueError, match="int8"):
+        make_generate_fn(
+            TransformerLM(vocab_size=64, d_model=32, n_layers=1, n_heads=4),
+            4,
+            quantize="int4",
+        )
