@@ -75,12 +75,18 @@ def make_generate_fn(
     def run(params, prompt, rng):
         B, Lp = prompt.shape
         max_len = Lp + max_new_tokens
-        # Cache layout via eval_shape (no FLOPs): init in decode mode with
-        # a [B, max_len] input sizes every layer's K/V cache.
+        # Cache layout via eval_shape (no FLOPs): init in decode mode
+        # with a [B, cache_len] input sizing every layer's K/V cache.
+        # The allocation rounds up to a 512 multiple so the cache tiles
+        # into the flash-decode kernel's S blocks
+        # (ops/pallas/decode_attention.py) — the frontier-clamped DMA
+        # never reads the pad slots, so the only cost is their
+        # allocation.
+        cache_len = -(-max_len // 512) * 512
         shapes = jax.eval_shape(
             lambda: dm.init(
                 jax.random.PRNGKey(0),
-                jnp.zeros((B, max_len), jnp.int32),
+                jnp.zeros((B, cache_len), jnp.int32),
                 train=False,
             )
         )["cache"]

@@ -60,11 +60,14 @@ def _cached_attention(q, k_cache, v_cache, q_positions):
     """Attention of fresh queries against the full K/V cache, GQA-native.
 
     ``q``: [B, Lq, H, D] at absolute positions ``q_positions`` ([Lq]);
-    ``k_cache``/``v_cache``: [B, S, Hkv, D] (Hkv | H) where slot j holds
+    ``k_cache``/``v_cache``: [B, Hkv, S, D] (Hkv | H) where slot j holds
     position j (zeros beyond the write frontier — masked out by
     causality, since unwritten slots all have j > max(q_positions)).
-    fp32 softmax, dtype preserved — matching
-    :func:`dense_self_attention`.
+    The head-major cache layout keeps each head's slots contiguous in
+    (slot, lane) tiles — the layout the flash-decode kernel DMAs at
+    full bandwidth (head-minor [S, Hkv, D] tiles pad Hkv=4 sublanes to
+    8, measured 8× slower DMA).  fp32 softmax, dtype preserved —
+    matching :func:`dense_self_attention`.
 
     The query heads are RESHAPED into [Hkv, rep] groups and contracted
     against the narrow cache directly — no widened K/V is ever
@@ -74,11 +77,11 @@ def _cached_attention(q, k_cache, v_cache, q_positions):
     buys.
     """
     B, Lq, H, D = q.shape
-    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    Hkv, S = k_cache.shape[1], k_cache.shape[2]
     rep = H // Hkv
     qg = q.astype(jnp.float32).reshape(B, Lq, Hkv, rep, D)
     s = jnp.einsum(
-        "bqhrd,bkhd->bhrqk",
+        "bqhrd,bhkd->bhrqk",
         qg,
         k_cache.astype(jnp.float32),
         preferred_element_type=jnp.float32,
@@ -87,7 +90,7 @@ def _cached_attention(q, k_cache, v_cache, q_positions):
     s = jnp.where(mask[None, None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
-        "bhrqk,bkhd->bqhrd", p, v_cache.astype(jnp.float32),
+        "bhrqk,bhkd->bqhrd", p, v_cache.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
     return out.reshape(B, Lq, H, D).astype(q.dtype)
@@ -225,20 +228,56 @@ class Attention(nn.Module):
             # are RoPE-rotated at their absolute position before being
             # written, so cached entries never need re-rotation.
             cache_dtype = self.kv_cache_dtype or k.dtype
+            quant_cache = jnp.dtype(cache_dtype) == jnp.int8
+            # Head-major cache layout [B, Hkv, S, D]: each head's slots
+            # form full (slot, lane) tiles, which is what lets the
+            # flash-decode kernel (and the einsum) stream the cache at
+            # HBM bandwidth — see _cached_attention's docstring.
+            cshape = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
             ck = self.variable(
-                "cache", "cached_key", jnp.zeros, k.shape, cache_dtype
+                "cache", "cached_key", jnp.zeros, cshape, cache_dtype
             )
             cv = self.variable(
-                "cache", "cached_value", jnp.zeros, v.shape, cache_dtype
+                "cache", "cached_value", jnp.zeros, cshape, cache_dtype
             )
+            if quant_cache:
+                # int8 KV: one f32 scale per (kv head, slot) beside the
+                # int8 rows — written together, dequantized in-register
+                # by the decode kernel (ops/pallas/decode_attention.py).
+                # Cache HBM traffic halves vs bf16; scales are [Hkv, S]
+                # floats, noise next to the [Hkv, S, D] rows.
+                cks = self.variable(
+                    "cache", "cached_key_scale", jnp.zeros, cshape[:3],
+                    jnp.float32,
+                )
+                cvs = self.variable(
+                    "cache", "cached_value_scale", jnp.zeros, cshape[:3],
+                    jnp.float32,
+                )
             if not self.is_initializing():
                 start = positions[0]
-                ck.value = lax.dynamic_update_slice(
-                    ck.value, k.astype(cache_dtype), (0, start, 0, 0)
-                )
-                cv.value = lax.dynamic_update_slice(
-                    cv.value, v.astype(cache_dtype), (0, start, 0, 0)
-                )
+
+                def _write(ref, t, sref=None):
+                    t = t.swapaxes(1, 2)  # [B, Hkv, L, D]
+                    if quant_cache:
+                        amax = jnp.max(
+                            jnp.abs(t.astype(jnp.float32)), axis=-1
+                        )
+                        s = jnp.where(amax > 0, amax / 127.0, 1.0)
+                        t = jnp.clip(
+                            jnp.round(t.astype(jnp.float32) / s[..., None]),
+                            -127, 127,
+                        ).astype(jnp.int8)
+                        sref.value = lax.dynamic_update_slice(
+                            sref.value, s, (0, 0, start)
+                        )
+                    ref.value = lax.dynamic_update_slice(
+                        ref.value, t.astype(ref.value.dtype),
+                        (0, 0, start, 0),
+                    )
+
+                _write(ck, k, cks if quant_cache else None)
+                _write(cv, v, cvs if quant_cache else None)
                 if L > 1:
                     # PREFILL (the one multi-token call, at start == 0 —
                     # generate.py's contract): the cache was empty, so
@@ -261,9 +300,42 @@ class Attention(nn.Module):
                             positions,
                         )
                 else:
-                    # Narrow cache straight into the GQA-native cached
+                    # Narrow cache straight into GQA-native cached
                     # attention — no repeat, no widened materialization.
-                    out = _cached_attention(q, ck.value, cv.value, positions)
+                    # The flash-decode kernel for int8 caches (XLA would
+                    # dequantize through HBM) and long bf16/f32 caches
+                    # (measured at/above the einsum from ~4k up, plus
+                    # frontier-clamped O(pos) reads); the head-major
+                    # einsum for short caches, where the kernel's
+                    # per-grid-step overhead still loses to XLA's single
+                    # fused op (84 vs 48 µs at S=2k — docs/PERF.md).
+                    from distributed_machine_learning_tpu.ops.pallas.decode_attention import (  # noqa: E501
+                        cached_flash_attention,
+                        decode_flash_qualifies,
+                    )
+
+                    S_alloc = ck.value.shape[2]
+                    if decode_flash_qualifies(S_alloc) and (
+                        quant_cache or S_alloc >= 4096
+                    ):
+                        out = cached_flash_attention(
+                            q, ck.value, cv.value, positions[0],
+                            cks.value if quant_cache else None,
+                            cvs.value if quant_cache else None,
+                        )
+                    elif quant_cache:
+                        out = _cached_attention(
+                            q,
+                            ck.value.astype(jnp.float32)
+                            * cks.value[..., None],
+                            cv.value.astype(jnp.float32)
+                            * cvs.value[..., None],
+                            positions,
+                        )
+                    else:
+                        out = _cached_attention(
+                            q, ck.value, cv.value, positions
+                        )
             else:
                 out = dense_self_attention(
                     q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), positions

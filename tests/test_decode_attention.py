@@ -1,0 +1,98 @@
+"""Flash-decode kernel: parity with the einsum cached attention at every
+frontier position, int8-cache accuracy, and the generate-path dispatch
+(ops/pallas/decode_attention.py — interpret mode on the CPU harness)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.models.transformer import (
+    TransformerLM,
+    _cached_attention,
+)
+from distributed_machine_learning_tpu.ops.pallas.decode_attention import (
+    cached_flash_attention,
+    decode_flash_qualifies,
+    pick_block_s,
+)
+
+
+@pytest.mark.parametrize("pos", [0, 5, 63, 64, 200, 255])
+def test_decode_kernel_matches_einsum(pos):
+    """Slots past ``pos`` hold garbage on purpose: the kernel's frontier
+    clamp + mask must make them invisible, exactly like the einsum's
+    position mask."""
+    rng = np.random.default_rng(0)
+    B, S, H, Hkv, D = 2, 256, 8, 2, 64
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.float32)
+    ref = _cached_attention(q, k, v, jnp.asarray([pos], jnp.int32))
+    out = cached_flash_attention(q, k, v, jnp.int32(pos))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_decode_kernel_int8_cache_close_to_exact():
+    rng = np.random.default_rng(1)
+    B, S, H, Hkv, D = 1, 128, 4, 4, 32
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    kf = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.float32)
+    vf = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), jnp.float32)
+
+    def quant(t):
+        amax = jnp.abs(t).max(axis=-1)
+        s = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q8 = jnp.clip(jnp.round(t / s[..., None]), -127, 127).astype(jnp.int8)
+        return q8, s
+
+    k8, ks = quant(kf)
+    v8, vs = quant(vf)
+    ref = _cached_attention(q, kf, vf, jnp.asarray([100], jnp.int32))
+    out = cached_flash_attention(q, k8, v8, jnp.int32(100), ks, vs)
+    # int8 KV error budget: ~1% relative on the attention output.
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=0.05, atol=0.05
+    )
+    with pytest.raises(ValueError, match="k_scale"):
+        cached_flash_attention(q, k8, v8, jnp.int32(100))
+
+
+def test_block_picker_and_dispatch_rule():
+    assert pick_block_s(2048) == 512
+    assert pick_block_s(2208) is None  # no 128-multiple divisor
+    assert pick_block_s(4) == 4
+    assert pick_block_s(128) == 128
+    assert decode_flash_qualifies(2048)
+    assert decode_flash_qualifies(69)  # small cache: one full block
+    assert not decode_flash_qualifies(2208)  # long + untileable: einsum
+
+
+def _greedy(model, params, prompt, n, kv_dtype=None):
+    from distributed_machine_learning_tpu.inference.generate import generate
+
+    m = model.clone(kv_cache_dtype=kv_dtype)
+    return np.asarray(generate(m, params, prompt, n))
+
+
+def test_generate_int8_kv_cache_matches_full_precision():
+    """End-to-end: int8 KV cache generation agrees with the f32-cache
+    run on a trained-scale-free tiny model (greedy decoding is stable
+    under the ~1% KV error at these sizes)."""
+    model = TransformerLM(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2
+    )
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    prompt = np.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], np.int32)
+    full = _greedy(model, params, prompt, 8)
+    quant = _greedy(model, params, prompt, 8, kv_dtype=jnp.int8)
+    # Same shape always; token agreement nearly always — assert a high
+    # overlap rather than exact equality to keep the test robust to the
+    # quantization noise it exists to exercise.
+    assert full.shape == quant.shape
+    agree = (full == quant).mean()
+    assert agree >= 0.8, f"int8-KV generation diverged: {agree:.0%} agreement"

@@ -73,7 +73,7 @@ def test_decode_cache_is_narrow(rng):
                            jnp.zeros((1, 8), jnp.int32), train=False)
     )["cache"]
     cached_key = shapes["block_0"]["attn"]["cached_key"]
-    assert cached_key.shape == (1, 8, 1, 4)  # [B, S, Hkv=1, Dh]
+    assert cached_key.shape == (1, 1, 8, 4)  # [B, Hkv=1, S, Dh] head-major
 
 
 @pytest.mark.slow
