@@ -46,6 +46,7 @@ def bench_one(attn: str, args) -> tuple[float, int]:
         attn_impl=attn,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         remat=args.remat,
+        remat_policy=args.remat_policy,
     )
     from distributed_machine_learning_tpu.train.sgd import SGDConfig
 
@@ -241,6 +242,12 @@ def main() -> None:
                         "long-context configs fit the chip; reported MFU "
                         "still counts model FLOPs only (not recompute), "
                         "i.e. it is MFU not HFU")
+    p.add_argument("--remat-policy", dest="remat_policy", default="mlp",
+                   choices=("mlp", "block"),
+                   help="'mlp' (selective: save attention residuals, remat "
+                        "only LN2+MLP — backward never re-runs the O(L^2) "
+                        "attention forward) or 'block' (whole-block, "
+                        "maximal memory savings)")
     p.add_argument("--fp32", dest="bf16", action="store_false",
                    help="run the trunk in fp32 (default bfloat16)")
     p.add_argument("--quant", action="store_true",

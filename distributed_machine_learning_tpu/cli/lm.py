@@ -178,6 +178,13 @@ def make_parser():
                    help="jax.checkpoint each transformer block: activation "
                         "memory drops ~n_layers-fold for ~33%% more FLOPs "
                         "— the long-context enabler (models/transformer.py)")
+    p.add_argument("--remat-policy", dest="remat_policy", default="mlp",
+                   choices=["mlp", "block"],
+                   help="with --remat: 'mlp' checkpoints only the LN2+MLP "
+                        "sub-layer (attention residuals incl. flash "
+                        "out+lse stay saved — backward never re-runs the "
+                        "O(L^2) attention forward); 'block' is whole-block "
+                        "remat, the maximal-memory-savings fallback")
     return p
 
 
@@ -206,6 +213,7 @@ def build(args):
     common = dict(
         vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
         n_heads=args.n_heads, compute_dtype=dtype, remat=args.remat,
+        remat_policy=getattr(args, "remat_policy", "mlp"),
         n_kv_heads=args.n_kv_heads,
         # ring/ulysses overwrite this below; all other modes honor it.
         attn_impl=attn,

@@ -417,10 +417,50 @@ class Attention(nn.Module):
         return proj(E, (-2, -1), "out")(out)
 
 
+def _mlp_sublayer(mdl: "Block", h: jax.Array) -> jax.Array:
+    """LN2 + feed-forward sub-layer of a Block (residual added by the
+    caller).  A module-level function (first arg = the Block) so it can be
+    lifted through ``nn.remat`` for the selective-remat policy without
+    changing the parameter tree: the same ``ln2``/``fc_in``/``fc_out``
+    names land in the same scope whether or not the wrap is applied, so
+    checkpoints are layout-compatible across remat policies."""
+    d_out = h.shape[-1]
+    h = nn.LayerNorm(dtype=mdl.compute_dtype, name="ln2")(h)
+    if mdl.mlp_factory is not None:
+        return mdl.mlp_factory()(h)
+    if mdl.weight_quant == "int8":
+        from distributed_machine_learning_tpu.ops.quant import (
+            QuantDenseGeneral,
+        )
+
+        h = QuantDenseGeneral(
+            out_features=(mdl.d_ff,), compute_dtype=mdl.compute_dtype,
+            name="fc_in",
+        )(h)
+        h = nn.gelu(h)
+        return QuantDenseGeneral(
+            out_features=(d_out,),
+            compute_dtype=mdl.compute_dtype, name="fc_out",
+        )(h)
+    h = nn.Dense(mdl.d_ff, dtype=mdl.compute_dtype, name="fc_in")(h)
+    h = nn.gelu(h)
+    return nn.Dense(d_out, dtype=mdl.compute_dtype, name="fc_out")(h)
+
+
 class Block(nn.Module):
     """Pre-LN transformer block.  ``mlp_factory`` swaps the feed-forward
     sub-layer (e.g. for a routed MoE MLP — ``models/moe.py``) while the
-    residual/LN/attention wiring stays in one place."""
+    residual/LN/attention wiring stays in one place.
+
+    ``remat_mlp=True`` is the SELECTIVE remat policy: only the LN2+MLP
+    sub-layer is checkpointed; the attention path's residuals — including
+    the flash kernel's saved ``(out, lse)`` (O(L·D), cheap) — stay
+    resident, so the backward pass never re-runs the O(L²) attention
+    forward.  Whole-block remat re-runs everything (flash forward
+    included) in backward — the ~4/3 HFU overhead docs/PERF.md's 16k/32k
+    rows paid in round 3; this policy converts most of that recompute
+    back into real tokens/sec at the cost of ~6·L·E saved activation
+    bytes per layer instead of ~1·L·E."""
 
     n_heads: int
     d_ff: int
@@ -436,6 +476,7 @@ class Block(nn.Module):
     flash_head_axis: str | None = None
     flash_manual_axes: tuple | None = None
     weight_quant: str | None = None
+    remat_mlp: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -455,28 +496,9 @@ class Block(nn.Module):
             weight_quant=self.weight_quant,
             name="attn",
         )(h, positions)
-        h = nn.LayerNorm(dtype=self.compute_dtype, name="ln2")(x)
-        if self.mlp_factory is not None:
-            return x + self.mlp_factory()(h)
-        if self.weight_quant == "int8":
-            from distributed_machine_learning_tpu.ops.quant import (
-                QuantDenseGeneral,
-            )
-
-            h = QuantDenseGeneral(
-                out_features=(self.d_ff,), compute_dtype=self.compute_dtype,
-                name="fc_in",
-            )(h)
-            h = nn.gelu(h)
-            h = QuantDenseGeneral(
-                out_features=(x.shape[-1],),
-                compute_dtype=self.compute_dtype, name="fc_out",
-            )(h)
-            return x + h
-        h = nn.Dense(self.d_ff, dtype=self.compute_dtype, name="fc_in")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(x.shape[-1], dtype=self.compute_dtype, name="fc_out")(h)
-        return x + h
+        if self.remat_mlp and not self.decode:
+            return x + nn.remat(_mlp_sublayer)(self, x)
+        return x + _mlp_sublayer(self, x)
 
 
 class TransformerLM(nn.Module):
@@ -519,6 +541,14 @@ class TransformerLM(nn.Module):
     # drops from O(L·E) per layer to per-block boundaries, recomputing the
     # block in backward — the HBM-for-FLOPs trade that lets long-context
     # (ring/ulysses) runs fit; FLOPs +~33%, memory ÷ ~n_layers.
+    # Which remat policy `remat=True` applies:
+    #   "mlp" (default)  — selective: checkpoint only the LN2+MLP
+    #     sub-layer; attention residuals (incl. the flash kernel's
+    #     out+lse) stay saved, so backward never re-runs the O(L²)
+    #     attention forward.  ~6·L·E saved bytes/layer.
+    #   "block" — whole-block jax.checkpoint (the maximal-savings
+    #     fallback, ~1·L·E bytes/layer): use when "mlp" does not fit.
+    remat_policy: str = "mlp"
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
@@ -562,9 +592,15 @@ class TransformerLM(nn.Module):
         d_ff = self.d_ff or 4 * self.d_model
         # nn.remat must see concrete (non-decode) blocks: the decode path
         # mutates cache variables, which checkpointing cannot replay.
-        block_cls = (
-            nn.remat(Block) if (self.remat and not self.decode) else Block
-        )
+        if self.remat_policy not in ("mlp", "block"):
+            raise ValueError(
+                f"remat_policy must be 'mlp' or 'block', got "
+                f"{self.remat_policy!r}"
+            )
+        rematting = self.remat and not self.decode
+        whole_block = rematting and self.remat_policy == "block"
+        block_cls = nn.remat(Block) if whole_block else Block
+        remat_mlp = rematting and self.remat_policy == "mlp"
         for i in range(self.n_layers):
             x = block_cls(
                 n_heads=self.n_heads,
@@ -580,6 +616,7 @@ class TransformerLM(nn.Module):
                 flash_head_axis=self.flash_head_axis,
                 flash_manual_axes=self.flash_manual_axes,
                 weight_quant=self.weight_quant,
+                remat_mlp=remat_mlp,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)

@@ -77,7 +77,23 @@ def _block_module(model: TransformerLM) -> Block:
         flash_batch_axis=model.flash_batch_axis,
         flash_head_axis=model.flash_head_axis,
         flash_manual_axes=model.flash_manual_axes,
+        # The selective remat policy lives INSIDE the block (LN2+MLP
+        # checkpointed, attention residuals saved), so the pipeline
+        # honors it here; the "block" policy is applied by
+        # _apply_local_span's whole-layer jax.checkpoint instead — see
+        # _whole_layer_remat.
+        remat_mlp=model.remat and model.remat_policy == "mlp",
     )
+
+
+def _whole_layer_remat(model: TransformerLM) -> bool:
+    """True when the pipeline span scan should wrap each layer in
+    ``jax.checkpoint`` — i.e. ``remat=True`` under the whole-block
+    policy.  The selective "mlp" policy checkpoints inside the Block
+    (``_block_module``) and must NOT also be wrapped here, or the outer
+    checkpoint would re-run attention anyway, silently downgrading the
+    policy the user asked for."""
+    return model.remat and model.remat_policy == "block"
 
 
 def stack_lm_params(params: dict, n_layers: int) -> dict:
@@ -189,7 +205,7 @@ def _pipeline_forward_loss(
         )
         x = jnp.where(is_first & (t < M), inject, act)
         y = _apply_local_span(block, params["blocks"], x, positions,
-                              remat=model.remat)
+                              remat=_whole_layer_remat(model))
         # Last stage peels off microbatch m = t − (P−1).
         m = t - (num_stages - 1)
         tgt = lax.dynamic_index_in_dim(

@@ -61,6 +61,7 @@ from distributed_machine_learning_tpu.parallel.pipeline import (
     PIPE_AXIS,
     _apply_local_span,
     _block_module,
+    _whole_layer_remat,
 )
 from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
 from distributed_machine_learning_tpu.train.optimizers import update_fn_for_config
@@ -106,7 +107,7 @@ def _1f1b_loss_and_grads(
         recompute holds one layer's activations at a time (the same
         knob the GPipe step honors)."""
         y = _apply_local_span(block, blocks_p, act, positions,
-                              remat=model.remat)
+                              remat=_whole_layer_remat(model))
         h = ln_f_mod.apply({"params": ln_f_p}, y)
         logits = head_mod.apply({"params": head_p}, h)
         loss = lm_cross_entropy(logits.astype(jnp.float32), tgt)

@@ -61,6 +61,7 @@ from distributed_machine_learning_tpu.parallel.pipeline import (
     PIPE_AXIS,
     _apply_local_span,
     _block_module,
+    _whole_layer_remat,
     make_pipeline_step,
 )
 from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
@@ -223,7 +224,7 @@ def _interleaved_forward_loss(
         )
         x = jnp.where(is_first & (c == 0) & valid, inject, act)
         y = _apply_local_span(block, chunk_params(c), x, positions,
-                              remat=model.remat)
+                              remat=_whole_layer_remat(model))
         tgt = lax.dynamic_index_in_dim(
             targets_mb, jnp.clip(m, 0, M - 1), keepdims=False
         )

@@ -125,3 +125,38 @@ def test_dense_model_rejects_sharded_seq_axis(batch):
     x, y = shard_lm_batch(mesh_dp, tokens, targets)
     state, loss = step(state, x, y)
     assert np.isfinite(float(loss))
+
+
+@pytest.mark.parametrize("policy", ["mlp", "block"])
+def test_remat_policies_match_no_remat(batch, policy):
+    """Both remat policies are pure memory/recompute trades: loss and
+    gradients must match the un-rematted model exactly (same jaxpr
+    numerics, just re-run in backward)."""
+    tokens, targets = batch
+    x, y = jnp.asarray(tokens), jnp.asarray(targets)
+    base = tiny_lm(remat=False)
+    rem = tiny_lm(remat=True, remat_policy=policy)
+    params = base.init(jax.random.PRNGKey(5), x)["params"]
+
+    def loss_fn(model):
+        def f(p):
+            logits = model.apply({"params": p}, x)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
+
+        return jax.jit(jax.value_and_grad(f))
+
+    l0, g0 = loss_fn(base)(params)
+    l1, g1 = loss_fn(rem)(params)
+    assert np.allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5,
+                                   atol=1e-6)
+
+
+def test_remat_policy_validated():
+    model = tiny_lm(remat=True, remat_policy="bogus")
+    with pytest.raises(ValueError, match="remat_policy"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
