@@ -77,10 +77,20 @@ def make_parser():
     p.add_argument("--moe-impl", dest="moe_impl", default="einsum",
                    choices=["einsum", "grouped"],
                    help="MoE expert compute (--parallel ep only): 'einsum' "
-                        "= Switch capacity + drops, shardable over the "
+                        "= Switch capacity + drops, GSPMD-sharded over the "
                         "expert axis; 'grouped' = dropless ragged-matmul "
-                        "fast path (ops/grouped.py), single-device only — "
-                        "measured 1.33x faster on the MoE portion on-chip")
+                        "path (ops/grouped.py) — single-device fast path "
+                        "(measured 1.33x on the MoE portion on-chip) AND, "
+                        "multi-device, the manual shard_map EP step with "
+                        "an explicit token all_to_all to expert owners "
+                        "(batch shards over data x expert; no attention "
+                        "duplication)")
+    p.add_argument("--ep-seq", dest="ep_seq", default=1, type=int,
+                   help="sequence-axis size for MoE x context parallelism "
+                        "(--parallel ep --moe-impl grouped only): shards "
+                        "the sequence over a third mesh axis and runs "
+                        "ring attention over it while the MoE dispatch "
+                        "all_to_alls over the expert axis")
     p.add_argument("--d-model", dest="d_model", default=256, type=int)
     p.add_argument("--n-layers", dest="n_layers", default=4, type=int)
     p.add_argument("--n-heads", dest="n_heads", default=8, type=int)
@@ -231,6 +241,13 @@ def build(args):
             f"interleaved only (got --parallel {args.parallel}, "
             f"--pp-schedule {args.pp_schedule})"
         )
+    if getattr(args, "ep_seq", 1) != 1 and args.parallel != "ep":
+        # Same pre-dispatch discipline as --pp-chunks: a flag that only
+        # one scheme reads must not be silently ignored by the others.
+        raise ValueError(
+            "--ep-seq (MoE x context parallelism) applies to --parallel "
+            f"ep only (got --parallel {args.parallel})"
+        )
     cfg_kwargs = {}
     if args.lr is not None:
         cfg_kwargs["learning_rate"] = args.lr
@@ -379,11 +396,25 @@ def build(args):
                 f"--n-experts {args.n_experts} must be divisible by "
                 f"--ep {ep}"
             )
-        dp = n // ep
+        sp = args.ep_seq
+        if sp < 1:
+            raise ValueError(f"--ep-seq must be >= 1, got {sp}")
+        if sp > 1 and args.moe_impl != "grouped":
+            raise ValueError(
+                "--ep-seq (MoE x context parallelism) requires "
+                "--moe-impl grouped (the manual shard_map step; the "
+                "GSPMD einsum step has no sequence axis)"
+            )
+        if n % (ep * sp):
+            raise ValueError(
+                f"--ep {ep} x --ep-seq {sp} must divide the device "
+                f"count {n}"
+            )
+        dp = n // (ep * sp)
         if args.batch_size % dp:
             raise ValueError(
                 f"--batch-size {args.batch_size} must be divisible by "
-                f"the {dp}-device data axis (devices/ep)"
+                f"the {dp}-device data axis (devices/(ep*ep_seq))"
             )
         model = MoETransformerLM(
             vocab_size=args.vocab, d_model=args.d_model,
@@ -392,19 +423,74 @@ def build(args):
             compute_dtype=dtype, attn_impl=attn, moe_impl=args.moe_impl,
         )
         if args.moe_impl == "grouped":
-            # The dropless ragged-matmul path has no expert-axis
-            # partitioning rule (parallel/expert_parallel.py guard); it is
-            # the single-device fast path, so take the plain-jit step.
-            if n != 1:
+            if n == 1 and sp == 1:
+                # Single device: the plain-jit dropless path.
+                step = make_ep_train_step(model, mesh=None)
+                state = init_moe_state(model, seed=SEED, config=opt_config)
+                place = lambda x, y: (jnp.asarray(x), jnp.asarray(y))
+                return step, state, place, model, lambda st: st.params
+            # Multi-device: the manual shard_map EP step — explicit token
+            # all_to_all to expert owners + local ragged_dot (dropless).
+            # The batch shards over data × expert (the einsum step
+            # replicates activations over the expert axis; this one does
+            # not).  With --ep-seq > 1 the sequence shards over a third
+            # mesh axis (MoE × context parallelism): attention becomes
+            # the ppermute ring — upgraded to the flash-kernel ring
+            # exactly like --parallel ring when the per-device chunk
+            # tiles natively and the user asked for flash/auto.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from distributed_machine_learning_tpu.parallel.expert_parallel import (  # noqa: E501
+                make_ep_grouped_train_step,
+            )
+
+            if args.batch_size % (dp * ep):
                 raise ValueError(
-                    "--moe-impl grouped runs single-device only (the "
-                    "ragged grouped matmul does not shard over the "
-                    f"expert axis); this run has {n} devices — use "
-                    "--moe-impl einsum for expert parallelism"
+                    f"--batch-size {args.batch_size} must be divisible "
+                    f"by data x expert = {dp * ep} (the EP-grouped step "
+                    "shards the batch over both)"
                 )
-            step = make_ep_train_step(model, mesh=None)
-            state = init_moe_state(model, seed=SEED, config=opt_config)
-            place = lambda x, y: (jnp.asarray(x), jnp.asarray(y))
+            if sp > 1:
+                from distributed_machine_learning_tpu.models.transformer import (  # noqa: E501
+                    _ring_flash_wins,
+                )
+
+                if args.seq_len % sp:
+                    raise ValueError(
+                        f"--seq-len {args.seq_len} must be divisible by "
+                        f"--ep-seq {sp}"
+                    )
+                chunk = args.seq_len // sp
+                if attn in ("auto", "flash") and _ring_flash_wins(chunk):
+                    ring_impl = "ring_flash"
+                else:
+                    if attn == "flash":
+                        rank0_print(
+                            f"WARNING: per-device chunk {chunk} does not "
+                            "qualify for the flash ring kernels — "
+                            "falling back to the einsum ring"
+                        )
+                    ring_impl = "ring"
+                model = model.clone(attn_impl=ring_impl)
+                mesh = make_mesh(
+                    n, ("batch", "expert", "seq"), (dp, ep, sp)
+                )
+                step = make_ep_grouped_train_step(
+                    model, mesh, seq_axis="seq"
+                )
+                batch_spec = P(("batch", "expert"), "seq")
+            else:
+                mesh = make_mesh(n, ("batch", "expert"), (dp, ep))
+                step = make_ep_grouped_train_step(model, mesh)
+                batch_spec = P(("batch", "expert"), None)
+            state = shard_ep_state(
+                init_moe_state(model, seed=SEED, config=opt_config), mesh
+            )
+            batch_sharding = NamedSharding(mesh, batch_spec)
+            place = lambda x, y: (
+                jax.device_put(jnp.asarray(x), batch_sharding),
+                jax.device_put(jnp.asarray(y), batch_sharding),
+            )
             return step, state, place, model, lambda st: st.params
         mesh = make_mesh(n, ("batch", "expert"), (dp, ep))
         step = make_ep_train_step(model, mesh)

@@ -47,12 +47,28 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     compute_dtype: Any = jnp.float32
     moe_impl: str = "einsum"
+    # Manual expert parallelism (shard_map context): when ``expert_axis``
+    # is set, this module's expert params are declared at their LOCAL
+    # shard shape [E/ep, ...] and the grouped compute path dispatches
+    # token rows to their owner device with an explicit all_to_all
+    # (ops/grouped.py::grouped_expert_mlp_ep).  ``token_axes`` names
+    # every mesh axis the token rows are sharded over, so the Switch aux
+    # loss is computed from GLOBAL routing statistics (pmean'd fractions)
+    # — numerically the same aux the unsharded model computes.
+    expert_axis: str | None = None
+    token_axes: tuple = ()
 
     @nn.compact
     def __call__(self, x):
         if self.moe_impl not in ("einsum", "grouped"):
             raise ValueError(
                 f"moe_impl must be 'einsum' or 'grouped', got {self.moe_impl!r}"
+            )
+        if self.expert_axis is not None and self.moe_impl != "grouped":
+            raise ValueError(
+                "expert_axis (the manual shard_map EP path) requires "
+                "moe_impl='grouped'; einsum EP is the GSPMD step "
+                "(parallel/expert_parallel.py::make_ep_train_step)"
             )
         B, T, D = x.shape
         N = B * T
@@ -70,19 +86,50 @@ class MoEMLP(nn.Module):
         onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [N, E]
 
         # Switch aux loss: E · Σ_e (token fraction)·(mean router prob).
+        # Under manual sharding the fractions pmean over every token-
+        # sharded axis first, so the sown scalar equals the global-batch
+        # aux on every device (and the einsum-EP / single-device value).
         frac = onehot.mean(axis=0)
         mean_prob = probs.mean(axis=0)
+        if self.token_axes:
+            from jax import lax
+
+            frac = lax.pmean(frac, self.token_axes)
+            mean_prob = lax.pmean(mean_prob, self.token_axes)
         self.sow("losses", "load_balancing", E * jnp.sum(frac * mean_prob))
 
         dt = self.compute_dtype
+        if self.expert_axis is not None:
+            from jax import lax
+
+            ep = lax.axis_size(self.expert_axis)
+            if E % ep:
+                raise ValueError(
+                    f"n_experts={E} must divide over expert axis size {ep}"
+                )
+            e_param = E // ep  # params declared at the LOCAL shard shape
+        else:
+            e_param = E
         w_in = self.param(
-            "w_in", nn.initializers.lecun_normal(), (E, D, self.d_ff)
+            "w_in", nn.initializers.lecun_normal(), (e_param, D, self.d_ff)
         )
-        b_in = self.param("b_in", nn.initializers.zeros, (E, self.d_ff))
+        b_in = self.param("b_in", nn.initializers.zeros, (e_param, self.d_ff))
         w_out = self.param(
-            "w_out", nn.initializers.lecun_normal(), (E, self.d_ff, D)
+            "w_out", nn.initializers.lecun_normal(), (e_param, self.d_ff, D)
         )
-        b_out = self.param("b_out", nn.initializers.zeros, (E, D))
+        b_out = self.param("b_out", nn.initializers.zeros, (e_param, D))
+
+        if self.expert_axis is not None:
+            from distributed_machine_learning_tpu.ops.grouped import (
+                grouped_expert_mlp_ep,
+            )
+
+            y = grouped_expert_mlp_ep(
+                tokens.astype(dt), expert_idx, w_in, b_in, w_out, b_out,
+                expert_axis=self.expert_axis, n_experts_global=E,
+            )
+            y = y * expert_prob[:, None].astype(dt)
+            return y.reshape(B, T, D)
 
         if self.moe_impl == "grouped":
             from distributed_machine_learning_tpu.ops.grouped import (
@@ -121,6 +168,10 @@ class MoEMLP(nn.Module):
 # Attention impls that need no sequence mesh axis — the set both the
 # model's guard and make_ep_train_step's guard accept.
 SEQ_LOCAL_ATTN_IMPLS = ("dense", "flash", "auto")
+# The sequence-SHARDED impls (MoE × context parallelism): one constant so
+# the model's RoPE-offset branch and the step builders can never disagree
+# about which impls shard the sequence.
+SEQ_SHARDED_ATTN_IMPLS = ("ring", "ring_flash", "ulysses")
 
 
 def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
@@ -132,7 +183,7 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
         n_heads=model.n_heads,
         d_ff=model.d_ff or 4 * model.d_model,
         attn_impl=model.attn_impl,
-        seq_axis="seq",
+        seq_axis=model.seq_axis,
         compute_dtype=model.compute_dtype,
         flash_mesh=model.flash_mesh,
         flash_batch_axis=model.flash_batch_axis,
@@ -142,6 +193,8 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
             capacity_factor=model.capacity_factor,
             compute_dtype=model.compute_dtype,
             moe_impl=model.moe_impl,
+            expert_axis=model.expert_axis,
+            token_axes=model.token_axes,
             name="moe",
         ),
         name=name,
@@ -161,27 +214,48 @@ class MoETransformerLM(nn.Module):
     aux_loss_weight: float = 0.01
     compute_dtype: Any = jnp.float32
     # "einsum" (capacity + drops, EP-shardable) or "grouped" (dropless
-    # ragged_dot — single-device / shard_map-DP only; see MoEMLP).
+    # ragged_dot; composes with real EP via the manual shard_map step).
     moe_impl: str = "einsum"
-    # dense / flash / auto (sequence-local kernels); the sequence-SHARDED
-    # impls (ring/ring_flash/ulysses) stay unsupported — the EP mesh has
-    # no seq axis to shard over.
+    # dense / flash / auto (sequence-local kernels) anywhere; the
+    # sequence-SHARDED impls (ring/ring_flash/ulysses) additionally
+    # require the manual MoE × context-parallel step
+    # (parallel/expert_parallel.py::make_ep_grouped_train_step with
+    # seq_axis) — a mesh whose ``seq_axis`` appears in ``token_axes``.
     attn_impl: str = "dense"
+    seq_axis: str = "seq"
     # Flash-under-GSPMD composition; see ``transformer.Attention``.
     flash_mesh: Any = None
     flash_batch_axis: str = "batch"
+    # Manual shard_map EP (see ``MoEMLP.expert_axis``): the step builder
+    # (parallel/expert_parallel.py::make_ep_grouped_train_step) clones
+    # the model with these set; user code leaves them None/().
+    expert_axis: str | None = None
+    token_axes: tuple = ()
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
         del train
-        if self.attn_impl not in SEQ_LOCAL_ATTN_IMPLS:
+        seq_sharded = self.seq_axis in self.token_axes
+        if self.attn_impl not in SEQ_LOCAL_ATTN_IMPLS and not seq_sharded:
             raise NotImplementedError(
-                "MoETransformerLM supports the sequence-local attention "
-                "kernels only (dense/flash/auto); ring/ulysses + MoE is "
-                "not wired up"
+                "MoETransformerLM runs the sequence-local attention "
+                "kernels (dense/flash/auto) under plain apply; the "
+                "sequence-sharded impls (ring/ring_flash/ulysses) need "
+                "the MoE x context-parallel step, which clones the model "
+                "with the seq axis in token_axes "
+                "(parallel/expert_parallel.py::make_ep_grouped_train_step)"
             )
         B, L = tokens.shape
-        positions = jnp.arange(L)
+        if self.attn_impl in SEQ_SHARDED_ATTN_IMPLS:
+            # Sequence-sharded: this device holds chunk axis_index(seq)
+            # of the global sequence — same RoPE offset rule as
+            # TransformerLM, so sharded and unsharded logits match.
+            from jax import lax
+
+            offset = lax.axis_index(self.seq_axis) * L
+        else:
+            offset = 0
+        positions = offset + jnp.arange(L)
         x = nn.Embed(
             self.vocab_size, self.d_model, dtype=self.compute_dtype, name="embed"
         )(tokens)

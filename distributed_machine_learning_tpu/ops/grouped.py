@@ -21,13 +21,21 @@ capacity rounding — group sizes are data-dependent *values*, which
 the einsum path; parity between the two holds whenever capacity is
 ample enough that nothing drops (tested).
 
-Scope: single-device and shard_map-style data parallelism (each device
-runs this on its local tokens).  The GSPMD expert-sharded step keeps
-the einsum path — ``ragged_dot`` has no partitioning rule that would
-recover the all-to-all (guarded in ``parallel/expert_parallel.py``).
+Scope: single-device, shard_map-style data parallelism (each device
+runs this on its local tokens), and — via
+:func:`grouped_expert_mlp_ep` — real expert parallelism under a
+fully-manual shard_map: token rows travel to their expert's owner
+device through an explicit ``lax.all_to_all`` along the expert mesh
+axis, ``ragged_dot`` runs over the received groups locally, and the
+outputs ride the inverse all-to-all home.  ``ragged_dot`` has no GSPMD
+partitioning rule, so the automatic-partitioner EP step keeps the
+einsum path (guarded in ``parallel/expert_parallel.py``); the manual
+path here is how the dropless kernel composes with EP.
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -123,3 +131,143 @@ def grouped_expert_mlp(
     ys = lax.ragged_dot(h, w_out.astype(dt), group_sizes)
     ys = ys + jnp.take(b_out.astype(dt), eids, axis=0)
     return _permute_rows(ys, inv_order, order)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _scatter_rows(x: jax.Array, idx: jax.Array, n_out: int):
+    """Rows of ``x`` scattered to UNIQUE slots ``idx`` of a zero
+    [n_out, D] buffer.  Because the slots are unique (an injection —
+    the EP slotting map below guarantees it), the exact cotangent is
+    the gather back by ``idx`` — never the generic scatter-add
+    transpose (row-at-a-time on TPU, ~22 GB/s measured; see
+    ``_permute_rows``)."""
+    return jnp.zeros((n_out, x.shape[1]), x.dtype).at[idx].set(x)
+
+
+def _scatter_rows_fwd(x, idx, n_out):
+    return _scatter_rows(x, idx, n_out), idx
+
+
+def _scatter_rows_bwd(n_out, idx, ct):
+    return jnp.take(ct, idx, axis=0), None
+
+
+_scatter_rows.defvjp(_scatter_rows_fwd, _scatter_rows_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _gather_rows(x: jax.Array, idx: jax.Array, n_in: int):
+    """``x[idx]`` where ``idx`` addresses UNIQUE rows of an [n_in, D]
+    buffer: the exact cotangent is the scatter-set back (unaddressed
+    rows correctly get zero), avoiding ``jnp.take``'s scatter-add
+    transpose."""
+    return jnp.take(x, idx, axis=0)
+
+
+def _gather_rows_fwd(x, idx, n_in):
+    return jnp.take(x, idx, axis=0), idx
+
+
+def _gather_rows_bwd(n_in, idx, ct):
+    return jnp.zeros((n_in, ct.shape[1]), ct.dtype).at[idx].set(ct), None
+
+
+_gather_rows.defvjp(_gather_rows_fwd, _gather_rows_bwd)
+
+
+def grouped_expert_mlp_ep(
+    tokens: jax.Array,
+    expert_idx: jax.Array,
+    w_in: jax.Array,
+    b_in: jax.Array,
+    w_out: jax.Array,
+    b_out: jax.Array,
+    *,
+    expert_axis: str,
+    n_experts_global: int,
+    activation=jax.nn.gelu,
+) -> jax.Array:
+    """Dropless routed expert MLP under REAL expert parallelism.
+
+    Must run inside a ``shard_map`` with ``expert_axis`` bound (fully
+    manual over it).  Each device holds ``tokens`` [N_local, D] — its
+    shard of the global batch — and the weights of its
+    ``E_local = n_experts_global / ep`` experts (leading axis of
+    ``w_in``/``b_in``/``w_out``/``b_out`` is the LOCAL expert count;
+    device r owns global experts [r·E_local, (r+1)·E_local)).
+    ``expert_idx`` routes each local token to a GLOBAL expert.
+
+    The dance (all static shapes, exact inverses on the way back):
+
+    1. **Slot**: token i goes to owner ``o = expert // E_local`` at
+       slot ``o·S + rank_within_owner(i)`` with ``S = N_local`` send
+       slots per owner — a device can send at most all its rows to one
+       owner, so the bound can never overflow: **provably dropless**,
+       unlike the einsum path's per-expert capacity.  The slot map is
+       injective, so scatter/gather custom VJPs are exact inverses.
+    2. **all_to_all** along ``expert_axis``: chunk o of the send
+       buffer lands on device o — the token all-to-all the einsum path
+       leaves to the GSPMD partitioner, written explicitly.
+    3. **Group**: received rows counting-sort by LOCAL expert with a
+       trailing dummy group for empty slots; ``lax.ragged_dot`` covers
+       only the real groups (uncovered trailing rows produce zeros
+       with zero gradients — verified semantics).
+    4. **Return**: un-sort, all_to_all back, gather by the slot map.
+
+    Returns [N_local, D] in ``tokens.dtype`` (router-prob scaling is
+    the caller's, as in :func:`grouped_expert_mlp`).  The ICI cost is
+    2 all_to_alls of ep·S rows; the matmul padding is bounded by the
+    receive buffer (ep·S rows vs ~N_local useful on a balanced
+    router).  Reference: the all-to-all pattern is Switch/GShard
+    dispatch (SURVEY.md §2.3 marks EP absent in the reference — this
+    is beyond-parity capability).
+    """
+    ep = lax.axis_size(expert_axis)
+    e_local = w_in.shape[0]
+    if e_local * ep != n_experts_global:
+        raise ValueError(
+            f"local expert axis {e_local} x mesh axis {ep} != "
+            f"n_experts_global {n_experts_global}"
+        )
+    n, d = tokens.shape
+    S = n  # per-owner send slots: provably overflow-free
+    e0 = lax.axis_index(expert_axis) * e_local
+
+    owner = expert_idx // e_local  # [N] destination device on the axis
+    oh = jax.nn.one_hot(owner, ep, dtype=jnp.int32)
+    rank = jnp.sum(jnp.cumsum(oh, axis=0) * oh, axis=1) - 1  # within-owner
+    slot = owner * S + rank  # unique in [0, ep*S)
+
+    send = _scatter_rows(tokens, slot, ep * S)  # [ep*S, D]
+    # Expert ids ride beside the rows; -1 marks never-written slots.
+    send_ids = jnp.full((ep * S,), -1, jnp.int32).at[slot].set(expert_idx)
+    recv = lax.all_to_all(
+        send.reshape(ep, S, d), expert_axis, 0, 0, tiled=False
+    ).reshape(ep * S, d)
+    recv_ids = lax.all_to_all(
+        send_ids.reshape(ep, S, 1), expert_axis, 0, 0, tiled=False
+    ).reshape(ep * S)
+
+    # Local grouping: dummy group (= e_local) LAST, so ragged_dot's
+    # group_sizes[:e_local] cover exactly the real rows.
+    le = jnp.where(recv_ids >= 0, recv_ids - e0, e_local)
+    order, inv_order, group_sizes = sort_by_expert(le, e_local + 1)
+    xs = _permute_rows(recv, order, inv_order)
+    eids = jnp.take(le, order, axis=0)  # sorted; dummies trail
+    gs = group_sizes[:e_local]
+    dt = tokens.dtype
+    # Biases extended with a zero row so dummy rows stay inert.
+    b_in_x = jnp.concatenate([b_in, jnp.zeros_like(b_in[:1])]).astype(dt)
+    b_out_x = jnp.concatenate([b_out, jnp.zeros_like(b_out[:1])]).astype(dt)
+    h = lax.ragged_dot(xs, w_in.astype(dt), gs)
+    h = activation(h + jnp.take(b_in_x, eids, axis=0))
+    ys = lax.ragged_dot(h, w_out.astype(dt), gs)
+    ys = ys + jnp.take(b_out_x, eids, axis=0)
+    # Dummy rows: ragged_dot left them zero but the bias add above put
+    # b_out there; they are never gathered on the sender side (the slot
+    # map only reads written slots), so no masking is needed.
+    ys = _permute_rows(ys, inv_order, order)
+    back = lax.all_to_all(
+        ys.reshape(ep, S, d), expert_axis, 0, 0, tiled=False
+    ).reshape(ep * S, d)
+    return _gather_rows(back, slot, ep * S)

@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_machine_learning_tpu.models.moe import (
     SEQ_LOCAL_ATTN_IMPLS,
+    SEQ_SHARDED_ATTN_IMPLS,
     MoETransformerLM,
 )
 from distributed_machine_learning_tpu.parallel.gspmd import (
@@ -83,6 +84,195 @@ def init_moe_state(model: MoETransformerLM, seed: int = 69143,
     from distributed_machine_learning_tpu.train.lm_step import init_lm_state
 
     return init_lm_state(model, seed=seed, config=config)
+
+
+def _is_expert_path(path: tuple[str, ...]) -> bool:
+    return bool(path) and path[-1] in _EXPERT_PARAMS and "moe" in path
+
+
+def state_pspecs(state: TrainState, mesh: Mesh, spec_for):
+    """PartitionSpec pytree for a TrainState (shard_map in/out specs),
+    derived from ``gspmd.state_shardings`` so the manual steps and the
+    GSPMD steps can never disagree about the state layout."""
+    from distributed_machine_learning_tpu.parallel.gspmd import (
+        state_shardings,
+    )
+
+    return jax.tree_util.tree_map(
+        lambda s: s.spec, state_shardings(state, mesh, spec_for)
+    )
+
+
+def make_ep_grouped_train_step(
+    model: MoETransformerLM,
+    mesh: Mesh,
+    data_axis: str = "batch",
+    expert_axis: str = EXPERT_AXIS,
+    seq_axis: str | None = None,
+):
+    """Dropless grouped MoE under REAL expert parallelism — the manual
+    shard_map twin of :func:`make_ep_train_step`.
+
+    Differences from the GSPMD einsum step:
+
+    - the batch shards over ``data_axis`` **and** ``expert_axis``
+      jointly (the einsum step replicates activations over the expert
+      axis, duplicating attention compute ep-fold; here every device
+      computes attention on its own batch shard);
+    - expert compute is ``ops/grouped.py::grouped_expert_mlp_ep``: an
+      explicit ``lax.all_to_all`` of token rows to their expert's owner
+      device, ``lax.ragged_dot`` over the received groups, and the
+      inverse all_to_all home — **dropless** (send slots bound at
+      N_local per owner, which cannot overflow), vs the einsum path's
+      per-expert capacity + overflow drops;
+    - gradient sync is per-leaf: every grad psums over ``data_axis``;
+      non-expert leaves additionally psum over ``expert_axis`` (expert
+      leaves are sharded there — averaging them would mix different
+      experts' gradients).
+
+    The state uses the SAME placement as the einsum step
+    (``shard_ep_state``), so checkpoints/eval tooling carry over;
+    inside the shard_map the model is cloned with
+    ``expert_axis``/``token_axes`` so expert params are declared at
+    their local shard shape and the aux loss uses global routing stats.
+
+    Update-equivalence to einsum-EP at non-dropping capacity is
+    property-tested (``tests/test_moe.py``).
+
+    ``seq_axis``: MoE × context parallelism.  When set, the sequence
+    shards over it too (batch over data×expert, sequence over seq — a
+    3-D token layout), attention runs the sequence-sharded ring
+    (``attn_impl="ring"``/``"ring_flash"``/``"ulysses"``), and the MoE
+    dispatch composes unchanged: the router is per-token, so each
+    device all_to_alls its (batch- AND sequence-)local rows to expert
+    owners along the expert axis exactly as in the 2-D case.  This
+    lifts round 3's MoE × sequence-parallel exclusion
+    (``models/moe.py`` guard; VERDICT r03 item 3).
+    """
+    from jax import lax
+
+    from distributed_machine_learning_tpu.runtime.mesh import (
+        shard_map_no_check,
+    )
+
+    if model.moe_impl != "grouped":
+        raise ValueError(
+            "make_ep_grouped_train_step requires moe_impl='grouped' "
+            f"(got {model.moe_impl!r}); use make_ep_train_step for the "
+            "einsum path"
+        )
+    seq_sharded_impls = SEQ_SHARDED_ATTN_IMPLS
+    if seq_axis is None:
+        if model.attn_impl not in SEQ_LOCAL_ATTN_IMPLS:
+            raise ValueError(
+                "sequence-sharded attention "
+                f"({model.attn_impl!r}) requires seq_axis= (the MoE x "
+                "context-parallel layout)"
+            )
+        mesh_axes = (data_axis, expert_axis)
+    else:
+        mesh_axes = (data_axis, expert_axis, seq_axis)
+        if (
+            model.attn_impl not in seq_sharded_impls
+            and mesh.shape.get(seq_axis, 1) > 1
+        ):
+            # A sequence-local kernel would silently attend within local
+            # chunks at offset-0 positions (same hazard lm_step guards).
+            raise ValueError(
+                f"attn_impl={model.attn_impl!r} cannot shard the "
+                f"sequence: axis {seq_axis!r} has size "
+                f"{mesh.shape.get(seq_axis)}; use ring/ring_flash/"
+                "ulysses or a seq-axis size of 1"
+            )
+        if (
+            model.attn_impl == "ulysses"
+            and model.n_heads % mesh.shape.get(seq_axis, 1)
+        ):
+            raise ValueError(
+                f"Ulysses needs n_heads divisible by the seq-axis size: "
+                f"{model.n_heads} heads over {mesh.shape.get(seq_axis)}"
+            )
+    for a in mesh_axes:
+        if a not in mesh.axis_names:
+            raise ValueError(f"mesh is missing axis {a!r}: {mesh.axis_names}")
+    ep = mesh.shape[expert_axis]
+    if model.n_experts % ep:
+        raise ValueError(
+            f"n_experts={model.n_experts} must be divisible by the "
+            f"expert-axis size {ep}"
+        )
+    axis_names = mesh_axes
+    # Inside the manual region: local expert shards + global aux stats.
+    local_model = model.clone(expert_axis=expert_axis, token_axes=axis_names)
+
+    import numpy as _np
+
+    n_total = int(_np.prod([mesh.shape[a] for a in axis_names]))
+
+    def impl(state: TrainState, tokens, targets):
+        def loss_fn(params):
+            logits, mutated = local_model.apply(
+                {"params": params}, tokens, train=True, mutable=["losses"]
+            )
+            ce = lm_cross_entropy(logits, targets)  # LOCAL token mean
+            aux_leaves = jax.tree_util.tree_leaves(mutated.get("losses", {}))
+            # Sown aux is computed from pmean'd global routing stats —
+            # identical on every device; add it once.
+            aux = sum(jax.numpy.sum(a) for a in aux_leaves) if aux_leaves else 0.0
+            return ce + model.aux_loss_weight * aux, ce
+
+        (loss, ce), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        # Every device seeds its local loss with cotangent 1, and the
+        # in-trace collective transposes (all_to_all, the aux pmeans)
+        # cross-route cotangents — so the per-device grads assemble to
+        # ∂(Σ_d loss_d)/∂θ under a psum.  The true loss is the device
+        # MEAN (1/n)Σ_d loss_d = global-mean ce + w·aux, hence the /n.
+        # Expert leaves psum over the data axis only: they are sharded
+        # over the expert axis, where a reduction would mix different
+        # experts' gradients (the expert-axis cross terms already
+        # arrived through the all_to_all transpose).
+        non_expert_axes = tuple(a for a in axis_names if a != expert_axis)
+
+        def sync(path, g):
+            keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
+            axes = non_expert_axes if _is_expert_path(keys) else axis_names
+            return lax.psum(g, axes) / n_total
+
+        grads = jax.tree_util.tree_map_with_path(sync, grads)
+        ce = lax.pmean(ce, axis_names)
+        new_params, new_momentum = update_fn_for_config(state.config)(
+            state.params, state.momentum, grads, state.config, step=state.step
+        )
+        new_state = state.replace(
+            params=new_params, momentum=new_momentum, step=state.step + 1
+        )
+        return new_state, ce
+
+    def build(state):
+        sspecs = state_pspecs(state, mesh, _spec_for(expert_axis))
+        batch_spec = P((data_axis, expert_axis), seq_axis)
+        return jax.jit(
+            shard_map_no_check(
+                impl,
+                mesh=mesh,
+                in_specs=(sspecs, batch_spec, batch_spec),
+                out_specs=(sspecs, P()),
+            ),
+            donate_argnums=(0,),
+        )
+
+    jitted: dict = {}
+
+    def step(state: TrainState, tokens, targets):
+        key = jax.tree_util.tree_structure(state)
+        fn = jitted.get(key)
+        if fn is None:
+            fn = jitted[key] = build(state)
+        return fn(state, tokens, targets)
+
+    return step
 
 
 def make_ep_train_step(

@@ -69,10 +69,14 @@ def test_lm_cli_bad_config_fails_fast():
         main(TINY + ["--parallel", "3d", "--pp", "0", "--tp", "2"])
     with pytest.raises(ValueError, match="divisible"):
         main(TINY + ["--parallel", "dp", "--batch-size", "12"])
-    # the dropless grouped MoE path refuses a multi-device run loudly
-    with pytest.raises(ValueError, match="single-device"):
+    # MoE x CP needs the grouped (manual shard_map) path, not einsum
+    with pytest.raises(ValueError, match="grouped"):
         main(TINY + ["--parallel", "ep", "--n-experts", "4",
-                     "--moe-impl", "grouped"])
+                     "--moe-impl", "einsum", "--ep-seq", "2"])
+    # ep x ep_seq must divide the device count
+    with pytest.raises(ValueError, match="divide"):
+        main(TINY + ["--parallel", "ep", "--n-experts", "4", "--ep", "4",
+                     "--moe-impl", "grouped", "--ep-seq", "3"])
     with pytest.raises(ValueError, match="sequence axis"):
         main(TINY + ["--parallel", "ring", "--seq-len", "100"])
     with pytest.raises(ValueError, match="data axis"):

@@ -223,3 +223,147 @@ def test_ep_step_flash_matches_dense(batch):
                     jax.tree_util.tree_leaves(state_d.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_ep_grouped_step_equals_single_device(batch):
+    """The manual shard_map EP step (explicit all_to_all + local
+    ragged_dot) takes the same update as the single-device dropless
+    grouped step."""
+    from distributed_machine_learning_tpu.parallel.expert_parallel import (
+        make_ep_grouped_train_step,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tokens, targets = batch
+    model = tiny_moe(moe_impl="grouped")
+
+    ref_state = init_moe_state(model)
+    ref_step = make_ep_train_step(model, mesh=None)
+    ref_state, ref_loss = ref_step(
+        ref_state, jnp.asarray(tokens), jnp.asarray(targets)
+    )
+
+    mesh = make_mesh(4, axis_names=("batch", "expert"), axis_shape=(2, 2))
+    state = shard_ep_state(init_moe_state(model), mesh)
+    step = make_ep_grouped_train_step(model, mesh)
+    sharding = NamedSharding(mesh, P(("batch", "expert"), None))
+    x = jax.device_put(jnp.asarray(tokens), sharding)
+    y = jax.device_put(jnp.asarray(targets), sharding)
+    state, loss = step(state, x, y)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(ref_state.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5
+        )
+
+
+def test_ep_grouped_step_equals_einsum_ep_at_ample_capacity(batch):
+    """At a capacity factor large enough that the einsum path drops
+    nothing, the dropless grouped-EP step and the GSPMD einsum-EP step
+    take the same update from the same state (VERDICT r03 item 2)."""
+    from distributed_machine_learning_tpu.parallel.expert_parallel import (
+        make_ep_grouped_train_step,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tokens, targets = batch
+    mesh = make_mesh(4, axis_names=("batch", "expert"), axis_shape=(2, 2))
+
+    ein = tiny_moe(capacity_factor=8.0)
+    ein_state = shard_ep_state(init_moe_state(ein), mesh)
+    sx, sy = shard_tp_batch(mesh, tokens, targets)
+    ein_state, ein_loss = make_ep_train_step(ein, mesh)(ein_state, sx, sy)
+
+    grp = tiny_moe(capacity_factor=8.0, moe_impl="grouped")
+    grp_state = shard_ep_state(init_moe_state(grp), mesh)
+    sharding = NamedSharding(mesh, P(("batch", "expert"), None))
+    gx = jax.device_put(jnp.asarray(tokens), sharding)
+    gy = jax.device_put(jnp.asarray(targets), sharding)
+    grp_state, grp_loss = make_ep_grouped_train_step(grp, mesh)(
+        grp_state, gx, gy
+    )
+
+    np.testing.assert_allclose(float(grp_loss), float(ein_loss), rtol=2e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(grp_state.params),
+        jax.tree_util.tree_leaves(ein_state.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
+        )
+
+
+def test_ep_grouped_step_guards():
+    from distributed_machine_learning_tpu.parallel.expert_parallel import (
+        make_ep_grouped_train_step,
+    )
+
+    mesh = make_mesh(4, axis_names=("batch", "expert"), axis_shape=(2, 2))
+    with pytest.raises(ValueError, match="grouped"):
+        make_ep_grouped_train_step(tiny_moe(), mesh)  # einsum model
+    with pytest.raises(ValueError, match="divisible"):
+        make_ep_grouped_train_step(
+            tiny_moe(n_experts=3, moe_impl="grouped"), mesh
+        )
+
+
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_moe_context_parallel_step_equals_single_device(batch, attn):
+    """MoE × context parallelism (VERDICT r03 item 3): experts on one
+    mesh axis, sequence on another — the ring/ulysses-sharded MoE step
+    equals the single-device dropless step."""
+    from distributed_machine_learning_tpu.parallel.expert_parallel import (
+        make_ep_grouped_train_step,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tokens, targets = batch
+    ref_model = tiny_moe(moe_impl="grouped")
+    ref_state = init_moe_state(ref_model)
+    ref_state, ref_loss = make_ep_train_step(ref_model, mesh=None)(
+        ref_state, jnp.asarray(tokens), jnp.asarray(targets)
+    )
+
+    model = tiny_moe(moe_impl="grouped", attn_impl=attn)
+    mesh = make_mesh(
+        8, axis_names=("batch", "expert", "seq"), axis_shape=(2, 2, 2)
+    )
+    state = shard_ep_state(init_moe_state(model), mesh)
+    step = make_ep_grouped_train_step(model, mesh, seq_axis="seq")
+    sharding = NamedSharding(mesh, P(("batch", "expert"), "seq"))
+    x = jax.device_put(jnp.asarray(tokens), sharding)
+    y = jax.device_put(jnp.asarray(targets), sharding)
+    state, loss = step(state, x, y)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(ref_state.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
+        )
+
+
+def test_moe_cp_guards():
+    from distributed_machine_learning_tpu.parallel.expert_parallel import (
+        make_ep_grouped_train_step,
+    )
+
+    mesh = make_mesh(
+        8, axis_names=("batch", "expert", "seq"), axis_shape=(2, 2, 2)
+    )
+    # ring without seq_axis → must name the CP layout.
+    with pytest.raises(ValueError, match="seq_axis"):
+        make_ep_grouped_train_step(
+            tiny_moe(moe_impl="grouped", attn_impl="ring"), mesh
+        )
+    # dense attention cannot shard the sequence.
+    with pytest.raises(ValueError, match="cannot shard"):
+        make_ep_grouped_train_step(
+            tiny_moe(moe_impl="grouped"), mesh, seq_axis="seq"
+        )
