@@ -263,9 +263,11 @@ def grouped_expert_mlp_ep(
     h = activation(h + jnp.take(b_in_x, eids, axis=0))
     ys = lax.ragged_dot(h, w_out.astype(dt), gs)
     ys = ys + jnp.take(b_out_x, eids, axis=0)
-    # Dummy rows: ragged_dot left them zero but the bias add above put
-    # b_out there; they are never gathered on the sender side (the slot
-    # map only reads written slots), so no masking is needed.
+    # Dummy rows stay exactly zero: ragged_dot leaves uncovered trailing
+    # rows zero and their bias row (index e_local of the extended bias)
+    # is zero.  They are also never gathered on the sender side — the
+    # slot map only reads slots it wrote — so BOTH properties protect
+    # the result independently.
     ys = _permute_rows(ys, inv_order, order)
     back = lax.all_to_all(
         ys.reshape(ep, S, d), expert_axis, 0, 0, tiled=False

@@ -22,10 +22,17 @@ schedule DDP's ring uses, here riding ICI links via ``ppermute``.
 Bucketing: gradients are flattened once (``ravel_pytree``) and split into
 ``bucket_bytes`` buckets (default 25 MB — the reference's
 ``bucket_cap_mb=25``).  Buckets are independent rings, so XLA's async
-collective scheduler can overlap bucket k's ppermutes with bucket k+1's
+collective scheduler overlaps bucket k's ppermutes with bucket k+1's
 adds — the same comm/compute overlap DDP's autograd hooks implement in
 C++ (``part3/main.py:59``, group25.pdf p.6), obtained from the compiler
-instead of hand-written callbacks.
+instead of hand-written callbacks.  **Verified, not assumed** (round 4,
+``bench/overlap_audit.py``): AOT-compiling the full part3 step for a
+real v5e 2×4 target shows 28 async ``collective-permute-start/done``
+pairs (= 2 buckets × 2·(N−1) steps), 21 of which have the *other*
+bucket's ``slice_add``/``slice_reduce`` fusions scheduled inside their
+in-flight window, with up to 2 ppermutes concurrently in flight and the
+two buckets' rings interleaved step-for-step — docs/PERF.md "Ring
+overlap audit" for the numbers and protocol.
 
 The ring steps use *static* chunk indices (the loop over steps is unrolled;
 N is a compile-time mesh constant), so every slice is a static-shape

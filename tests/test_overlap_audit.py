@@ -1,0 +1,35 @@
+"""Schedule-walker unit tests for the ring overlap audit
+(bench/overlap_audit.py); the TPU AOT compile itself is exercised by
+the audit's __main__ on TPU-capable hosts."""
+
+import pytest
+
+from distributed_machine_learning_tpu.bench.overlap_audit import audit_schedule
+
+HLO = """\
+HloModule m
+
+ENTRY main {
+  p0 = f32[8]{0} parameter(0)
+  cps.1 = (f32[8]{0}, f32[8]{0}) collective-permute-start(p0), source_target_pairs={{0,1}}
+  f.1 = f32[8]{0} fusion(p0), kind=kLoop, calls=fused_add
+  cpd.1 = f32[8]{0} collective-permute-done(cps.1)
+  cps.2 = (f32[8]{0}, f32[8]{0}) collective-permute-start(cpd.1), source_target_pairs={{0,1}}
+  cpd.2 = f32[8]{0} collective-permute-done(cps.2)
+  ROOT r = f32[8]{0} add(cpd.1, cpd.2)
+}
+"""
+
+
+def test_audit_counts_windows_and_overlap():
+    s = audit_schedule(HLO)
+    assert s["async_ppermute_pairs"] == 2
+    assert s["pairs_with_compute_in_window"] == 1  # f.1 inside window 1
+    assert s["distinct_compute_ops_in_windows"] == 1
+    assert s["op_kinds_in_windows"] == {"fusion": 1}
+    assert s["max_concurrent_in_flight"] == 1
+
+
+def test_audit_rejects_entryless_text():
+    with pytest.raises(ValueError, match="ENTRY"):
+        audit_schedule("HloModule empty")
