@@ -59,6 +59,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "int8 weights through the Pallas kernel "
                         "(ops/quant.py) — decode is weight-bandwidth-"
                         "bound, measured 1.3-1.8x tokens/s (docs/PERF.md)")
+    p.add_argument("--tp", default=1, type=int,
+                   help="tensor-parallel decode over this many devices "
+                        "(manual Megatron shard_map — heads, d_ff, and "
+                        "the KV cache sharded; composes with --quant "
+                        "int8: inference/generate.py::make_tp_generate_fn)")
     return p
 
 
@@ -169,9 +174,32 @@ def main(argv=None) -> None:
         toks = [b % vocab for b in prompt_bytes] or [0]
     prompt = jnp.asarray(np.asarray(toks, np.int32)[None, :])
 
-    fn = make_generate_fn(model, args.max_new_tokens,
-                          temperature=args.temperature, top_k=args.top_k,
-                          quantize=args.quant)
+    if args.tp > 1:
+        from distributed_machine_learning_tpu.inference.generate import (
+            make_tp_generate_fn,
+        )
+        from distributed_machine_learning_tpu.parallel.tensor_parallel import (  # noqa: E501
+            tp_decode_params,
+        )
+        from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+        if args.tp > jax.device_count():
+            raise ValueError(
+                f"--tp {args.tp} exceeds the device count "
+                f"{jax.device_count()} (the mesh uses the first tp "
+                "devices)"
+            )
+        mesh = make_mesh(args.tp, axis_names=("model",))
+        fn = make_tp_generate_fn(
+            model, args.max_new_tokens, mesh,
+            temperature=args.temperature, top_k=args.top_k,
+            quantize=args.quant,
+        )
+        params = tp_decode_params(params, args.tp)
+    else:
+        fn = make_generate_fn(model, args.max_new_tokens,
+                              temperature=args.temperature,
+                              top_k=args.top_k, quantize=args.quant)
     out = np.asarray(
         fn(params, prompt, jax.random.PRNGKey(args.seed))
     )[0, prompt.shape[1]:]

@@ -70,54 +70,155 @@ def make_generate_fn(
         raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
     dm = model.clone(attn_impl="dense", decode=True, weight_quant=quantize)
     sample = partial(_sample, temperature=temperature, top_k=top_k)
+    return jax.jit(partial(_generate_body, dm, sample, max_new_tokens))
 
-    @jax.jit
-    def run(params, prompt, rng):
-        B, Lp = prompt.shape
-        max_len = Lp + max_new_tokens
-        # Cache layout via eval_shape (no FLOPs): init in decode mode
-        # with a [B, cache_len] input sizing every layer's K/V cache.
-        # The allocation rounds up to a 512 multiple so the cache tiles
-        # into the flash-decode kernel's S blocks
-        # (ops/pallas/decode_attention.py) — the frontier-clamped DMA
-        # never reads the pad slots, so the only cost is their
-        # allocation.
-        cache_len = -(-max_len // 512) * 512
-        shapes = jax.eval_shape(
-            lambda: dm.init(
-                jax.random.PRNGKey(0),
-                jnp.zeros((B, cache_len), jnp.int32),
-                train=False,
-            )
-        )["cache"]
-        cache = jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+
+def _generate_body(dm, sample, max_new_tokens, params, prompt, rng):
+    """The traced generate program (prefill + decode scan) — shared by
+    the single-device jit (:func:`make_generate_fn`) and the manual-TP
+    shard_map wrap (:func:`make_tp_generate_fn`), so the two paths can
+    never drift."""
+    B, Lp = prompt.shape
+    max_len = Lp + max_new_tokens
+    # Cache layout via eval_shape (no FLOPs): init in decode mode
+    # with a [B, cache_len] input sizing every layer's K/V cache.
+    # The allocation rounds up to a 512 multiple so the cache tiles
+    # into the flash-decode kernel's S blocks
+    # (ops/pallas/decode_attention.py) — the frontier-clamped DMA
+    # never reads the pad slots, so the only cost is their
+    # allocation.
+    cache_len = -(-max_len // 512) * 512
+    shapes = jax.eval_shape(
+        lambda: dm.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((B, cache_len), jnp.int32),
+            train=False,
         )
+    )["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    )
 
-        # Prefill: one pass over the whole prompt fills slots [0, Lp).
+    # Prefill: one pass over the whole prompt fills slots [0, Lp).
+    logits, vars_ = dm.apply(
+        {"params": params, "cache": cache}, prompt, train=False,
+        mutable=["cache"],
+    )
+    rng, r = jax.random.split(rng)
+    tok = sample(logits[:, -1], r)  # first generated token
+
+    def body(carry, _):
+        cache, tok, rng = carry
         logits, vars_ = dm.apply(
-            {"params": params, "cache": cache}, prompt, train=False,
-            mutable=["cache"],
+            {"params": params, "cache": cache}, tok[:, None],
+            train=False, mutable=["cache"],
         )
         rng, r = jax.random.split(rng)
-        tok = sample(logits[:, -1], r)  # first generated token
+        nxt = sample(logits[:, -1], r)
+        return (vars_["cache"], nxt, rng), tok
 
-        def body(carry, _):
-            cache, tok, rng = carry
-            logits, vars_ = dm.apply(
-                {"params": params, "cache": cache}, tok[:, None],
-                train=False, mutable=["cache"],
-            )
-            rng, r = jax.random.split(rng)
-            nxt = sample(logits[:, -1], r)
-            return (vars_["cache"], nxt, rng), tok
+    (_, last, _), toks = lax.scan(
+        body, (vars_["cache"], tok, rng), None, length=max_new_tokens - 1
+    )
+    # toks: [max_new-1, B] tokens 1..max_new-1; `last` is the final one.
+    gen = jnp.concatenate([toks, last[None]], axis=0).swapaxes(0, 1)
+    return jnp.concatenate([prompt, gen], axis=1)
 
-        (_, last, _), toks = lax.scan(
-            body, (vars_["cache"], tok, rng), None, length=max_new_tokens - 1
+
+def make_tp_generate_fn(
+    model,
+    max_new_tokens: int,
+    mesh,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    quantize: str | None = None,
+    model_axis: str = "model",
+):
+    """Tensor-parallel generation: ``fn(params, prompt, rng) -> tokens``.
+
+    The Megatron decode layout, written as a fully-manual shard_map over
+    ``model_axis``: each device runs the SAME generate program as the
+    single-device path (:func:`_generate_body`) on a model clone at its
+    LOCAL width (``n_heads=H/tp``, ``n_kv_heads=Hkv/tp``,
+    ``d_ff=F/tp``), with the model's ``tp_axis`` psums completing the
+    row-parallel out-projection and fc_out (``models/transformer.py``).
+    Every Pallas kernel on the path — flash prefill, the decode cache
+    kernel, the int8 weight-reading matmul — sees purely local shapes
+    and never meets the GSPMD partitioner: this is how ``--quant int8``
+    composes with TP.  The KV cache is born head-sharded (each device's
+    cache holds its Hkv/tp heads — the cache memory ÷ tp).
+
+    ``params`` must be pre-arranged by
+    ``parallel.tensor_parallel.tp_decode_params`` (row-parallel biases
+    ÷ tp; fused quantized column blocks re-ordered head-contiguous);
+    pass them global — the shard_map in_specs slice each device's
+    shard.  Sampling runs replicated (same rng, same logits on every
+    device), so the returned tokens are identical across devices.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+        tp_decode_spec_for,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import (
+        shard_map_no_check,
+    )
+
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
+    if model_axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh is missing axis {model_axis!r}: {mesh.axis_names}"
         )
-        # toks: [max_new-1, B] tokens 1..max_new-1; `last` is the final one.
-        gen = jnp.concatenate([toks, last[None]], axis=0).swapaxes(0, 1)
-        return jnp.concatenate([prompt, gen], axis=1)
+    tp = mesh.shape[model_axis]
+    if model.n_heads % tp:
+        raise ValueError(
+            f"n_heads={model.n_heads} must be divisible by tp={tp}"
+        )
+    n_kv = model.n_kv_heads
+    if n_kv is not None and n_kv % tp:
+        raise ValueError(
+            f"n_kv_heads={n_kv} must be divisible by tp={tp}"
+        )
+    d_ff = model.d_ff or 4 * model.d_model
+    if d_ff % tp:
+        raise ValueError(f"d_ff={d_ff} must be divisible by tp={tp}")
+    local = model.clone(
+        n_heads=model.n_heads // tp,
+        n_kv_heads=None if n_kv is None else n_kv // tp,
+        d_ff=d_ff // tp,
+        head_dim=model.d_model // model.n_heads,  # global per-head width
+        attn_impl="dense", decode=True, weight_quant=quantize,
+        tp_axis=model_axis,
+    )
+    sample = partial(_sample, temperature=temperature, top_k=top_k)
+    body = partial(_generate_body, local, sample, max_new_tokens)
+
+    jitted: dict = {}
+
+    def run(params, prompt, rng):
+        key = jax.tree_util.tree_structure(params)
+        fn = jitted.get(key)
+        if fn is None:
+            specs = jax.tree_util.tree_map_with_path(
+                lambda path, leaf: tp_decode_spec_for(
+                    tuple(
+                        k.key if hasattr(k, "key") else str(k) for k in path
+                    ),
+                    leaf.ndim if hasattr(leaf, "ndim") else 0,
+                    model_axis,
+                ),
+                params,
+            )
+            fn = jitted[key] = jax.jit(shard_map_no_check(
+                body,
+                mesh=mesh,
+                in_specs=(specs, P(), P()),
+                out_specs=P(),
+            ))
+        return fn(params, prompt, rng)
 
     return run
 

@@ -179,12 +179,25 @@ class Attention(nn.Module):
     # "int8" = weight-only quantized projections for serving decode
     # (ops/quant.py); None = full-precision nn.DenseGeneral.
     weight_quant: str | None = None
+    # Manual Megatron tensor parallelism for DECODE (shard_map context,
+    # parallel/tensor_parallel.py::make_tp_generate_fn): this module is
+    # then configured at its LOCAL width (n_heads = H/tp), its
+    # out-projection is row-parallel (each device holds the rows of its
+    # heads), and the psum below completes the Megatron g-collective.
+    # The out-proj bias must be pre-divided by tp (tp_decode_params) so
+    # the psum reassembles it exactly.
+    tp_axis: str | None = None
+    # Explicit per-head width.  None = E // n_heads (the usual rule);
+    # the manual-TP decode clone MUST set it to the GLOBAL head dim,
+    # since its local n_heads no longer divides E into real head widths.
+    head_dim: int | None = None
 
     @nn.compact
     def __call__(self, x, positions):
         B, L, E = x.shape
-        assert E % self.n_heads == 0, "n_heads must divide d_model"
-        head_dim = E // self.n_heads
+        if self.head_dim is None:
+            assert E % self.n_heads == 0, "n_heads must divide d_model"
+        head_dim = self.head_dim or E // self.n_heads
 
         def proj(features, axis, name):
             """nn.DenseGeneral, or its int8 twin when weight_quant is on
@@ -414,7 +427,10 @@ class Attention(nn.Module):
             out = dense_self_attention(
                 q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), positions
             )
-        return proj(E, (-2, -1), "out")(out)
+        y = proj(E, (-2, -1), "out")(out)
+        if self.tp_axis is not None:
+            y = lax.psum(y, self.tp_axis)
+        return y
 
 
 def _mlp_sublayer(mdl: "Block", h: jax.Array) -> jax.Array:
@@ -438,13 +454,21 @@ def _mlp_sublayer(mdl: "Block", h: jax.Array) -> jax.Array:
             name="fc_in",
         )(h)
         h = nn.gelu(h)
-        return QuantDenseGeneral(
+        h = QuantDenseGeneral(
             out_features=(d_out,),
             compute_dtype=mdl.compute_dtype, name="fc_out",
         )(h)
-    h = nn.Dense(mdl.d_ff, dtype=mdl.compute_dtype, name="fc_in")(h)
-    h = nn.gelu(h)
-    return nn.Dense(d_out, dtype=mdl.compute_dtype, name="fc_out")(h)
+    else:
+        h = nn.Dense(mdl.d_ff, dtype=mdl.compute_dtype, name="fc_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(d_out, dtype=mdl.compute_dtype, name="fc_out")(h)
+    if mdl.tp_axis is not None:
+        # Manual TP decode: fc_in is column-parallel (local d_ff slice),
+        # fc_out row-parallel — this psum is Megatron's second
+        # g-collective (fc_out's bias pre-divided by tp, as for the
+        # attention out-projection).
+        h = jax.lax.psum(h, mdl.tp_axis)
+    return h
 
 
 class Block(nn.Module):
@@ -477,6 +501,8 @@ class Block(nn.Module):
     flash_manual_axes: tuple | None = None
     weight_quant: str | None = None
     remat_mlp: bool = False
+    tp_axis: str | None = None  # manual TP decode (see Attention.tp_axis)
+    head_dim: int | None = None  # explicit head width (TP decode clones)
 
     @nn.compact
     def __call__(self, x, positions):
@@ -494,6 +520,8 @@ class Block(nn.Module):
             flash_head_axis=self.flash_head_axis,
             flash_manual_axes=self.flash_manual_axes,
             weight_quant=self.weight_quant,
+            tp_axis=self.tp_axis,
+            head_dim=self.head_dim,
             name="attn",
         )(h, positions)
         if self.remat_mlp and not self.decode:
@@ -537,6 +565,14 @@ class TransformerLM(nn.Module):
     # kernel (ops/quant.py; params from quantize_lm_params).  Embeddings
     # stay full precision (a gather).
     weight_quant: str | None = None
+    # Manual Megatron TP for DECODE: set by make_tp_generate_fn's
+    # shard_map wrap, with the model configured at LOCAL width
+    # (n_heads=H/tp, n_kv_heads=Hkv/tp, d_ff=F/tp, head_dim pinned to
+    # the global per-head width).  Embed + lm_head + LayerNorms stay
+    # replicated (the embed gather reads only B rows per step; sharding
+    # lm_head would shard the logits).  Decode-only.
+    tp_axis: str | None = None
+    head_dim: int | None = None
     remat: bool = False  # jax.checkpoint each block: activation memory
     # drops from O(L·E) per layer to per-block boundaries, recomputing the
     # block in backward — the HBM-for-FLOPs trade that lets long-context
@@ -564,6 +600,12 @@ class TransformerLM(nn.Module):
                 "weight_quant is a serving-decode feature (int8 weights "
                 "are not trainable); clone with decode=True — "
                 "inference/generate.py does this"
+            )
+        if self.tp_axis is not None and not self.decode:
+            raise ValueError(
+                "tp_axis is the manual TP-decode wiring "
+                "(make_tp_generate_fn); training-time TP is the GSPMD "
+                "step (parallel/tensor_parallel.py)"
             )
         if self.decode:
             if self.attn_impl != "dense":
@@ -617,6 +659,8 @@ class TransformerLM(nn.Module):
                 flash_manual_axes=self.flash_manual_axes,
                 weight_quant=self.weight_quant,
                 remat_mlp=remat_mlp,
+                tp_axis=self.tp_axis,
+                head_dim=self.head_dim,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)

@@ -157,6 +157,96 @@ def make_tp_lm_train_step(
     return make_cached_sharded_step(impl, mesh, _spec_for(model_axis), batch_sharding)
 
 
+def tp_decode_spec_for(
+    path: tuple[str, ...], ndim: int, model_axis: str = MODEL_AXIS
+) -> P:
+    """PartitionSpec for one DECODE parameter (manual Megatron layout —
+    ``inference.generate.make_tp_generate_fn``).
+
+    Differences from the training rules (:func:`tp_spec_for`): embed and
+    lm_head stay replicated (the embed gather reads only B rows per
+    step; a sharded lm_head would shard the logits the sampler needs),
+    row-parallel biases (``out``/``fc_out``) are replicated (pre-divided
+    by tp in :func:`tp_decode_params` so the model's psum reassembles
+    them), and the quantized leaves (``w_q`` [D_in, K_out] flat /
+    ``scale`` [K_out]) shard the axis their module's parallelism splits
+    — columns for the column-parallel projections (qkv/q/kv/fc_in),
+    rows for the row-parallel ones (out/fc_out, scale replicated).
+    """
+    path = tuple(path)
+    leaf = path[-1]
+    module = path[-2] if len(path) >= 2 else ""
+    m = model_axis
+    col_parallel = module in ("qkv", "q", "kv", "fc_in")
+    row_parallel = module in ("out", "fc_out")
+    if leaf == "w_q":
+        if col_parallel:
+            return P(None, m)
+        if row_parallel:
+            return P(m, None)
+        return P(*(None,) * ndim)  # lm_head & others: replicated
+    if leaf == "scale":
+        return P(m) if col_parallel else P(*(None,) * ndim)
+    if leaf == "bias" and row_parallel:
+        return P(*(None,) * ndim)  # replicated, pre-divided by tp
+    if module == "embed" or module == "lm_head":
+        return P(*(None,) * ndim)
+    if leaf in ("kernel", "bias"):
+        return tp_spec_for(path, ndim, model_axis)
+    return P(*(None,) * ndim)
+
+
+# Fused projections whose FLAT quantized k_out mixes a leading part axis
+# with the head axis: (n_parts, n_heads_axis_position). qkv = (3, H, Dh),
+# kv = (2, Hkv, Dh); q = (H, Dh) is head-major already.
+_FUSED_QUANT_LAYOUTS = {"qkv": 3, "kv": 2}
+
+
+def tp_decode_params(params, tp: int, model_axis: str = MODEL_AXIS):
+    """Arrange a decode param tree (full-precision or
+    ``quantize_lm_params`` output) for :func:`tp_decode_spec_for`:
+
+    - row-parallel biases (``out``/``fc_out``) divide by ``tp`` so the
+      model's psum reassembles them exactly (tp is a power of two in
+      practice, making the division bit-exact);
+    - fused quantized projections (qkv/kv) re-order their flat ``w_q``
+      columns and ``scale`` head-contiguously: [D, (3, H, Dh)flat] →
+      [D, (tp, 3, H/tp, Dh)flat], so a plain ``P(None, model)`` hands
+      each device exactly its heads' columns in the local flat layout
+      its ``QuantDenseGeneral`` expects.
+
+    Pure array transform — run once at serving setup, before
+    ``jax.device_put`` with the decode shardings.
+    """
+
+    def permute_cols(w_q, scale, parts: int):
+        d_in, k_out = w_q.shape
+        hd = k_out // parts  # H·Dh
+        # [D, parts, tp, H/tp, Dh] → [D, tp, parts, H/tp, Dh] → flat.
+        def arrange(a, lead):
+            # (H, Dh) is head-major in the flat layout, so tp blocks of
+            # hd/tp columns ARE head blocks; hoisting the tp axis over
+            # the parts axis makes each device's slice contiguous.
+            shaped = a.reshape(*lead, parts, tp, hd // tp)
+            return shaped.swapaxes(-3, -2).reshape(*lead, k_out)
+
+        return arrange(w_q, (d_in,)), arrange(scale, ())
+
+    def walk(name, node):
+        if isinstance(node, dict) or hasattr(node, "items"):
+            node = dict(node)
+            if name in _FUSED_QUANT_LAYOUTS and "w_q" in node:
+                parts = _FUSED_QUANT_LAYOUTS[name]
+                w_q, scale = permute_cols(node["w_q"], node["scale"], parts)
+                node = {**node, "w_q": w_q, "scale": scale}
+            if name in ("out", "fc_out") and "bias" in node:
+                node = {**node, "bias": node["bias"] / tp}
+            return {k: walk(k, v) for k, v in node.items()}
+        return node
+
+    return walk("", params)
+
+
 def shard_tp_batch(mesh: Mesh, tokens, targets, data_axis: str = "batch"):
     """Tokens/targets sharded over the data axis, sequence whole."""
     from distributed_machine_learning_tpu.train.lm_step import shard_lm_batch

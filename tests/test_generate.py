@@ -90,3 +90,74 @@ def test_top_k_exceeding_vocab_is_a_clear_error(rng):
     with pytest.raises(ValueError, match="top_k"):
         generate(model, params, prompt, max_new_tokens=2,
                  temperature=1.0, top_k=VOCAB + 1)
+
+
+def test_tp_decode_token_exact_vs_single_device(rng):
+    """Manual Megatron TP decode (VERDICT r03 item 5): the tp=4
+    head-sharded generate produces the same greedy tokens as the
+    single-device path, bf16-free f32 for exactness headroom."""
+    from distributed_machine_learning_tpu.inference.generate import (
+        make_tp_generate_fn,
+    )
+    from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+        tp_decode_params,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+    model = TransformerLM(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+    )
+    params = init_lm_state(model).params
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (2, 5)), jnp.int32)
+    ref = generate(model, params, prompt, max_new_tokens=8)
+
+    mesh = make_mesh(4, axis_names=("model",))
+    fn = make_tp_generate_fn(model, 8, mesh)
+    out = fn(tp_decode_params(params, 4), prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_tp_decode_fused_qkv_and_gqa_layouts(rng):
+    """Both param layouts cross TP correctly: classic MHA (fused qkv
+    kernel) and GQA (separate q / fused kv) at tp=2."""
+    from distributed_machine_learning_tpu.inference.generate import (
+        make_tp_generate_fn,
+    )
+    from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+        tp_decode_params,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh(2, axis_names=("model",))
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (1, 4)), jnp.int32)
+    for n_kv in (None, 2):  # None = fused qkv; 2 = GQA q+kv modules
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=4,
+            n_kv_heads=n_kv,
+        )
+        params = init_lm_state(model).params
+        ref = generate(model, params, prompt, max_new_tokens=6)
+        fn = make_tp_generate_fn(model, 6, mesh)
+        out = fn(tp_decode_params(params, 2), prompt, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_tp_decode_guards(rng):
+    from distributed_machine_learning_tpu.inference.generate import (
+        make_tp_generate_fn,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh(4, axis_names=("model",))
+    import pytest
+
+    with pytest.raises(ValueError, match="n_heads"):
+        make_tp_generate_fn(
+            TransformerLM(vocab_size=VOCAB, d_model=18, n_layers=1,
+                          n_heads=6), 4, mesh,
+        )
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        make_tp_generate_fn(
+            TransformerLM(vocab_size=VOCAB, d_model=32, n_layers=1,
+                          n_heads=8, n_kv_heads=2), 4, mesh,
+        )

@@ -133,3 +133,40 @@ def test_weight_quant_requires_decode():
             4,
             quantize="int4",
         )
+
+
+def test_tp_int8_decode_token_exact(rng):
+    """--quant int8 composes with TP (VERDICT r03 item 5): the tp=2
+    head-sharded int8 decode (permuted fused w_q column blocks, sharded
+    scales, pre-divided row-parallel biases) generates the same greedy
+    tokens as single-device int8 decode — both read the SAME quantized
+    values, so any layout slip would show immediately."""
+    from distributed_machine_learning_tpu.inference.generate import (
+        generate,
+        make_tp_generate_fn,
+    )
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from distributed_machine_learning_tpu.ops.quant import quantize_lm_params
+    from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+        tp_decode_params,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+    mesh = make_mesh(2, axis_names=("model",))
+    prompt = jnp.asarray(rng.integers(0, 32, (2, 4)), jnp.int32)
+    for n_kv in (None, 2):  # fused-qkv MHA and GQA layouts
+        model = TransformerLM(
+            vocab_size=32, d_model=32, n_layers=2, n_heads=4,
+            n_kv_heads=n_kv,
+        )
+        params = init_lm_state(model).params
+        qparams = quantize_lm_params(params)
+        ref = generate(model, params, prompt, max_new_tokens=6,
+                       quantize="int8")
+        fn = make_tp_generate_fn(model, 6, mesh, quantize="int8")
+        out = fn(tp_decode_params(qparams, 2), prompt,
+                 jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
