@@ -189,7 +189,8 @@ def make_tp_generate_fn(
         n_heads=model.n_heads // tp,
         n_kv_heads=None if n_kv is None else n_kv // tp,
         d_ff=d_ff // tp,
-        head_dim=model.d_model // model.n_heads,  # global per-head width
+        # Global per-head width (honoring an explicit override).
+        head_dim=model.head_dim or model.d_model // model.n_heads,
         attn_impl="dense", decode=True, weight_quant=quantize,
         tp_axis=model_axis,
     )

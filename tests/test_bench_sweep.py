@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from distributed_machine_learning_tpu.bench.sweep import (
     run_point,
@@ -39,3 +40,39 @@ def test_run_point_does_not_consume_shared_state():
     p = run_point(model, "all_reduce", 2, per_device_batch=4, timed_iters=1,
                   init_state=state)
     assert p.imgs_per_sec > 0
+
+
+@pytest.mark.parametrize("scheme", ["fsdp_pl", "tp", "pp"])
+def test_lm_sweep_point_runs_and_reports(scheme):
+    """Each LM scheme's sweep point builds its sharded program, runs the
+    chained-timing protocol, and reports sane fields (bench/lm_sweep.py;
+    VERDICT r03 item 6)."""
+    from distributed_machine_learning_tpu.bench.lm_sweep import lm_run_point
+
+    p = lm_run_point(
+        scheme, 2, d_model=32, n_heads=4, n_layers=2, layers_per_stage=1,
+        seq_len=32, per_device_batch=2, timed_iters=2,
+    )
+    assert p.num_devices == 2 and p.scheme == scheme
+    assert p.tokens_per_sec > 0
+    assert p.tokens_per_sec_per_device == p.tokens_per_sec / 2
+    if scheme == "pp":
+        assert p.mode == "weak-depth" and p.n_layers == 2  # 1 x 2 stages
+    elif scheme == "tp":
+        assert p.mode == "strong"
+    else:
+        assert p.mode == "weak-batch" and p.global_batch == 4
+
+
+def test_lm_sweep_guards():
+    from distributed_machine_learning_tpu.bench.lm_sweep import (
+        lm_run_point,
+        lm_scaling_sweep,
+    )
+
+    with pytest.raises(ValueError, match="scheme"):
+        lm_run_point("zz", 2)
+    with pytest.raises(ValueError, match="n_heads"):
+        lm_run_point("tp", 3, n_heads=4)
+    with pytest.raises(ValueError, match="empty"):
+        lm_scaling_sweep("tp", device_counts=[])
