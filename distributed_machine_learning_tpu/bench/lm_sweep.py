@@ -85,7 +85,15 @@ def _per_step_time(step, state, x, y, iters: int):
     _, state = _time_chained(step, state, x, y, iters)
     t1, state = _time_chained(step, state, x, y, iters)
     t2, state = _time_chained(step, state, x, y, 2 * iters)
-    return max((t2 - t1) / iters, 1e-9)
+    slope = (t2 - t1) / iters
+    avg = t2 / (2 * iters)
+    # Same jitter guard as bench/harness.py::two_point_fit: a noisy t1
+    # can push the slope negative (absurd throughput) or above the
+    # chained average (impossible) — fall back to the average, which
+    # over-counts only the fixed overhead instead of fabricating rates.
+    if slope <= 0 or slope > avg:
+        slope = avg
+    return slope
 
 
 def lm_run_point(
@@ -227,13 +235,19 @@ def lm_scaling_sweep(
     """Sweep device counts for one LM scheme; annotate efficiency
     against the smallest point.
 
-    Efficiency semantics follow the point's mode: per-device throughput
-    ratio for the weak modes (fsdp_pl batch, pp depth), and
-    ``tps(d) / (d · tps(base))`` for tp's strong scaling — numerically
-    the same formula, read against a fixed problem."""
+    Efficiency = per-device WORK rate relative to the smallest point:
+    tokens/sec/device for the fixed-model modes (fsdp_pl weak-batch, tp
+    strong), tokens·layers/sec/device for pp's weak-depth mode (the
+    model grows with the pipeline, so raw token rate falls ~1/d even on
+    ideal hardware — see ``norm`` below)."""
     if device_counts is None:
         n = len(devices) if devices is not None else jax.device_count()
         device_counts = [d for d in (1, 2, 4, 8, 16, 32) if d <= n]
+        if scheme == "tp":
+            # Auto-selection must not crash the sweep mid-run at a count
+            # n_heads cannot shard over (explicit counts still raise).
+            heads = point_kwargs.get("n_heads", 8)
+            device_counts = [d for d in device_counts if heads % d == 0]
     device_counts = sorted(set(device_counts))
     if not device_counts:
         raise ValueError("device_counts is empty: nothing to sweep")
@@ -241,11 +255,22 @@ def lm_scaling_sweep(
         lm_run_point(scheme, d, devices=devices, **point_kwargs)
         for d in device_counts
     ]
-    base = points[0].tokens_per_sec_per_device
-    for p in points:
-        p.efficiency = (
-            round(p.tokens_per_sec_per_device / base, 4) if base else None
+
+    def norm(p: LMScalePoint) -> float:
+        # Per-device WORK rate, not raw token rate: pp's weak-depth mode
+        # grows per-token FLOPs with the model (n_layers ∝ stages), so
+        # tokens/sec/device falls ~1/d on IDEAL hardware — the honest
+        # per-device quantity is tokens·layers/sec/device (∝ model
+        # FLOPs/sec/device).  The remaining shortfall under this
+        # normalization is the genuine pipeline bubble + comm.  The flat
+        # modes normalize by 1 (their model is fixed).
+        return p.tokens_per_sec_per_device * (
+            p.n_layers if p.mode == "weak-depth" else 1
         )
+
+    base = norm(points[0])
+    for p in points:
+        p.efficiency = round(norm(p) / base, 4) if base else None
     return points
 
 
@@ -299,15 +324,20 @@ def main() -> None:
     for p in points:
         print(json.dumps(format_row(p)))
     if len(points) > 1:
-        print(json.dumps({
+        summary = {
             "metric": f"lm_{args.scheme}_scaling_efficiency",
             "value": points[-1].efficiency,
             "unit": (
                 f"x{points[-1].num_devices}_vs_x{points[0].num_devices}"
             ),
-            # BASELINE.md north-star: >=85% weak scaling on real chips.
-            "target": 0.85,
-        }))
+            "mode": points[-1].mode,
+        }
+        if points[-1].mode != "strong":
+            # BASELINE.md north-star (>=85%) is a WEAK-scaling target;
+            # attaching it to tp's fixed-problem strong-scaling curve
+            # would flag healthy runs as regressions.
+            summary["target"] = 0.85
+        print(json.dumps(summary))
 
 
 if __name__ == "__main__":

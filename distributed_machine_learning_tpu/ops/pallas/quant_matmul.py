@@ -123,6 +123,18 @@ def int8_matmul(
         R += pad_rows
     bR = block_rows or _pick_block(R, r_cap, 8) or R
     bK = block_k or _pick_block(K, k_cap, 128)
+    pad_k = 0
+    if bK is None and block_k is None:
+        # K has no 128-multiple divisor under the cap (e.g. the fused
+        # qkv of a d_model=320 model gives K=960): zero-pad the weight
+        # columns and scales up to the next 128 multiple — padded
+        # columns multiply to exact zeros and are sliced off below —
+        # mirroring the row-padding path instead of refusing the width.
+        pad_k = (-K) % 128
+        q = jnp.pad(q, ((0, 0), (0, pad_k)))
+        scale = jnp.pad(scale, ((0, pad_k),))
+        K += pad_k
+        bK = _pick_block(K, k_cap, 128)
     if bK is None or K % bK or R % bR:
         raise ValueError(
             f"K={K} must tile by a multiple of 128 and R={R} by the row "
@@ -151,4 +163,4 @@ def int8_matmul(
         interpret=_interpret(),
         **kwargs,
     )(x, q, scale.reshape(1, K))
-    return out[: R - pad_rows] if pad_rows else out
+    return out[: R - pad_rows, : K - pad_k] if (pad_rows or pad_k) else out

@@ -80,6 +80,23 @@ _TWO_AXIS_MODULES = frozenset({"out"})
 def _quantize_module(name: str, leaves: dict) -> dict:
     kernel = leaves["kernel"]
     n_in = 2 if name in _TWO_AXIS_MODULES else 1
+    if name in _TWO_AXIS_MODULES and kernel.ndim != 3:
+        # The two-input-axis flatten is keyed on the module NAME alone,
+        # so validate the structure it assumes: the attention
+        # out-projection's kernel is [H, Dh, E].  Any other module that
+        # happens to be named 'out' would otherwise be silently
+        # mis-flattened into wrong serving weights.
+        raise ValueError(
+            f"module {name!r} is flattened over two input axes "
+            f"(attention out-projection, kernel rank 3) but its kernel "
+            f"has rank {kernel.ndim} {kernel.shape}; rename the module "
+            "or extend _TWO_AXIS_MODULES' rule"
+        )
+    if kernel.ndim < n_in + 1:
+        raise ValueError(
+            f"module {name!r}: kernel rank {kernel.ndim} leaves no "
+            f"output axis after {n_in} input axes"
+        )
     d_in = math.prod(kernel.shape[:n_in])
     q, scale = quantize_int8(jnp.reshape(kernel, (d_in, -1)))
     out = {"w_q": q, "scale": scale}

@@ -69,13 +69,13 @@ def test_int8_matmul_pads_awkward_row_counts():
 
 
 def test_int8_matmul_shape_guards():
-    # 1000 > the block cap with no 128-multiple divisor → must refuse
-    # (a K smaller than the cap, e.g. 200, runs as one full-dim block).
-    q, s = quantize_int8(jnp.ones((64, 1000)))
-    with pytest.raises(ValueError, match="tile"):
-        int8_matmul(jnp.ones((8, 64)), q, s)
     with pytest.raises(ValueError, match="shape mismatch"):
         int8_matmul(jnp.ones((8, 32)), *quantize_int8(jnp.ones((64, 128))))
+    # An explicit block_k that does not tile still refuses loudly (the
+    # auto path pads instead — test_int8_matmul_pads_awkward_widths).
+    q, s = quantize_int8(jnp.ones((64, 1000)))
+    with pytest.raises(ValueError, match="tile"):
+        int8_matmul(jnp.ones((8, 64)), q, s, block_k=384)
 
 
 def _dequant_tree(params, qparams):
@@ -170,3 +170,39 @@ def test_tp_int8_decode_token_exact(rng):
         out = fn(tp_decode_params(qparams, 2), prompt,
                  jax.random.PRNGKey(0))
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_int8_matmul_pads_awkward_widths():
+    """K with no 128-multiple divisor under the cap (e.g. 960 from a
+    d_model=320 fused qkv) zero-pads to the next 128 multiple and
+    slices back instead of raising (ADVICE r03)."""
+    from distributed_machine_learning_tpu.ops.pallas.quant_matmul import (
+        int8_matmul,
+        quantize_int8,
+    )
+
+    rng = np.random.default_rng(11)
+    w = jnp.asarray(rng.standard_normal((64, 960)), jnp.float32) * 0.05
+    x = jnp.asarray(rng.standard_normal((8, 64)), jnp.float32)
+    q, scale = quantize_int8(w)
+    out = int8_matmul(x, q, scale)
+    assert out.shape == (8, 960)
+    ref = x.astype(jnp.bfloat16) @ (
+        q.astype(jnp.bfloat16) * scale[None, :].astype(jnp.bfloat16)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_quantize_lm_params_rejects_misshaped_out_module():
+    """The name-keyed two-axis flatten validates the kernel rank it
+    assumes (ADVICE r03): a rank-2 kernel under a module named 'out'
+    raises instead of silently mis-flattening."""
+    from distributed_machine_learning_tpu.ops.quant import quantize_lm_params
+
+    bad = {"blk": {"out": {"kernel": jnp.zeros((8, 4)),
+                           "bias": jnp.zeros((4,))}}}
+    with pytest.raises(ValueError, match="rank"):
+        quantize_lm_params(bad)
