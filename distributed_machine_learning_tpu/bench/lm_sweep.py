@@ -45,6 +45,9 @@ import jax.numpy as jnp
 import numpy as np
 
 LM_SWEEP_SCHEMES = ("fsdp_pl", "tp", "pp")
+# One default, shared by lm_run_point's signature and the tp auto-count
+# filter, so they cannot drift.
+DEFAULT_N_HEADS = 8
 
 
 @dataclass
@@ -101,7 +104,7 @@ def lm_run_point(
     num_devices: int,
     *,
     d_model: int = 256,
-    n_heads: int = 8,
+    n_heads: int = DEFAULT_N_HEADS,
     vocab: int = 256,
     seq_len: int = 128,
     per_device_batch: int = 4,
@@ -246,7 +249,7 @@ def lm_scaling_sweep(
         if scheme == "tp":
             # Auto-selection must not crash the sweep mid-run at a count
             # n_heads cannot shard over (explicit counts still raise).
-            heads = point_kwargs.get("n_heads", 8)
+            heads = point_kwargs.get("n_heads", DEFAULT_N_HEADS)
             device_counts = [d for d in device_counts if heads % d == 0]
     device_counts = sorted(set(device_counts))
     if not device_counts:

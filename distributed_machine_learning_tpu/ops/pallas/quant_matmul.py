@@ -130,6 +130,11 @@ def int8_matmul(
         # columns and scales up to the next 128 multiple — padded
         # columns multiply to exact zeros and are sliced off below —
         # mirroring the row-padding path instead of refusing the width.
+        # Inside a scanned decode program the padded weight is loop-
+        # invariant and XLA hoists it (verified on the compiled HLO:
+        # the s8 pad lives outside the while body, the padded array
+        # rides the loop carry) — the copy costs once per program, not
+        # per token.
         pad_k = (-K) % 128
         q = jnp.pad(q, ((0, 0), (0, pad_k)))
         scale = jnp.pad(scale, ((0, pad_k),))
