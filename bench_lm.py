@@ -146,18 +146,26 @@ def bench_decode(args) -> None:
     )
     state = init_lm_state(model)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    master = state.params
+    # A serving bench must not hold training state: the f32 momentum
+    # buffer alone is ~2 GB at this width, and keeping it (plus the f32
+    # master params after the cast) resident is the difference between
+    # the f32-cache 32k config fitting the 16 GB chip or OOMing.
+    del state
     if args.quant:
         # Weight-only int8 serving: quantize from the f32 master params.
         from distributed_machine_learning_tpu.ops.quant import (
             quantize_lm_params,
         )
 
-        params = quantize_lm_params(state.params)
+        params = quantize_lm_params(master)
     else:
         params = jax.tree_util.tree_map(
             lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p,
-            state.params,
+            master,
         )
+    del master
+    params = jax.block_until_ready(params)
     rng = np.random.default_rng(0)
     prompt = jax.device_put(jnp.asarray(
         rng.integers(0, args.vocab, (args.batch, args.prompt_len)),

@@ -72,3 +72,18 @@ def test_equivalence_cli_exit_code():
 
     main(["--equivalence", "--model", "vggtest", "--batch-size", "4",
           "--max-iters", "4"])
+
+
+def test_equivalence_refuses_vacuous_world():
+    """A single-device 'equivalence check' is five identical runs that
+    pass by construction — it must refuse, not certify."""
+    import jax
+
+    from distributed_machine_learning_tpu.cli.parity import (
+        make_parser,
+        run_equivalence,
+    )
+
+    args = make_parser().parse_args(["--equivalence", "--model", "vggtest"])
+    with pytest.raises(ValueError, match="vacuous"):
+        run_equivalence(args, devices=jax.devices()[:1])
