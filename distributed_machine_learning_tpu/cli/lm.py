@@ -370,10 +370,21 @@ def build(args):
             shard_tp_batch,
         )
 
-        if args.n_kv_heads is not None or args.remat:
+        if args.n_kv_heads is not None and (
+            args.n_kv_heads < 1 or args.n_heads % args.n_kv_heads
+        ):
             raise ValueError(
-                "--n-kv-heads / --remat are not supported with "
-                "--parallel ep (MoETransformerLM has neither knob)"
+                f"--n-kv-heads {args.n_kv_heads} must be a positive "
+                f"divisor of --n-heads {args.n_heads}"
+            )
+        if args.remat and getattr(args, "remat_policy", "mlp") != "mlp":
+            # MoETransformerLM implements the selective policy only (the
+            # Block-level remat_mlp wrap); dropping 'block' silently
+            # would surprise anyone counting on its memory profile.
+            raise ValueError(
+                "--parallel ep supports --remat-policy mlp only (the "
+                "selective LN2+expert-MLP checkpoint); whole-block "
+                "remat is not wired through the MoE blocks"
             )
         if args.n_experts < 1:
             raise ValueError(f"--n-experts must be >= 1, got "
@@ -419,6 +430,7 @@ def build(args):
         model = MoETransformerLM(
             vocab_size=args.vocab, d_model=args.d_model,
             n_layers=args.n_layers, n_heads=args.n_heads,
+            n_kv_heads=args.n_kv_heads, remat=args.remat,
             n_experts=args.n_experts, capacity_factor=args.capacity_factor,
             compute_dtype=dtype, attn_impl=attn, moe_impl=args.moe_impl,
         )

@@ -181,12 +181,17 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
 
     return Block(
         n_heads=model.n_heads,
+        n_kv_heads=model.n_kv_heads,
         d_ff=model.d_ff or 4 * model.d_model,
         attn_impl=model.attn_impl,
         seq_axis=model.seq_axis,
         compute_dtype=model.compute_dtype,
         flash_mesh=model.flash_mesh,
         flash_batch_axis=model.flash_batch_axis,
+        # Selective remat (models/transformer.py::_mlp_sublayer wraps
+        # the mlp_factory too): LN2 + the routed expert MLP recompute
+        # in backward; attention residuals stay saved.
+        remat_mlp=model.remat,
         mlp_factory=lambda: MoEMLP(
             n_experts=model.n_experts,
             d_ff=model.d_ff or 4 * model.d_model,
@@ -231,6 +236,14 @@ class MoETransformerLM(nn.Module):
     # the model with these set; user code leaves them None/().
     expert_axis: str | None = None
     token_axes: tuple = ()
+    # Grouped-query attention (see ``transformer.Attention``); None =
+    # classic MHA with the fused qkv layout.
+    n_kv_heads: int | None = None
+    # Selective rematerialization: checkpoint LN2 + the expert MLP of
+    # every block (the "mlp" policy — attention residuals stay saved,
+    # backward never re-runs attention; models/transformer.py).  The
+    # long-context enabler for MoE exactly as for the dense LM.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):

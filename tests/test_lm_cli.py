@@ -82,3 +82,12 @@ def test_lm_cli_bad_config_fails_fast():
     with pytest.raises(ValueError, match="data axis"):
         main(TINY + ["--parallel", "3d", "--n-heads", "8", "--pp", "2",
                      "--tp", "2", "--batch-size", "6"])
+
+
+def test_ep_flag_guards():
+    with pytest.raises(ValueError, match="positive divisor"):
+        main(TINY + ["--parallel", "ep", "--n-experts", "4",
+                     "--n-kv-heads", "0"])
+    with pytest.raises(ValueError, match="mlp only"):
+        main(TINY + ["--parallel", "ep", "--n-experts", "4", "--remat",
+                     "--remat-policy", "block"])

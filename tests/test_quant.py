@@ -206,3 +206,30 @@ def test_quantize_lm_params_rejects_misshaped_out_module():
                            "bias": jnp.zeros((4,))}}}
     with pytest.raises(ValueError, match="rank"):
         quantize_lm_params(bad)
+
+
+def test_tp_decode_with_int8_kv_cache_token_exact(rng):
+    """TP decode composes with the int8 KV cache: per-(head, slot)
+    quantization is local to each device's cache shard, so the tp=2
+    run matches single-device int8-KV decode token-for-token."""
+    from distributed_machine_learning_tpu.inference.generate import (
+        generate,
+        make_tp_generate_fn,
+    )
+    from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+        tp_decode_params,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+    mesh = make_mesh(2, axis_names=("model",))
+    model = TransformerLM(
+        vocab_size=32, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        kv_cache_dtype=jnp.int8,
+    )
+    params = init_lm_state(model).params
+    prompt = jnp.asarray(rng.integers(0, 32, (2, 4)), jnp.int32)
+    ref = generate(model, params, prompt, max_new_tokens=6)
+    fn = make_tp_generate_fn(model, 6, mesh)
+    out = fn(tp_decode_params(params, 2), prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
