@@ -373,9 +373,13 @@ class Attention(nn.Module):
                 ulysses_self_attention,
             )
 
+            # GQA stays NARROW into the all-to-all: when the sequence-
+            # axis size divides the KV heads, ulysses re-shards q and
+            # the narrow k/v separately (block-aligned groups) and the
+            # ICI bytes drop by the group factor — widening happens
+            # after the re-shard, or not at all on the flash path.
             out = ulysses_self_attention(
-                q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
-                self.seq_axis, lax.axis_size(self.seq_axis)
+                q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
             )
         elif self.attn_impl == "flash" or (
             self.attn_impl == "auto" and _flash_wins(L)

@@ -47,9 +47,16 @@ def ulysses_self_attention(
     kernel's pad-and-slice path — ``flash_wins``).
     """
     n = axis_size
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % Hkv:
+        raise ValueError(
+            f"query heads ({H}) must be a multiple of K/V heads ({Hkv})"
+        )
+    rep = H // Hkv
     if n == 1:
+        k = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+        v = jnp.repeat(v, rep, axis=2) if rep > 1 else v
         return dense_self_attention(q, k, v)
-    H = q.shape[2]
     if H % n:
         raise ValueError(
             f"Ulysses needs n_heads divisible by the sequence-axis size: "
@@ -64,6 +71,42 @@ def ulysses_self_attention(
     use_flash = local_attn == "flash" or (
         local_attn == "auto" and flash_wins(L)
     )
+    if rep > 1 and Hkv % n == 0:
+        # GQA narrow path: the all-to-all moves the NARROW K/V heads —
+        # query head block r = [r·H/n, (r+1)·H/n) maps exactly onto kv
+        # block r = [r·Hkv/n, (r+1)·Hkv/n) (h → h//rep is block-
+        # preserving when n | Hkv), so the bytes drop from 3·H to
+        # H + 2·Hkv per token — the same group-factor ICI saving the
+        # flash ring gets by rotating narrow chunks.  One launch, like
+        # the wide path: head order is (hkv, rep) under h//rep, so q
+        # viewed [B, Lc, Hkv, rep, D] concatenates with k/v on the rep
+        # axis and the single collective splits the SHARED Hkv axis —
+        # alignment of q and kv blocks is then true by construction.
+        qg = q.reshape(*q.shape[:2], Hkv, rep, q.shape[3])
+        pack = jnp.concatenate(
+            [qg, k[:, :, :, None], v[:, :, :, None]], axis=3
+        )  # [B, Lc, Hkv, rep+2, D]
+        pack = lax.all_to_all(
+            pack, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )  # [B, L, Hkv/n, rep+2, D]
+        B, L_, hkv_l = pack.shape[:3]
+        q2 = pack[:, :, :, :rep].reshape(B, L_, hkv_l * rep, -1)
+        k2, v2 = pack[:, :, :, rep], pack[:, :, :, rep + 1]
+        if use_flash:
+            # The kernel is GQA-native: the narrow K/V stream as-is.
+            out = flash_self_attention(q2, k2, v2)
+        else:
+            out = dense_self_attention(
+                q2, jnp.repeat(k2, rep, axis=2), jnp.repeat(v2, rep, axis=2)
+            )
+        return lax.all_to_all(
+            out, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+    if rep > 1:
+        # Hkv not divisible by n: widen first (block alignment would
+        # break), paying the classic wide all-to-all.
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     # seq-sharded → head-sharded: each device keeps heads [r·H/n,(r+1)·H/n)
     # for the FULL sequence (all_to_all concatenates chunks in axis order,
     # so global sequence order is preserved).  Q/K/V ride ONE stacked

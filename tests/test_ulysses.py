@@ -177,3 +177,47 @@ def test_ulysses_lm_step_matches_dense():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
         )
+
+
+def test_ulysses_gqa_narrow_path_matches_dense():
+    """GQA through Ulysses: the narrow-K/V packed all-to-all path
+    (Hkv % n == 0) and the widen-first fallback (Hkv % n != 0) both
+    equal unsharded dense attention, on the dense AND flash local
+    kernels (flash consumes the narrow K/V natively)."""
+    rng = np.random.default_rng(9)
+    for Hkv, n, Lg, local in (
+        (4, 4, 32, "dense"),   # narrow path, dense local kernel
+        (2, 4, 32, "dense"),   # widen-first fallback
+        (4, 4, 512, "flash"),  # narrow path, flash local kernel
+    ):
+        rep = H // Hkv
+        q = jnp.asarray(rng.standard_normal((B, Lg, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, Lg, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, Lg, Hkv, D)), jnp.float32)
+        ref = dense_self_attention(
+            q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+        )
+        from distributed_machine_learning_tpu.runtime.mesh import (
+            shard_map_no_check,
+        )
+
+        mesh = make_mesh(n, axis_names=("seq",))
+        fn = shard_map_no_check(
+            lambda q, k, v, local=local: ulysses_self_attention(
+                q, k, v, "seq", n, local_attn=local
+            ),
+            mesh=mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"),
+        )
+        out = fn(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_ulysses_rejects_non_divisor_kv_heads():
+    q = jnp.zeros((1, 8, 8, 4))
+    kv = jnp.zeros((1, 8, 3, 4))
+    with pytest.raises(ValueError, match="multiple of K/V"):
+        ulysses_self_attention(q, kv, kv, "seq", 1)
