@@ -41,6 +41,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", default=1.0, type=float,
                    help="0 = greedy decoding")
     p.add_argument("--top-k", dest="top_k", default=None, type=int)
+    p.add_argument("--top-p", dest="top_p", default=None, type=float,
+                   help="nucleus sampling: keep the smallest token set "
+                        "with cumulative probability >= p (composes "
+                        "with --top-k; applied before temperature)")
     p.add_argument("--seed", default=0, type=int)
     # Architecture flags — must match the training run.
     p.add_argument("--d-model", dest="d_model", default=256, type=int)
@@ -193,13 +197,14 @@ def main(argv=None) -> None:
         fn = make_tp_generate_fn(
             model, args.max_new_tokens, mesh,
             temperature=args.temperature, top_k=args.top_k,
-            quantize=args.quant,
+            top_p=args.top_p, quantize=args.quant,
         )
         params = tp_decode_params(params, args.tp)
     else:
         fn = make_generate_fn(model, args.max_new_tokens,
                               temperature=args.temperature,
-                              top_k=args.top_k, quantize=args.quant)
+                              top_k=args.top_k, top_p=args.top_p,
+                              quantize=args.quant)
     out = np.asarray(
         fn(params, prompt, jax.random.PRNGKey(args.seed))
     )[0, prompt.shape[1]:]

@@ -23,9 +23,22 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _sample(logits, rng, temperature: float, top_k: int | None):
-    """One sampling decision per batch row.  [B, V] fp32 → [B] int32."""
+def _sample(logits, rng, temperature: float, top_k: int | None,
+            top_p: float | None = None):
+    """One sampling decision per batch row.  [B, V] fp32 → [B] int32.
+
+    Order matches the de-facto serving convention (the HuggingFace
+    warper chain): temperature FIRST, then ``top_k``, then ``top_p``
+    (nucleus sampling, Holtzman et al.: the smallest token set whose
+    tempered probability mass ≥ p) over the survivors.  Greedy
+    (``temperature=0``) returns before any masking — argmax is
+    invariant to it, and the nucleus sort is O(V log V) per decoded
+    token inside the scan.
+    """
     logits = logits.astype(jnp.float32)
+    if temperature == 0.0:  # greedy (static: part of the compiled program)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
     if top_k is not None:
         if top_k > logits.shape[-1]:
             raise ValueError(
@@ -36,11 +49,23 @@ def _sample(logits, rng, temperature: float, top_k: int | None):
         # per decoded token inside the scan, so it matters at real vocabs.
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if temperature == 0.0:  # greedy (static: part of the compiled program)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(rng, logits / temperature, axis=-1).astype(
-        jnp.int32
-    )
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        # Nucleus: sort descending, keep the prefix whose cumulative
+        # probability is < p PLUS the first token crossing it (so the
+        # kept mass is >= p and at least one token always survives).
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = cum - probs < top_p  # prefix + the crossing token
+        # Threshold logit = smallest kept logit per row; mask below it.
+        thresh = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True,
+        )
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def make_generate_fn(
@@ -49,6 +74,7 @@ def make_generate_fn(
     temperature: float = 0.0,
     top_k: int | None = None,
     quantize: str | None = None,
+    top_p: float | None = None,
 ):
     """Build a jitted ``fn(params, prompt, rng) -> tokens``.
 
@@ -69,7 +95,8 @@ def make_generate_fn(
     if quantize not in (None, "int8"):
         raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
     dm = model.clone(attn_impl="dense", decode=True, weight_quant=quantize)
-    sample = partial(_sample, temperature=temperature, top_k=top_k)
+    sample = partial(_sample, temperature=temperature, top_k=top_k,
+                     top_p=top_p)
     return jax.jit(partial(_generate_body, dm, sample, max_new_tokens))
 
 
@@ -133,6 +160,7 @@ def make_tp_generate_fn(
     top_k: int | None = None,
     quantize: str | None = None,
     model_axis: str = "model",
+    top_p: float | None = None,
 ):
     """Tensor-parallel generation: ``fn(params, prompt, rng) -> tokens``.
 
@@ -194,7 +222,8 @@ def make_tp_generate_fn(
         attn_impl="dense", decode=True, weight_quant=quantize,
         tp_axis=model_axis,
     )
-    sample = partial(_sample, temperature=temperature, top_k=top_k)
+    sample = partial(_sample, temperature=temperature, top_k=top_k,
+                     top_p=top_p)
     body = partial(_generate_body, local, sample, max_new_tokens)
 
     jitted: dict = {}
@@ -233,6 +262,7 @@ def generate(
     top_k: int | None = None,
     rng=None,
     quantize: str | None = None,
+    top_p: float | None = None,
 ):
     """One-shot convenience wrapper around :func:`make_generate_fn`.
 
@@ -241,7 +271,7 @@ def generate(
     the (full-precision) params with ``quantize_lm_params`` here.
     """
     fn = make_generate_fn(model, max_new_tokens, temperature, top_k,
-                          quantize=quantize)
+                          quantize=quantize, top_p=top_p)
     if quantize == "int8":
         from distributed_machine_learning_tpu.ops.quant import (
             quantize_lm_params,

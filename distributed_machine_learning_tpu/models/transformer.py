@@ -374,10 +374,12 @@ class Attention(nn.Module):
             )
 
             # GQA stays NARROW into the all-to-all: when the sequence-
-            # axis size divides the KV heads, ulysses re-shards q and
-            # the narrow k/v separately (block-aligned groups) and the
-            # ICI bytes drop by the group factor — widening happens
-            # after the re-shard, or not at all on the flash path.
+            # axis size divides the KV heads, ulysses packs q (viewed
+            # [.., Hkv, rep, D]) with the narrow k/v into ONE collective
+            # split on the shared Hkv axis — block alignment by
+            # construction, ICI bytes ÷ the group factor; widening
+            # happens after the re-shard, or not at all on the flash
+            # path (the kernel is GQA-native).
             out = ulysses_self_attention(
                 q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
             )

@@ -161,3 +161,64 @@ def test_tp_decode_guards(rng):
             TransformerLM(vocab_size=VOCAB, d_model=32, n_layers=1,
                           n_heads=8, n_kv_heads=2), 4, mesh,
         )
+
+
+def test_top_p_nucleus_sampling(rng):
+    """Nucleus sampling invariants: top_p=1.0 keeps the full
+    distribution; a tiny top_p degenerates to greedy (only the argmax
+    survives the nucleus); sampled tokens always come from the kept
+    set."""
+    from distributed_machine_learning_tpu.inference.generate import _sample
+
+    logits = jnp.asarray(rng.standard_normal((4, 32)) * 3, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    # Tiny p: nucleus = {argmax} exactly.
+    t = _sample(logits, key, temperature=1.0, top_k=None, top_p=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(t), np.argmax(np.asarray(logits), axis=-1)
+    )
+    # p=1.0 == unrestricted sampling (identical to top_p=None, same key).
+    a = _sample(logits, key, temperature=1.0, top_k=None, top_p=1.0)
+    b = _sample(logits, key, temperature=1.0, top_k=None, top_p=None)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Sampled tokens live inside the nucleus for moderate p.
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    for trial in range(8):
+        t = _sample(logits, jax.random.PRNGKey(trial), temperature=1.0,
+                    top_k=None, top_p=0.5)
+        for row, tok in enumerate(np.asarray(t)):
+            order = np.argsort(-probs[row])
+            cum = np.cumsum(probs[row][order])
+            nucleus = set(order[: int(np.searchsorted(cum, 0.5)) + 1])
+            assert int(tok) in nucleus
+    # Guard.
+    import pytest
+
+    with pytest.raises(ValueError, match="top_p"):
+        _sample(logits, key, temperature=1.0, top_k=None, top_p=1.5)
+
+
+def test_top_p_through_generate(rng):
+    """top_p threads through the jitted generate loop AND the TP shard
+    map path — with the same rng and replicated sampling, the two must
+    produce identical tokens."""
+    from distributed_machine_learning_tpu.inference.generate import (
+        make_generate_fn,
+        make_tp_generate_fn,
+    )
+    from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+        tp_decode_params,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+    model, params = _model_and_params()
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (2, 4)), jnp.int32)
+    fn = make_generate_fn(model, 6, temperature=0.8, top_p=0.9)
+    out = fn(params, prompt, jax.random.PRNGKey(1))
+    assert out.shape == (2, 10)
+    assert np.asarray(out).max() < VOCAB
+
+    mesh = make_mesh(2, axis_names=("model",))
+    tp_fn = make_tp_generate_fn(model, 6, mesh, temperature=0.8, top_p=0.9)
+    tp_out = tp_fn(tp_decode_params(params, 2), prompt, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(tp_out), np.asarray(out))
