@@ -43,8 +43,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--top-k", dest="top_k", default=None, type=int)
     p.add_argument("--top-p", dest="top_p", default=None, type=float,
                    help="nucleus sampling: keep the smallest token set "
-                        "with cumulative probability >= p (composes "
-                        "with --top-k; applied before temperature)")
+                        "whose TEMPERED cumulative probability >= p "
+                        "(HF warper order: temperature, then top-k, "
+                        "then top-p)")
     p.add_argument("--seed", default=0, type=int)
     # Architecture flags — must match the training run.
     p.add_argument("--d-model", dest="d_model", default=256, type=int)
@@ -68,7 +69,66 @@ def make_parser() -> argparse.ArgumentParser:
                         "(manual Megatron shard_map — heads, d_ff, and "
                         "the KV cache sharded; composes with --quant "
                         "int8: inference/generate.py::make_tp_generate_fn)")
+    # Speculative decoding (inference/speculative.py): a cheap draft
+    # model proposes --spec-gamma tokens per target verify pass; output
+    # distribution is EXACTLY the target's (greedy: bitwise-identical).
+    p.add_argument("--spec-gamma", dest="spec_gamma", default=0, type=int,
+                   help="enable speculative decoding with this many draft "
+                        "tokens per verify round (0 = off); the draft "
+                        "defaults to the target architecture at random "
+                        "init unless --draft-* flags say otherwise; "
+                        "batch-1, incompatible with --tp")
+    p.add_argument("--draft-ckpt-dir", dest="draft_ckpt_dir", default=None,
+                   help="cli.lm checkpoint for the draft model; absent "
+                        "= random-init draft (output stays exact, "
+                        "acceptance is just poor)")
+    p.add_argument("--draft-d-model", dest="draft_d_model", default=None,
+                   type=int, help="draft architecture (defaults mirror "
+                                  "the target's flags)")
+    p.add_argument("--draft-n-layers", dest="draft_n_layers", default=None,
+                   type=int)
+    p.add_argument("--draft-n-heads", dest="draft_n_heads", default=None,
+                   type=int)
+    p.add_argument("--draft-n-kv-heads", dest="draft_n_kv_heads",
+                   default=None, type=int)
     return p
+
+
+def _restore_lm_params(ckpt_dir: str, n_layers: int):
+    """Restore a cli.lm checkpoint's params, unstacking pipeline-layout
+    trees (contiguous or interleaved) into the per-layer form plain
+    apply expects — the ONE restore path for target AND draft models."""
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        checkpoint_layout,
+        latest_checkpoint,
+        restore_checkpoint,
+    )
+
+    latest = latest_checkpoint(ckpt_dir)
+    if latest is None:
+        raise FileNotFoundError(f"no complete checkpoint under {ckpt_dir}")
+    params = restore_checkpoint(latest).params
+    if "blocks" in params:
+        from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
+            parse_interleaved_layout,
+        )
+
+        interleaved = parse_interleaved_layout(checkpoint_layout(latest))
+        if interleaved is not None:
+            from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
+                unstack_interleaved,
+            )
+
+            p_saved, v_saved = interleaved
+            params = unstack_interleaved(params, n_layers, p_saved, v_saved)
+        else:
+            from distributed_machine_learning_tpu.parallel.pipeline import (
+                unstack_lm_params,
+            )
+
+            params = unstack_lm_params(params, n_layers)
+    print(f"restored {latest}")
+    return params
 
 
 def main(argv=None) -> None:
@@ -104,50 +164,7 @@ def main(argv=None) -> None:
     )
 
     if args.ckpt_dir:
-        from distributed_machine_learning_tpu.train.checkpoint import (
-            latest_checkpoint,
-            restore_checkpoint,
-        )
-
-        latest = latest_checkpoint(args.ckpt_dir)
-        if latest is None:
-            raise FileNotFoundError(
-                f"no complete checkpoint under {args.ckpt_dir}"
-            )
-        params = restore_checkpoint(latest).params
-        if "blocks" in params:
-            # Pipeline-layout checkpoint: blocks stacked on a leading
-            # layer axis — restore the per-layer tree the plain apply
-            # expects.  The layout tag distinguishes the interleaved
-            # schedule's permuted stacking (which carries its P and v)
-            # from the contiguous gpipe/1f1b order.
-            from distributed_machine_learning_tpu.train.checkpoint import (
-                checkpoint_layout,
-            )
-
-            from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
-                parse_interleaved_layout,
-            )
-
-            interleaved = parse_interleaved_layout(
-                checkpoint_layout(latest)
-            )
-            if interleaved is not None:
-                from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
-                    unstack_interleaved,
-                )
-
-                p_saved, v_saved = interleaved
-                params = unstack_interleaved(
-                    params, args.n_layers, p_saved, v_saved
-                )
-            else:
-                from distributed_machine_learning_tpu.parallel.pipeline import (  # noqa: E501
-                    unstack_lm_params,
-                )
-
-                params = unstack_lm_params(params, args.n_layers)
-        print(f"restored {latest}")
+        params = _restore_lm_params(args.ckpt_dir, args.n_layers)
     else:
         from distributed_machine_learning_tpu.train.lm_step import (
             init_lm_state,
@@ -177,6 +194,60 @@ def main(argv=None) -> None:
     else:
         toks = [b % vocab for b in prompt_bytes] or [0]
     prompt = jnp.asarray(np.asarray(toks, np.int32)[None, :])
+
+    if args.spec_gamma > 0:
+        from distributed_machine_learning_tpu.inference.speculative import (
+            make_speculative_generate_fn,
+        )
+
+        if args.tp > 1:
+            raise ValueError(
+                "--spec-gamma and --tp are mutually exclusive (the "
+                "speculative loop is batch-1 single-program)"
+            )
+        draft = TransformerLM(
+            vocab_size=vocab,
+            d_model=args.draft_d_model or args.d_model,
+            n_layers=args.draft_n_layers or args.n_layers,
+            n_heads=args.draft_n_heads or args.n_heads,
+            n_kv_heads=(args.draft_n_kv_heads
+                        if args.draft_n_kv_heads is not None
+                        else args.n_kv_heads),
+            compute_dtype=dtype,
+        )
+        from distributed_machine_learning_tpu.train.lm_step import (
+            init_lm_state,
+        )
+
+        if args.draft_ckpt_dir:
+            draft_params = _restore_lm_params(
+                args.draft_ckpt_dir, draft.n_layers
+            )
+        else:
+            draft_params = init_lm_state(draft, seed=11).params
+            print("WARNING: random-init draft (exact output, poor "
+                  "acceptance)")
+        draft_params = jax.tree_util.tree_map(
+            lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p,
+            draft_params,
+        )
+        fn = make_speculative_generate_fn(
+            model, draft, args.max_new_tokens, gamma=args.spec_gamma,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, quantize=args.quant,
+        )
+        out = np.asarray(
+            fn(params, draft_params, prompt,
+               jax.random.PRNGKey(args.seed))
+        )[0, prompt.shape[1]:]
+        if vocab == VOCAB_SIZE:
+            text = bytes(t for t in out.tolist() if t < 256).decode(
+                "utf-8", errors="replace"
+            )
+        else:
+            text = " ".join(str(t) for t in out.tolist())
+        print(args.prompt + text)
+        return
 
     if args.tp > 1:
         from distributed_machine_learning_tpu.inference.generate import (

@@ -23,22 +23,16 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _sample(logits, rng, temperature: float, top_k: int | None,
-            top_p: float | None = None):
-    """One sampling decision per batch row.  [B, V] fp32 → [B] int32.
-
-    Order matches the de-facto serving convention (the HuggingFace
-    warper chain): temperature FIRST, then ``top_k``, then ``top_p``
-    (nucleus sampling, Holtzman et al.: the smallest token set whose
-    tempered probability mass ≥ p) over the survivors.  Greedy
-    (``temperature=0``) returns before any masking — argmax is
-    invariant to it, and the nucleus sort is O(V log V) per decoded
-    token inside the scan.
-    """
-    logits = logits.astype(jnp.float32)
-    if temperature == 0.0:  # greedy (static: part of the compiled program)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
+def warp_logits(logits, temperature: float, top_k: int | None,
+                top_p: float | None):
+    """The sampling warper chain, HF-warper order: temperature FIRST,
+    then ``top_k``, then ``top_p`` (nucleus sampling, Holtzman et al.:
+    the smallest token set whose TEMPERED probability mass ≥ p) over
+    the survivors.  Returns f32 logits with masked entries at -inf.
+    The ONE warper shared by ``_sample`` and the speculative decoder
+    (``inference/speculative.py``) — guards and semantics cannot drift.
+    ``temperature`` must be > 0 (greedy has its own exact path)."""
+    logits = logits.astype(jnp.float32) / temperature
     if top_k is not None:
         if top_k > logits.shape[-1]:
             raise ValueError(
@@ -65,7 +59,22 @@ def _sample(logits, rng, temperature: float, top_k: int | None,
             keepdims=True,
         )
         logits = jnp.where(logits < thresh, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def _sample(logits, rng, temperature: float, top_k: int | None,
+            top_p: float | None = None):
+    """One sampling decision per batch row.  [B, V] fp32 → [B] int32.
+    Greedy (``temperature=0``) returns before any masking — argmax is
+    invariant to it, and the nucleus sort is O(V log V) per decoded
+    token inside the scan."""
+    if temperature == 0.0:  # greedy (static: part of the compiled program)
+        return jnp.argmax(
+            logits.astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, warp_logits(logits, temperature, top_k, top_p), axis=-1
+    ).astype(jnp.int32)
 
 
 def make_generate_fn(

@@ -1,0 +1,247 @@
+"""Speculative decoding — draft-and-verify autoregressive generation.
+
+Decode is bound by HBM reads of the target model's weights per token
+(docs/PERF.md); speculative decoding (Leviathan et al.) buys tokens per
+weight-read: a cheap DRAFT model proposes ``gamma`` tokens
+autoregressively, the TARGET verifies all of them in ONE forward pass
+(γ+1 positions against its cache — compute-parallel, the same weight
+bytes as a single decode step), and a rejection rule keeps the output
+distribution EXACTLY the target's:
+
+- greedy (``temperature=0``): accept the longest prefix where the
+  draft's token equals the target argmax, then emit the target argmax
+  at the first mismatch (or the bonus token when all γ survive) — the
+  output is bitwise the target-only greedy stream, which is how the
+  tests pin it;
+- sampled: accept ``d_i`` with probability ``min(1, p_i(d_i)/q_i(d_i))``
+  (p = target, q = draft, both WARPED — temperature/top-k/top-p — so
+  the preserved distribution is the one the plain sampler uses); on
+  rejection sample from ``norm(max(p_i − q_i, 0))``; on full acceptance
+  sample the bonus from ``p_γ``.
+
+TPU-shaped implementation notes:
+
+- **Cache rollback is free.**  The KV caches index slots by absolute
+  position with a single ``idx`` frontier counter; slots past the
+  frontier are causally masked (``slot <= pos``) and overwritten by the
+  next write.  Rejecting draft tokens is therefore just rewinding the
+  counter in the carried cache pytree — no K/V copy, no re-prefill.
+- The draft phase runs γ+1 steps (it processes its own last proposal),
+  keeping its cache exactly one token behind the committed stream at
+  every round — the invariant that makes the loop shape-static.
+- One ``lax.while_loop`` emits a variable 1..γ+1 tokens per round into
+  a fixed output buffer at a moving pointer; every slot below the final
+  pointer is committed before it can be read.
+- Batch 1 only: acceptance length is data-dependent PER ROW, and the
+  cache frontier is one scalar — the standard latency-serving shape.
+
+The reference has no inference path at all (SURVEY.md §2); this extends
+the serving surface of ``inference/generate.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_machine_learning_tpu.inference.generate import warp_logits
+
+
+def make_speculative_generate_fn(
+    target_model,
+    draft_model,
+    max_new_tokens: int,
+    gamma: int = 4,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    quantize: str | None = None,
+    draft_quantize: str | None = None,
+):
+    """Build ``fn(target_params, draft_params, prompt, rng) -> tokens``.
+
+    ``prompt``: [1, Lp] int32 (batch 1 — see module docstring); returns
+    [1, Lp + max_new_tokens].  ``gamma``: draft tokens per verify round.
+    ``quantize``/``draft_quantize``: "int8" serves that model through
+    the weight-only kernel (``ops/quant.py``) — pass params converted by
+    ``quantize_lm_params``.
+
+    Correctness contract: the emitted stream follows the TARGET's
+    sampling distribution exactly (greedy: bitwise-identical to
+    ``make_generate_fn`` with the same flags — tested); the draft only
+    changes HOW FAST tokens appear, never WHICH distribution they come
+    from.
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if target_model.vocab_size != draft_model.vocab_size:
+        raise ValueError(
+            f"target and draft must share a vocabulary (got "
+            f"{target_model.vocab_size} vs {draft_model.vocab_size})"
+        )
+    for q in (quantize, draft_quantize):
+        if q not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', got {q!r}")
+    tm = target_model.clone(attn_impl="dense", decode=True,
+                            weight_quant=quantize)
+    dm = draft_model.clone(attn_impl="dense", decode=True,
+                           weight_quant=draft_quantize)
+    # The verify pass applies γ+1 tokens MID-STREAM: it must attend the
+    # full cache, not take the start-0 prefill fast path — the
+    # continuation clone routes multi-token decode through
+    # _cached_attention (same params, same cache layout).
+    tm_verify = tm.clone(decode_continuation=True)
+    greedy = temperature == 0.0
+    V = target_model.vocab_size
+
+    def warp(logits):
+        return warp_logits(logits, temperature, top_k, top_p)
+
+    @jax.jit
+    def run(tparams, dparams, prompt, rng):
+        B, Lp = prompt.shape
+        if B != 1:
+            raise ValueError(
+                f"speculative decoding is batch-1 (got B={B}): acceptance "
+                "length is data-dependent per row but the KV-cache "
+                "frontier is one scalar"
+            )
+        budget = max_new_tokens + gamma + 1  # output buffer slack
+        cache_len = -(-(Lp + budget + 1) // 512) * 512
+
+        def init_cache(model):
+            shapes = jax.eval_shape(
+                lambda: model.init(
+                    jax.random.PRNGKey(0),
+                    jnp.zeros((B, cache_len), jnp.int32),
+                    train=False,
+                )
+            )["cache"]
+            return jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes
+            )
+
+        tcache, dcache = init_cache(tm), init_cache(dm)
+
+        # Prefill both models on the prompt; the target's last logits
+        # sample the first committed token.
+        tlogits, tvars = tm.apply(
+            {"params": tparams, "cache": tcache}, prompt, train=False,
+            mutable=["cache"],
+        )
+        _, dvars = dm.apply(
+            {"params": dparams, "cache": dcache}, prompt, train=False,
+            mutable=["cache"],
+        )
+        tcache, dcache = tvars["cache"], dvars["cache"]
+        rng, r0 = jax.random.split(rng)
+        if greedy:
+            cur = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
+        else:
+            cur = jax.random.categorical(
+                r0, warp(tlogits[:, -1]), axis=-1
+            ).astype(jnp.int32)
+
+        out = jnp.zeros((B, budget), jnp.int32)
+        out = lax.dynamic_update_slice(out, cur[:, None], (0, 0))
+        # ptr: tokens EMITTED so far (cur at slot 0 counts).
+        state = (tcache, dcache, cur, out, jnp.asarray(1, jnp.int32), rng)
+
+        def round_body(state):
+            tcache, dcache, cur, out, ptr, rng = state
+
+            # ---- draft phase: γ+1 steps (the last processes its own
+            # final proposal, keeping the draft cache one token behind
+            # the committed stream after any acceptance count).
+            def dstep(carry, r):
+                dcache, tok = carry
+                logits, vars_ = dm.apply(
+                    {"params": dparams, "cache": dcache}, tok[:, None],
+                    train=False, mutable=["cache"],
+                )
+                lg = logits[:, -1]
+                if greedy:
+                    nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                    q = jnp.zeros((B, V), jnp.float32)  # unused
+                else:
+                    w = warp(lg)  # one warp per step: probs AND sample
+                    q = jax.nn.softmax(w, axis=-1)
+                    nxt = jax.random.categorical(r, w, axis=-1).astype(
+                        jnp.int32
+                    )
+                return (vars_["cache"], nxt), (nxt, q)
+
+            rng, *draft_keys = jax.random.split(rng, gamma + 2)
+            (dcache2, _), (draft_toks, draft_q) = lax.scan(
+                dstep, (dcache, cur), jnp.stack(draft_keys)
+            )
+            # draft_toks: [γ+1, B]; proposals are the first γ.
+            d = draft_toks[:gamma, 0]  # [γ] int32 (B=1)
+            q = draft_q[:gamma, 0]  # [γ, V]
+
+            # ---- verify: one target pass over [cur, d_0..d_{γ-1}].
+            verify_in = jnp.concatenate([cur, d], axis=0)[None]  # [1, γ+1]
+            vlogits, tvars = tm_verify.apply(
+                {"params": tparams, "cache": tcache}, verify_in,
+                train=False, mutable=["cache"],
+            )
+            vlogits = vlogits[0]  # [γ+1, V]; row i predicts slot of d_i
+
+            rng, r_acc, r_fix = jax.random.split(rng, 3)
+            if greedy:
+                tbest = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+                acc = d == tbest[:gamma]  # [γ]
+                # n_acc = length of the all-accepted prefix.
+                n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32)))
+                # Correction/bonus token: target argmax at position n_acc.
+                t_new = tbest[n_acc][None]
+            else:
+                p = jax.nn.softmax(warp(vlogits), axis=-1)  # [γ+1, V]
+                p_d = jnp.take_along_axis(
+                    p[:gamma], d[:, None], axis=1
+                )[:, 0]
+                q_d = jnp.take_along_axis(q, d[:, None], axis=1)[:, 0]
+                u = jax.random.uniform(r_acc, (gamma,))
+                acc = u * q_d < p_d  # accept iff u < p/q (q>0 where sampled)
+                n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32)))
+                # Residual at the first rejection; bonus row at γ.
+                p_row = p[n_acc]
+                q_row = jnp.where(
+                    n_acc < gamma,
+                    q[jnp.minimum(n_acc, gamma - 1)],
+                    jnp.zeros((V,), jnp.float32),
+                )
+                resid = jnp.maximum(p_row - q_row, 0.0)
+                resid = resid / jnp.maximum(resid.sum(), 1e-30)
+                t_new = jax.random.categorical(
+                    r_fix, jnp.log(jnp.maximum(resid, 1e-30))
+                )[None].astype(jnp.int32)
+
+            # ---- commit: window = [d_0..d_{n_acc-1}, t_new, junk...];
+            # the junk beyond n_acc is overwritten by the next round's
+            # window (or never read past the final pointer).
+            window = jnp.where(
+                jnp.arange(gamma + 1) == n_acc,
+                t_new[0],
+                jnp.concatenate([d, jnp.zeros((1,), jnp.int32)]),
+            )
+            out = lax.dynamic_update_slice(out, window[None], (0, ptr))
+
+            # ---- cache rewinds (the free rollback): target holds the
+            # committed stream MINUS t_new; draft holds one token less.
+            tcache = dict(tvars["cache"])
+            tcache["idx"] = tcache["idx"] - (gamma + 1) + (n_acc + 1)
+            dcache2 = dict(dcache2)
+            dcache2["idx"] = dcache2["idx"] - (gamma + 1) + (n_acc + 1)
+            return (tcache, dcache2, t_new, out, ptr + n_acc + 1, rng)
+
+        def cond(state):
+            return state[4] < max_new_tokens
+
+        _, _, _, out, _, _ = lax.while_loop(cond, round_body, state)
+        return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
+
+    return run

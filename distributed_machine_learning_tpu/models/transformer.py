@@ -96,6 +96,33 @@ def _cached_attention(q, k_cache, v_cache, q_positions):
     return out.reshape(B, Lq, H, D).astype(q.dtype)
 
 
+def _cached_attention_quant(q, k_int, ks, v_int, vs, q_positions):
+    """:func:`_cached_attention` over an int8 cache WITHOUT materializing
+    a dequantized f32 copy: the per-slot scales fold into the f32
+    score/probability path — ``s·ks`` after the QK einsum, ``p·vs``
+    before the PV einsum — algebraically identical to dequantize-then-
+    attend, while the int8→f32 convert fuses into the einsums (HBM only
+    ever reads the int8 bytes; a materialized f32 cache copy would cost
+    4× the traffic the int8 cache exists to save)."""
+    B, Lq, H, D = q.shape
+    Hkv, S = k_int.shape[1], k_int.shape[2]
+    rep = H // Hkv
+    qg = q.astype(jnp.float32).reshape(B, Lq, Hkv, rep, D)
+    s = jnp.einsum(
+        "bqhrd,bhkd->bhrqk", qg, k_int.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * (1.0 / (D**0.5))
+    s = s * ks[:, :, None, None, :]  # fold the key scales, f32
+    mask = jnp.arange(S)[None, :] <= q_positions[:, None]
+    s = jnp.where(mask[None, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhrqk,bhkd->bqhrd", p * vs[:, :, None, None, :],
+        v_int.astype(jnp.float32), preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, Lq, H, D).astype(q.dtype)
+
+
 def _flash_wins(L: int) -> bool:
     """attn_impl="auto" policy — delegates to the kernel module's shared
     ``flash_wins`` length rule (docs/PERF.md r02 crossover table)."""
@@ -191,6 +218,10 @@ class Attention(nn.Module):
     # the manual-TP decode clone MUST set it to the GLOBAL head dim,
     # since its local n_heads no longer divides E into real head widths.
     head_dim: int | None = None
+    # Multi-token decode calls attend the full cache instead of taking
+    # the start-0 prefill fast path — speculative decoding's verify
+    # pass (inference/speculative.py).  decode=True only.
+    decode_continuation: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -291,7 +322,24 @@ class Attention(nn.Module):
 
                 _write(ck, k, cks if quant_cache else None)
                 _write(cv, v, cvs if quant_cache else None)
-                if L > 1:
+                if L > 1 and self.decode_continuation:
+                    # Mid-stream multi-token continuation (speculative
+                    # decoding's verify pass): the fresh queries attend
+                    # the FULL cache — prefix plus the just-written
+                    # fresh K/V — causally masked by absolute position.
+                    # _cached_attention handles Lq > 1 natively; at the
+                    # verify shape (Lq = γ+1, small) the f32 score
+                    # tensor is tiny, so no kernel dispatch is needed.
+                    if quant_cache:
+                        out = _cached_attention_quant(
+                            q, ck.value, cks.value, cv.value, cvs.value,
+                            positions,
+                        )
+                    else:
+                        out = _cached_attention(
+                            q, ck.value, cv.value, positions
+                        )
+                elif L > 1:
                     # PREFILL (the one multi-token call, at start == 0 —
                     # generate.py's contract): the cache was empty, so
                     # attention over the prompt is plain causal
@@ -337,12 +385,8 @@ class Attention(nn.Module):
                             cvs.value if quant_cache else None,
                         )
                     elif quant_cache:
-                        out = _cached_attention(
-                            q,
-                            ck.value.astype(jnp.float32)
-                            * cks.value[..., None],
-                            cv.value.astype(jnp.float32)
-                            * cvs.value[..., None],
+                        out = _cached_attention_quant(
+                            q, ck.value, cks.value, cv.value, cvs.value,
                             positions,
                         )
                     else:
@@ -509,6 +553,7 @@ class Block(nn.Module):
     remat_mlp: bool = False
     tp_axis: str | None = None  # manual TP decode (see Attention.tp_axis)
     head_dim: int | None = None  # explicit head width (TP decode clones)
+    decode_continuation: bool = False  # verify-pass decode (speculative)
 
     @nn.compact
     def __call__(self, x, positions):
@@ -528,6 +573,7 @@ class Block(nn.Module):
             weight_quant=self.weight_quant,
             tp_axis=self.tp_axis,
             head_dim=self.head_dim,
+            decode_continuation=self.decode_continuation,
             name="attn",
         )(h, positions)
         if self.remat_mlp and not self.decode:
@@ -579,6 +625,10 @@ class TransformerLM(nn.Module):
     # lm_head would shard the logits).  Decode-only.
     tp_axis: str | None = None
     head_dim: int | None = None
+    # Multi-token decode applies attend the full cache (speculative
+    # decoding's verify pass — inference/speculative.py) instead of
+    # assuming the start-0 prefill contract.
+    decode_continuation: bool = False
     remat: bool = False  # jax.checkpoint each block: activation memory
     # drops from O(L·E) per layer to per-block boundaries, recomputing the
     # block in backward — the HBM-for-FLOPs trade that lets long-context
@@ -667,6 +717,7 @@ class TransformerLM(nn.Module):
                 remat_mlp=remat_mlp,
                 tp_axis=self.tp_axis,
                 head_dim=self.head_dim,
+                decode_continuation=self.decode_continuation,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)

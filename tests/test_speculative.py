@@ -1,0 +1,85 @@
+"""Speculative decoding (inference/speculative.py): the draft must
+change SPEED, never the distribution — greedy output is pinned bitwise
+to the target-only stream."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.inference.generate import (
+    make_generate_fn,
+)
+from distributed_machine_learning_tpu.inference.speculative import (
+    make_speculative_generate_fn,
+)
+from distributed_machine_learning_tpu.models.transformer import TransformerLM
+from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+VOCAB = 48
+
+
+def _models():
+    target = TransformerLM(vocab_size=VOCAB, d_model=32, n_layers=3,
+                           n_heads=4)
+    draft = TransformerLM(vocab_size=VOCAB, d_model=16, n_layers=1,
+                          n_heads=2)
+    return (target, init_lm_state(target).params,
+            draft, init_lm_state(draft, seed=7).params)
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+def test_greedy_speculative_bitwise_equals_vanilla(rng, gamma):
+    """Any draft — here an unrelated random model with terrible
+    acceptance — must produce EXACTLY the target's greedy stream."""
+    target, tparams, draft, dparams = _models()
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (1, 6)), jnp.int32)
+    ref = make_generate_fn(target, 12)(
+        tparams, prompt, jax.random.PRNGKey(0)
+    )
+    fn = make_speculative_generate_fn(target, draft, 12, gamma=gamma)
+    out = fn(tparams, dparams, prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_greedy_speculative_with_target_as_draft(rng):
+    """draft == target: every proposal accepted, output still the exact
+    greedy stream (the all-accept + bonus path)."""
+    target, tparams, _, _ = _models()
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (1, 5)), jnp.int32)
+    ref = make_generate_fn(target, 10)(
+        tparams, prompt, jax.random.PRNGKey(0)
+    )
+    fn = make_speculative_generate_fn(target, target, 10, gamma=4)
+    out = fn(tparams, tparams, prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sampled_speculative_runs_and_stays_in_vocab(rng):
+    target, tparams, draft, dparams = _models()
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (1, 5)), jnp.int32)
+    fn = make_speculative_generate_fn(
+        target, draft, 10, gamma=3, temperature=0.8, top_p=0.9
+    )
+    out = fn(tparams, dparams, prompt, jax.random.PRNGKey(3))
+    assert out.shape == (1, 15)
+    o = np.asarray(out)
+    assert (o >= 0).all() and (o < VOCAB).all()
+    np.testing.assert_array_equal(o[:, :5], np.asarray(prompt))
+
+
+def test_speculative_guards(rng):
+    target, tparams, draft, dparams = _models()
+    with pytest.raises(ValueError, match="gamma"):
+        make_speculative_generate_fn(target, draft, 8, gamma=0)
+    with pytest.raises(ValueError, match="vocabulary"):
+        make_speculative_generate_fn(
+            target,
+            TransformerLM(vocab_size=VOCAB + 1, d_model=16, n_layers=1,
+                          n_heads=2),
+            8,
+        )
+    fn = make_speculative_generate_fn(target, draft, 8)
+    with pytest.raises(ValueError, match="batch-1"):
+        fn(tparams, dparams, jnp.zeros((2, 4), jnp.int32),
+           jax.random.PRNGKey(0))
