@@ -52,6 +52,15 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-layers", dest="n_layers", default=4, type=int)
     p.add_argument("--n-heads", dest="n_heads", default=8, type=int)
     p.add_argument("--n-kv-heads", dest="n_kv_heads", default=None, type=int)
+    p.add_argument("--moe", action="store_true",
+                   help="serve a Switch-MoE checkpoint (cli.lm --parallel "
+                        "ep): per-token routing runs inside the cached "
+                        "decode loop; pair with --n-experts etc.")
+    p.add_argument("--n-experts", dest="n_experts", default=8, type=int)
+    p.add_argument("--capacity-factor", dest="capacity_factor",
+                   default=1.25, type=float)
+    p.add_argument("--moe-impl", dest="moe_impl", default="einsum",
+                   choices=["einsum", "grouped"])
     p.add_argument("--vocab", default=None, type=int,
                    help="default: byte-level 257 (data/text.py)")
     p.add_argument("--compute-dtype", default="bfloat16",
@@ -151,17 +160,36 @@ def main(argv=None) -> None:
     vocab = args.vocab or VOCAB_SIZE
     dtype = (jnp.bfloat16 if args.compute_dtype == "bfloat16"
              else jnp.float32)
-    model = TransformerLM(
-        vocab_size=vocab,
-        d_model=args.d_model,
-        n_layers=args.n_layers,
-        n_heads=args.n_heads,
-        n_kv_heads=args.n_kv_heads,
-        compute_dtype=dtype,
-        kv_cache_dtype=(
-            jnp.dtype(args.kv_cache_dtype) if args.kv_cache_dtype else None
-        ),
+    kv_dtype = (
+        jnp.dtype(args.kv_cache_dtype) if args.kv_cache_dtype else None
     )
+    if args.moe:
+        from distributed_machine_learning_tpu.models.moe import (
+            MoETransformerLM,
+        )
+
+        if args.quant or args.spec_gamma or args.tp > 1:
+            raise ValueError(
+                "--moe serving supports the plain decode loop only "
+                "(no --quant / --spec-gamma / --tp yet)"
+            )
+        model = MoETransformerLM(
+            vocab_size=vocab, d_model=args.d_model,
+            n_layers=args.n_layers, n_heads=args.n_heads,
+            n_kv_heads=args.n_kv_heads, n_experts=args.n_experts,
+            capacity_factor=args.capacity_factor, moe_impl=args.moe_impl,
+            compute_dtype=dtype, kv_cache_dtype=kv_dtype,
+        )
+    else:
+        model = TransformerLM(
+            vocab_size=vocab,
+            d_model=args.d_model,
+            n_layers=args.n_layers,
+            n_heads=args.n_heads,
+            n_kv_heads=args.n_kv_heads,
+            compute_dtype=dtype,
+            kv_cache_dtype=kv_dtype,
+        )
 
     if args.ckpt_dir:
         params = _restore_lm_params(args.ckpt_dir, args.n_layers)
@@ -231,25 +259,15 @@ def main(argv=None) -> None:
             lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p,
             draft_params,
         )
-        fn = make_speculative_generate_fn(
+        spec_fn = make_speculative_generate_fn(
             model, draft, args.max_new_tokens, gamma=args.spec_gamma,
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, quantize=args.quant,
         )
-        out = np.asarray(
-            fn(params, draft_params, prompt,
-               jax.random.PRNGKey(args.seed))
-        )[0, prompt.shape[1]:]
-        if vocab == VOCAB_SIZE:
-            text = bytes(t for t in out.tolist() if t < 256).decode(
-                "utf-8", errors="replace"
-            )
-        else:
-            text = " ".join(str(t) for t in out.tolist())
-        print(args.prompt + text)
-        return
-
-    if args.tp > 1:
+        # Same (params, prompt, key) signature as the other paths, so
+        # the shared detokenize/print epilogue below serves all three.
+        fn = lambda p, pr, k: spec_fn(p, draft_params, pr, k)
+    elif args.tp > 1:
         from distributed_machine_learning_tpu.inference.generate import (
             make_tp_generate_fn,
         )

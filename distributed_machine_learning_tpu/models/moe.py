@@ -57,6 +57,14 @@ class MoEMLP(nn.Module):
     # — numerically the same aux the unsharded model computes.
     expert_axis: str | None = None
     token_axes: tuple = ()
+    # Dropless routing regardless of capacity_factor (einsum path:
+    # capacity = N, so no expert can overflow).  Serving sets this:
+    # Switch's capacity drop is a TRAINING-time load-balancing
+    # mechanism whose drop pattern depends on the batch shape — a
+    # decode step's N is B·1, so per-expert capacity collapses and two
+    # batch rows routing to one expert would silently drop a token,
+    # diverging the served stream from the trained model.
+    dropless: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -73,7 +81,10 @@ class MoEMLP(nn.Module):
         B, T, D = x.shape
         N = B * T
         E = self.n_experts
-        capacity = max(1, math.ceil(N / E * self.capacity_factor))
+        capacity = (
+            N if self.dropless
+            else max(1, math.ceil(N / E * self.capacity_factor))
+        )
         tokens = x.reshape(N, D)
 
         # Router in fp32: small matmul, precision matters for argmax ties.
@@ -192,6 +203,9 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
         # the mlp_factory too): LN2 + the routed expert MLP recompute
         # in backward; attention residuals stay saved.
         remat_mlp=model.remat,
+        decode=model.decode,
+        kv_cache_dtype=model.kv_cache_dtype,
+        decode_continuation=model.decode_continuation,
         mlp_factory=lambda: MoEMLP(
             n_experts=model.n_experts,
             d_ff=model.d_ff or 4 * model.d_model,
@@ -200,6 +214,9 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
             moe_impl=model.moe_impl,
             expert_axis=model.expert_axis,
             token_axes=model.token_axes,
+            # Serving routes dropless (see MoEMLP.dropless): the grouped
+            # path always is; the einsum path gets capacity = N.
+            dropless=model.decode,
             name="moe",
         ),
         name=name,
@@ -244,10 +261,26 @@ class MoETransformerLM(nn.Module):
     # backward never re-runs attention; models/transformer.py).  The
     # long-context enabler for MoE exactly as for the dense LM.
     remat: bool = False
+    # KV-cached autoregressive serving, exactly as TransformerLM: the
+    # attention caches live in the shared Block; the router runs
+    # per-token, so routed expert compute needs no cache at all.
+    # ``inference/generate.py`` clones these on.
+    decode: bool = False
+    kv_cache_dtype: Any = None
+    decode_continuation: bool = False
+    # Serving quantization is not wired for the expert weights; the
+    # field exists so generate.py's clone succeeds with its default
+    # None, and a non-None value fails loudly below.
+    weight_quant: str | None = None
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
         del train
+        if self.weight_quant is not None:
+            raise NotImplementedError(
+                "weight-only int8 serving is not wired for MoE expert "
+                "weights; serve MoE models unquantized"
+            )
         seq_sharded = self.seq_axis in self.token_axes
         if self.attn_impl not in SEQ_LOCAL_ATTN_IMPLS and not seq_sharded:
             raise NotImplementedError(
@@ -259,16 +292,31 @@ class MoETransformerLM(nn.Module):
                 "(parallel/expert_parallel.py::make_ep_grouped_train_step)"
             )
         B, L = tokens.shape
-        if self.attn_impl in SEQ_SHARDED_ATTN_IMPLS:
+        if self.decode:
+            if self.attn_impl != "dense":
+                raise ValueError(
+                    "decode mode runs dense cached attention; clone the "
+                    'model with attn_impl="dense" (generate.py does this)'
+                )
+            # Autoregressive position tracking — one counter for the
+            # stack, same contract as TransformerLM.
+            idx = self.variable(
+                "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+            )
+            start = idx.value
+            positions = start + jnp.arange(L)
+            if not self.is_initializing():
+                idx.value = start + L
+        elif self.attn_impl in SEQ_SHARDED_ATTN_IMPLS:
             # Sequence-sharded: this device holds chunk axis_index(seq)
             # of the global sequence — same RoPE offset rule as
             # TransformerLM, so sharded and unsharded logits match.
             from jax import lax
 
             offset = lax.axis_index(self.seq_axis) * L
+            positions = offset + jnp.arange(L)
         else:
-            offset = 0
-        positions = offset + jnp.arange(L)
+            positions = jnp.arange(L)
         x = nn.Embed(
             self.vocab_size, self.d_model, dtype=self.compute_dtype, name="embed"
         )(tokens)

@@ -418,3 +418,38 @@ def test_moe_gqa_ep_step_runs(batch):
     # GQA param structure: separate q and fused kv with 2 heads.
     kv = state.params["block_0"]["attn"]["kv"]["kernel"]
     assert kv.shape[2] == 2
+
+
+@pytest.mark.parametrize("impl", ["einsum", "grouped"])
+@pytest.mark.parametrize("seed", [1, 4, 17])
+def test_moe_cached_decode_matches_teacher_forced(batch, impl, seed):
+    """MoE serving: KV-cached greedy generation equals the argmax of the
+    teacher-forced forward at every step — the cache, RoPE offsets,
+    position counter, and per-token routing all line up (the dense LM's
+    strongest cache invariant, MoE flavor).
+
+    Serving routes DROPLESS (MoEMLP.dropless — a decode step's N is
+    B·1, so Switch capacity would drop on any expert collision), so the
+    teacher-forced reference must be dropless too: ample
+    capacity_factor makes the einsum forward drop-free.  Multiple seeds
+    guard against expert-collision luck (the bug a single lucky seed
+    hid in review)."""
+    from distributed_machine_learning_tpu.inference.generate import generate
+
+    model = tiny_moe(moe_impl=impl, capacity_factor=8.0)
+    params = model.init(
+        jax.random.PRNGKey(4), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(seed)
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (2, 5)), jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=6)
+    assert out.shape == (2, 11)
+    full_logits = model.apply({"params": params}, out)
+    want = np.argmax(np.asarray(full_logits[:, 4:-1]), axis=-1)
+    np.testing.assert_array_equal(np.asarray(out[:, 5:]), want)
+
+
+def test_moe_decode_rejects_quant():
+    model = tiny_moe(decode=True, weight_quant="int8")
+    with pytest.raises(NotImplementedError, match="int8"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
