@@ -286,10 +286,12 @@ class Attention(nn.Module):
             )
             if quant_cache:
                 # int8 KV: one f32 scale per (kv head, slot) beside the
-                # int8 rows — written together, dequantized in-register
-                # by the decode kernel (ops/pallas/decode_attention.py).
-                # Cache HBM traffic halves vs bf16; scales are [Hkv, S]
-                # floats, noise next to the [Hkv, S, D] rows.
+                # int8 rows — written together, folded into the f32
+                # score/probability path by the scale-folding einsum
+                # (_cached_attention_quant — the measured-fastest int8
+                # dispatch at every context; see below).  Cache HBM
+                # traffic halves vs bf16; scales are [Hkv, S] floats,
+                # noise next to the [Hkv, S, D] rows.
                 cks = self.variable(
                     "cache", "cached_key_scale", jnp.zeros, cshape[:3],
                     jnp.float32,
@@ -363,31 +365,35 @@ class Attention(nn.Module):
                 else:
                     # Narrow cache straight into GQA-native cached
                     # attention — no repeat, no widened materialization.
-                    # The flash-decode kernel for int8 caches (XLA would
-                    # dequantize through HBM) and long bf16/f32 caches
-                    # (measured at/above the einsum from ~4k up, plus
-                    # frontier-clamped O(pos) reads); the head-major
-                    # einsum for short caches, where the kernel's
-                    # per-grid-step overhead still loses to XLA's single
-                    # fused op (84 vs 48 µs at S=2k — docs/PERF.md).
+                    # Dispatch (all measured on-chip, docs/PERF.md):
+                    # - int8 caches: ALWAYS the scale-folding einsum
+                    #   (_cached_attention_quant) — XLA fuses the s8
+                    #   convert into the dot, so HBM reads int8 bytes,
+                    #   and it beats the kernel ~2.7-2.9× at every S
+                    #   tested (2k/8k/32k: 29/103/217 µs vs
+                    #   83/282/612) since the kernel's exact-f32
+                    #   dequant took it off its DMA-bound point;
+                    # - long bf16/f32 caches (≥4k): the flash-decode
+                    #   kernel (frontier-clamped O(pos) reads);
+                    # - short bf16/f32 caches: the head-major einsum
+                    #   (the kernel's per-grid-step overhead loses to
+                    #   XLA's single fused op — 84 vs 48 µs at S=2k).
                     from distributed_machine_learning_tpu.ops.pallas.decode_attention import (  # noqa: E501
                         cached_flash_attention,
                         decode_flash_qualifies,
                     )
 
                     S_alloc = ck.value.shape[2]
-                    if decode_flash_qualifies(S_alloc) and (
-                        quant_cache or S_alloc >= 4096
-                    ):
-                        out = cached_flash_attention(
-                            q, ck.value, cv.value, positions[0],
-                            cks.value if quant_cache else None,
-                            cvs.value if quant_cache else None,
-                        )
-                    elif quant_cache:
+                    if quant_cache:
                         out = _cached_attention_quant(
                             q, ck.value, cks.value, cv.value, cvs.value,
                             positions,
+                        )
+                    elif (
+                        decode_flash_qualifies(S_alloc) and S_alloc >= 4096
+                    ):
+                        out = cached_flash_attention(
+                            q, ck.value, cv.value, positions[0]
                         )
                     else:
                         out = _cached_attention(
