@@ -372,7 +372,18 @@ class Attention(nn.Module):
                     #   and it beats the kernel ~2.7-2.9× at every S
                     #   tested (2k/8k/32k: 29/103/217 µs vs
                     #   83/282/612) since the kernel's exact-f32
-                    #   dequant took it off its DMA-bound point;
+                    #   dequant took it off its DMA-bound point.
+                    #   Caveat, priced in: the einsum reads all S
+                    #   ALLOCATED slots while the kernel's frontier
+                    #   clamp reads O(pos) — but at ~2.8× cheaper per
+                    #   byte the einsum loses only while pos/S < 0.36,
+                    #   and the mean of pos/S over ANY full generation
+                    #   is (Lp/S + 1)/2 ≥ 0.5, so the einsum wins
+                    #   integrated over every workload shape (a
+                    #   dynamic-length slice is impossible under
+                    #   static shapes; a tiered lax.switch is not
+                    #   worth its compile cost for a transient early
+                    #   phase);
                     # - long bf16/f32 caches (≥4k): the flash-decode
                     #   kernel (frontier-clamped O(pos) reads);
                     # - short bf16/f32 caches: the head-major einsum

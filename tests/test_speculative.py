@@ -83,3 +83,24 @@ def test_speculative_guards(rng):
     with pytest.raises(ValueError, match="batch-1"):
         fn(tparams, dparams, jnp.zeros((2, 4), jnp.int32),
            jax.random.PRNGKey(0))
+
+
+def test_greedy_speculative_with_int8_target(rng):
+    """Speculative composes with int8 serving: an int8-quantized target
+    (and/or draft) still produces its own exact greedy stream — the
+    reference is vanilla int8 decode, so quantization error and the
+    speculative machinery are isolated from each other."""
+    from distributed_machine_learning_tpu.ops.quant import quantize_lm_params
+
+    target, tparams, draft, dparams = _models()
+    qt = quantize_lm_params(tparams)
+    qd = quantize_lm_params(dparams)
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (1, 5)), jnp.int32)
+    ref = make_generate_fn(target, 10, quantize="int8")(
+        qt, prompt, jax.random.PRNGKey(0)
+    )
+    fn = make_speculative_generate_fn(
+        target, draft, 10, gamma=3, quantize="int8", draft_quantize="int8"
+    )
+    out = fn(qt, qd, prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
