@@ -415,9 +415,10 @@ class Attention(nn.Module):
                     q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), positions
                 )
         elif self.attn_impl == "ring":
+            # GQA rotates the NARROW K/V chunks (ICI bytes ÷ the group
+            # factor — ring_self_attention widens locally per block).
             out = ring_self_attention(
-                q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
-                self.seq_axis, lax.axis_size(self.seq_axis)
+                q, k, v, self.seq_axis, lax.axis_size(self.seq_axis)
             )
         elif self.attn_impl == "ring_flash":
             from distributed_machine_learning_tpu.ops.pallas.ring_flash_attention import (

@@ -67,10 +67,20 @@ def ring_self_attention(
 
     Must run inside ``shard_map``.  ``q``/``k``/``v`` are the local chunks,
     shape [B, L/n, H, D] with global sequence order following the mesh axis
-    order.  Returns the local output chunk, same shape/dtype as ``q``.
+    order; grouped-query K/V may be NARROW ([B, L/n, Hkv, D], Hkv | H) —
+    the narrow chunks are what rotates around the ring (ICI bytes ÷ the
+    group factor, same saving as the flash ring), widened only at the
+    local block math where XLA fuses the broadcast into the einsums.
+    Returns the local output chunk, same shape/dtype as ``q``.
     """
     n = axis_size
     B, Lc, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv:
+        raise ValueError(
+            f"query heads ({H}) must be a multiple of K/V heads ({Hkv})"
+        )
+    rep = H // Hkv
     scale = 1.0 / (D**0.5)
     rank = lax.axis_index(axis_name)
     q_pos = rank * Lc + jnp.arange(Lc)
@@ -82,12 +92,18 @@ def ring_self_attention(
 
     perm = [(i, (i + 1) % n) for i in range(n)]
     kv = (k, v)
+
+    def widen(t):
+        return jnp.repeat(t, rep, axis=2) if rep > 1 else t
+
     for s in range(n):
         # After s right-shifts this device holds the K/V chunk that
         # originated on rank − s.
         kv_rank = (rank - s) % n
         k_pos = kv_rank * Lc + jnp.arange(Lc)
-        carry = _online_update(carry, q, kv[0], kv[1], q_pos, k_pos, scale)
+        carry = _online_update(
+            carry, q, widen(kv[0]), widen(kv[1]), q_pos, k_pos, scale
+        )
         if s < n - 1:
             kv = lax.ppermute(kv, axis_name, perm)
 

@@ -14,6 +14,7 @@ from distributed_machine_learning_tpu.ops.ring_attention import (
     dense_self_attention,
     ring_self_attention,
 )
+from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 
 B, L, H, D = 2, 32, 4, 8
 
@@ -93,3 +94,32 @@ def test_ring_bf16_stays_finite(qkv):
     out = np.asarray(jax.jit(ring)(q, k, v), dtype=np.float32)
     assert np.isfinite(out).all()
     assert out.dtype == np.float32 and np.abs(out).max() < 10.0
+
+
+def test_ring_gqa_narrow_rotation_matches_dense():
+    """GQA through the einsum ring: narrow K/V chunks rotate (widened
+    only at the local block math) and the result equals unsharded dense
+    attention with widened heads."""
+    rng = np.random.default_rng(21)
+    B, L, H, Hkv, D, n = 2, 32, 8, 2, 8, 4
+    rep = H // Hkv
+    q = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, Hkv, D)), jnp.float32)
+    ref = dense_self_attention(
+        q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+    )
+    mesh = make_mesh(n, axis_names=("seq",))
+    fn = shard_map(
+        lambda q, k, v: ring_self_attention(q, k, v, "seq", n),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+    )
+    out = fn(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    with pytest.raises(ValueError, match="multiple of K/V"):
+        ring_self_attention(q, k[:, :, :1].repeat(3, axis=2)[:, :, :3], v,
+                            "seq", 1)
