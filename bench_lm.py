@@ -35,6 +35,34 @@ from distributed_machine_learning_tpu.train.lm_step import (
 TIMED_ITERS = 20
 
 
+def _cast_params(params, dtype):
+    """bf16 serving cast (f32 leaves only) — one definition for target
+    and draft params."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params
+    )
+
+
+def _two_point_dispatch(dispatch, fetch, reps, chain):
+    """The decode benches' shared timing harness: best-of-reps over
+    n chained dispatches closed by a host fetch, slope via
+    two_point_fit (cancels the tunnel RTT)."""
+    from distributed_machine_learning_tpu.bench.harness import two_point_fit
+
+    def timed(n_dispatches):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n_dispatches):
+                out = dispatch()
+            fetch(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return two_point_fit(timed, chain)
+
+
 def bench_one(attn: str, args) -> tuple[float, int]:
     """(tokens/sec, n_params) for one attention implementation."""
     model = TransformerLM(
@@ -160,10 +188,7 @@ def bench_decode(args) -> None:
 
         params = quantize_lm_params(master)
     else:
-        params = jax.tree_util.tree_map(
-            lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p,
-            master,
-        )
+        params = _cast_params(master, dtype)
     del master
     params = jax.block_until_ready(params)
     rng = np.random.default_rng(0)
@@ -181,20 +206,12 @@ def bench_decode(args) -> None:
     def timed_for(n_tokens):
         fn = make_generate_fn(model, n_tokens, temperature=0.0,
                               quantize="int8" if args.quant else None)
-        out = fn(params, prompt, key)
-        jax.block_until_ready(out)
-
-        def timed(n_dispatches):
-            best = float("inf")
-            for _ in range(args.reps):
-                t0 = time.perf_counter()
-                for _ in range(n_dispatches):
-                    out = fn(params, prompt, key)
-                np.asarray(out[0, -1])  # host fetch drains the queue
-                best = min(best, time.perf_counter() - t0)
-            return best
-
-        return two_point_fit(timed, args.chain)
+        jax.block_until_ready(fn(params, prompt, key))
+        return _two_point_dispatch(
+            lambda: fn(params, prompt, key),
+            lambda out: np.asarray(out[0, -1]),  # fetch drains the queue
+            args.reps, args.chain,
+        )
 
     t_small = timed_for(n_small)
     t_big = timed_for(n_big)
@@ -222,6 +239,48 @@ def bench_decode(args) -> None:
         },
     }))
 
+    if args.spec_gamma > 0:
+        # Speculative-decoding FLOOR (random draft, acceptance ~ 0): the
+        # reproducible command behind docs/PERF.md's envelope — a real
+        # draft only raises tokens/round, never the per-round cost.
+        from distributed_machine_learning_tpu.inference.speculative import (
+            make_speculative_generate_fn,
+        )
+
+        draft = TransformerLM(
+            vocab_size=args.vocab, d_model=args.spec_draft_d_model,
+            n_layers=args.spec_draft_n_layers, n_heads=args.n_heads,
+            n_kv_heads=args.n_kv_heads, compute_dtype=dtype,
+        )
+        dparams = _cast_params(init_lm_state(draft, seed=11).params, dtype)
+
+        def spec_timed_for(n_tokens):
+            fn = make_speculative_generate_fn(
+                model, draft, n_tokens, gamma=args.spec_gamma,
+                quantize="int8" if args.quant else None,
+            )
+            jax.block_until_ready(fn(params, dparams, prompt, key))
+            return _two_point_dispatch(
+                lambda: fn(params, dparams, prompt, key),
+                lambda out: np.asarray(out[0, -1]),
+                args.reps, args.chain,
+            )
+
+        st_small = spec_timed_for(n_small)
+        st_big = spec_timed_for(n_big)
+        st_tok = (st_big - st_small) / (n_big - n_small)
+        print(json.dumps({
+            "metric": "lm_speculative_decode_floor_tokens_per_sec",
+            "value": round(1.0 / st_tok, 1),
+            "unit": "tokens/sec",
+            "ms_per_token": round(st_tok * 1e3, 3),
+            "vs_vanilla": round(t_tok / st_tok, 3),
+            "note": "random draft: acceptance~0 floor of the envelope",
+            "config": {"gamma": args.spec_gamma,
+                       "draft_d_model": args.spec_draft_d_model,
+                       "draft_n_layers": args.spec_draft_n_layers},
+        }))
+
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
@@ -246,10 +305,10 @@ def main() -> None:
                         "— optimizer-state memory is what bounds depth at "
                         "realistic width on one chip (train/sgd.py)")
     p.add_argument("--remat", action="store_true",
-                   help="jax.checkpoint each block — lets realistic-width "
-                        "long-context configs fit the chip; reported MFU "
-                        "still counts model FLOPs only (not recompute), "
-                        "i.e. it is MFU not HFU")
+                   help="rematerialization (selective 'mlp' policy by "
+                        "default — attention residuals stay saved) — lets "
+                        "realistic-width long-context configs fit the "
+                        "chip; reported MFU counts model FLOPs only")
     p.add_argument("--remat-policy", dest="remat_policy", default="mlp",
                    choices=("mlp", "block"),
                    help="'mlp' (selective: save attention residuals, remat "
@@ -264,6 +323,15 @@ def main() -> None:
     p.add_argument("--decode", action="store_true",
                    help="benchmark the KV-cached decode path instead of "
                         "the train step (prefill vs steady-state tok/s)")
+    p.add_argument("--spec-gamma", dest="spec_gamma", default=0, type=int,
+                   help="with --decode: ALSO measure speculative decoding "
+                        "at this gamma with a random draft (the "
+                        "acceptance~0 FLOOR of the envelope -- "
+                        "docs/PERF.md; batch must be 1)")
+    p.add_argument("--spec-draft-d-model", dest="spec_draft_d_model",
+                   default=512, type=int)
+    p.add_argument("--spec-draft-n-layers", dest="spec_draft_n_layers",
+                   default=2, type=int)
     p.add_argument("--prompt-len", dest="prompt_len", default=2048, type=int)
     p.add_argument("--gen-tokens", dest="gen_tokens", default=160, type=int)
     p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype", default=None,
@@ -271,6 +339,11 @@ def main() -> None:
                         "(e.g. float32; default = compute dtype)")
     args = p.parse_args()
 
+    if args.spec_gamma > 0 and (not args.decode or args.batch != 1):
+        raise ValueError(
+            "--spec-gamma needs --decode and --batch 1 (the speculative "
+            "loop is batch-1); checked before any timing runs"
+        )
     if args.quant and not args.decode:
         raise ValueError(
             "--quant is a decode-path option (weight-only int8 serving); "
