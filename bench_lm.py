@@ -201,7 +201,6 @@ def bench_decode(args) -> None:
     n_small, n_big = 32, args.gen_tokens
     if n_big <= n_small:
         raise ValueError(f"--gen-tokens must exceed {n_small}")
-    from distributed_machine_learning_tpu.bench.harness import two_point_fit
 
     def timed_for(n_tokens):
         fn = make_generate_fn(model, n_tokens, temperature=0.0,
@@ -251,6 +250,10 @@ def bench_decode(args) -> None:
             vocab_size=args.vocab, d_model=args.spec_draft_d_model,
             n_layers=args.spec_draft_n_layers, n_heads=args.n_heads,
             n_kv_heads=args.n_kv_heads, compute_dtype=dtype,
+            kv_cache_dtype=(
+                jnp.dtype(args.kv_cache_dtype) if args.kv_cache_dtype
+                else None
+            ),
         )
         dparams = _cast_params(init_lm_state(draft, seed=11).params, dtype)
 
@@ -269,6 +272,15 @@ def bench_decode(args) -> None:
         st_small = spec_timed_for(n_small)
         st_big = spec_timed_for(n_big)
         st_tok = (st_big - st_small) / (n_big - n_small)
+        if st_tok <= 0:
+            # Cross-fit jitter (two_point_fit guards within one fit,
+            # not across the two): fail loudly like harness.py's own
+            # slope guard rather than print a negative rate.
+            raise RuntimeError(
+                f"speculative slope non-positive ({st_tok:.2e}s): "
+                "tunnel jitter swamped the measurement; raise "
+                "--gen-tokens and/or --reps"
+            )
         print(json.dumps({
             "metric": "lm_speculative_decode_floor_tokens_per_sec",
             "value": round(1.0 / st_tok, 1),
@@ -278,7 +290,9 @@ def bench_decode(args) -> None:
             "note": "random draft: acceptance~0 floor of the envelope",
             "config": {"gamma": args.spec_gamma,
                        "draft_d_model": args.spec_draft_d_model,
-                       "draft_n_layers": args.spec_draft_n_layers},
+                       "draft_n_layers": args.spec_draft_n_layers,
+                       "kv_cache_dtype": args.kv_cache_dtype,
+                       "quant": "int8" if args.quant else None},
         }))
 
 

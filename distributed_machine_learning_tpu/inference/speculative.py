@@ -11,8 +11,17 @@ distribution EXACTLY the target's:
 - greedy (``temperature=0``): accept the longest prefix where the
   draft's token equals the target argmax, then emit the target argmax
   at the first mismatch (or the bonus token when all γ survive) — the
-  output is bitwise the target-only greedy stream, which is how the
-  tests pin it;
+  output is bitwise the target-only greedy stream under matched
+  numerics (f32 compute, as the tests pin it).  bf16-serving caveat,
+  measured not hypothesized: where the top-2 logits tie within one
+  bf16 ulp, DIFFERENTLY-SHAPED programs break the tie differently —
+  the Lq=γ+1 verify pass vs the Lq=1 decode step, but equally the
+  Lq=1 decode step vs the teacher-forced full forward (at the first
+  observed flip on a trained bf16 model, the teacher-forced argmax
+  matched NEITHER stream; top-2 gap exactly one bf16 ulp).  Ties are
+  equal-probability choices, so the served distribution is unchanged;
+  this is a property of shape-dependent XLA numerics, not of
+  speculation;
 - sampled: accept ``d_i`` with probability ``min(1, p_i(d_i)/q_i(d_i))``
   (p = target, q = draft, both WARPED — temperature/top-k/top-p — so
   the preserved distribution is the one the plain sampler uses); on
