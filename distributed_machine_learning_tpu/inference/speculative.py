@@ -26,14 +26,16 @@ distribution EXACTLY the target's:
   (p = target, q = draft, both WARPED — temperature/top-k/top-p — so
   the preserved distribution is the one the plain sampler uses); on
   rejection sample from ``norm(max(p_i − q_i, 0))``; on full acceptance
-  sample the bonus from ``p_γ``.
+  sample the bonus from ``p_γ``.  The tests pin this branch against a
+  NumPy oracle of the rule and check the served empirical distribution
+  against plain sampling (tests/test_speculative.py).
 
 TPU-shaped implementation notes:
 
 - **Cache rollback is free.**  The KV caches index slots by absolute
-  position with a single ``idx`` frontier counter; slots past the
-  frontier are causally masked (``slot <= pos``) and overwritten by the
-  next write.  Rejecting draft tokens is therefore just rewinding the
+  position with an ``idx`` frontier counter; slots past the frontier
+  are causally masked (``slot <= pos``) and overwritten by the next
+  write.  Rejecting draft tokens is therefore just rewinding the
   counter in the carried cache pytree — no K/V copy, no re-prefill.
 - The draft phase runs γ+1 steps (it processes its own last proposal),
   keeping its cache exactly one token behind the committed stream at
@@ -41,8 +43,16 @@ TPU-shaped implementation notes:
 - One ``lax.while_loop`` emits a variable 1..γ+1 tokens per round into
   a fixed output buffer at a moving pointer; every slot below the final
   pointer is committed before it can be read.
-- Batch 1 only: acceptance length is data-dependent PER ROW, and the
-  cache frontier is one scalar — the standard latency-serving shape.
+- **Batched** (B > 1): acceptance length is data-dependent PER ROW, so
+  the models are cloned with ``decode_batched_frontier=True`` — the
+  cache frontier becomes a [B] counter, positions/RoPE/masks go
+  per-row (``models/transformer.py``), and every round each row
+  rewinds by its own rejection count.  Rows that reach
+  ``max_new_tokens`` freeze (their frontier, pointer, and last token
+  stop advancing) and keep verifying dead tokens until the slowest
+  row finishes — the standard batched-speculation shape; per-row
+  output is token-exact vs the row served alone (tested at batch 8).
+  Batch 1 keeps the scalar frontier (and its measured perf numbers).
 
 The reference has no inference path at all (SURVEY.md §2); this extends
 the serving surface of ``inference/generate.py``.
@@ -70,17 +80,18 @@ def make_speculative_generate_fn(
 ):
     """Build ``fn(target_params, draft_params, prompt, rng) -> tokens``.
 
-    ``prompt``: [1, Lp] int32 (batch 1 — see module docstring); returns
-    [1, Lp + max_new_tokens].  ``gamma``: draft tokens per verify round.
+    ``prompt``: [B, Lp] int32 (any batch; rows share the prompt length
+    but not content — each decodes its own stream); returns
+    [B, Lp + max_new_tokens].  ``gamma``: draft tokens per verify round.
     ``quantize``/``draft_quantize``: "int8" serves that model through
     the weight-only kernel (``ops/quant.py``) — pass params converted by
     ``quantize_lm_params``.
 
-    Correctness contract: the emitted stream follows the TARGET's
+    Correctness contract: each row's emitted stream follows the TARGET's
     sampling distribution exactly (greedy: bitwise-identical to
-    ``make_generate_fn`` with the same flags — tested); the draft only
-    changes HOW FAST tokens appear, never WHICH distribution they come
-    from.
+    ``make_generate_fn`` with the same flags — tested, per row at batch
+    8); the draft only changes HOW FAST tokens appear, never WHICH
+    distribution they come from.
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -98,11 +109,6 @@ def make_speculative_generate_fn(
                             weight_quant=quantize)
     dm = draft_model.clone(attn_impl="dense", decode=True,
                            weight_quant=draft_quantize)
-    # The verify pass applies γ+1 tokens MID-STREAM: it must attend the
-    # full cache, not take the start-0 prefill fast path — the
-    # continuation clone routes multi-token decode through
-    # _cached_attention (same params, same cache layout).
-    tm_verify = tm.clone(decode_continuation=True)
     greedy = temperature == 0.0
     V = target_model.vocab_size
 
@@ -112,13 +118,23 @@ def make_speculative_generate_fn(
     @jax.jit
     def run(tparams, dparams, prompt, rng):
         B, Lp = prompt.shape
-        if B != 1:
-            raise ValueError(
-                f"speculative decoding is batch-1 (got B={B}): acceptance "
-                "length is data-dependent per row but the KV-cache "
-                "frontier is one scalar"
-            )
-        budget = max_new_tokens + gamma + 1  # output buffer slack
+        # Batch 1 keeps the scalar cache frontier (the measured-perf
+        # latency path); B > 1 switches the models to per-row frontiers.
+        batched = B > 1
+        tm_b = tm.clone(decode_batched_frontier=batched)
+        dm_b = dm.clone(decode_batched_frontier=batched)
+        # The verify pass applies γ+1 tokens MID-STREAM: it must attend
+        # the full cache, not take the start-0 prefill fast path — the
+        # continuation clone routes multi-token decode through
+        # _cached_attention (same params, same cache layout).
+        tm_verify = tm_b.clone(decode_continuation=True)
+        # Output slack: an ACTIVE row's pointer tops out at
+        # max_new−1 + (γ+1); a FROZEN row's window writes span γ+1 more
+        # slots — 2(γ+1) covers both without DUS clamping ever shifting
+        # a write into committed slots.  Batch 1 never freezes, so it
+        # keeps the tighter γ+1 slack (the extra slots could bump
+        # cache_len across a 512 tile and tax every einsum read).
+        budget = max_new_tokens + (gamma + 1) * (2 if batched else 1)
         cache_len = -(-(Lp + budget + 1) // 512) * 512
 
         def init_cache(model):
@@ -133,15 +149,15 @@ def make_speculative_generate_fn(
                 lambda s: jnp.zeros(s.shape, s.dtype), shapes
             )
 
-        tcache, dcache = init_cache(tm), init_cache(dm)
+        tcache, dcache = init_cache(tm_b), init_cache(dm_b)
 
         # Prefill both models on the prompt; the target's last logits
         # sample the first committed token.
-        tlogits, tvars = tm.apply(
+        tlogits, tvars = tm_b.apply(
             {"params": tparams, "cache": tcache}, prompt, train=False,
             mutable=["cache"],
         )
-        _, dvars = dm.apply(
+        _, dvars = dm_b.apply(
             {"params": dparams, "cache": dcache}, prompt, train=False,
             mutable=["cache"],
         )
@@ -156,18 +172,22 @@ def make_speculative_generate_fn(
 
         out = jnp.zeros((B, budget), jnp.int32)
         out = lax.dynamic_update_slice(out, cur[:, None], (0, 0))
-        # ptr: tokens EMITTED so far (cur at slot 0 counts).
-        state = (tcache, dcache, cur, out, jnp.asarray(1, jnp.int32), rng)
+        # ptr[b]: tokens EMITTED by row b so far (cur at slot 0 counts).
+        ptr = jnp.ones((B,), jnp.int32)
+        state = (tcache, dcache, cur, out, ptr, rng)
 
         def round_body(state):
             tcache, dcache, cur, out, ptr, rng = state
+            # Frozen rows (only possible when batched): done decoding,
+            # still riding the loop until the slowest row finishes.
+            done = ptr >= max_new_tokens  # [B]
 
             # ---- draft phase: γ+1 steps (the last processes its own
             # final proposal, keeping the draft cache one token behind
             # the committed stream after any acceptance count).
             def dstep(carry, r):
                 dcache, tok = carry
-                logits, vars_ = dm.apply(
+                logits, vars_ = dm_b.apply(
                     {"params": dparams, "cache": dcache}, tok[:, None],
                     train=False, mutable=["cache"],
                 )
@@ -188,67 +208,89 @@ def make_speculative_generate_fn(
                 dstep, (dcache, cur), jnp.stack(draft_keys)
             )
             # draft_toks: [γ+1, B]; proposals are the first γ.
-            d = draft_toks[:gamma, 0]  # [γ] int32 (B=1)
-            q = draft_q[:gamma, 0]  # [γ, V]
+            d = draft_toks[:gamma].swapaxes(0, 1)  # [B, γ] int32
+            q = draft_q[:gamma].swapaxes(0, 1)  # [B, γ, V]
 
             # ---- verify: one target pass over [cur, d_0..d_{γ-1}].
-            verify_in = jnp.concatenate([cur, d], axis=0)[None]  # [1, γ+1]
+            verify_in = jnp.concatenate([cur[:, None], d], axis=1)
             vlogits, tvars = tm_verify.apply(
                 {"params": tparams, "cache": tcache}, verify_in,
                 train=False, mutable=["cache"],
-            )
-            vlogits = vlogits[0]  # [γ+1, V]; row i predicts slot of d_i
+            )  # [B, γ+1, V]; row (b, i) predicts the slot of d_i.
 
             rng, r_acc, r_fix = jax.random.split(rng, 3)
             if greedy:
                 tbest = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
-                acc = d == tbest[:gamma]  # [γ]
-                # n_acc = length of the all-accepted prefix.
-                n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32)))
-                # Correction/bonus token: target argmax at position n_acc.
-                t_new = tbest[n_acc][None]
-            else:
-                p = jax.nn.softmax(warp(vlogits), axis=-1)  # [γ+1, V]
-                p_d = jnp.take_along_axis(
-                    p[:gamma], d[:, None], axis=1
+                acc = d == tbest[:, :gamma]  # [B, γ]
+                # n_acc[b] = length of row b's all-accepted prefix.
+                n_acc = jnp.sum(
+                    jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1
+                )
+                # Correction/bonus token: target argmax at slot n_acc.
+                t_new = jnp.take_along_axis(
+                    tbest, n_acc[:, None], axis=1
                 )[:, 0]
-                q_d = jnp.take_along_axis(q, d[:, None], axis=1)[:, 0]
-                u = jax.random.uniform(r_acc, (gamma,))
+            else:
+                p = jax.nn.softmax(warp(vlogits), axis=-1)  # [B, γ+1, V]
+                p_d = jnp.take_along_axis(
+                    p[:, :gamma], d[..., None], axis=2
+                )[..., 0]
+                q_d = jnp.take_along_axis(q, d[..., None], axis=2)[..., 0]
+                u = jax.random.uniform(r_acc, (B, gamma))
                 acc = u * q_d < p_d  # accept iff u < p/q (q>0 where sampled)
-                n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32)))
+                n_acc = jnp.sum(
+                    jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1
+                )
                 # Residual at the first rejection; bonus row at γ.
-                p_row = p[n_acc]
+                p_row = jnp.take_along_axis(
+                    p, n_acc[:, None, None], axis=1
+                )[:, 0]  # [B, V]
                 q_row = jnp.where(
-                    n_acc < gamma,
-                    q[jnp.minimum(n_acc, gamma - 1)],
-                    jnp.zeros((V,), jnp.float32),
+                    (n_acc < gamma)[:, None],
+                    jnp.take_along_axis(
+                        q, jnp.minimum(n_acc, gamma - 1)[:, None, None],
+                        axis=1,
+                    )[:, 0],
+                    jnp.zeros((B, V), jnp.float32),
                 )
                 resid = jnp.maximum(p_row - q_row, 0.0)
-                resid = resid / jnp.maximum(resid.sum(), 1e-30)
+                resid = resid / jnp.maximum(
+                    resid.sum(axis=-1, keepdims=True), 1e-30
+                )
                 t_new = jax.random.categorical(
-                    r_fix, jnp.log(jnp.maximum(resid, 1e-30))
-                )[None].astype(jnp.int32)
+                    r_fix, jnp.log(jnp.maximum(resid, 1e-30)), axis=-1
+                ).astype(jnp.int32)
+
+            # Tokens row b commits this round (frozen rows commit none).
+            adv = jnp.where(done, 0, n_acc + 1)  # [B]
 
             # ---- commit: window = [d_0..d_{n_acc-1}, t_new, junk...];
             # the junk beyond n_acc is overwritten by the next round's
-            # window (or never read past the final pointer).
+            # window (or never read past the final pointer); frozen
+            # rows' windows land entirely past max_new_tokens.
             window = jnp.where(
-                jnp.arange(gamma + 1) == n_acc,
-                t_new[0],
-                jnp.concatenate([d, jnp.zeros((1,), jnp.int32)]),
+                jnp.arange(gamma + 1)[None] == n_acc[:, None],
+                t_new[:, None],
+                jnp.concatenate([d, jnp.zeros((B, 1), jnp.int32)], axis=1),
             )
-            out = lax.dynamic_update_slice(out, window[None], (0, ptr))
+            out = jax.vmap(
+                lambda o, w, p0: lax.dynamic_update_slice(o, w, (p0,))
+            )(out, window, ptr)
 
             # ---- cache rewinds (the free rollback): target holds the
             # committed stream MINUS t_new; draft holds one token less.
+            # Frozen rows rewind the full γ+1 — their frontier is pinned.
+            delta = adv - (gamma + 1)  # [B], <= 0
+            back = delta if batched else delta[0]
             tcache = dict(tvars["cache"])
-            tcache["idx"] = tcache["idx"] - (gamma + 1) + (n_acc + 1)
+            tcache["idx"] = tcache["idx"] + back
             dcache2 = dict(dcache2)
-            dcache2["idx"] = dcache2["idx"] - (gamma + 1) + (n_acc + 1)
-            return (tcache, dcache2, t_new, out, ptr + n_acc + 1, rng)
+            dcache2["idx"] = dcache2["idx"] + back
+            cur = jnp.where(done, cur, t_new)
+            return (tcache, dcache2, cur, out, ptr + adv, rng)
 
         def cond(state):
-            return state[4] < max_new_tokens
+            return jnp.any(state[4] < max_new_tokens)
 
         _, _, _, out, _, _ = lax.while_loop(cond, round_body, state)
         return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)

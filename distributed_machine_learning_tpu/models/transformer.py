@@ -35,12 +35,19 @@ from distributed_machine_learning_tpu.ops.ring_attention import (
 
 
 def apply_rope(x: jax.Array, positions: jax.Array, base: float = 10000.0):
-    """Rotate [B, L, H, D] by per-position angles; fp32 math, dtype preserved."""
+    """Rotate [B, L, H, D] by per-position angles; fp32 math, dtype
+    preserved.  ``positions``: [L] (one stream position per slot) or
+    [B, L] (per-ROW absolute positions — the batched-frontier decode
+    path, where each batch row's committed stream has its own length)."""
     d_half = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(d_half, dtype=jnp.float32) / d_half)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [L, Dh/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., L, Dh/2]
+    if positions.ndim == 1:
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
+    else:  # [B, L] per-row positions
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return rotated.astype(x.dtype)
@@ -56,10 +63,25 @@ def _repeat_kv(t: jax.Array, n_rep: int) -> jax.Array:
     return jnp.repeat(t, n_rep, axis=2)
 
 
+def _cached_mask(s, q_positions, S):
+    """Causal frontier mask for the cached-attention einsums.  ``s``:
+    [B, Hkv, rep, Lq, S] scores; ``q_positions``: [Lq] (one shared
+    stream) or [B, Lq] (per-row frontiers — batched speculative
+    decoding)."""
+    if q_positions.ndim == 1:
+        mask = jnp.arange(S)[None, :] <= q_positions[:, None]  # [Lq, S]
+        return jnp.where(mask[None, None, None], s, -jnp.inf)
+    mask = (
+        jnp.arange(S)[None, None, :] <= q_positions[:, :, None]
+    )  # [B, Lq, S]
+    return jnp.where(mask[:, None, None], s, -jnp.inf)
+
+
 def _cached_attention(q, k_cache, v_cache, q_positions):
     """Attention of fresh queries against the full K/V cache, GQA-native.
 
-    ``q``: [B, Lq, H, D] at absolute positions ``q_positions`` ([Lq]);
+    ``q``: [B, Lq, H, D] at absolute positions ``q_positions`` ([Lq],
+    or [B, Lq] for per-row frontiers — see :func:`_cached_mask`);
     ``k_cache``/``v_cache``: [B, Hkv, S, D] (Hkv | H) where slot j holds
     position j (zeros beyond the write frontier — masked out by
     causality, since unwritten slots all have j > max(q_positions)).
@@ -86,8 +108,7 @@ def _cached_attention(q, k_cache, v_cache, q_positions):
         k_cache.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     ) * (1.0 / (D**0.5))
-    mask = jnp.arange(S)[None, :] <= q_positions[:, None]  # [Lq, S]
-    s = jnp.where(mask[None, None, None], s, -jnp.inf)
+    s = _cached_mask(s, q_positions, S)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
         "bhrqk,bhkd->bqhrd", p, v_cache.astype(jnp.float32),
@@ -113,8 +134,7 @@ def _cached_attention_quant(q, k_int, ks, v_int, vs, q_positions):
         preferred_element_type=jnp.float32,
     ) * (1.0 / (D**0.5))
     s = s * ks[:, :, None, None, :]  # fold the key scales, f32
-    mask = jnp.arange(S)[None, :] <= q_positions[:, None]
-    s = jnp.where(mask[None, None, None], s, -jnp.inf)
+    s = _cached_mask(s, q_positions, S)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
         "bhrqk,bhkd->bqhrd", p * vs[:, :, None, None, :],
@@ -301,7 +321,16 @@ class Attention(nn.Module):
                     jnp.float32,
                 )
             if not self.is_initializing():
-                start = positions[0]
+                # [L] positions: one shared frontier (start scalar).
+                # [B, L]: per-ROW frontiers (batched speculative decode)
+                # — each row writes its slots at its own offset, via a
+                # vmapped slice-update (XLA lowers it to a scatter whose
+                # windows are the tiny per-row [Hkv, L, D] fresh K/V —
+                # decode-scale, not cache-scale, bytes).
+                batched_frontier = positions.ndim == 2
+                start = (
+                    positions[:, 0] if batched_frontier else positions[0]
+                )
 
                 def _write(ref, t, sref=None):
                     t = t.swapaxes(1, 2)  # [B, Hkv, L, D]
@@ -314,13 +343,27 @@ class Attention(nn.Module):
                             jnp.round(t.astype(jnp.float32) / s[..., None]),
                             -127, 127,
                         ).astype(jnp.int8)
-                        sref.value = lax.dynamic_update_slice(
-                            sref.value, s, (0, 0, start)
+                        if batched_frontier:
+                            sref.value = jax.vmap(
+                                lambda c, u, s0: lax.dynamic_update_slice(
+                                    c, u, (0, s0)
+                                )
+                            )(sref.value, s, start)
+                        else:
+                            sref.value = lax.dynamic_update_slice(
+                                sref.value, s, (0, 0, start)
+                            )
+                    t = t.astype(ref.value.dtype)
+                    if batched_frontier:
+                        ref.value = jax.vmap(
+                            lambda c, u, s0: lax.dynamic_update_slice(
+                                c, u, (0, s0, 0)
+                            )
+                        )(ref.value, t, start)
+                    else:
+                        ref.value = lax.dynamic_update_slice(
+                            ref.value, t, (0, 0, start, 0)
                         )
-                    ref.value = lax.dynamic_update_slice(
-                        ref.value, t.astype(ref.value.dtype),
-                        (0, 0, start, 0),
-                    )
 
                 _write(ck, k, cks if quant_cache else None)
                 _write(cv, v, cvs if quant_cache else None)
@@ -343,14 +386,15 @@ class Attention(nn.Module):
                         )
                 elif L > 1:
                     # PREFILL (the one multi-token call, at start == 0 —
-                    # generate.py's contract): the cache was empty, so
-                    # attention over the prompt is plain causal
-                    # self-attention over the fresh K/V.  Routing it
-                    # through the training kernels instead of
-                    # _cached_attention avoids materializing the f32
-                    # [B, H, L, S] score tensor against the whole cache
-                    # (34 GB at an 8k prompt) — flash when the length
-                    # qualifies, dense below.
+                    # generate.py's contract; in batched-frontier mode
+                    # every row prefills from 0, so row 0's positions
+                    # speak for all): the cache was empty, so attention
+                    # over the prompt is plain causal self-attention over
+                    # the fresh K/V.  Routing it through the training
+                    # kernels instead of _cached_attention avoids
+                    # materializing the f32 [B, H, L, S] score tensor
+                    # against the whole cache (34 GB at an 8k prompt) —
+                    # flash when the length qualifies, dense below.
                     if _flash_wins(L):
                         from distributed_machine_learning_tpu.ops.pallas.flash_attention import (  # noqa: E501
                             flash_self_attention,
@@ -360,7 +404,7 @@ class Attention(nn.Module):
                     else:
                         out = dense_self_attention(
                             q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
-                            positions,
+                            positions[0] if batched_frontier else positions,
                         )
                 else:
                     # Narrow cache straight into GQA-native cached
@@ -401,8 +445,14 @@ class Attention(nn.Module):
                             positions,
                         )
                     elif (
-                        decode_flash_qualifies(S_alloc) and S_alloc >= 4096
+                        not batched_frontier
+                        and decode_flash_qualifies(S_alloc)
+                        and S_alloc >= 4096
                     ):
+                        # The flash-decode kernel clamps its DMA at ONE
+                        # scalar frontier; per-row frontiers (batched
+                        # speculative decode) take the einsum, whose
+                        # mask is per-row for free.
                         out = cached_flash_attention(
                             q, ck.value, cv.value, positions[0]
                         )
@@ -411,8 +461,12 @@ class Attention(nn.Module):
                             q, ck.value, cv.value, positions
                         )
             else:
+                # Init-time shape pass (is_initializing): positions may
+                # be per-row [B, L] under the batched frontier — row 0
+                # speaks for the shapes.
                 out = dense_self_attention(
-                    q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), positions
+                    q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
+                    positions[0] if positions.ndim == 2 else positions,
                 )
         elif self.attn_impl == "ring":
             # GQA rotates the NARROW K/V chunks (ICI bytes ÷ the group
@@ -647,6 +701,13 @@ class TransformerLM(nn.Module):
     # decoding's verify pass — inference/speculative.py) instead of
     # assuming the start-0 prefill contract.
     decode_continuation: bool = False
+    # Per-ROW cache frontiers for decode: the ``idx`` cache variable is
+    # [B] instead of a scalar, positions are [B, L], and each row's K/V
+    # land at its own offset.  Batched speculative decoding needs this
+    # (acceptance length is data-dependent PER ROW); plain generate
+    # keeps the scalar (every row's stream has one length).  Prefill
+    # must still start every row at 0.
+    decode_batched_frontier: bool = False
     remat: bool = False  # jax.checkpoint each block: activation memory
     # drops from O(L·E) per layer to per-block boundaries, recomputing the
     # block in backward — the HBM-for-FLOPs trade that lets long-context
@@ -688,12 +749,21 @@ class TransformerLM(nn.Module):
                     'model with attn_impl="dense" (generate.py does this)'
                 )
             # Autoregressive position tracking: one counter for the whole
-            # stack (every layer sees the same absolute positions).
-            idx = self.variable(
-                "cache", "idx", lambda: jnp.zeros((), jnp.int32)
-            )
-            start = idx.value
-            positions = start + jnp.arange(L)
+            # stack (every layer sees the same absolute positions) — or
+            # one PER ROW under decode_batched_frontier (batched
+            # speculative decoding: rows commit different lengths).
+            if self.decode_batched_frontier:
+                idx = self.variable(
+                    "cache", "idx", lambda: jnp.zeros((B,), jnp.int32)
+                )
+                start = idx.value  # [B]
+                positions = start[:, None] + jnp.arange(L)[None, :]
+            else:
+                idx = self.variable(
+                    "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+                )
+                start = idx.value
+                positions = start + jnp.arange(L)
             if not self.is_initializing():
                 idx.value = start + L
         else:

@@ -68,8 +68,11 @@ def test_sampled_speculative_runs_and_stays_in_vocab(rng):
     np.testing.assert_array_equal(o[:, :5], np.asarray(prompt))
 
 
-def test_speculative_guards(rng):
-    target, tparams, draft, dparams = _models()
+def test_speculative_guards():
+    target = TransformerLM(vocab_size=VOCAB, d_model=32, n_layers=3,
+                           n_heads=4)
+    draft = TransformerLM(vocab_size=VOCAB, d_model=16, n_layers=1,
+                          n_heads=2)
     with pytest.raises(ValueError, match="gamma"):
         make_speculative_generate_fn(target, draft, 8, gamma=0)
     with pytest.raises(ValueError, match="vocabulary"):
@@ -79,10 +82,70 @@ def test_speculative_guards(rng):
                           n_heads=2),
             8,
         )
-    fn = make_speculative_generate_fn(target, draft, 8)
-    with pytest.raises(ValueError, match="batch-1"):
-        fn(tparams, dparams, jnp.zeros((2, 4), jnp.int32),
-           jax.random.PRNGKey(0))
+
+
+@pytest.mark.parametrize("gamma", [2, 4])
+def test_batched_greedy_speculative_token_exact(rng, gamma):
+    """Batch 8, rows with DIFFERENT prompts: every row's speculative
+    stream must equal vanilla batched greedy — per-row frontiers commit
+    different counts each round (the draft is random, so acceptance
+    varies wildly by row) yet the output is token-exact per row."""
+    target, tparams, draft, dparams = _models()
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (8, 6)), jnp.int32)
+    ref = make_generate_fn(target, 12)(
+        tparams, prompt, jax.random.PRNGKey(0)
+    )
+    fn = make_speculative_generate_fn(target, draft, 12, gamma=gamma)
+    out = fn(tparams, dparams, prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_batched_equals_rowwise_single(rng):
+    """The batched program must serve each row exactly as the batch-1
+    program serves it alone — freezing finished rows cannot leak into
+    live rows' streams."""
+    target, tparams, draft, dparams = _models()
+    prompts = jnp.asarray(rng.integers(0, VOCAB, (4, 5)), jnp.int32)
+    fn = make_speculative_generate_fn(target, draft, 9, gamma=3)
+    batched = np.asarray(
+        fn(tparams, dparams, prompts, jax.random.PRNGKey(1))
+    )
+    for b in range(4):
+        solo = np.asarray(
+            fn(tparams, dparams, prompts[b:b + 1], jax.random.PRNGKey(1))
+        )
+        np.testing.assert_array_equal(batched[b:b + 1], solo)
+
+
+def test_batched_sampled_speculative_in_vocab(rng):
+    target, tparams, draft, dparams = _models()
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (4, 5)), jnp.int32)
+    fn = make_speculative_generate_fn(
+        target, draft, 8, gamma=3, temperature=0.9, top_k=20
+    )
+    out = np.asarray(fn(tparams, dparams, prompt, jax.random.PRNGKey(5)))
+    assert out.shape == (4, 13)
+    assert (out >= 0).all() and (out < VOCAB).all()
+    np.testing.assert_array_equal(out[:, :5], np.asarray(prompt))
+
+
+def test_batched_greedy_speculative_int8_kv_cache(rng):
+    """Per-row frontiers compose with the int8 KV cache: the vmapped
+    per-row scale writes and the scale-folding einsum must keep the
+    batched stream equal to the vanilla int8-cache stream."""
+    target = TransformerLM(vocab_size=VOCAB, d_model=32, n_layers=2,
+                           n_heads=4, kv_cache_dtype=jnp.int8)
+    draft = TransformerLM(vocab_size=VOCAB, d_model=16, n_layers=1,
+                          n_heads=2, kv_cache_dtype=jnp.int8)
+    tparams = init_lm_state(target).params
+    dparams = init_lm_state(draft, seed=7).params
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (4, 6)), jnp.int32)
+    ref = make_generate_fn(target, 10)(
+        tparams, prompt, jax.random.PRNGKey(0)
+    )
+    fn = make_speculative_generate_fn(target, draft, 10, gamma=3)
+    out = fn(tparams, dparams, prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
 def test_greedy_speculative_with_int8_target(rng):
