@@ -168,10 +168,11 @@ def main(argv=None) -> None:
             MoETransformerLM,
         )
 
-        if args.quant or args.spec_gamma or args.tp > 1:
+        if args.tp > 1:
             raise ValueError(
-                "--moe serving supports the plain decode loop only "
-                "(no --quant / --spec-gamma / --tp yet)"
+                "--moe serving composes with --quant and --spec-gamma "
+                "but not --tp (the manual Megatron decode shard_map has "
+                "no expert layout)"
             )
         model = MoETransformerLM(
             vocab_size=vocab, d_model=args.d_model,
@@ -230,9 +231,13 @@ def main(argv=None) -> None:
 
         if args.tp > 1:
             raise ValueError(
-                "--spec-gamma and --tp are mutually exclusive (the "
-                "speculative loop is batch-1 single-program)"
+                "--spec-gamma and --tp are mutually exclusive (the TP "
+                "shard_map decode program has no speculative wiring yet)"
             )
+        # The draft is a plain dense LM even for an MoE target — it only
+        # proposes; the target's verify pass owns the distribution.  It
+        # shares --kv-cache-dtype: the draft runs the most decode steps,
+        # so the int8 cache pays off there first (ADVICE r4).
         draft = TransformerLM(
             vocab_size=vocab,
             d_model=args.draft_d_model or args.d_model,
@@ -242,6 +247,7 @@ def main(argv=None) -> None:
                         if args.draft_n_kv_heads is not None
                         else args.n_kv_heads),
             compute_dtype=dtype,
+            kv_cache_dtype=kv_dtype,
         )
         from distributed_machine_learning_tpu.train.lm_step import (
             init_lm_state,

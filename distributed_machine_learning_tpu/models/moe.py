@@ -57,14 +57,25 @@ class MoEMLP(nn.Module):
     # — numerically the same aux the unsharded model computes.
     expert_axis: str | None = None
     token_axes: tuple = ()
-    # Dropless routing regardless of capacity_factor (einsum path:
-    # capacity = N, so no expert can overflow).  Serving sets this:
-    # Switch's capacity drop is a TRAINING-time load-balancing
+    # Dropless routing regardless of capacity_factor.  Serving sets
+    # this: Switch's capacity drop is a TRAINING-time load-balancing
     # mechanism whose drop pattern depends on the batch shape — a
     # decode step's N is B·1, so per-expert capacity collapses and two
     # batch rows routing to one expert would silently drop a token,
-    # diverging the served stream from the trained model.
+    # diverging the served stream from the trained model.  Dropless
+    # compute runs the GROUPED path (sort + ragged_dot) regardless of
+    # ``moe_impl``: it is dropless with no one-hot, so a served prompt
+    # prefill costs O(N·D) dispatch instead of the einsum's O(N²·E)
+    # one-hot tensors (a multi-thousand-token prompt under the einsum
+    # dispatch would OOM on the [N, E, N] slot one-hot — ADVICE r4).
     dropless: bool = False
+    # "int8" = weight-only quantized expert serving (dropless/decode
+    # only): expert weights are int8 with per-expert per-output-channel
+    # scales, read through the scale-folded ragged_dot
+    # (ops/grouped.py::grouped_expert_mlp).  The router stays f32 —
+    # routing decisions are argmax ties waiting to happen, and its
+    # [D, E] matmul has no bandwidth to win.
+    weight_quant: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -78,13 +89,25 @@ class MoEMLP(nn.Module):
                 "moe_impl='grouped'; einsum EP is the GSPMD step "
                 "(parallel/expert_parallel.py::make_ep_train_step)"
             )
+        if self.weight_quant not in (None, "int8"):
+            raise ValueError(
+                f"weight_quant must be None or 'int8', got "
+                f"{self.weight_quant!r}"
+            )
+        if self.weight_quant is not None and not self.dropless:
+            raise ValueError(
+                "weight_quant is a serving feature (int8 experts are not "
+                "trainable); it requires the dropless serving path "
+                "(decode=True — inference/generate.py clones it on)"
+            )
+        if self.weight_quant is not None and self.expert_axis is not None:
+            raise NotImplementedError(
+                "int8 expert serving is single-host (no manual-EP "
+                "shard_map decode path exists to quantize)"
+            )
         B, T, D = x.shape
         N = B * T
         E = self.n_experts
-        capacity = (
-            N if self.dropless
-            else max(1, math.ceil(N / E * self.capacity_factor))
-        )
         tokens = x.reshape(N, D)
 
         # Router in fp32: small matmul, precision matters for argmax ties.
@@ -121,13 +144,36 @@ class MoEMLP(nn.Module):
             e_param = E // ep  # params declared at the LOCAL shard shape
         else:
             e_param = E
-        w_in = self.param(
-            "w_in", nn.initializers.lecun_normal(), (e_param, D, self.d_ff)
-        )
+        if self.weight_quant == "int8":
+            # Serving layout (quantize_lm_params writes it): int8 expert
+            # kernels + per-(expert, out-channel) f32 scales; biases keep
+            # the unquantized shape.  Zeros/ones inits — real values come
+            # from the converted checkpoint.
+            w_in = self.param(
+                "w_in_q", nn.initializers.zeros, (e_param, D, self.d_ff),
+                jnp.int8,
+            )
+            w_in_scale = self.param(
+                "w_in_scale", nn.initializers.ones, (e_param, self.d_ff),
+                jnp.float32,
+            )
+            w_out = self.param(
+                "w_out_q", nn.initializers.zeros, (e_param, self.d_ff, D),
+                jnp.int8,
+            )
+            w_out_scale = self.param(
+                "w_out_scale", nn.initializers.ones, (e_param, D),
+                jnp.float32,
+            )
+        else:
+            w_in = self.param(
+                "w_in", nn.initializers.lecun_normal(), (e_param, D, self.d_ff)
+            )
+            w_out = self.param(
+                "w_out", nn.initializers.lecun_normal(), (e_param, self.d_ff, D)
+            )
+            w_in_scale = w_out_scale = None
         b_in = self.param("b_in", nn.initializers.zeros, (e_param, self.d_ff))
-        w_out = self.param(
-            "w_out", nn.initializers.lecun_normal(), (e_param, self.d_ff, D)
-        )
         b_out = self.param("b_out", nn.initializers.zeros, (e_param, D))
 
         if self.expert_axis is not None:
@@ -142,18 +188,24 @@ class MoEMLP(nn.Module):
             y = y * expert_prob[:, None].astype(dt)
             return y.reshape(B, T, D)
 
-        if self.moe_impl == "grouped":
+        # Serving (dropless) always computes through the grouped path —
+        # see the ``dropless`` field note: same dropless math as
+        # "einsum with capacity=N" minus the O(N²·E) one-hots, and the
+        # only expert path the int8 serving scales are wired through.
+        if self.moe_impl == "grouped" or self.dropless:
             from distributed_machine_learning_tpu.ops.grouped import (
                 grouped_expert_mlp,
             )
 
             y = grouped_expert_mlp(
-                tokens.astype(dt), expert_idx, w_in, b_in, w_out, b_out
+                tokens.astype(dt), expert_idx, w_in, b_in, w_out, b_out,
+                w_in_scale=w_in_scale, w_out_scale=w_out_scale,
             )
             y = y * expert_prob[:, None].astype(dt)
             return y.reshape(B, T, D)
 
         # Position of each token within its expert's queue; drop overflow.
+        capacity = max(1, math.ceil(N / E * self.capacity_factor))
         pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based where routed
         within = (pos > 0) & (pos <= capacity)
         slot = jax.nn.one_hot(
@@ -206,6 +258,9 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
         decode=model.decode,
         kv_cache_dtype=model.kv_cache_dtype,
         decode_continuation=model.decode_continuation,
+        # Attention projections follow the same int8 serving story as
+        # the dense LM (ops/quant.py::QuantDenseGeneral).
+        weight_quant=model.weight_quant,
         mlp_factory=lambda: MoEMLP(
             n_experts=model.n_experts,
             d_ff=model.d_ff or 4 * model.d_model,
@@ -214,9 +269,10 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
             moe_impl=model.moe_impl,
             expert_axis=model.expert_axis,
             token_axes=model.token_axes,
-            # Serving routes dropless (see MoEMLP.dropless): the grouped
-            # path always is; the einsum path gets capacity = N.
+            # Serving routes dropless (see MoEMLP.dropless), through the
+            # grouped sort+ragged_dot compute path.
             dropless=model.decode,
+            weight_quant=model.weight_quant,
             name="moe",
         ),
         name=name,
@@ -268,18 +324,24 @@ class MoETransformerLM(nn.Module):
     decode: bool = False
     kv_cache_dtype: Any = None
     decode_continuation: bool = False
-    # Serving quantization is not wired for the expert weights; the
-    # field exists so generate.py's clone succeeds with its default
-    # None, and a non-None value fails loudly below.
+    # Per-row cache frontiers (batched speculative decoding) — same
+    # contract as ``TransformerLM.decode_batched_frontier``.
+    decode_batched_frontier: bool = False
+    # "int8" = weight-only quantized serving (decode only): attention
+    # projections and the lm_head through QuantDenseGeneral, expert
+    # weights through the scale-folded ragged_dot (``MoEMLP``); params
+    # from ``ops.quant.quantize_lm_params`` (it recognizes the expert
+    # leaves).  The router stays f32.
     weight_quant: str | None = None
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False):
         del train
-        if self.weight_quant is not None:
-            raise NotImplementedError(
-                "weight-only int8 serving is not wired for MoE expert "
-                "weights; serve MoE models unquantized"
+        if self.weight_quant is not None and not self.decode:
+            raise ValueError(
+                "weight_quant is a serving-decode feature (int8 weights "
+                "are not trainable); clone with decode=True — "
+                "inference/generate.py does this"
             )
         seq_sharded = self.seq_axis in self.token_axes
         if self.attn_impl not in SEQ_LOCAL_ATTN_IMPLS and not seq_sharded:
@@ -299,12 +361,20 @@ class MoETransformerLM(nn.Module):
                     'model with attn_impl="dense" (generate.py does this)'
                 )
             # Autoregressive position tracking — one counter for the
-            # stack, same contract as TransformerLM.
-            idx = self.variable(
-                "cache", "idx", lambda: jnp.zeros((), jnp.int32)
-            )
-            start = idx.value
-            positions = start + jnp.arange(L)
+            # stack (or one per ROW under decode_batched_frontier),
+            # same contract as TransformerLM.
+            if self.decode_batched_frontier:
+                idx = self.variable(
+                    "cache", "idx", lambda: jnp.zeros((B,), jnp.int32)
+                )
+                start = idx.value  # [B]
+                positions = start[:, None] + jnp.arange(L)[None, :]
+            else:
+                idx = self.variable(
+                    "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+                )
+                start = idx.value
+                positions = start + jnp.arange(L)
             if not self.is_initializing():
                 idx.value = start + L
         elif self.attn_impl in SEQ_SHARDED_ATTN_IMPLS:
@@ -323,5 +393,17 @@ class MoETransformerLM(nn.Module):
         for i in range(self.n_layers):
             x = _moe_block(self, name=f"block_{i}")(x, positions)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_f")(x)
-        logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype, name="lm_head")(x)
+        if self.weight_quant == "int8":
+            from distributed_machine_learning_tpu.ops.quant import (
+                QuantDenseGeneral,
+            )
+
+            logits = QuantDenseGeneral(
+                out_features=(self.vocab_size,),
+                compute_dtype=self.compute_dtype, name="lm_head",
+            )(x)
+        else:
+            logits = nn.Dense(
+                self.vocab_size, dtype=self.compute_dtype, name="lm_head"
+            )(x)
         return logits.astype(jnp.float32)

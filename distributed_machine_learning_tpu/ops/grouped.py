@@ -111,6 +111,8 @@ def grouped_expert_mlp(
     b_out: jax.Array,
     *,
     activation=jax.nn.gelu,
+    w_in_scale: jax.Array | None = None,
+    w_out_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Dropless routed expert MLP over ``[N, D]`` token rows.
 
@@ -120,6 +122,16 @@ def grouped_expert_mlp(
     the caller applies router-prob scaling.  Gradients flow to tokens
     and all four weight leaves through ``ragged_dot``'s VJP; the integer
     routing path is non-differentiable exactly as the one-hot path is.
+
+    ``w_in_scale``/``w_out_scale`` ([E, F] / [E, D] f32): weight-only
+    int8 expert serving — ``w_in``/``w_out`` are then int8 and the
+    per-expert per-output-channel scales fold into the activations
+    AFTER each ragged matmul (each row multiplies its own expert's
+    scale row, gathered by ``eids``), the same
+    quantize-stays-in-the-dot recipe as the int8 KV cache's einsum
+    (``models/transformer.py::_cached_attention_quant``): the int8→
+    compute-dtype convert fuses into ``ragged_dot``'s operand read, so
+    HBM only ever reads the int8 expert bytes.
     """
     n_experts = w_in.shape[0]
     order, inv_order, group_sizes = sort_by_expert(expert_idx, n_experts)
@@ -127,8 +139,12 @@ def grouped_expert_mlp(
     eids = jnp.take(expert_idx, order, axis=0)
     dt = tokens.dtype
     h = lax.ragged_dot(xs, w_in.astype(dt), group_sizes)
+    if w_in_scale is not None:
+        h = h * jnp.take(w_in_scale, eids, axis=0).astype(dt)
     h = activation(h + jnp.take(b_in.astype(dt), eids, axis=0))
     ys = lax.ragged_dot(h, w_out.astype(dt), group_sizes)
+    if w_out_scale is not None:
+        ys = ys * jnp.take(w_out_scale, eids, axis=0).astype(dt)
     ys = ys + jnp.take(b_out.astype(dt), eids, axis=0)
     return _permute_rows(ys, inv_order, order)
 

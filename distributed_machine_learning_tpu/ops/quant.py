@@ -105,16 +105,37 @@ def _quantize_module(name: str, leaves: dict) -> dict:
     return out
 
 
+def _quantize_expert_module(leaves: dict) -> dict:
+    """A ``MoEMLP`` module's params → its ``weight_quant="int8"``
+    layout: the [E, D_in, D_out] expert kernels quantize per-expert
+    per-output-channel (a vmapped :func:`quantize_int8` over the expert
+    axis), biases pass through, and the ROUTER stays f32 — its [D, E]
+    matmul has no bandwidth to win and its argmax decides the routing
+    (``models/moe.py::MoEMLP``)."""
+    qi, si = jax.vmap(quantize_int8)(leaves["w_in"])
+    qo, so = jax.vmap(quantize_int8)(leaves["w_out"])
+    return {
+        "router": leaves["router"],
+        "w_in_q": qi, "w_in_scale": si, "b_in": leaves["b_in"],
+        "w_out_q": qo, "w_out_scale": so, "b_out": leaves["b_out"],
+    }
+
+
 def quantize_lm_params(params) -> dict:
-    """Trained ``TransformerLM`` params → the ``weight_quant="int8"``
-    decode model's structure.  Pure function of arrays — jit-safe, and
-    cheap enough to run once at serving setup."""
+    """Trained ``TransformerLM`` / ``MoETransformerLM`` params → the
+    ``weight_quant="int8"`` decode model's structure.  Dense projections
+    (any module with a ``kernel``) go per-output-channel int8; MoE
+    expert modules (the ``w_in``/``w_out`` leaves) go per-expert
+    per-output-channel with the router left f32.  Pure function of
+    arrays — jit-safe, and cheap enough to run once at serving setup."""
 
     def walk(name: str, node):
         if isinstance(node, dict) or hasattr(node, "items"):
             node = dict(node)
             if "kernel" in node:
                 return _quantize_module(name, node)
+            if "w_in" in node and "w_out" in node:
+                return _quantize_expert_module(node)
             return {k: walk(k, v) for k, v in node.items()}
         return node
 

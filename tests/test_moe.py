@@ -449,7 +449,132 @@ def test_moe_cached_decode_matches_teacher_forced(batch, impl, seed):
     np.testing.assert_array_equal(np.asarray(out[:, 5:]), want)
 
 
-def test_moe_decode_rejects_quant():
-    model = tiny_moe(decode=True, weight_quant="int8")
-    with pytest.raises(NotImplementedError, match="int8"):
+def test_moe_quant_requires_decode():
+    model = tiny_moe(weight_quant="int8")
+    with pytest.raises(ValueError, match="decode"):
         model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def _dequant_moe_tree(params, qparams):
+    """Quantized MoE tree → full-precision tree (the serving reference):
+    dense modules un-flatten w_q·scale back into ``kernel``; expert
+    modules rebuild [E, D_in, D_out] ``w_in``/``w_out`` from the
+    per-expert scales; the router passed through untouched."""
+
+    def walk(ref, node):
+        if isinstance(ref, dict):
+            if "w_q" in node:
+                w = node["w_q"].astype(jnp.float32) * node["scale"][None, :]
+                out = {"kernel": w.reshape(ref["kernel"].shape)}
+                if "bias" in node:
+                    out["bias"] = node["bias"]
+                return out
+            if "w_in_q" in node:
+                return {
+                    "router": node["router"],
+                    "w_in": node["w_in_q"].astype(jnp.float32)
+                    * node["w_in_scale"][:, None, :],
+                    "w_out": node["w_out_q"].astype(jnp.float32)
+                    * node["w_out_scale"][:, None, :],
+                    "b_in": node["b_in"], "b_out": node["b_out"],
+                }
+            return {k: walk(ref[k], node[k]) for k in ref}
+        return node
+
+    return walk(params, qparams)
+
+
+@pytest.mark.parametrize("seed", [1, 4])
+def test_moe_quantized_generate_token_exact_vs_dequant(seed):
+    """int8 MoE serving (VERDICT r4 item 2): expert weights quantized
+    per-expert per-channel and read through the scale-folded ragged_dot,
+    attention/lm_head through QuantDenseGeneral — the served stream must
+    equal the unquantized model serving the DEQUANTIZED weights (same
+    numbers, two read paths)."""
+    from distributed_machine_learning_tpu.inference.generate import (
+        generate,
+        make_generate_fn,
+    )
+    from distributed_machine_learning_tpu.ops.quant import quantize_lm_params
+
+    model = tiny_moe()
+    params = model.init(
+        jax.random.PRNGKey(4), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    qparams = quantize_lm_params(params)
+    moe = qparams["block_0"]["moe"]
+    assert moe["w_in_q"].dtype == jnp.int8
+    assert moe["w_in_scale"].shape == (4, 128)  # [E, d_ff]
+    assert moe["router"]["kernel"].dtype == jnp.float32  # router stays f32
+
+    rng = np.random.default_rng(seed)
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (2, 5)), jnp.int32)
+    ref = generate(model, _dequant_moe_tree(params, qparams), prompt, 8)
+    fn = make_generate_fn(model, 8, quantize="int8")
+    out = fn(qparams, prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.parametrize("batch_rows", [1, 3])
+def test_moe_speculative_greedy_token_exact(batch_rows):
+    """Speculative decoding with an MoE TARGET and a dense draft
+    (VERDICT r4 item 2): the served stream equals vanilla MoE greedy —
+    including batched rows on per-row frontiers."""
+    from distributed_machine_learning_tpu.inference.generate import (
+        make_generate_fn,
+    )
+    from distributed_machine_learning_tpu.inference.speculative import (
+        make_speculative_generate_fn,
+    )
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+    target = tiny_moe()
+    tparams = target.init(
+        jax.random.PRNGKey(4), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    draft = TransformerLM(vocab_size=VOCAB, d_model=16, n_layers=1,
+                          n_heads=2)
+    dparams = init_lm_state(draft, seed=7).params
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (batch_rows, 5)), jnp.int32)
+    ref = make_generate_fn(target, 8)(tparams, prompt, jax.random.PRNGKey(0))
+    fn = make_speculative_generate_fn(target, draft, 8, gamma=3)
+    out = fn(tparams, dparams, prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_moe_speculative_with_int8_target():
+    """--moe --quant --spec-gamma all at once: the int8 MoE target's
+    speculative stream equals its own vanilla int8 stream."""
+    from distributed_machine_learning_tpu.inference.generate import (
+        make_generate_fn,
+    )
+    from distributed_machine_learning_tpu.inference.speculative import (
+        make_speculative_generate_fn,
+    )
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from distributed_machine_learning_tpu.ops.quant import quantize_lm_params
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+    target = tiny_moe()
+    tparams = target.init(
+        jax.random.PRNGKey(4), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    qt = quantize_lm_params(tparams)
+    draft = TransformerLM(vocab_size=VOCAB, d_model=16, n_layers=1,
+                          n_heads=2)
+    dparams = init_lm_state(draft, seed=7).params
+    rng = np.random.default_rng(9)
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (1, 6)), jnp.int32)
+    ref = make_generate_fn(target, 8, quantize="int8")(
+        qt, prompt, jax.random.PRNGKey(0)
+    )
+    fn = make_speculative_generate_fn(target, draft, 8, gamma=3,
+                                      quantize="int8")
+    out = fn(qt, dparams, prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
