@@ -20,6 +20,19 @@ item 6).  This module makes each of them a one-command sweep:
   the pipeline — pipeline parallelism's reason to exist.  Microbatches
   scale with the stage count to hold the bubble fraction
   (P−1)/(M+P−1) comparable across points.
+- ``ep`` — **weak scaling over the expert axis** (VERDICT r04 item 5):
+  ``n_experts = experts_per_device × devices`` and the global batch
+  grows with the mesh, through the dropless grouped-EP step (explicit
+  token all_to_all + ragged_dot).  Top-1 routing keeps per-TOKEN
+  compute constant as experts grow, so tokens/sec/device is flat on
+  ideal hardware — the efficiency norm is 1, and the shortfall is the
+  genuine all_to_all + padding cost.
+- ``ring`` — **weak scaling over sequence** (the long-context pod
+  scheme): global ``seq = seq_len × devices`` at a fixed per-device
+  chunk, ring-attention context parallelism.  Causal attention work
+  per token GROWS with the global sequence, so the efficiency norm is
+  FLOPs/sec/device (tokens/sec/device × modeled FLOPs/token at that
+  point's length — ``utils/flops.py``), not raw token rate.
 
 Timing: chained donated steps, per-step time from the two-point slope
 (N vs 2N chained steps — fixed dispatch overhead cancels; same
@@ -44,7 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-LM_SWEEP_SCHEMES = ("fsdp_pl", "tp", "pp")
+LM_SWEEP_SCHEMES = ("fsdp_pl", "tp", "pp", "ep", "ring")
 # One default, shared by lm_run_point's signature and the tp auto-count
 # filter, so they cannot drift.
 DEFAULT_N_HEADS = 8
@@ -64,6 +77,10 @@ class LMScalePoint:
     tokens_per_sec: float
     tokens_per_sec_per_device: float
     efficiency: float | None = None
+    # Modeled train FLOPs per token at this point's shape (set for the
+    # weak-seq ring mode, whose per-token work grows with the global
+    # sequence — the efficiency norm multiplies by it).
+    flops_per_token: float | None = None
 
 
 def _time_chained(step, state, x, y, n: int):
@@ -111,6 +128,7 @@ def lm_run_point(
     global_batch: int | None = None,
     n_layers: int = 4,
     layers_per_stage: int = 2,
+    experts_per_device: int = 2,
     timed_iters: int = 4,
     devices=None,
 ) -> LMScalePoint:
@@ -179,6 +197,53 @@ def lm_run_point(
         step = make_tp_lm_train_step(model, mesh)
         sharding = NamedSharding(mesh, P("batch", None))
         layers = n_layers
+    elif scheme == "ep":
+        from distributed_machine_learning_tpu.models.moe import (
+            MoETransformerLM,
+        )
+        from distributed_machine_learning_tpu.parallel.expert_parallel import (
+            init_moe_state,
+            make_ep_grouped_train_step,
+            shard_ep_state,
+        )
+
+        mode = "weak-expert"
+        batch = per_device_batch * num_devices
+        model = MoETransformerLM(
+            vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+            n_heads=n_heads, n_experts=experts_per_device * num_devices,
+            moe_impl="grouped", compute_dtype=jnp.bfloat16,
+        )
+        mesh = make_mesh(
+            num_devices, ("batch", "expert"), (1, num_devices),
+            devices=devices,
+        )
+        state = shard_ep_state(init_moe_state(model), mesh)
+        step = make_ep_grouped_train_step(model, mesh)
+        # The grouped-EP step's contract: token rows shard over the
+        # combined (data, expert) axes.
+        sharding = NamedSharding(mesh, P(("batch", "expert"), None))
+        layers = n_layers
+    elif scheme == "ring":
+        from distributed_machine_learning_tpu.train.lm_step import (
+            make_lm_train_step,
+        )
+
+        mode = "weak-seq"
+        batch = per_device_batch  # fixed global batch; the SEQUENCE grows
+        seq_len = seq_len * num_devices  # seq_len acts as per-device chunk
+        model = TransformerLM(
+            vocab_size=vocab, d_model=d_model, n_layers=n_layers,
+            n_heads=n_heads, attn_impl="ring", compute_dtype=jnp.bfloat16,
+        )
+        mesh = make_mesh(
+            num_devices, ("batch", "seq"), (1, num_devices),
+            devices=devices,
+        )
+        state = init_lm_state(model)
+        step = make_lm_train_step(model, mesh=mesh)
+        sharding = NamedSharding(mesh, P("batch", "seq"))
+        layers = n_layers
     else:  # pp — weak over depth
         from distributed_machine_learning_tpu.parallel.pipeline import (
             init_pipeline_state,
@@ -216,6 +281,23 @@ def lm_run_point(
 
     per_step = _per_step_time(step, state, x, y, timed_iters)
     tps = batch * seq_len / per_step
+    fpt = None
+    if mode == "weak-seq":
+        # Per-token work grows with the global sequence (causal
+        # attention); the sweep's efficiency norm needs the modeled
+        # FLOPs/token at THIS length.  Embedding is a gather, not a
+        # matmul — excluded, as in bench_lm.py.
+        from distributed_machine_learning_tpu.utils.flops import (
+            transformer_train_flops_per_token,
+        )
+
+        n_params = sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(state.params)
+        ) - vocab * d_model
+        fpt = transformer_train_flops_per_token(
+            n_params, layers, d_model, seq_len, causal=True
+        )
     return LMScalePoint(
         num_devices=num_devices,
         scheme=scheme,
@@ -226,6 +308,7 @@ def lm_run_point(
         global_batch=batch,
         tokens_per_sec=tps,
         tokens_per_sec_per_device=tps / num_devices,
+        flops_per_token=fpt,
     )
 
 
@@ -264,9 +347,13 @@ def lm_scaling_sweep(
         # grows per-token FLOPs with the model (n_layers ∝ stages), so
         # tokens/sec/device falls ~1/d on IDEAL hardware — the honest
         # per-device quantity is tokens·layers/sec/device (∝ model
-        # FLOPs/sec/device).  The remaining shortfall under this
-        # normalization is the genuine pipeline bubble + comm.  The flat
-        # modes normalize by 1 (their model is fixed).
+        # FLOPs/sec/device).  ring's weak-seq mode grows the causal
+        # attention term with the global sequence — its norm is the
+        # modeled FLOPs/sec/device.  The flat modes (fsdp_pl, tp, and
+        # ep — top-1 routing holds per-token compute constant as
+        # experts grow) normalize by 1.
+        if p.mode == "weak-seq":
+            return p.tokens_per_sec_per_device * p.flops_per_token
         return p.tokens_per_sec_per_device * (
             p.n_layers if p.mode == "weak-depth" else 1
         )
@@ -301,7 +388,12 @@ def main() -> None:
                              "layers-per-stage x stages)")
     parser.add_argument("--layers-per-stage", dest="layers_per_stage",
                         default=2, type=int)
-    parser.add_argument("--seq-len", dest="seq_len", default=128, type=int)
+    parser.add_argument("--experts-per-device", dest="experts_per_device",
+                        default=2, type=int,
+                        help="ep mode: n_experts = this x device count")
+    parser.add_argument("--seq-len", dest="seq_len", default=128, type=int,
+                        help="ring mode: the PER-DEVICE chunk (global "
+                             "sequence = seq-len x device count)")
     parser.add_argument("--batch-per-device", dest="per_device_batch",
                         default=4, type=int)
     parser.add_argument("--global-batch", dest="global_batch", default=None,
@@ -319,6 +411,7 @@ def main() -> None:
         n_heads=args.n_heads,
         n_layers=args.n_layers,
         layers_per_stage=args.layers_per_stage,
+        experts_per_device=args.experts_per_device,
         seq_len=args.seq_len,
         per_device_batch=args.per_device_batch,
         global_batch=args.global_batch,

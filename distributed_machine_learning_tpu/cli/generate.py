@@ -86,7 +86,8 @@ def make_parser() -> argparse.ArgumentParser:
                         "tokens per verify round (0 = off); the draft "
                         "defaults to the target architecture at random "
                         "init unless --draft-* flags say otherwise; "
-                        "batch-1, incompatible with --tp")
+                        "composes with --quant, --moe, and --tp (the "
+                        "target verifies sharded, the draft replicates)")
     p.add_argument("--draft-ckpt-dir", dest="draft_ckpt_dir", default=None,
                    help="cli.lm checkpoint for the draft model; absent "
                         "= random-init draft (output stays exact, "
@@ -227,13 +228,10 @@ def main(argv=None) -> None:
     if args.spec_gamma > 0:
         from distributed_machine_learning_tpu.inference.speculative import (
             make_speculative_generate_fn,
+            make_tp_speculative_generate_fn,
         )
 
-        if args.tp > 1:
-            raise ValueError(
-                "--spec-gamma and --tp are mutually exclusive (the TP "
-                "shard_map decode program has no speculative wiring yet)"
-            )
+        # (--moe x --tp was already rejected by the --moe branch above.)
         # The draft is a plain dense LM even for an MoE target — it only
         # proposes; the target's verify pass owns the distribution.  It
         # shares --kv-cache-dtype: the draft runs the most decode steps,
@@ -265,11 +263,32 @@ def main(argv=None) -> None:
             lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p,
             draft_params,
         )
-        spec_fn = make_speculative_generate_fn(
-            model, draft, args.max_new_tokens, gamma=args.spec_gamma,
-            temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, quantize=args.quant,
-        )
+        if args.tp > 1:
+            from distributed_machine_learning_tpu.parallel.tensor_parallel import (  # noqa: E501
+                tp_decode_params,
+            )
+            from distributed_machine_learning_tpu.runtime.mesh import (
+                make_mesh,
+            )
+
+            if args.tp > jax.device_count():
+                raise ValueError(
+                    f"--tp {args.tp} exceeds the device count "
+                    f"{jax.device_count()}"
+                )
+            mesh = make_mesh(args.tp, axis_names=("model",))
+            spec_fn = make_tp_speculative_generate_fn(
+                model, draft, args.max_new_tokens, mesh,
+                gamma=args.spec_gamma, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p, quantize=args.quant,
+            )
+            params = tp_decode_params(params, args.tp)
+        else:
+            spec_fn = make_speculative_generate_fn(
+                model, draft, args.max_new_tokens, gamma=args.spec_gamma,
+                temperature=args.temperature, top_k=args.top_k,
+                top_p=args.top_p, quantize=args.quant,
+            )
         # Same (params, prompt, key) signature as the other paths, so
         # the shared detokenize/print epilogue below serves all three.
         fn = lambda p, pr, k: spec_fn(p, draft_params, pr, k)

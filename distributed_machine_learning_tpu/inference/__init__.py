@@ -1,6 +1,17 @@
 from distributed_machine_learning_tpu.inference.generate import (
     generate,
     make_generate_fn,
+    make_tp_generate_fn,
+)
+from distributed_machine_learning_tpu.inference.speculative import (
+    make_speculative_generate_fn,
+    make_tp_speculative_generate_fn,
 )
 
-__all__ = ["generate", "make_generate_fn"]
+__all__ = [
+    "generate",
+    "make_generate_fn",
+    "make_tp_generate_fn",
+    "make_speculative_generate_fn",
+    "make_tp_speculative_generate_fn",
+]

@@ -161,6 +161,62 @@ def _generate_body(dm, sample, max_new_tokens, params, prompt, rng):
     return jnp.concatenate([prompt, gen], axis=1)
 
 
+def tp_local_decode_clone(model, mesh, model_axis: str,
+                          quantize: str | None):
+    """Validate the Megatron decode layout's divisibility rules and
+    clone ``model`` at its LOCAL width (heads, KV heads, d_ff ÷ tp;
+    head_dim pinned global; ``tp_axis`` set so the model's psums
+    complete each row-parallel projection).  The ONE place those rules
+    live — shared by :func:`make_tp_generate_fn` and the speculative TP
+    wrapper (``inference/speculative.py``), so the two cannot drift."""
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
+    if model_axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh is missing axis {model_axis!r}: {mesh.axis_names}"
+        )
+    tp = mesh.shape[model_axis]
+    if model.n_heads % tp:
+        raise ValueError(
+            f"n_heads={model.n_heads} must be divisible by tp={tp}"
+        )
+    n_kv = model.n_kv_heads
+    if n_kv is not None and n_kv % tp:
+        raise ValueError(
+            f"n_kv_heads={n_kv} must be divisible by tp={tp}"
+        )
+    d_ff = model.d_ff or 4 * model.d_model
+    if d_ff % tp:
+        raise ValueError(f"d_ff={d_ff} must be divisible by tp={tp}")
+    return model.clone(
+        n_heads=model.n_heads // tp,
+        n_kv_heads=None if n_kv is None else n_kv // tp,
+        d_ff=d_ff // tp,
+        # Global per-head width (honoring an explicit override).
+        head_dim=model.head_dim or model.d_model // model.n_heads,
+        attn_impl="dense", decode=True, weight_quant=quantize,
+        tp_axis=model_axis,
+    )
+
+
+def tp_param_specs(params, model_axis: str):
+    """The TP decode in_specs tree for params arranged by
+    ``tp_decode_params`` — one leaf-path → PartitionSpec mapping for
+    every TP decode factory."""
+    from distributed_machine_learning_tpu.parallel.tensor_parallel import (
+        tp_decode_spec_for,
+    )
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: tp_decode_spec_for(
+            tuple(k.key if hasattr(k, "key") else str(k) for k in path),
+            leaf.ndim if hasattr(leaf, "ndim") else 0,
+            model_axis,
+        ),
+        params,
+    )
+
+
 def make_tp_generate_fn(
     model,
     max_new_tokens: int,
@@ -194,43 +250,13 @@ def make_tp_generate_fn(
     """
     from jax.sharding import PartitionSpec as P
 
-    from distributed_machine_learning_tpu.parallel.tensor_parallel import (
-        tp_decode_spec_for,
-    )
     from distributed_machine_learning_tpu.runtime.mesh import (
         shard_map_no_check,
     )
 
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if quantize not in (None, "int8"):
-        raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
-    if model_axis not in mesh.axis_names:
-        raise ValueError(
-            f"mesh is missing axis {model_axis!r}: {mesh.axis_names}"
-        )
-    tp = mesh.shape[model_axis]
-    if model.n_heads % tp:
-        raise ValueError(
-            f"n_heads={model.n_heads} must be divisible by tp={tp}"
-        )
-    n_kv = model.n_kv_heads
-    if n_kv is not None and n_kv % tp:
-        raise ValueError(
-            f"n_kv_heads={n_kv} must be divisible by tp={tp}"
-        )
-    d_ff = model.d_ff or 4 * model.d_model
-    if d_ff % tp:
-        raise ValueError(f"d_ff={d_ff} must be divisible by tp={tp}")
-    local = model.clone(
-        n_heads=model.n_heads // tp,
-        n_kv_heads=None if n_kv is None else n_kv // tp,
-        d_ff=d_ff // tp,
-        # Global per-head width (honoring an explicit override).
-        head_dim=model.head_dim or model.d_model // model.n_heads,
-        attn_impl="dense", decode=True, weight_quant=quantize,
-        tp_axis=model_axis,
-    )
+    local = tp_local_decode_clone(model, mesh, model_axis, quantize)
     sample = partial(_sample, temperature=temperature, top_k=top_k,
                      top_p=top_p)
     body = partial(_generate_body, local, sample, max_new_tokens)
@@ -241,20 +267,10 @@ def make_tp_generate_fn(
         key = jax.tree_util.tree_structure(params)
         fn = jitted.get(key)
         if fn is None:
-            specs = jax.tree_util.tree_map_with_path(
-                lambda path, leaf: tp_decode_spec_for(
-                    tuple(
-                        k.key if hasattr(k, "key") else str(k) for k in path
-                    ),
-                    leaf.ndim if hasattr(leaf, "ndim") else 0,
-                    model_axis,
-                ),
-                params,
-            )
             fn = jitted[key] = jax.jit(shard_map_no_check(
                 body,
                 mesh=mesh,
-                in_specs=(specs, P(), P()),
+                in_specs=(tp_param_specs(params, model_axis), P(), P()),
                 out_specs=P(),
             ))
         return fn(params, prompt, rng)

@@ -67,6 +67,41 @@ from jax import lax
 from distributed_machine_learning_tpu.inference.generate import warp_logits
 
 
+def sampled_acceptance(d, q, p, u):
+    """The Leviathan accept/reject-residual rule, vectorized per row —
+    the exact math the sampled branch runs, factored out so the tests
+    can pin it against a NumPy oracle (tests/test_speculative.py).
+
+    ``d``: [B, γ] draft proposals; ``q``: [B, γ, V] draft probabilities
+    and ``p``: [B, γ+1, V] target probabilities (both already WARPED —
+    the preserved distribution is the warped one); ``u``: [B, γ]
+    uniforms.  Returns ``(n_acc, resid)``: ``n_acc[b]`` = length of row
+    b's accepted prefix (accept d_i iff u_i·q_i(d_i) < p_i(d_i), i.e.
+    u_i < p/q), and ``resid[b]`` = the [V] distribution the correction
+    token samples from — ``norm(max(p_i − q_i, 0))`` at the first
+    rejection i, or the bonus row ``p_γ`` on full acceptance (q_row is
+    zeroed there, so the residual IS p_γ).  Emitting ``d_{<n_acc}``
+    then one draw from ``resid`` makes each committed token exactly
+    target-distributed (Leviathan et al., Theorem 1)."""
+    gamma = d.shape[1]
+    p_d = jnp.take_along_axis(p[:, :gamma], d[..., None], axis=2)[..., 0]
+    q_d = jnp.take_along_axis(q, d[..., None], axis=2)[..., 0]
+    acc = u * q_d < p_d  # accept iff u < p/q (q>0 where sampled)
+    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+    # Residual at the first rejection; bonus row at γ.
+    p_row = jnp.take_along_axis(p, n_acc[:, None, None], axis=1)[:, 0]
+    q_row = jnp.where(
+        (n_acc < gamma)[:, None],
+        jnp.take_along_axis(
+            q, jnp.minimum(n_acc, gamma - 1)[:, None, None], axis=1
+        )[:, 0],
+        jnp.zeros_like(p_row),
+    )
+    resid = jnp.maximum(p_row - q_row, 0.0)
+    resid = resid / jnp.maximum(resid.sum(axis=-1, keepdims=True), 1e-30)
+    return n_acc, resid
+
+
 def make_speculative_generate_fn(
     target_model,
     draft_model,
@@ -109,190 +144,268 @@ def make_speculative_generate_fn(
                             weight_quant=quantize)
     dm = draft_model.clone(attn_impl="dense", decode=True,
                            weight_quant=draft_quantize)
+    from functools import partial
+
+    return jax.jit(partial(
+        _speculative_body, tm, dm, max_new_tokens, gamma, temperature,
+        top_k, top_p,
+    ))
+
+
+def _speculative_body(tm, dm, max_new_tokens, gamma, temperature, top_k,
+                      top_p, tparams, dparams, prompt, rng):
+    """The traced speculative program (prefill + draft/verify rounds) —
+    shared by the single-device jit (:func:`make_speculative_generate_fn`)
+    and the manual-TP shard_map wrap (:func:`make_tp_speculative_generate_fn`),
+    so the two paths can never drift.  ``tm``/``dm`` are decode-mode
+    clones (the TP path passes a LOCAL-width target whose ``tp_axis``
+    psums complete each projection)."""
     greedy = temperature == 0.0
-    V = target_model.vocab_size
+    V = tm.vocab_size
 
     def warp(logits):
         return warp_logits(logits, temperature, top_k, top_p)
 
-    @jax.jit
-    def run(tparams, dparams, prompt, rng):
-        B, Lp = prompt.shape
-        # Batch 1 keeps the scalar cache frontier (the measured-perf
-        # latency path); B > 1 switches the models to per-row frontiers.
-        batched = B > 1
-        tm_b = tm.clone(decode_batched_frontier=batched)
-        dm_b = dm.clone(decode_batched_frontier=batched)
-        # The verify pass applies γ+1 tokens MID-STREAM: it must attend
-        # the full cache, not take the start-0 prefill fast path — the
-        # continuation clone routes multi-token decode through
-        # _cached_attention (same params, same cache layout).
-        tm_verify = tm_b.clone(decode_continuation=True)
-        # Output slack: an ACTIVE row's pointer tops out at
-        # max_new−1 + (γ+1); a FROZEN row's window writes span γ+1 more
-        # slots — 2(γ+1) covers both without DUS clamping ever shifting
-        # a write into committed slots.  Batch 1 never freezes, so it
-        # keeps the tighter γ+1 slack (the extra slots could bump
-        # cache_len across a 512 tile and tax every einsum read).
-        budget = max_new_tokens + (gamma + 1) * (2 if batched else 1)
-        cache_len = -(-(Lp + budget + 1) // 512) * 512
+    B, Lp = prompt.shape
+    # Batch 1 keeps the scalar cache frontier (the measured-perf
+    # latency path); B > 1 switches the models to per-row frontiers.
+    batched = B > 1
+    tm_b = tm.clone(decode_batched_frontier=batched)
+    dm_b = dm.clone(decode_batched_frontier=batched)
+    # The verify pass applies γ+1 tokens MID-STREAM: it must attend
+    # the full cache, not take the start-0 prefill fast path — the
+    # continuation clone routes multi-token decode through
+    # _cached_attention (same params, same cache layout).
+    tm_verify = tm_b.clone(decode_continuation=True)
+    # Output slack: an ACTIVE row's pointer tops out at
+    # max_new−1 + (γ+1); a FROZEN row's window writes span γ+1 more
+    # slots — 2(γ+1) covers both without DUS clamping ever shifting
+    # a write into committed slots.  Batch 1 never freezes, so it
+    # keeps the tighter γ+1 slack (the extra slots could bump
+    # cache_len across a 512 tile and tax every einsum read).
+    budget = max_new_tokens + (gamma + 1) * (2 if batched else 1)
+    cache_len = -(-(Lp + budget + 1) // 512) * 512
 
-        def init_cache(model):
-            shapes = jax.eval_shape(
-                lambda: model.init(
-                    jax.random.PRNGKey(0),
-                    jnp.zeros((B, cache_len), jnp.int32),
-                    train=False,
-                )
-            )["cache"]
-            return jax.tree_util.tree_map(
-                lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    def init_cache(model):
+        shapes = jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((B, cache_len), jnp.int32),
+                train=False,
             )
-
-        tcache, dcache = init_cache(tm_b), init_cache(dm_b)
-
-        # Prefill both models on the prompt; the target's last logits
-        # sample the first committed token.
-        tlogits, tvars = tm_b.apply(
-            {"params": tparams, "cache": tcache}, prompt, train=False,
-            mutable=["cache"],
+        )["cache"]
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes
         )
-        _, dvars = dm_b.apply(
-            {"params": dparams, "cache": dcache}, prompt, train=False,
-            mutable=["cache"],
+
+    tcache, dcache = init_cache(tm_b), init_cache(dm_b)
+
+    # Prefill both models on the prompt; the target's last logits
+    # sample the first committed token.
+    tlogits, tvars = tm_b.apply(
+        {"params": tparams, "cache": tcache}, prompt, train=False,
+        mutable=["cache"],
+    )
+    _, dvars = dm_b.apply(
+        {"params": dparams, "cache": dcache}, prompt, train=False,
+        mutable=["cache"],
+    )
+    tcache, dcache = tvars["cache"], dvars["cache"]
+    rng, r0 = jax.random.split(rng)
+    if greedy:
+        cur = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
+    else:
+        cur = jax.random.categorical(
+            r0, warp(tlogits[:, -1]), axis=-1
+        ).astype(jnp.int32)
+
+    out = jnp.zeros((B, budget), jnp.int32)
+    out = lax.dynamic_update_slice(out, cur[:, None], (0, 0))
+    # ptr[b]: tokens EMITTED by row b so far (cur at slot 0 counts).
+    ptr = jnp.ones((B,), jnp.int32)
+    state = (tcache, dcache, cur, out, ptr, rng)
+
+    def round_body(state):
+        tcache, dcache, cur, out, ptr, rng = state
+        # Frozen rows (only possible when batched): done decoding,
+        # still riding the loop until the slowest row finishes.
+        done = ptr >= max_new_tokens  # [B]
+
+        # ---- draft phase: γ+1 steps (the last processes its own
+        # final proposal, keeping the draft cache one token behind
+        # the committed stream after any acceptance count).
+        def dstep(carry, r):
+            dcache, tok = carry
+            logits, vars_ = dm_b.apply(
+                {"params": dparams, "cache": dcache}, tok[:, None],
+                train=False, mutable=["cache"],
+            )
+            lg = logits[:, -1]
+            if greedy:
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                q = jnp.zeros((B, V), jnp.float32)  # unused
+            else:
+                w = warp(lg)  # one warp per step: probs AND sample
+                q = jax.nn.softmax(w, axis=-1)
+                nxt = jax.random.categorical(r, w, axis=-1).astype(
+                    jnp.int32
+                )
+            return (vars_["cache"], nxt), (nxt, q)
+
+        rng, *draft_keys = jax.random.split(rng, gamma + 2)
+        (dcache2, _), (draft_toks, draft_q) = lax.scan(
+            dstep, (dcache, cur), jnp.stack(draft_keys)
         )
-        tcache, dcache = tvars["cache"], dvars["cache"]
-        rng, r0 = jax.random.split(rng)
+        # draft_toks: [γ+1, B]; proposals are the first γ.
+        d = draft_toks[:gamma].swapaxes(0, 1)  # [B, γ] int32
+        q = draft_q[:gamma].swapaxes(0, 1)  # [B, γ, V]
+
+        # ---- verify: one target pass over [cur, d_0..d_{γ-1}].
+        verify_in = jnp.concatenate([cur[:, None], d], axis=1)
+        vlogits, tvars = tm_verify.apply(
+            {"params": tparams, "cache": tcache}, verify_in,
+            train=False, mutable=["cache"],
+        )  # [B, γ+1, V]; row (b, i) predicts the slot of d_i.
+
+        rng, r_acc, r_fix = jax.random.split(rng, 3)
         if greedy:
-            cur = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
+            tbest = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            acc = d == tbest[:, :gamma]  # [B, γ]
+            # n_acc[b] = length of row b's all-accepted prefix.
+            n_acc = jnp.sum(
+                jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1
+            )
+            # Correction/bonus token: target argmax at slot n_acc.
+            t_new = jnp.take_along_axis(
+                tbest, n_acc[:, None], axis=1
+            )[:, 0]
         else:
-            cur = jax.random.categorical(
-                r0, warp(tlogits[:, -1]), axis=-1
+            p = jax.nn.softmax(warp(vlogits), axis=-1)  # [B, γ+1, V]
+            u = jax.random.uniform(r_acc, (B, gamma))
+            n_acc, resid = sampled_acceptance(d, q, p, u)
+            t_new = jax.random.categorical(
+                r_fix, jnp.log(jnp.maximum(resid, 1e-30)), axis=-1
             ).astype(jnp.int32)
 
-        out = jnp.zeros((B, budget), jnp.int32)
-        out = lax.dynamic_update_slice(out, cur[:, None], (0, 0))
-        # ptr[b]: tokens EMITTED by row b so far (cur at slot 0 counts).
-        ptr = jnp.ones((B,), jnp.int32)
-        state = (tcache, dcache, cur, out, ptr, rng)
+        # Tokens row b commits this round (frozen rows commit none).
+        adv = jnp.where(done, 0, n_acc + 1)  # [B]
 
-        def round_body(state):
-            tcache, dcache, cur, out, ptr, rng = state
-            # Frozen rows (only possible when batched): done decoding,
-            # still riding the loop until the slowest row finishes.
-            done = ptr >= max_new_tokens  # [B]
+        # ---- commit: window = [d_0..d_{n_acc-1}, t_new, junk...];
+        # the junk beyond n_acc is overwritten by the next round's
+        # window (or never read past the final pointer); frozen
+        # rows' windows land entirely past max_new_tokens.
+        window = jnp.where(
+            jnp.arange(gamma + 1)[None] == n_acc[:, None],
+            t_new[:, None],
+            jnp.concatenate([d, jnp.zeros((B, 1), jnp.int32)], axis=1),
+        )
+        out = jax.vmap(
+            lambda o, w, p0: lax.dynamic_update_slice(o, w, (p0,))
+        )(out, window, ptr)
 
-            # ---- draft phase: γ+1 steps (the last processes its own
-            # final proposal, keeping the draft cache one token behind
-            # the committed stream after any acceptance count).
-            def dstep(carry, r):
-                dcache, tok = carry
-                logits, vars_ = dm_b.apply(
-                    {"params": dparams, "cache": dcache}, tok[:, None],
-                    train=False, mutable=["cache"],
-                )
-                lg = logits[:, -1]
-                if greedy:
-                    nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-                    q = jnp.zeros((B, V), jnp.float32)  # unused
-                else:
-                    w = warp(lg)  # one warp per step: probs AND sample
-                    q = jax.nn.softmax(w, axis=-1)
-                    nxt = jax.random.categorical(r, w, axis=-1).astype(
-                        jnp.int32
-                    )
-                return (vars_["cache"], nxt), (nxt, q)
+        # ---- cache rewinds (the free rollback): target holds the
+        # committed stream MINUS t_new; draft holds one token less.
+        # Frozen rows rewind the full γ+1 — their frontier is pinned.
+        delta = adv - (gamma + 1)  # [B], <= 0
+        back = delta if batched else delta[0]
+        tcache = dict(tvars["cache"])
+        tcache["idx"] = tcache["idx"] + back
+        dcache2 = dict(dcache2)
+        dcache2["idx"] = dcache2["idx"] + back
+        cur = jnp.where(done, cur, t_new)
+        return (tcache, dcache2, cur, out, ptr + adv, rng)
 
-            rng, *draft_keys = jax.random.split(rng, gamma + 2)
-            (dcache2, _), (draft_toks, draft_q) = lax.scan(
-                dstep, (dcache, cur), jnp.stack(draft_keys)
-            )
-            # draft_toks: [γ+1, B]; proposals are the first γ.
-            d = draft_toks[:gamma].swapaxes(0, 1)  # [B, γ] int32
-            q = draft_q[:gamma].swapaxes(0, 1)  # [B, γ, V]
+    def cond(state):
+        return jnp.any(state[4] < max_new_tokens)
 
-            # ---- verify: one target pass over [cur, d_0..d_{γ-1}].
-            verify_in = jnp.concatenate([cur[:, None], d], axis=1)
-            vlogits, tvars = tm_verify.apply(
-                {"params": tparams, "cache": tcache}, verify_in,
-                train=False, mutable=["cache"],
-            )  # [B, γ+1, V]; row (b, i) predicts the slot of d_i.
+    _, _, _, out, _, _ = lax.while_loop(cond, round_body, state)
+    return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
 
-            rng, r_acc, r_fix = jax.random.split(rng, 3)
-            if greedy:
-                tbest = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
-                acc = d == tbest[:, :gamma]  # [B, γ]
-                # n_acc[b] = length of row b's all-accepted prefix.
-                n_acc = jnp.sum(
-                    jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1
-                )
-                # Correction/bonus token: target argmax at slot n_acc.
-                t_new = jnp.take_along_axis(
-                    tbest, n_acc[:, None], axis=1
-                )[:, 0]
-            else:
-                p = jax.nn.softmax(warp(vlogits), axis=-1)  # [B, γ+1, V]
-                p_d = jnp.take_along_axis(
-                    p[:, :gamma], d[..., None], axis=2
-                )[..., 0]
-                q_d = jnp.take_along_axis(q, d[..., None], axis=2)[..., 0]
-                u = jax.random.uniform(r_acc, (B, gamma))
-                acc = u * q_d < p_d  # accept iff u < p/q (q>0 where sampled)
-                n_acc = jnp.sum(
-                    jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1
-                )
-                # Residual at the first rejection; bonus row at γ.
-                p_row = jnp.take_along_axis(
-                    p, n_acc[:, None, None], axis=1
-                )[:, 0]  # [B, V]
-                q_row = jnp.where(
-                    (n_acc < gamma)[:, None],
-                    jnp.take_along_axis(
-                        q, jnp.minimum(n_acc, gamma - 1)[:, None, None],
-                        axis=1,
-                    )[:, 0],
-                    jnp.zeros((B, V), jnp.float32),
-                )
-                resid = jnp.maximum(p_row - q_row, 0.0)
-                resid = resid / jnp.maximum(
-                    resid.sum(axis=-1, keepdims=True), 1e-30
-                )
-                t_new = jax.random.categorical(
-                    r_fix, jnp.log(jnp.maximum(resid, 1e-30)), axis=-1
-                ).astype(jnp.int32)
 
-            # Tokens row b commits this round (frozen rows commit none).
-            adv = jnp.where(done, 0, n_acc + 1)  # [B]
 
-            # ---- commit: window = [d_0..d_{n_acc-1}, t_new, junk...];
-            # the junk beyond n_acc is overwritten by the next round's
-            # window (or never read past the final pointer); frozen
-            # rows' windows land entirely past max_new_tokens.
-            window = jnp.where(
-                jnp.arange(gamma + 1)[None] == n_acc[:, None],
-                t_new[:, None],
-                jnp.concatenate([d, jnp.zeros((B, 1), jnp.int32)], axis=1),
-            )
-            out = jax.vmap(
-                lambda o, w, p0: lax.dynamic_update_slice(o, w, (p0,))
-            )(out, window, ptr)
+def make_tp_speculative_generate_fn(
+    target_model,
+    draft_model,
+    max_new_tokens: int,
+    mesh,
+    gamma: int = 4,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    quantize: str | None = None,
+    draft_quantize: str | None = None,
+    model_axis: str = "model",
+):
+    """Speculative decoding with a TENSOR-PARALLEL target: the whole
+    draft/verify/accept program runs inside one shard_map over
+    ``model_axis`` (the Megatron decode layout of
+    ``inference/generate.py::make_tp_generate_fn``).
 
-            # ---- cache rewinds (the free rollback): target holds the
-            # committed stream MINUS t_new; draft holds one token less.
-            # Frozen rows rewind the full γ+1 — their frontier is pinned.
-            delta = adv - (gamma + 1)  # [B], <= 0
-            back = delta if batched else delta[0]
-            tcache = dict(tvars["cache"])
-            tcache["idx"] = tcache["idx"] + back
-            dcache2 = dict(dcache2)
-            dcache2["idx"] = dcache2["idx"] + back
-            cur = jnp.where(done, cur, t_new)
-            return (tcache, dcache2, cur, out, ptr + adv, rng)
+    The TARGET runs at its LOCAL width (heads, KV cache, and d_ff ÷ tp;
+    ``tp_axis`` psums complete each row-parallel projection), so the
+    expensive verify pass — the reason TP serves the model at all —
+    is sharded exactly like plain TP decode.  The DRAFT is replicated:
+    it exists to be small, so sharding it would trade its whole matmul
+    for ICI latency γ times per round.  Acceptance, sampling, and the
+    round loop run replicated on every device (same rng ⇒ same
+    control flow ⇒ the emitted tokens are identical across devices).
 
-        def cond(state):
-            return jnp.any(state[4] < max_new_tokens)
+    ``target_params`` must be pre-arranged by
+    ``parallel.tensor_parallel.tp_decode_params``; draft params pass
+    through whole.  Output is token-exact vs single-device speculative
+    decoding (tested on the virtual mesh).
+    """
+    from jax.sharding import PartitionSpec as P
 
-        _, _, _, out, _, _ = lax.while_loop(cond, round_body, state)
-        return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
+    from distributed_machine_learning_tpu.inference.generate import (
+        tp_local_decode_clone,
+        tp_param_specs,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import (
+        shard_map_no_check,
+    )
+
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if target_model.vocab_size != draft_model.vocab_size:
+        raise ValueError(
+            f"target and draft must share a vocabulary (got "
+            f"{target_model.vocab_size} vs {draft_model.vocab_size})"
+        )
+    if draft_quantize not in (None, "int8"):
+        raise ValueError(
+            f"quantize must be None or 'int8', got {draft_quantize!r}"
+        )
+    # Layout rules + local-width clone shared with make_tp_generate_fn
+    # (inference/generate.py::tp_local_decode_clone) — quantize is
+    # validated there too.
+    local_target = tp_local_decode_clone(
+        target_model, mesh, model_axis, quantize
+    )
+    dm = draft_model.clone(attn_impl="dense", decode=True,
+                           weight_quant=draft_quantize)
+    from functools import partial
+
+    body = partial(_speculative_body, local_target, dm, max_new_tokens,
+                   gamma, temperature, top_k, top_p)
+
+    jitted: dict = {}
+
+    def run(tparams, dparams, prompt, rng):
+        key = (jax.tree_util.tree_structure(tparams),
+               jax.tree_util.tree_structure(dparams))
+        fn = jitted.get(key)
+        if fn is None:
+            dspecs = jax.tree_util.tree_map(lambda _: P(), dparams)
+            fn = jitted[key] = jax.jit(shard_map_no_check(
+                body,
+                mesh=mesh,
+                in_specs=(tp_param_specs(tparams, model_axis), dspecs,
+                          P(), P()),
+                out_specs=P(),
+            ))
+        return fn(tparams, dparams, prompt, rng)
 
     return run
