@@ -225,6 +225,25 @@ def main(argv=None) -> None:
         toks = [b % vocab for b in prompt_bytes] or [0]
     prompt = jnp.asarray(np.asarray(toks, np.int32)[None, :])
 
+    # Shared TP setup (one copy for the speculative and plain branches):
+    # device-count guard, the model-axis mesh, and the Megatron decode
+    # param arrangement.
+    mesh = None
+    if args.tp > 1:
+        from distributed_machine_learning_tpu.parallel.tensor_parallel import (  # noqa: E501
+            tp_decode_params,
+        )
+        from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+        if args.tp > jax.device_count():
+            raise ValueError(
+                f"--tp {args.tp} exceeds the device count "
+                f"{jax.device_count()} (the mesh uses the first tp "
+                "devices)"
+            )
+        mesh = make_mesh(args.tp, axis_names=("model",))
+        params = tp_decode_params(params, args.tp)
+
     if args.spec_gamma > 0:
         from distributed_machine_learning_tpu.inference.speculative import (
             make_speculative_generate_fn,
@@ -263,26 +282,12 @@ def main(argv=None) -> None:
             lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p,
             draft_params,
         )
-        if args.tp > 1:
-            from distributed_machine_learning_tpu.parallel.tensor_parallel import (  # noqa: E501
-                tp_decode_params,
-            )
-            from distributed_machine_learning_tpu.runtime.mesh import (
-                make_mesh,
-            )
-
-            if args.tp > jax.device_count():
-                raise ValueError(
-                    f"--tp {args.tp} exceeds the device count "
-                    f"{jax.device_count()}"
-                )
-            mesh = make_mesh(args.tp, axis_names=("model",))
+        if mesh is not None:
             spec_fn = make_tp_speculative_generate_fn(
                 model, draft, args.max_new_tokens, mesh,
                 gamma=args.spec_gamma, temperature=args.temperature,
                 top_k=args.top_k, top_p=args.top_p, quantize=args.quant,
             )
-            params = tp_decode_params(params, args.tp)
         else:
             spec_fn = make_speculative_generate_fn(
                 model, draft, args.max_new_tokens, gamma=args.spec_gamma,
@@ -292,28 +297,16 @@ def main(argv=None) -> None:
         # Same (params, prompt, key) signature as the other paths, so
         # the shared detokenize/print epilogue below serves all three.
         fn = lambda p, pr, k: spec_fn(p, draft_params, pr, k)
-    elif args.tp > 1:
+    elif mesh is not None:
         from distributed_machine_learning_tpu.inference.generate import (
             make_tp_generate_fn,
         )
-        from distributed_machine_learning_tpu.parallel.tensor_parallel import (  # noqa: E501
-            tp_decode_params,
-        )
-        from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 
-        if args.tp > jax.device_count():
-            raise ValueError(
-                f"--tp {args.tp} exceeds the device count "
-                f"{jax.device_count()} (the mesh uses the first tp "
-                "devices)"
-            )
-        mesh = make_mesh(args.tp, axis_names=("model",))
         fn = make_tp_generate_fn(
             model, args.max_new_tokens, mesh,
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, quantize=args.quant,
         )
-        params = tp_decode_params(params, args.tp)
     else:
         fn = make_generate_fn(model, args.max_new_tokens,
                               temperature=args.temperature,

@@ -102,6 +102,26 @@ def sampled_acceptance(d, q, p, u):
     return n_acc, resid
 
 
+def _validate_speculative_args(target_model, draft_model,
+                               max_new_tokens: int, gamma: int,
+                               quantize, draft_quantize) -> None:
+    """The speculative factories' shared contract — one copy, so the
+    single-device and TP entry points cannot drift."""
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if target_model.vocab_size != draft_model.vocab_size:
+        raise ValueError(
+            f"target and draft must share a vocabulary (got "
+            f"{target_model.vocab_size} vs {draft_model.vocab_size})"
+        )
+    for name, q in (("quantize", quantize),
+                    ("draft_quantize", draft_quantize)):
+        if q not in (None, "int8"):
+            raise ValueError(f"{name} must be None or 'int8', got {q!r}")
+
+
 def make_speculative_generate_fn(
     target_model,
     draft_model,
@@ -128,18 +148,8 @@ def make_speculative_generate_fn(
     8); the draft only changes HOW FAST tokens appear, never WHICH
     distribution they come from.
     """
-    if max_new_tokens < 1:
-        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if gamma < 1:
-        raise ValueError(f"gamma must be >= 1, got {gamma}")
-    if target_model.vocab_size != draft_model.vocab_size:
-        raise ValueError(
-            f"target and draft must share a vocabulary (got "
-            f"{target_model.vocab_size} vs {draft_model.vocab_size})"
-        )
-    for q in (quantize, draft_quantize):
-        if q not in (None, "int8"):
-            raise ValueError(f"quantize must be None or 'int8', got {q!r}")
+    _validate_speculative_args(target_model, draft_model, max_new_tokens,
+                               gamma, quantize, draft_quantize)
     tm = target_model.clone(attn_impl="dense", decode=True,
                             weight_quant=quantize)
     dm = draft_model.clone(attn_impl="dense", decode=True,
@@ -365,22 +375,10 @@ def make_tp_speculative_generate_fn(
         shard_map_no_check,
     )
 
-    if max_new_tokens < 1:
-        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if gamma < 1:
-        raise ValueError(f"gamma must be >= 1, got {gamma}")
-    if target_model.vocab_size != draft_model.vocab_size:
-        raise ValueError(
-            f"target and draft must share a vocabulary (got "
-            f"{target_model.vocab_size} vs {draft_model.vocab_size})"
-        )
-    if draft_quantize not in (None, "int8"):
-        raise ValueError(
-            f"quantize must be None or 'int8', got {draft_quantize!r}"
-        )
+    _validate_speculative_args(target_model, draft_model, max_new_tokens,
+                               gamma, quantize, draft_quantize)
     # Layout rules + local-width clone shared with make_tp_generate_fn
-    # (inference/generate.py::tp_local_decode_clone) — quantize is
-    # validated there too.
+    # (inference/generate.py::tp_local_decode_clone).
     local_target = tp_local_decode_clone(
         target_model, mesh, model_axis, quantize
     )
