@@ -142,9 +142,7 @@ def make_distill_step(student_model, teacher_model, kd_weight: float,
         )
         return new_state, (loss, kd, ce)
 
-    import jax as _jax
-
-    return _jax.jit(step, donate_argnums=(0,))
+    return jax.jit(step, donate_argnums=(0,))
 
 
 def main(argv=None) -> None:

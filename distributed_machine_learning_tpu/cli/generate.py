@@ -226,13 +226,12 @@ def main(argv=None) -> None:
     prompt = jnp.asarray(np.asarray(toks, np.int32)[None, :])
 
     # Shared TP setup (one copy for the speculative and plain branches):
-    # device-count guard, the model-axis mesh, and the Megatron decode
-    # param arrangement.
+    # device-count guard + the model-axis mesh.  The Megatron param
+    # arrangement (tp_decode_params) runs AFTER the factory below — the
+    # factories' divisibility validation (tp_local_decode_clone) must
+    # fire before any reshape touches the arrays.
     mesh = None
     if args.tp > 1:
-        from distributed_machine_learning_tpu.parallel.tensor_parallel import (  # noqa: E501
-            tp_decode_params,
-        )
         from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 
         if args.tp > jax.device_count():
@@ -242,7 +241,6 @@ def main(argv=None) -> None:
                 "devices)"
             )
         mesh = make_mesh(args.tp, axis_names=("model",))
-        params = tp_decode_params(params, args.tp)
 
     if args.spec_gamma > 0:
         from distributed_machine_learning_tpu.inference.speculative import (
@@ -312,6 +310,12 @@ def main(argv=None) -> None:
                               temperature=args.temperature,
                               top_k=args.top_k, top_p=args.top_p,
                               quantize=args.quant)
+    if mesh is not None:
+        from distributed_machine_learning_tpu.parallel.tensor_parallel import (  # noqa: E501
+            tp_decode_params,
+        )
+
+        params = tp_decode_params(params, args.tp)
     out = np.asarray(
         fn(params, prompt, jax.random.PRNGKey(args.seed))
     )[0, prompt.shape[1]:]

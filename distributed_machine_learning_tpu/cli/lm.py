@@ -137,6 +137,12 @@ def make_parser():
                    help="pipe-axis size for --parallel 3d")
     p.add_argument("--tp", default=2, type=int,
                    help="model-axis size for --parallel 3d")
+    p.add_argument("--zero1-dp", dest="zero1_dp", action="store_true",
+                   help="with --parallel 3d: shard the optimizer moments "
+                        "1/dp over the data axis (ZeRO-1 x 3-D, the 4th "
+                        "composed axis — parallel/parallel3d.py::"
+                        "p3_zero1_moment_spec); update-equivalent to "
+                        "plain 3d")
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"])
     from distributed_machine_learning_tpu.train.optimizers import (
@@ -247,6 +253,12 @@ def build(args):
         raise ValueError(
             "--ep-seq (MoE x context parallelism) applies to --parallel "
             f"ep only (got --parallel {args.parallel})"
+        )
+    if getattr(args, "zero1_dp", False) and args.parallel != "3d":
+        raise ValueError(
+            "--zero1-dp (ZeRO-1 x 3-D moment sharding) applies to "
+            f"--parallel 3d only (got --parallel {args.parallel}); the "
+            "standalone ZeRO-1 scheme is parallel/zero1.py"
         )
     cfg_kwargs = {}
     if args.lr is not None:
@@ -621,8 +633,12 @@ def build(args):
         )
     mesh = make_3d_mesh(dp, args.pp, args.tp)
     model = TransformerLM(**common)
-    step = make_3d_lm_train_step(model, mesh, args.microbatches)
-    state = shard_3d_state(init_pipeline_state(model, seed=SEED, config=opt_config), mesh)
+    step = make_3d_lm_train_step(model, mesh, args.microbatches,
+                                 zero1_dp=args.zero1_dp)
+    state = shard_3d_state(
+        init_pipeline_state(model, seed=SEED, config=opt_config), mesh,
+        zero1_dp=args.zero1_dp,
+    )
     place = lambda x, y: shard_3d_batch(mesh, *microbatch(x, y, args.microbatches))
     return step, state, place, model, lambda st: st.params
 

@@ -56,6 +56,7 @@ __all__ = [
     "MESH_AXES",
     "make_3d_mesh",
     "p3_param_spec",
+    "p3_zero1_moment_spec",
     "shard_3d_state",
     "make_3d_lm_train_step",
     "shard_3d_batch",
@@ -104,23 +105,74 @@ def p3_param_spec(
     return tp_spec_for(path, ndim, model_axis)
 
 
-def _state_shardings_3d(state: TrainState, mesh: Mesh) -> TrainState:
-    """NamedSharding pytree: params/momentum per ``p3_param_spec``,
-    scalar fields replicated."""
+def p3_zero1_moment_spec(
+    path: tuple[str, ...],
+    shape: tuple[int, ...],
+    dp: int,
+    data_axis: str = DATA_AXIS,
+) -> P:
+    """Optimizer-moment PartitionSpec under ZeRO-1 × 3-D: the param's
+    3-D spec (``p3_param_spec``) PLUS the data axis on the largest
+    dp-divisible still-unsharded dim — the moments are the state the dp
+    axis otherwise replicates dp-fold for nothing (a real pod LM run
+    wants ZeRO-1 on its data axis; VERDICT r4 item 8).  Leaves with no
+    divisible free dim replicate over dp, the O(d) minority (same
+    degrade rule as ``fsdp_perlayer.fsdp_pl_spec_for``).  Params are
+    NOT touched: every dp rank needs them whole each forward, and the
+    update's shard→replicated transition is exactly the all-gather
+    GSPMD inserts."""
+    if path and path[0] == "embed":
+        # Same exclusion (and reason) as p3_param_spec's embed rule: a
+        # dp-sharded embedding MOMENT forces the partitioner to push the
+        # vocab sharding up through the scatter-add gradient into the
+        # token gather, tripping the same SPMD-partitioner CHECK under
+        # partial-manual shard_map (observed from the cli.lm 3d
+        # --zero1-dp program).  O(V·E) — noise next to the block stack.
+        return P(*(None,) * len(shape))
+    base = tuple(p3_param_spec(path, len(shape)))
+    axes = list(base) + [None] * (len(shape) - len(base))
+    best = None
+    for i, d in enumerate(shape):
+        if axes[i] is None and d % dp == 0 and d >= dp and (
+            best is None or d > shape[best]
+        ):
+            best = i
+    if best is not None:
+        axes[best] = data_axis
+    return P(*axes)
+
+
+def _state_shardings_3d(
+    state: TrainState, mesh: Mesh, zero1_dp: bool = False
+) -> TrainState:
+    """NamedSharding pytree: params per ``p3_param_spec``; momentum the
+    same, or additionally dp-sharded (``p3_zero1_moment_spec``) when
+    ``zero1_dp``; scalar fields replicated."""
 
     def spec(path, leaf):
         keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
         return NamedSharding(mesh, p3_param_spec(keys, leaf.ndim))
+
+    def z1_spec(path, leaf):
+        keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
+        return NamedSharding(
+            mesh,
+            p3_zero1_moment_spec(keys, leaf.shape, mesh.shape[DATA_AXIS]),
+        )
 
     from distributed_machine_learning_tpu.train.optimizers import (
         moment_layout as _moment_layout,
     )
 
     param_shardings = jax.tree_util.tree_map_with_path(spec, state.params)
+    moment_base = (
+        jax.tree_util.tree_map_with_path(z1_spec, state.params)
+        if zero1_dp else param_shardings
+    )
     replicated = NamedSharding(mesh, P())
     return TrainState(
         params=param_shardings,
-        momentum=_moment_layout(param_shardings, state.params, state.momentum),
+        momentum=_moment_layout(moment_base, state.params, state.momentum),
         batch_stats=jax.tree_util.tree_map(lambda _: replicated, state.batch_stats),
         step=replicated,
         rng=replicated,
@@ -128,11 +180,15 @@ def _state_shardings_3d(state: TrainState, mesh: Mesh) -> TrainState:
     )
 
 
-def shard_3d_state(state: TrainState, mesh: Mesh) -> TrainState:
+def shard_3d_state(
+    state: TrainState, mesh: Mesh, zero1_dp: bool = False
+) -> TrainState:
     """Place a pipeline-layout TrainState (``init_pipeline_state``) into
-    the 3-D layout."""
+    the 3-D layout.  ``zero1_dp=True`` additionally shards the optimizer
+    moments 1/dp over the data axis (pass the same flag to
+    ``make_3d_lm_train_step``)."""
     return jax.tree_util.tree_map(
-        jax.device_put, state, _state_shardings_3d(state, mesh)
+        jax.device_put, state, _state_shardings_3d(state, mesh, zero1_dp)
     )
 
 
@@ -157,7 +213,8 @@ def shard_3d_batch(mesh: Mesh, tokens_mb, targets_mb):
 
 
 def make_3d_lm_train_step(
-    model: TransformerLM, mesh: Mesh, num_microbatches: int
+    model: TransformerLM, mesh: Mesh, num_microbatches: int,
+    zero1_dp: bool = False,
 ):
     """Build ``step(state, tokens_mb, targets_mb) -> (state, loss)``.
 
@@ -167,7 +224,15 @@ def make_3d_lm_train_step(
     size.  Reuses the pipeline step implementation unchanged — only the
     shard_map becomes partial-manual and the jit shardings add the
     batch/model dimensions.
-    """
+
+    ``zero1_dp=True`` (ZeRO-1 × 3-D, the 4th axis): the optimizer
+    moments live dp-sharded (``p3_zero1_moment_spec``; state placed
+    with the same flag).  The MANUAL pipe region is untouched — the
+    extra sharding enters purely through the jit in/out_shardings, so
+    GSPMD partitions the elementwise update to the moment shards and
+    inserts the dp all-gather where the updated params go back to
+    replicated; the update stays elementwise-exact, so the trajectory
+    equals plain 3-D (tested)."""
     if model.attn_impl in ("flash", "auto"):
         if model.flash_mesh is not None:
             raise ValueError(
@@ -209,7 +274,31 @@ def make_3d_lm_train_step(
     if num_microbatches < 1:
         raise ValueError("num_microbatches must be >= 1")
 
-    impl = partial(_pp_step_impl, model, pipe_axis=PIPE_AXIS, num_stages=pp)
+    grad_constraint = None
+    if zero1_dp:
+        def grad_constraint(grads):
+            # Barrier between backward and update: pin the grads to the
+            # PARAM sharding (pipe is manual inside the region — drop
+            # it from the spec), so the dp-sharded moment layout stops
+            # propagating up into the stacked-layer backward scatter
+            # (XLA SPMD-partitioner CHECK otherwise; see
+            # pp_grads_and_update).  GSPMD then reshards each grad down
+            # to its moment's dp shard at the update — a local slice.
+            def spec(path, leaf):
+                keys = tuple(
+                    k.key if hasattr(k, "key") else str(k) for k in path
+                )
+                full = tuple(p3_param_spec(keys, leaf.ndim))
+                axes = [None if a == PIPE_AXIS else a for a in full]
+                axes += [None] * (leaf.ndim - len(axes))
+                return P(*axes)
+
+            return jax.lax.with_sharding_constraint(
+                grads, jax.tree_util.tree_map_with_path(spec, grads)
+            )
+
+    impl = partial(_pp_step_impl, model, pipe_axis=PIPE_AXIS, num_stages=pp,
+                   grad_constraint=grad_constraint)
     batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS, None))
     jitted: dict = {}
 
@@ -228,7 +317,7 @@ def make_3d_lm_train_step(
             pipe_spec = _state_specs(PIPE_AXIS, state.params,
                                      state.momentum)
             pipe_spec = pipe_spec.replace(config=state.config)
-            shardings = _state_shardings_3d(state, mesh)
+            shardings = _state_shardings_3d(state, mesh, zero1_dp)
             fn = jitted[key] = jax.jit(
                 _shard_map(
                     impl,

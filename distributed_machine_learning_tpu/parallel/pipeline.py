@@ -251,7 +251,8 @@ def _reject_lars(config) -> None:
         )
 
 
-def pp_grads_and_update(state: TrainState, loss_fn, pipe_axis):
+def pp_grads_and_update(state: TrainState, loss_fn, pipe_axis,
+                        grad_constraint=None):
     """Shared back half of every jax.grad-scheduled pipeline step (GPipe
     and interleaved): differentiate the forward-loss, share the
     last-stage loss, psum the boundary-module grads, update.
@@ -262,7 +263,14 @@ def pp_grads_and_update(state: TrainState, loss_fn, pipe_axis):
     replication-checking off), and every replicated (non-"blocks") param
     — each stage holds a share that is zero unless it used the param —
     is summed here; stage-sharded blocks grads are already exact
-    locally."""
+    locally.
+
+    ``grad_constraint``: optional ``grads -> grads`` hook applied
+    between the backward and the update — the ZeRO-1 × 3-D step pins
+    the grads to the PARAM sharding here (a ``with_sharding_constraint``
+    barrier), so the dp-sharded moments' layout cannot propagate up
+    into the stacked-layer backward scatter (which trips an XLA SPMD
+    partitioner CHECK under the partial-manual shard_map)."""
     _reject_lars(state.config)
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
     loss = lax.psum(loss, pipe_axis)
@@ -270,6 +278,8 @@ def pp_grads_and_update(state: TrainState, loss_fn, pipe_axis):
         grads[name] = jax.tree_util.tree_map(
             lambda g: lax.psum(g, pipe_axis), grads[name]
         )
+    if grad_constraint is not None:
+        grads = grad_constraint(grads)
     new_params, new_momentum = update_fn_for_config(state.config)(
         state.params, state.momentum, grads, state.config, step=state.step
     )
@@ -280,7 +290,8 @@ def pp_grads_and_update(state: TrainState, loss_fn, pipe_axis):
 
 
 def _pp_step_impl(
-    model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis, num_stages
+    model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis,
+    num_stages, grad_constraint=None,
 ):
     loss_fn = partial(
         _pipeline_forward_loss,
@@ -290,7 +301,8 @@ def _pp_step_impl(
         pipe_axis=pipe_axis,
         num_stages=num_stages,
     )
-    return pp_grads_and_update(state, loss_fn, pipe_axis)
+    return pp_grads_and_update(state, loss_fn, pipe_axis,
+                               grad_constraint=grad_constraint)
 
 
 def _state_specs(

@@ -159,3 +159,105 @@ def test_3d_flash_matches_3d_dense():
                     jax.tree_util.tree_leaves(d_params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-6)
+
+
+def test_3d_zero1_dp_update_equivalence(batch):
+    """ZeRO-1 over the dp axis of 3-D (VERDICT r4 item 8): dp-sharded
+    optimizer moments take EXACTLY the plain-3-D step — elementwise
+    update on shards + GSPMD's all-gather cannot change the math."""
+    from distributed_machine_learning_tpu.train.adamw import AdamWConfig
+
+    x, y = batch
+    mesh = make_3d_mesh(2, 2, 2)
+    mx, my = shard_3d_batch(mesh, *microbatch(x, y, 2))
+
+    def run(zero1_dp):
+        state = shard_3d_state(
+            init_pipeline_state(MODEL, config=AdamWConfig()), mesh,
+            zero1_dp=zero1_dp,
+        )
+        step = make_3d_lm_train_step(MODEL, mesh, num_microbatches=2,
+                                     zero1_dp=zero1_dp)
+        losses = []
+        for _ in range(3):
+            state, loss = step(state, mx, my)
+            losses.append(float(loss))
+        return state, losses
+
+    plain_state, plain_losses = run(False)
+    z1_state, z1_losses = run(True)
+    np.testing.assert_allclose(z1_losses, plain_losses, rtol=1e-6)
+    # fp tolerance, not bitwise: the dp-sharded update re-partitions the
+    # grad reduction/all-gather, so reduction order shifts by ulps and
+    # AdamW's rsqrt amplifies them (measured: 1/16384 elements at
+    # |Δ|≈5e-6 after 3 steps).  A real layout slip (wrong slice, shard
+    # misalignment) would blow past this on MANY elements.
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plain_state.params),
+        jax.tree_util.tree_leaves(z1_state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=2e-5)
+    # The moments really live dp-sharded: every shardable leaf's spec
+    # carries the data axis (the memory claim, checked structurally).
+    def dp_sharded(arr):
+        return any(
+            ax == "batch" or (isinstance(ax, tuple) and "batch" in ax)
+            for ax in tuple(arr.sharding.spec)
+        )
+
+    # Every WEIGHT-MATRIX moment (>= 2 free-dim leaves; the memory) must
+    # be dp-sharded; small leaves with no free divisible dim (e.g. the
+    # column-parallel fc_in bias, already fully TP-sharded) may
+    # replicate — the documented O(d) minority.
+    sharded = [
+        dp_sharded(m)
+        for m in jax.tree_util.tree_leaves(z1_state.momentum)
+        if m.ndim >= 3  # stacked [L, ...] weight matrices
+    ]
+    assert sharded and all(sharded), "weight-moment leaves not dp-sharded"
+    assert not any(
+        dp_sharded(p)
+        for p in jax.tree_util.tree_leaves(z1_state.params)
+    ), "params must stay dp-replicated"
+
+
+def test_3d_zero1_moment_spec_rules():
+    from distributed_machine_learning_tpu.parallel.parallel3d import (
+        p3_zero1_moment_spec,
+    )
+
+    # Stacked block leaf: pipe on dim 0, model on the TP dim, dp lands
+    # on the largest FREE dp-divisible dim.
+    spec = p3_zero1_moment_spec(
+        ("blocks", "attn", "qkv", "kernel"), (2, 32, 3, 4, 8), dp=2
+    )
+    assert spec[0] == "pipe" and "batch" in tuple(spec)
+    # No free divisible dim -> dp replicated (spec unchanged).
+    spec2 = p3_zero1_moment_spec(("blocks", "attn", "qkv", "bias"),
+                                 (2, 3), dp=4)
+    assert "batch" not in tuple(spec2)
+
+
+def test_3d_zero1_dp_batch8_compiles_and_runs():
+    """Regression: at microbatch rows > 1 per dp shard the partitioner
+    used to hit an SPMD CHECK (the dp-sharded moment layout propagated
+    into the stacked-layer backward scatter) — the grad barrier in
+    pp_grads_and_update must keep this shape compiling."""
+    from distributed_machine_learning_tpu.train.adamw import AdamWConfig
+
+    rng = np.random.default_rng(7)
+    toks = rng.integers(0, 64, (8, 17))
+    mesh = make_3d_mesh(2, 2, 2)
+    mx, my = shard_3d_batch(
+        mesh, *microbatch(toks[:, :-1].astype(np.int32),
+                          toks[:, 1:].astype(np.int32), 2)
+    )
+    state = shard_3d_state(
+        init_pipeline_state(MODEL, config=AdamWConfig()), mesh,
+        zero1_dp=True,
+    )
+    step = make_3d_lm_train_step(MODEL, mesh, num_microbatches=2,
+                                 zero1_dp=True)
+    state, loss = step(state, mx, my)
+    assert np.isfinite(float(loss))
