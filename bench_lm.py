@@ -161,17 +161,31 @@ def bench_decode(args) -> None:
         make_generate_fn,
     )
 
-    model = TransformerLM(
-        vocab_size=args.vocab,
-        d_model=args.d_model,
-        n_layers=args.n_layers,
-        n_heads=args.n_heads,
-        n_kv_heads=args.n_kv_heads,
-        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
-        kv_cache_dtype=(
-            jnp.dtype(args.kv_cache_dtype) if args.kv_cache_dtype else None
-        ),
+    kv_dtype = (
+        jnp.dtype(args.kv_cache_dtype) if args.kv_cache_dtype else None
     )
+    if args.moe:
+        from distributed_machine_learning_tpu.models.moe import (
+            MoETransformerLM,
+        )
+
+        model = MoETransformerLM(
+            vocab_size=args.vocab, d_model=args.d_model,
+            n_layers=args.n_layers, n_heads=args.n_heads,
+            n_kv_heads=args.n_kv_heads, n_experts=args.n_experts,
+            compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+            kv_cache_dtype=kv_dtype,
+        )
+    else:
+        model = TransformerLM(
+            vocab_size=args.vocab,
+            d_model=args.d_model,
+            n_layers=args.n_layers,
+            n_heads=args.n_heads,
+            n_kv_heads=args.n_kv_heads,
+            compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+            kv_cache_dtype=kv_dtype,
+        )
     state = init_lm_state(model)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     master = state.params
@@ -235,6 +249,7 @@ def bench_decode(args) -> None:
             "prompt_len": args.prompt_len, "gen_tokens": args.gen_tokens,
             "bf16": args.bf16, "kv_cache_dtype": args.kv_cache_dtype,
             "quant": "int8" if args.quant else None,
+            "moe": args.n_experts if args.moe else None,
         },
     }))
 
@@ -252,10 +267,7 @@ def bench_decode(args) -> None:
             vocab_size=args.vocab, d_model=args.spec_draft_d_model,
             n_layers=args.spec_draft_n_layers, n_heads=args.n_heads,
             n_kv_heads=args.n_kv_heads, compute_dtype=dtype,
-            kv_cache_dtype=(
-                jnp.dtype(args.kv_cache_dtype) if args.kv_cache_dtype
-                else None
-            ),
+            kv_cache_dtype=kv_dtype,
         )
         dparams = _cast_params(init_lm_state(draft, seed=11).params, dtype)
 
@@ -340,6 +352,11 @@ def main() -> None:
     p.add_argument("--decode", action="store_true",
                    help="benchmark the KV-cached decode path instead of "
                         "the train step (prefill vs steady-state tok/s)")
+    p.add_argument("--moe", action="store_true",
+                   help="with --decode: serve a Switch-MoE model "
+                        "(dropless grouped expert path; composes with "
+                        "--quant int8 expert weights and --spec-gamma)")
+    p.add_argument("--n-experts", dest="n_experts", default=8, type=int)
     p.add_argument("--spec-gamma", dest="spec_gamma", default=0, type=int,
                    help="with --decode: ALSO measure speculative decoding "
                         "at this gamma with a random draft (the "
@@ -365,6 +382,11 @@ def main() -> None:
         raise ValueError(
             "--quant is a decode-path option (weight-only int8 serving); "
             "pass --decode with it — the train benches run full precision"
+        )
+    if args.moe and not args.decode:
+        raise ValueError(
+            "--moe here is a decode-path option (the MoE TRAIN benches "
+            "are cli.lm --parallel ep and bench/lm_sweep --scheme ep)"
         )
     if args.decode:
         bench_decode(args)

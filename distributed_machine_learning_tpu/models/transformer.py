@@ -143,6 +143,19 @@ def _cached_attention_quant(q, k_int, ks, v_int, vs, q_positions):
     return out.reshape(B, Lq, H, D).astype(q.dtype)
 
 
+# Two-tier int8-KV-cache dispatch (VERDICT r4 item 7; measured by
+# bench/int8_tier.py): when True, single-token int8 decode picks at
+# RUNTIME between the frontier-clamped Pallas kernel (early in the
+# stream, pos/S below the break-even — it reads O(pos) while the einsum
+# reads all S allocated slots) and the scale-folding einsum (late —
+# ~2.8x cheaper per byte).  Both branches live in the compiled program;
+# the flag exists so the compile cost and the early-phase win are
+# MEASURABLE rather than asserted — see the dispatch comment below for
+# the measured verdict that keeps the default False.
+_INT8_TIERED_DISPATCH = False
+_INT8_TIER_BREAK_EVEN_PCT = 36  # einsum wins from pos/S ≈ 0.36 up (r4)
+
+
 def _flash_wins(L: int) -> bool:
     """attn_impl="auto" policy — delegates to the kernel module's shared
     ``flash_wins`` length rule (docs/PERF.md r02 crossover table)."""
@@ -440,10 +453,32 @@ class Attention(nn.Module):
 
                     S_alloc = ck.value.shape[2]
                     if quant_cache:
-                        out = _cached_attention_quant(
-                            q, ck.value, cks.value, cv.value, cvs.value,
-                            positions,
-                        )
+                        if (
+                            _INT8_TIERED_DISPATCH
+                            and not batched_frontier
+                            and decode_flash_qualifies(S_alloc)
+                        ):
+                            # Runtime two-tier switch: kernel while the
+                            # cache is mostly empty, einsum once filled
+                            # past the break-even.  Gated off by default
+                            # (see _INT8_TIERED_DISPATCH).
+                            out = lax.cond(
+                                positions[0] * 100
+                                < S_alloc * _INT8_TIER_BREAK_EVEN_PCT,
+                                lambda q, ki, ks, vi, vs, p:
+                                    cached_flash_attention(
+                                        q, ki, vi, p[0],
+                                        k_scale=ks, v_scale=vs,
+                                    ),
+                                _cached_attention_quant,
+                                q, ck.value, cks.value, cv.value,
+                                cvs.value, positions,
+                            )
+                        else:
+                            out = _cached_attention_quant(
+                                q, ck.value, cks.value, cv.value,
+                                cvs.value, positions,
+                            )
                     elif (
                         not batched_frontier
                         and decode_flash_qualifies(S_alloc)

@@ -105,6 +105,12 @@ def p3_param_spec(
     return tp_spec_for(path, ndim, model_axis)
 
 
+def _path_keys(path) -> tuple:
+    """KeyPath → plain string keys — the ONE normalization the param,
+    moment, and grad spec builders all share."""
+    return tuple(k.key if hasattr(k, "key") else str(k) for k in path)
+
+
 def p3_zero1_moment_spec(
     path: tuple[str, ...],
     shape: tuple[int, ...],
@@ -150,11 +156,10 @@ def _state_shardings_3d(
     ``zero1_dp``; scalar fields replicated."""
 
     def spec(path, leaf):
-        keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
-        return NamedSharding(mesh, p3_param_spec(keys, leaf.ndim))
+        return NamedSharding(mesh, p3_param_spec(_path_keys(path), leaf.ndim))
 
     def z1_spec(path, leaf):
-        keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
+        keys = _path_keys(path)
         return NamedSharding(
             mesh,
             p3_zero1_moment_spec(keys, leaf.shape, mesh.shape[DATA_AXIS]),
@@ -285,10 +290,7 @@ def make_3d_lm_train_step(
             # pp_grads_and_update).  GSPMD then reshards each grad down
             # to its moment's dp shard at the update — a local slice.
             def spec(path, leaf):
-                keys = tuple(
-                    k.key if hasattr(k, "key") else str(k) for k in path
-                )
-                full = tuple(p3_param_spec(keys, leaf.ndim))
+                full = tuple(p3_param_spec(_path_keys(path), leaf.ndim))
                 axes = [None if a == PIPE_AXIS else a for a in full]
                 axes += [None] * (leaf.ndim - len(axes))
                 return P(*axes)

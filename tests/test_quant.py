@@ -233,3 +233,38 @@ def test_tp_decode_with_int8_kv_cache_token_exact(rng):
     fn = make_tp_generate_fn(model, 6, mesh)
     out = fn(tp_decode_params(params, 2), prompt, jax.random.PRNGKey(0))
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_int8_tiered_dispatch_token_exact(rng):
+    """The gated two-tier int8-cache dispatch (bench/int8_tier.py;
+    models/transformer.py::_INT8_TIERED_DISPATCH) must be semantics-
+    neutral: same greedy stream as the default einsum-only dispatch,
+    with the generation crossing the break-even so BOTH branches run.
+
+    Exact token equality is a property of THIS suite's platform (CPU,
+    interpret-mode kernel, f32 softmax in both paths); the kernel-vs-
+    einsum ulp differences that could flip a near-tied argmax on other
+    backends are the same shape-dependent ties the speculative
+    docstring documents — if this ever flakes off-CPU, compare
+    prefix-agreement rates instead of pinning bitwise."""
+    import distributed_machine_learning_tpu.models.transformer as tmod
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+    model = TransformerLM(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        kv_cache_dtype=jnp.int8,
+    )
+    params = init_lm_state(model).params
+    prompt = jnp.asarray(rng.integers(0, 64, (1, 6)), jnp.int32)
+    # 320 new tokens in a 512-slot cache: pos/S runs 0..0.64, crossing
+    # the 0.36 break-even — the lax.cond takes the kernel branch early
+    # and the einsum branch late.
+    ref = make_generate_fn(model, 320)(params, prompt, jax.random.PRNGKey(0))
+    tmod._INT8_TIERED_DISPATCH = True
+    try:
+        out = make_generate_fn(model, 320)(
+            params, prompt, jax.random.PRNGKey(0)
+        )
+    finally:
+        tmod._INT8_TIERED_DISPATCH = False
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
