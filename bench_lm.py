@@ -35,32 +35,12 @@ from distributed_machine_learning_tpu.train.lm_step import (
 TIMED_ITERS = 20
 
 
-def _cast_params(params, dtype):
-    """bf16 serving cast (f32 leaves only) — one definition for target
-    and draft params."""
-    return jax.tree_util.tree_map(
-        lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params
-    )
-
-
-def _two_point_dispatch(dispatch, fetch, reps, chain):
-    """The decode benches' shared timing harness: best-of-reps over
-    n chained dispatches closed by a host fetch, slope via
-    two_point_fit (cancels the tunnel RTT)."""
-    from distributed_machine_learning_tpu.bench.harness import two_point_fit
-
-    def timed(n_dispatches):
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            out = None
-            for _ in range(n_dispatches):
-                out = dispatch()
-            fetch(out)
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    return two_point_fit(timed, chain)
+# Shared with bench/spec_trained.py via the package harness (one copy
+# of the serving cast + chained-dispatch fit).
+from distributed_machine_learning_tpu.bench.harness import (  # noqa: E402
+    cast_serving_params as _cast_params,
+    two_point_dispatch as _two_point_dispatch,
+)
 
 
 def bench_one(attn: str, args) -> tuple[float, int]:

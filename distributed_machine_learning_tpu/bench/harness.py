@@ -40,6 +40,50 @@ def two_point_fit(timed, chain: int) -> float:
     return min(slope, tk / chain)
 
 
+def length_slope_fit(timed, n1: int, n2: int) -> float:
+    """Per-unit seconds from measurements at two WORK SIZES ``n1 < n2``
+    (scan lengths, generation lengths): slope ``(t2−t1)/(n2−n1)``
+    cancels every size-independent cost (dispatch RTT, prefill,
+    compile-warm residue).  Jitter guard mirrors :func:`two_point_fit`:
+    an impossible slope falls back to the overhead-inclusive average
+    ``t2/n2``."""
+    if not 0 < n1 < n2:
+        raise ValueError(f"need 0 < n1 < n2, got ({n1}, {n2})")
+    t1 = timed(n1)
+    t2 = timed(n2)
+    slope = (t2 - t1) / (n2 - n1)
+    avg = t2 / n2
+    return avg if slope <= 0 else min(slope, avg)
+
+
+def cast_serving_params(params, dtype):
+    """Serving cast (f32 leaves only → ``dtype``) — one definition for
+    every bench's target and draft params."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(dtype) if p.dtype == jax.numpy.float32 else p,
+        params,
+    )
+
+
+def two_point_dispatch(dispatch, fetch, reps: int, chain: int) -> float:
+    """The decode benches' shared timing harness: best-of-``reps`` over
+    n chained dispatches closed by one host fetch, per-dispatch seconds
+    via :func:`two_point_fit` (cancels the tunnel RTT)."""
+
+    def timed(n_dispatches):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n_dispatches):
+                out = dispatch()
+            fetch(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return two_point_fit(timed, chain)
+
+
 def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1, chain: int = 1):
     """Time ``len(imgs)`` train steps as one compiled scan.
 

@@ -15,9 +15,13 @@ the numbers for both sides of that call:
    dispatch _INT8_TIERED_DISPATCH enables) vs the single-path program,
    at a realistic layer count (the cond is traced per layer).
 
-Timing uses the two-point chained-dispatch fit (bench/harness.py) —
-single dispatches on the tunneled chip carry a 50-100 ms RTT that
-swamps µs-scale attention ops.
+Timing: the attention ops are µs-scale, far below even the VARIANCE of
+the tunnel's per-dispatch RTT, so each measurement runs N data-dependent
+iterations inside ONE jitted ``lax.scan`` (the step's output feeds the
+next step's query — nothing can be hoisted or elided) and the per-op
+time is the two-point slope over scan lengths (N vs 2N), which cancels
+the single dispatch+fetch round-trip.  The first cut of this bench used
+chained dispatches per op and read 100× RTT jitter, not op time.
 
 Run on the TPU::
 
@@ -36,26 +40,41 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _time_op(fn, *args, reps: int = 3, chain: int = 8):
-    """Per-call seconds via the two-point chained fit; args stay
-    device-resident."""
-    from distributed_machine_learning_tpu.bench.harness import two_point_fit
+def _time_op(op, q, *rest, reps: int = 3, iters: int = 200):
+    """Per-op seconds for ``op(q, *rest) -> array shaped like q``: N
+    data-dependent iterations inside one jitted scan (q threads
+    through), per-op time from the (N vs 2N)-scan slope — see the
+    module docstring for why chained dispatches cannot measure this."""
+    from jax import lax
 
-    out = fn(*args)
-    jax.block_until_ready(out)
+    def make(n):
+        @jax.jit
+        def run(q0, *r):
+            def body(qc, _):
+                return op(qc, *r).astype(q0.dtype), ()
+
+            qn, _ = lax.scan(body, q0, None, length=n)
+            return qn
+
+        return run
+
+    from distributed_machine_learning_tpu.bench.harness import (
+        length_slope_fit,
+    )
 
     def timed(n):
+        run = make(n)
+        jax.block_until_ready(run(q, *rest))  # compile + warm
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
-            o = None
-            for _ in range(n):
-                o = fn(*args)
-            np.asarray(jax.tree_util.tree_leaves(o)[0][..., 0])
+            np.asarray(run(q, *rest)[..., 0])  # fetch closes the timing
             best = min(best, time.perf_counter() - t0)
         return best
 
-    return two_point_fit(timed, chain)
+    # One slope fit for every bench (bench/harness.py): per-op seconds
+    # from the N-vs-2N scan lengths, jitter-guarded.
+    return length_slope_fit(timed, iters, 2 * iters)
 
 
 def bench_attention_ladder(s_alloc: int, fracs, hkv: int, rep: int,
@@ -82,26 +101,22 @@ def bench_attention_ladder(s_alloc: int, fracs, hkv: int, rep: int,
     ks = jnp.asarray(rng.random((B, hkv, s_alloc)) * 0.01, jnp.float32)
     vs = jnp.asarray(rng.random((B, hkv, s_alloc)) * 0.01, jnp.float32)
 
-    einsum_fn = jax.jit(
-        lambda q, ki, ks_, vi, vs_, pos: _cached_attention_quant(
-            q, ki, ks_, vi, vs_, pos
-        )
-    )
-    kernel_fn = jax.jit(
-        lambda q, ki, ks_, vi, vs_, p0: cached_flash_attention(
-            q, ki, vi, p0, k_scale=ks_, v_scale=vs_
-        )
-    )
+    def einsum_op(q_, ki, ks_, vi, vs_, pos):
+        return _cached_attention_quant(q_, ki, ks_, vi, vs_, pos)
+
+    def kernel_op(q_, ki, ks_, vi, vs_, p0):
+        return cached_flash_attention(q_, ki, vi, p0, k_scale=ks_,
+                                      v_scale=vs_)
 
     rows = []
     for frac in fracs:
         pos = max(1, int(s_alloc * frac) - 1)
         positions = jnp.asarray([pos], jnp.int32)
         p0 = jnp.asarray(pos, jnp.int32)
-        t_e = _time_op(einsum_fn, q, k_int, ks, v_int, vs, positions,
-                       reps=reps, chain=chain)
-        t_k = _time_op(kernel_fn, q, k_int, ks, v_int, vs, p0,
-                       reps=reps, chain=chain)
+        t_e = _time_op(einsum_op, q, k_int, ks, v_int, vs, positions,
+                       reps=reps, iters=chain)
+        t_k = _time_op(kernel_op, q, k_int, ks, v_int, vs, p0,
+                       reps=reps, iters=chain)
         rows.append({
             "pos_over_S": round(frac, 3), "pos": pos,
             "einsum_us": round(t_e * 1e6, 1),
@@ -173,7 +188,8 @@ def main() -> None:
                    help="query heads per KV head (GQA group)")
     p.add_argument("--head-dim", dest="head_dim", default=64, type=int)
     p.add_argument("--reps", default=3, type=int)
-    p.add_argument("--chain", default=8, type=int)
+    p.add_argument("--chain", default=200, type=int,
+               help="scan iterations per timed dispatch (per-op\n                    time is the N-vs-2N slope)")
     p.add_argument("--compile-layers", dest="compile_layers", default=8,
                    type=int)
     p.add_argument("--compile-d-model", dest="compile_d_model",

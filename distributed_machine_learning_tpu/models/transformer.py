@@ -146,14 +146,24 @@ def _cached_attention_quant(q, k_int, ks, v_int, vs, q_positions):
 # Two-tier int8-KV-cache dispatch (VERDICT r4 item 7; measured by
 # bench/int8_tier.py): when True, single-token int8 decode picks at
 # RUNTIME between the frontier-clamped Pallas kernel (early in the
-# stream, pos/S below the break-even — it reads O(pos) while the einsum
-# reads all S allocated slots) and the scale-folding einsum (late —
-# ~2.8x cheaper per byte).  Both branches live in the compiled program;
-# the flag exists so the compile cost and the early-phase win are
-# MEASURABLE rather than asserted — see the dispatch comment below for
-# the measured verdict that keeps the default False.
+# stream — it reads O(pos) while the einsum reads all S allocated
+# slots) and the scale-folding einsum (late).  Measured r5 on-chip at
+# S_alloc=32k (Hkv=8, D=64; 2000-iteration scanned slope):
+#   - einsum: FLAT ~60 µs at every fill; kernel: 20 µs at pos/S=0.05
+#     growing to 305 µs at 0.95 — crossover at pos/S ≈ 0.19 (r4's 0.36
+#     estimate assumed the kernel 2.8× costlier per byte; it measures
+#     ~5×, its exact-f32 dequant off the DMA roofline);
+#   - compile cost of the tiered program (8L, 32k-token generate):
+#     +4.6-5 s (11.3 s vs 6.7 s warm cache; 20.4 vs 15.7 cold).
+# Verdict: default OFF — over any run-to-completion generation the
+# mean fill is >= 0.5, so the sub-0.19 phase is ~1-2% end-to-end, not
+# worth 5 s compile per serving shape.  Flip it ON for the workload the
+# numbers DO favor: serving that allocates a generous max_new_tokens
+# and usually stops early (fill stays below the crossover all request:
+# up to ~39 µs/layer/step back, ~0.3 ms/step on an 8L model — the
+# attention share drops ~3x).
 _INT8_TIERED_DISPATCH = False
-_INT8_TIER_BREAK_EVEN_PCT = 36  # einsum wins from pos/S ≈ 0.36 up (r4)
+_INT8_TIER_BREAK_EVEN_PCT = 19  # measured crossover (bench/int8_tier.py)
 
 
 def _flash_wins(L: int) -> bool:
@@ -426,21 +436,32 @@ class Attention(nn.Module):
                     # - int8 caches: ALWAYS the scale-folding einsum
                     #   (_cached_attention_quant) — XLA fuses the s8
                     #   convert into the dot, so HBM reads int8 bytes,
-                    #   and it beats the kernel ~2.7-2.9× at every S
-                    #   tested (2k/8k/32k: 29/103/217 µs vs
-                    #   83/282/612) since the kernel's exact-f32
-                    #   dequant took it off its DMA-bound point.
-                    #   Caveat, priced in: the einsum reads all S
+                    #   and it beats the kernel at any filled cache
+                    #   (the kernel's exact-f32 dequant takes it off
+                    #   its DMA-bound point).  Numbers: r5's scanned-
+                    #   slope bench (bench/int8_tier.py — the r4
+                    #   figures of 29/103/217 µs vs 83/282/612 came
+                    #   from chained dispatches, which that bench
+                    #   showed carry tunnel-RTT jitter into µs ops;
+                    #   direction right, absolutes superseded)
+                    #   measures the einsum flat ~60 µs at 32k alloc
+                    #   vs the kernel's O(pos) 20→305 µs ladder —
+                    #   einsum from pos/S ≈ 0.19 of the ALLOCATION up.
+                    #   Caveat, priced in AND measured (r5,
+                    #   bench/int8_tier.py): the einsum reads all S
                     #   ALLOCATED slots while the kernel's frontier
-                    #   clamp reads O(pos) — but at ~2.8× cheaper per
-                    #   byte the einsum loses only while pos/S < 0.36,
-                    #   and the mean of pos/S over ANY full generation
-                    #   is (Lp/S + 1)/2 ≥ 0.5, so the einsum wins
-                    #   integrated over every workload shape (a
-                    #   dynamic-length slice is impossible under
-                    #   static shapes; a tiered lax.switch is not
-                    #   worth its compile cost for a transient early
-                    #   phase);
+                    #   clamp reads O(pos) — measured crossover at
+                    #   pos/S ≈ 0.19 (einsum flat ~60 µs at 32k alloc;
+                    #   kernel 20→305 µs across the fill ladder), and
+                    #   the mean of pos/S over ANY full generation is
+                    #   (Lp/S + 1)/2 ≥ 0.5, so the einsum wins
+                    #   integrated over every run-to-completion shape.
+                    #   The tiered lax.cond alternative costs a
+                    #   measured +4.6-5 s compile per serving shape
+                    #   for a ~1-2% end-to-end win — kept available as
+                    #   _INT8_TIERED_DISPATCH (above) for the one
+                    #   workload that inverts the math: generous
+                    #   max_new allocations that usually stop early;
                     # - long bf16/f32 caches (≥4k): the flash-decode
                     #   kernel (frontier-clamped O(pos) reads);
                     # - short bf16/f32 caches: the head-major einsum
