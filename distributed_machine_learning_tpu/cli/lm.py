@@ -85,6 +85,11 @@ def make_parser():
                         "an explicit token all_to_all to expert owners "
                         "(batch shards over data x expert; no attention "
                         "duplication)")
+    p.add_argument("--ep-slots", dest="ep_slots", default=None, type=int,
+                   help="grouped-EP send slots per owner device (default "
+                        "N_local = provably dropless; lower bounds the "
+                        "dispatch all-to-all bytes at Switch-style "
+                        "per-owner overflow drops -- ops/grouped.py)")
     p.add_argument("--ep-seq", dest="ep_seq", default=1, type=int,
                    help="sequence-axis size for MoE x context parallelism "
                         "(--parallel ep --moe-impl grouped only): shards "
@@ -253,6 +258,13 @@ def build(args):
         raise ValueError(
             "--ep-seq (MoE x context parallelism) applies to --parallel "
             f"ep only (got --parallel {args.parallel})"
+        )
+    if getattr(args, "ep_slots", None) is not None and not (
+        args.parallel == "ep" and args.moe_impl == "grouped"
+    ):
+        raise ValueError(
+            "--ep-slots applies to --parallel ep --moe-impl grouped only "
+            f"(got --parallel {args.parallel}, --moe-impl {args.moe_impl})"
         )
     if getattr(args, "zero1_dp", False) and args.parallel != "3d":
         raise ValueError(
@@ -500,12 +512,15 @@ def build(args):
                     n, ("batch", "expert", "seq"), (dp, ep, sp)
                 )
                 step = make_ep_grouped_train_step(
-                    model, mesh, seq_axis="seq"
+                    model, mesh, seq_axis="seq",
+                    slots_per_owner=args.ep_slots,
                 )
                 batch_spec = P(("batch", "expert"), "seq")
             else:
                 mesh = make_mesh(n, ("batch", "expert"), (dp, ep))
-                step = make_ep_grouped_train_step(model, mesh)
+                step = make_ep_grouped_train_step(
+                    model, mesh, slots_per_owner=args.ep_slots
+                )
                 batch_spec = P(("batch", "expert"), None)
             state = shard_ep_state(
                 init_moe_state(model, seed=SEED, config=opt_config), mesh

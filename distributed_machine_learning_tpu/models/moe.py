@@ -57,6 +57,11 @@ class MoEMLP(nn.Module):
     # — numerically the same aux the unsharded model computes.
     expert_axis: str | None = None
     token_axes: tuple = ()
+    # Manual-EP send-slot bound (ADVICE r4; ops/grouped.py): None =
+    # N_local slots per owner (provably dropless, ~ep× the useful
+    # all-to-all rows on a balanced router); an int bounds the wire
+    # bytes at Switch-style per-owner overflow drops.
+    ep_slots_per_owner: int | None = None
     # Dropless routing regardless of capacity_factor.  Serving sets
     # this: Switch's capacity drop is a TRAINING-time load-balancing
     # mechanism whose drop pattern depends on the batch shape — a
@@ -99,6 +104,13 @@ class MoEMLP(nn.Module):
                 "weight_quant is a serving feature (int8 experts are not "
                 "trainable); it requires the dropless serving path "
                 "(decode=True — inference/generate.py clones it on)"
+            )
+        if self.ep_slots_per_owner is not None and self.expert_axis is None:
+            raise ValueError(
+                "ep_slots_per_owner bounds the manual-EP dispatch "
+                "all-to-all; it requires expert_axis (the shard_map EP "
+                "path) — without it the grouped path is dropless and "
+                "the bound would be silently ignored"
             )
         if self.weight_quant is not None and self.expert_axis is not None:
             raise NotImplementedError(
@@ -184,6 +196,7 @@ class MoEMLP(nn.Module):
             y = grouped_expert_mlp_ep(
                 tokens.astype(dt), expert_idx, w_in, b_in, w_out, b_out,
                 expert_axis=self.expert_axis, n_experts_global=E,
+                slots_per_owner=self.ep_slots_per_owner,
             )
             y = y * expert_prob[:, None].astype(dt)
             return y.reshape(B, T, D)
@@ -269,6 +282,7 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
             moe_impl=model.moe_impl,
             expert_axis=model.expert_axis,
             token_axes=model.token_axes,
+            ep_slots_per_owner=model.ep_slots_per_owner,
             # Serving routes dropless (see MoEMLP.dropless), through the
             # grouped sort+ragged_dot compute path.
             dropless=model.decode,
@@ -309,6 +323,8 @@ class MoETransformerLM(nn.Module):
     # the model with these set; user code leaves them None/().
     expert_axis: str | None = None
     token_axes: tuple = ()
+    # Manual-EP send-slot bound (see ``MoEMLP.ep_slots_per_owner``).
+    ep_slots_per_owner: int | None = None
     # Grouped-query attention (see ``transformer.Attention``); None =
     # classic MHA with the fused qkv layout.
     n_kv_heads: int | None = None

@@ -202,7 +202,9 @@ def grouped_expert_mlp_ep(
     expert_axis: str,
     n_experts_global: int,
     activation=jax.nn.gelu,
-) -> jax.Array:
+    slots_per_owner: int | None = None,
+    return_dropped: bool = False,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Dropless routed expert MLP under REAL expert parallelism.
 
     Must run inside a ``shard_map`` with ``expert_axis`` bound (fully
@@ -237,6 +239,17 @@ def grouped_expert_mlp_ep(
     router).  Reference: the all-to-all pattern is Switch/GShard
     dispatch (SURVEY.md §2.3 marks EP absent in the reference — this
     is beyond-parity capability).
+
+    ``slots_per_owner`` (ADVICE r4): by default S = N_local send slots
+    per owner — provably dropless, but the all-to-all moves ep·N_local
+    rows (~ep× the useful rows on a balanced router).  Setting S lower
+    (e.g. ``2·N_local/ep``) bounds the wire bytes and matmul padding at
+    the cost of Switch-style drops: a token whose within-owner rank
+    exceeds S gets ZERO output (residual pass-through) and zero
+    gradients — the same overflow semantics as einsum capacity, applied
+    per OWNER at the transport instead of per expert.
+    ``return_dropped=True`` additionally returns the local dropped-row
+    count (int32 scalar) for monitoring.
     """
     ep = lax.axis_size(expert_axis)
     e_local = w_in.shape[0]
@@ -246,17 +259,32 @@ def grouped_expert_mlp_ep(
             f"n_experts_global {n_experts_global}"
         )
     n, d = tokens.shape
-    S = n  # per-owner send slots: provably overflow-free
+    if slots_per_owner is not None and not 1 <= slots_per_owner <= n:
+        raise ValueError(
+            f"slots_per_owner must be in [1, N_local={n}], got "
+            f"{slots_per_owner} (None = dropless N_local slots)"
+        )
+    S = n if slots_per_owner is None else slots_per_owner
     e0 = lax.axis_index(expert_axis) * e_local
 
     owner = expert_idx // e_local  # [N] destination device on the axis
     oh = jax.nn.one_hot(owner, ep, dtype=jnp.int32)
     rank = jnp.sum(jnp.cumsum(oh, axis=0) * oh, axis=1) - 1  # within-owner
-    slot = owner * S + rank  # unique in [0, ep*S)
-
-    send = _scatter_rows(tokens, slot, ep * S)  # [ep*S, D]
-    # Expert ids ride beside the rows; -1 marks never-written slots.
-    send_ids = jnp.full((ep * S,), -1, jnp.int32).at[slot].set(expert_idx)
+    # ONE dispatch form for both modes (tested bitwise-equal at ample
+    # slots): overflowing rows — impossible when S = N_local, since
+    # rank < n always — route to a TRASH slot past the buffer; the
+    # [:ep*S] slice discards it, so (a) receivers never see them and
+    # (b) the slice's transpose zeroes their cotangent.  _scatter_rows'
+    # unique-slot contract is violated only at the trash slot, whose
+    # value and cotangent are both dead.  Expert ids ride beside the
+    # rows; -1 marks never-written slots.
+    valid = rank < S
+    slot = jnp.where(valid, owner * S + rank, ep * S)
+    send = _scatter_rows(tokens, slot, ep * S + 1)[:ep * S]
+    send_ids = jnp.full((ep * S + 1,), -1, jnp.int32).at[slot].set(
+        expert_idx
+    )[:ep * S]
+    n_dropped = jnp.sum((~valid).astype(jnp.int32))
     recv = lax.all_to_all(
         send.reshape(ep, S, d), expert_axis, 0, 0, tiled=False
     ).reshape(ep * S, d)
@@ -288,4 +316,12 @@ def grouped_expert_mlp_ep(
     back = lax.all_to_all(
         ys.reshape(ep, S, d), expert_axis, 0, 0, tiled=False
     ).reshape(ep * S, d)
-    return _gather_rows(back, slot, ep * S)
+    # Dropped rows gather the appended zero row (their slot is the
+    # trash index ep*S): zero output, and the concat transpose discards
+    # the trash cotangent — zero gradients, matching the forward's
+    # pass-through semantics.  (Unbounded: no row points at the trash
+    # index, so the appended zero row is inert — the single code path
+    # the ample-slots test pins bitwise against the r4 form.)
+    back = jnp.concatenate([back, jnp.zeros((1, d), back.dtype)])
+    y = _gather_rows(back, slot, ep * S + 1)
+    return (y, n_dropped) if return_dropped else y

@@ -91,3 +91,19 @@ def test_ep_flag_guards():
     with pytest.raises(ValueError, match="mlp only"):
         main(TINY + ["--parallel", "ep", "--n-experts", "4", "--remat",
                      "--remat-policy", "block"])
+
+
+def test_lm_cli_ep_slots_flag_discipline():
+    with pytest.raises(ValueError, match="ep-slots"):
+        main(TINY + ["--parallel", "dp", "--ep-slots", "4"])
+    with pytest.raises(ValueError, match="ep-slots"):
+        main(TINY + ["--parallel", "ep", "--moe-impl", "einsum",
+                     "--ep-slots", "4"])
+
+
+def test_lm_cli_ep_grouped_bounded_slots_runs(capsys):
+    main(TINY + ["--parallel", "ep", "--moe-impl", "grouped",
+                 "--n-experts", "4", "--ep", "4", "--ep-slots", "8",
+                 "--batch-size", "8"])
+    out = capsys.readouterr().out
+    assert "Total execution time" in out

@@ -578,3 +578,157 @@ def test_moe_speculative_with_int8_target():
                                       quantize="int8")
     out = fn(qt, dparams, prompt, jax.random.PRNGKey(0))
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_ep_bounded_slots_matches_dropless_when_ample(batch):
+    """slots_per_owner = N_local (ample) must take EXACTLY the dropless
+    default's step: the trash-slot machinery is inert when nothing
+    overflows (ADVICE r4 — capacity-bounded EP dispatch)."""
+    from distributed_machine_learning_tpu.parallel.expert_parallel import (
+        make_ep_grouped_train_step,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tokens, targets = batch
+    mesh = make_mesh(4, axis_names=("batch", "expert"), axis_shape=(2, 2))
+    sharding = NamedSharding(mesh, P(("batch", "expert"), None))
+    x = jax.device_put(jnp.asarray(tokens), sharding)
+    y = jax.device_put(jnp.asarray(targets), sharding)
+    n_local = tokens.shape[0] * tokens.shape[1] // 4
+
+    model = tiny_moe(moe_impl="grouped")
+    losses = {}
+    for slots in (None, n_local):
+        state = shard_ep_state(init_moe_state(model), mesh)
+        step = make_ep_grouped_train_step(model, mesh,
+                                          slots_per_owner=slots)
+        state, loss = step(state, x, y)
+        losses[slots] = (float(loss), state.params)
+    np.testing.assert_allclose(losses[None][0], losses[n_local][0],
+                               rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(losses[None][1]),
+                    jax.tree_util.tree_leaves(losses[n_local][1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ep_bounded_slots_overflow_drops_with_zero_grads():
+    """Adversarial routing into a tiny slot bound: overflowing rows get
+    ZERO expert output (residual pass-through) and ZERO gradients;
+    surviving rows are exact vs the dropless path.  Exercised at the op
+    level under shard_map so the trash-slot scatter/gather VJPs are the
+    thing being tested."""
+    from distributed_machine_learning_tpu.ops.grouped import (
+        grouped_expert_mlp_ep,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import (
+        make_mesh as _mk,
+        shard_map_no_check,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    ep, n_local, d, dff, e_global = 2, 8, 4, 8, 4
+    mesh = _mk(2, axis_names=("expert",))
+    rng = np.random.default_rng(5)
+    tokens = jnp.asarray(rng.standard_normal((ep * n_local, d)), jnp.float32)
+    # ALL tokens route to expert 0 (owner device 0) — with S=2 slots,
+    # each sender keeps 2 rows and drops the rest.
+    eidx = jnp.zeros((ep * n_local,), jnp.int32)
+    w_in = jnp.asarray(rng.standard_normal((e_global // ep, d, dff)),
+                       jnp.float32)
+    b_in = jnp.zeros((e_global // ep, dff))
+    w_out = jnp.asarray(rng.standard_normal((e_global // ep, dff, d)),
+                        jnp.float32)
+    b_out = jnp.zeros((e_global // ep, d))
+
+    def run(slots):
+        def f(t, ei, wi, bi, wo, bo):
+            out = grouped_expert_mlp_ep(
+                t, ei, wi, bi, wo, bo, expert_axis="expert",
+                n_experts_global=e_global, slots_per_owner=slots,
+                return_dropped=slots is not None,
+            )
+            if slots is None:
+                return out
+            y, nd = out
+            return y, nd[None]  # rank >= 1 for the out_specs
+
+        spec = (P("expert"),) * 6
+        out_spec = (P("expert"), P("expert")) if slots is not None \
+            else P("expert")
+        return jax.jit(shard_map_no_check(
+            f, mesh=mesh, in_specs=spec, out_specs=out_spec,
+        ))(tokens, eidx, jnp.concatenate([w_in, w_in]),
+           jnp.concatenate([b_in, b_in]),
+           jnp.concatenate([w_out, w_out]),
+           jnp.concatenate([b_out, b_out]))
+
+    y_full = run(None)
+    y_bounded, dropped = run(2)
+    dropped = np.asarray(dropped)
+    # Each of the 2 senders dropped all but 2 of its 8 rows.
+    assert dropped.sum() == 2 * (n_local - 2), dropped
+    yb = np.asarray(y_bounded)
+    yf = np.asarray(y_full)
+    # Surviving rows (within-owner rank < 2 per sender): exact; dropped
+    # rows: exactly zero.
+    for s in range(ep):
+        lo = s * n_local
+        np.testing.assert_allclose(yb[lo:lo + 2], yf[lo:lo + 2],
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(yb[lo + 2:lo + n_local], 0.0)
+
+    # Gradients: dropped rows' token grads are exactly zero; surviving
+    # rows' match the dropless path.
+    def loss(slots):
+        def f(t, ei, wi, bi, wo, bo):
+            out = grouped_expert_mlp_ep(
+                t, ei, wi, bi, wo, bo, expert_axis="expert",
+                n_experts_global=e_global, slots_per_owner=slots,
+            )
+            return jnp.sum(out * out)
+
+        spec = (P("expert"),) * 6
+        fn = shard_map_no_check(
+            lambda *a: jax.lax.psum(f(*a), "expert"), mesh=mesh,
+            in_specs=spec, out_specs=P(),
+        )
+        return jax.jit(jax.grad(fn))(
+            tokens, eidx, jnp.concatenate([w_in, w_in]),
+            jnp.concatenate([b_in, b_in]),
+            jnp.concatenate([w_out, w_out]),
+            jnp.concatenate([b_out, b_out]))
+
+    g_full = np.asarray(loss(None))
+    g_bounded = np.asarray(loss(2))
+    for s in range(ep):
+        lo = s * n_local
+        np.testing.assert_allclose(g_bounded[lo:lo + 2],
+                                   g_full[lo:lo + 2], rtol=1e-5)
+        np.testing.assert_array_equal(g_bounded[lo + 2:lo + n_local], 0.0)
+
+
+def test_ep_bounded_slots_guards():
+    from distributed_machine_learning_tpu.ops.grouped import (
+        grouped_expert_mlp_ep,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import (
+        make_mesh as _mk,
+        shard_map_no_check,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mk(2, axis_names=("expert",))
+
+    def f(t, ei, wi, bi, wo, bo):
+        return grouped_expert_mlp_ep(
+            t, ei, wi, bi, wo, bo, expert_axis="expert",
+            n_experts_global=4, slots_per_owner=99,
+        )
+
+    with pytest.raises(ValueError, match="slots_per_owner"):
+        jax.jit(shard_map_no_check(
+            f, mesh=mesh, in_specs=(P("expert"),) * 6,
+            out_specs=P("expert"),
+        ))(jnp.zeros((8, 4)), jnp.zeros((8,), jnp.int32),
+           jnp.zeros((4, 4, 8)), jnp.zeros((4, 8)),
+           jnp.zeros((4, 8, 4)), jnp.zeros((4, 4)))
