@@ -174,15 +174,15 @@ def bench_decode(args) -> None:
     # master params after the cast) resident is the difference between
     # the f32-cache 32k config fitting the 16 GB chip or OOMing.
     del state
-    if args.quant:
-        # Weight-only int8 serving: quantize from the f32 master params.
-        from distributed_machine_learning_tpu.ops.quant import (
-            quantize_lm_params,
-        )
+    # Shared serving pipeline (bench/harness.py): int8 quantization from
+    # the f32 master params, or the compute-dtype cast.
+    from distributed_machine_learning_tpu.bench.harness import (
+        prepare_serving_params,
+    )
 
-        params = quantize_lm_params(master)
-    else:
-        params = _cast_params(master, dtype)
+    params = prepare_serving_params(
+        master, "int8" if args.quant else None, dtype
+    )
     del master
     params = jax.block_until_ready(params)
     rng = np.random.default_rng(0)

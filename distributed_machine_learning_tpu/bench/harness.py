@@ -65,6 +65,22 @@ def cast_serving_params(params, dtype):
     )
 
 
+def prepare_serving_params(master, quant: str | None, dtype=None):
+    """The serving param pipeline every decode bench shares: int8
+    quantization from the f32 master params (``quant="int8"``), or the
+    compute-dtype cast.  One copy (bench_lm.py, bench/spec_trained.py)
+    so the benches can never measure different pipelines."""
+    if quant == "int8":
+        from distributed_machine_learning_tpu.ops.quant import (
+            quantize_lm_params,
+        )
+
+        return quantize_lm_params(master)
+    return cast_serving_params(
+        master, dtype if dtype is not None else jax.numpy.bfloat16
+    )
+
+
 def two_point_dispatch(dispatch, fetch, reps: int, chain: int) -> float:
     """The decode benches' shared timing harness: best-of-``reps`` over
     n chained dispatches closed by one host fetch, per-dispatch seconds

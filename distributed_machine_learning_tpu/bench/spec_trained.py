@@ -89,11 +89,19 @@ def main() -> None:
     p.add_argument("--batches", default="1,8")
     p.add_argument("--reps", default=3, type=int)
     p.add_argument("--chain", default=4, type=int)
+    p.add_argument("--quant", action="store_true",
+                   help="serve the TARGET weight-only int8 (the draft "
+                        "stays bf16 — it is small and runs the most "
+                        "steps per round, latency-bound not weight-"
+                        "bound); composes with batched speculation")
+    p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype", default=None,
+                   help="decode cache storage dtype for BOTH models")
     args = p.parse_args()
 
     from distributed_machine_learning_tpu.bench.harness import (
         cast_serving_params,
         length_slope_fit,
+        prepare_serving_params,
         two_point_dispatch,
     )
 
@@ -111,19 +119,23 @@ def main() -> None:
         TransformerLM,
     )
 
+    kv_dtype = (
+        jnp.dtype(args.kv_cache_dtype) if args.kv_cache_dtype else None
+    )
     target = TransformerLM(
         vocab_size=VOCAB_SIZE, d_model=args.d_model,
         n_layers=args.n_layers, n_heads=args.n_heads,
         n_kv_heads=args.n_kv_heads, compute_dtype=jnp.bfloat16,
+        kv_cache_dtype=kv_dtype,
     )
     draft = TransformerLM(
         vocab_size=VOCAB_SIZE, d_model=args.draft_d_model,
         n_layers=args.draft_n_layers, n_heads=args.draft_n_heads,
-        compute_dtype=jnp.bfloat16,
+        compute_dtype=jnp.bfloat16, kv_cache_dtype=kv_dtype,
     )
-    tparams = cast_serving_params(
-        _restore_lm_params(args.target_ckpt_dir, args.n_layers),
-        jnp.bfloat16,
+    quant = "int8" if args.quant else None
+    tparams = prepare_serving_params(
+        _restore_lm_params(args.target_ckpt_dir, args.n_layers), quant
     )
     dparams = cast_serving_params(
         _restore_lm_params(args.draft_ckpt_dir, args.draft_n_layers),
@@ -151,12 +163,12 @@ def main() -> None:
     # retrace every call — the first cut of this bench did exactly
     # that and read compile-cache jitter as negative slopes).
     def vanilla_fn(n, **warp):
-        g = make_generate_fn(target, n, **warp)
+        g = make_generate_fn(target, n, quantize=quant, **warp)
         return lambda pr, k: g(tparams, pr, k)
 
     def spec_fn(n, gamma, **warp):
         g = make_speculative_generate_fn(target, draft, n, gamma=gamma,
-                                         **warp)
+                                         quantize=quant, **warp)
         return lambda pr, k: g(tparams, dparams, pr, k)
 
     for batch in (int(b) for b in args.batches.split(",")):
@@ -165,6 +177,7 @@ def main() -> None:
         print(json.dumps({
             "metric": "spec_trained_vanilla_tokens_per_sec",
             "value": round(batch / t_van, 1), "batch": batch,
+            "quant": quant, "kv_cache_dtype": args.kv_cache_dtype,
             "per_sequence_tokens_per_sec": round(1 / t_van, 1),
             "ms_per_step": round(t_van * 1e3, 3),
         }), flush=True)
@@ -175,7 +188,8 @@ def main() -> None:
             print(json.dumps({
                 "metric": "spec_trained_tokens_per_sec",
                 "value": round(batch / t_spec, 1), "batch": batch,
-                "gamma": gamma,
+                "gamma": gamma, "quant": quant,
+                "kv_cache_dtype": args.kv_cache_dtype,
                 "per_sequence_tokens_per_sec": round(1 / t_spec, 1),
                 "vs_vanilla": round(t_van / t_spec, 3),
             }), flush=True)
@@ -188,7 +202,8 @@ def main() -> None:
     t_spec = slope(lambda n: spec_fn(n, 4, **warp), prompt)
     print(json.dumps({
         "metric": "spec_trained_sampled_tokens_per_sec",
-        "value": round(1 / t_spec, 1), "gamma": 4, **warp,
+        "value": round(1 / t_spec, 1), "gamma": 4, "quant": quant,
+        "kv_cache_dtype": args.kv_cache_dtype, **warp,
         "plain_sampled_tokens_per_sec": round(1 / t_plain, 1),
         "vs_plain_sampled": round(t_plain / t_spec, 3),
     }), flush=True)
