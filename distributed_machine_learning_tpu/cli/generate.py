@@ -169,12 +169,6 @@ def main(argv=None) -> None:
             MoETransformerLM,
         )
 
-        if args.tp > 1:
-            raise ValueError(
-                "--moe serving composes with --quant and --spec-gamma "
-                "but not --tp (the manual Megatron decode shard_map has "
-                "no expert layout)"
-            )
         model = MoETransformerLM(
             vocab_size=vocab, d_model=args.d_model,
             n_layers=args.n_layers, n_heads=args.n_heads,
@@ -248,7 +242,6 @@ def main(argv=None) -> None:
             make_tp_speculative_generate_fn,
         )
 
-        # (--moe x --tp was already rejected by the --moe branch above.)
         # The draft is a plain dense LM even for an MoE target — it only
         # proposes; the target's verify pass owns the distribution.  It
         # shares --kv-cache-dtype: the draft runs the most decode steps,

@@ -81,6 +81,13 @@ class MoEMLP(nn.Module):
     # routing decisions are argmax ties waiting to happen, and its
     # [D, E] matmul has no bandwidth to win.
     weight_quant: str | None = None
+    # Manual Megatron TP for DECODE (make_tp_generate_fn's shard_map):
+    # this module is then configured at its LOCAL expert width
+    # (d_ff = F/tp — the column/row split applied per expert), the
+    # router runs replicated (identical routing on every device), and
+    # the psum below completes the per-expert row-parallel w_out
+    # (b_out pre-divided by tp — tp_decode_params).  Serving-only.
+    tp_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -116,6 +123,17 @@ class MoEMLP(nn.Module):
             raise NotImplementedError(
                 "int8 expert serving is single-host (no manual-EP "
                 "shard_map decode path exists to quantize)"
+            )
+        if self.tp_axis is not None and not self.dropless:
+            raise ValueError(
+                "tp_axis is the manual TP-decode wiring (serving only); "
+                "training-time expert parallelism is the EP step "
+                "(parallel/expert_parallel.py)"
+            )
+        if self.tp_axis is not None and self.expert_axis is not None:
+            raise NotImplementedError(
+                "TP decode and manual-EP shard_map do not compose (one "
+                "shard_map program each); shard experts' d_ff via tp"
             )
         B, T, D = x.shape
         N = B * T
@@ -215,6 +233,14 @@ class MoEMLP(nn.Module):
                 w_in_scale=w_in_scale, w_out_scale=w_out_scale,
             )
             y = y * expert_prob[:, None].astype(dt)
+            if self.tp_axis is not None:
+                # Megatron's second g-collective, per expert: w_out is
+                # row-parallel over the local d_ff slice (b_out and the
+                # router-prob scale commute with the sum — both are
+                # identical across devices).
+                from jax import lax
+
+                y = lax.psum(y, self.tp_axis)
             return y.reshape(B, T, D)
 
         # Position of each token within its expert's queue; drop overflow.
@@ -274,6 +300,10 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
         # Attention projections follow the same int8 serving story as
         # the dense LM (ops/quant.py::QuantDenseGeneral).
         weight_quant=model.weight_quant,
+        # Manual TP decode: attention psums ride the shared Block
+        # wiring; head_dim pins the GLOBAL per-head width.
+        tp_axis=model.tp_axis,
+        head_dim=model.head_dim,
         mlp_factory=lambda: MoEMLP(
             n_experts=model.n_experts,
             d_ff=model.d_ff or 4 * model.d_model,
@@ -287,6 +317,7 @@ def _moe_block(model: "MoETransformerLM", name: str) -> "nn.Module":
             # grouped sort+ragged_dot compute path.
             dropless=model.decode,
             weight_quant=model.weight_quant,
+            tp_axis=model.tp_axis,
             name="moe",
         ),
         name=name,
@@ -343,6 +374,12 @@ class MoETransformerLM(nn.Module):
     # Per-row cache frontiers (batched speculative decoding) — same
     # contract as ``TransformerLM.decode_batched_frontier``.
     decode_batched_frontier: bool = False
+    # Manual Megatron TP for DECODE (``tp_local_decode_clone`` sets
+    # these): attention heads/KV cache and every expert's d_ff shard
+    # over the model axis; embed/router/lm_head/LayerNorms replicate.
+    # Same contract as ``TransformerLM.tp_axis``/``head_dim``.
+    tp_axis: str | None = None
+    head_dim: int | None = None
     # "int8" = weight-only quantized serving (decode only): attention
     # projections and the lm_head through QuantDenseGeneral, expert
     # weights through the scale-folded ragged_dot (``MoEMLP``); params
@@ -358,6 +395,12 @@ class MoETransformerLM(nn.Module):
                 "weight_quant is a serving-decode feature (int8 weights "
                 "are not trainable); clone with decode=True — "
                 "inference/generate.py does this"
+            )
+        if self.tp_axis is not None and not self.decode:
+            raise ValueError(
+                "tp_axis is the manual TP-decode wiring "
+                "(make_tp_generate_fn); training-time parallelism for "
+                "MoE is the EP step (parallel/expert_parallel.py)"
             )
         seq_sharded = self.seq_axis in self.token_axes
         if self.attn_impl not in SEQ_LOCAL_ATTN_IMPLS and not seq_sharded:

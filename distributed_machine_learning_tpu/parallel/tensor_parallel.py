@@ -179,6 +179,25 @@ def tp_decode_spec_for(
     m = model_axis
     col_parallel = module in ("qkv", "q", "kv", "fc_in")
     row_parallel = module in ("out", "fc_out")
+    if "router" in path:
+        # MoE router: tiny f32 [D, E] matmul whose argmax decides the
+        # routing — replicated so every device routes identically.
+        return P(*(None,) * ndim)
+    if module == "moe":
+        # Expert leaves shard their d_ff dim over the model axis — the
+        # Megatron column/row split applied per expert (w_in/w_in_q
+        # [E, D, F] column-parallel on F, w_out/w_out_q [E, F, D]
+        # row-parallel on F); b_out is the row-parallel bias
+        # (replicated, pre-divided by tp); w_out_scale is per-OUT-
+        # channel [E, D], applied to each partial sum — commutes with
+        # the psum, so replicated.
+        return {
+            "w_in": P(None, None, m), "w_in_q": P(None, None, m),
+            "b_in": P(None, m), "w_in_scale": P(None, m),
+            "w_out": P(None, m, None), "w_out_q": P(None, m, None),
+            "b_out": P(*(None,) * ndim),
+            "w_out_scale": P(*(None,) * ndim),
+        }.get(leaf, P(*(None,) * ndim))
     if leaf == "w_q":
         if col_parallel:
             return P(None, m)
@@ -241,6 +260,10 @@ def tp_decode_params(params, tp: int, model_axis: str = MODEL_AXIS):
                 node = {**node, "w_q": w_q, "scale": scale}
             if name in ("out", "fc_out") and "bias" in node:
                 node = {**node, "bias": node["bias"] / tp}
+            if name == "moe" and "b_out" in node:
+                # Expert row-parallel bias — the model's psum over the
+                # tp partial sums reassembles it (same trick as fc_out).
+                node = {**node, "b_out": node["b_out"] / tp}
             return {k: walk(k, v) for k, v in node.items()}
         return node
 
