@@ -10,6 +10,7 @@ kept verbatim (north-star): ``--master-ip`` (default ``127.0.1.1:8000``),
 from __future__ import annotations
 
 import argparse
+import contextlib
 
 import jax
 
@@ -85,11 +86,46 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                              "AsyncCheckpointWriter) — training continues "
                              "while the save serializes; the run waits for "
                              "the last save before exiting")
-    parser.add_argument("--resume", action="store_true",
+    parser.add_argument("--resume", nargs="?", const="latest", default=None,
+                        choices=["latest", "auto"],
                         help="resume weights/optimizer/step from the latest "
                              "complete checkpoint in --ckpt-dir; the run then "
                              "trains --epochs further epochs (the epoch count "
-                             "is not offset by prior progress)")
+                             "is not offset by prior progress).  '--resume "
+                             "auto' additionally supervises the run: on a "
+                             "stall, crash, or preemption it restores the "
+                             "newest complete checkpoint and continues, up "
+                             "to --max-restarts times (runtime/supervisor.py)")
+    parser.add_argument("--max-restarts", dest="max_restarts", default=3,
+                        type=int,
+                        help="with --resume auto: restore-and-continue this "
+                             "many times before giving up (default 3)")
+    parser.add_argument("--keep-last-n", dest="keep_last_n", default=None,
+                        type=int,
+                        help="garbage-collect all but the newest N complete "
+                             "checkpoints after each save (supervised long "
+                             "runs checkpoint often; default keeps "
+                             "everything).  The newest complete checkpoint "
+                             "is never deleted")
+    parser.add_argument("--guard-nonfinite", dest="guard_nonfinite",
+                        action="store_true",
+                        help="compile a non-finite-gradient guard into the "
+                             "train step: a NaN/Inf gradient skips that "
+                             "update (state unchanged, step not counted) "
+                             "instead of poisoning the params; skips are "
+                             "counted in the resilience summary")
+    parser.add_argument("--loader-retries", dest="loader_retries", default=0,
+                        type=int,
+                        help="retry the training data iterator this many "
+                             "times on exceptions (exponential backoff; a "
+                             "batch failing twice is skipped — "
+                             "data/retry.py); 0 disables")
+    parser.add_argument("--faults", default=None, type=str,
+                        help="deterministic fault injection spec, e.g. "
+                             "'nan@2,raise@4,stall@7:2.5,kill_ckpt@1' "
+                             "(runtime/faults.py; also read from the "
+                             "DML_FAULTS env var); chaos-testing only, "
+                             "off by default")
     parser.add_argument("--trace-dir", default=None, type=str,
                         help="write a jax.profiler trace of the training "
                              "loop here (view with TensorBoard/Perfetto)")
@@ -211,6 +247,23 @@ def parse_flags(parser: argparse.ArgumentParser, argv=None) -> argparse.Namespac
     args = parser.parse_args(argv)
     if args.resume and not args.ckpt_dir:
         parser.error("--resume requires --ckpt-dir")
+    if args.max_restarts < 0:
+        parser.error(f"--max-restarts must be >= 0, got {args.max_restarts}")
+    if args.keep_last_n is not None and args.keep_last_n < 1:
+        parser.error(f"--keep-last-n must be >= 1, got {args.keep_last_n}")
+    if args.loader_retries < 0:
+        parser.error(
+            f"--loader-retries must be >= 0, got {args.loader_retries}"
+        )
+    if args.faults:
+        from distributed_machine_learning_tpu.runtime.faults import (
+            FaultInjector,
+        )
+
+        try:  # validate the spec at parse time, before any runtime spin-up
+            FaultInjector.parse(args.faults)
+        except ValueError as e:
+            parser.error(f"--faults: {e}")
     if args.clip_norm is not None and args.clip_norm <= 0:
         parser.error(f"--clip-norm must be positive, got {args.clip_norm}")
     if args.grad_accum < 1:
@@ -254,11 +307,15 @@ def run_part(
     under one sync strategy."""
     import jax.numpy as jnp
 
+    from distributed_machine_learning_tpu.runtime.faults import FaultEvents
+
     metrics = MetricsLogger() if args.metrics_file else None
     ctx = initialize_from_flags(args.master_ip, args.rank, args.num_nodes)
     preemption = None
     watchdog = None
     ckpt_writer = None
+    events = FaultEvents()
+    show_resilience = False
     try:
         distributed = strategy_name != "none"
         mesh = make_mesh() if distributed else None
@@ -299,7 +356,14 @@ def run_part(
             return broadcast_bn_stats(st, world) if unsync_bn else st
 
         state = _maybe_stack(state)
-        if args.resume:
+
+        def restore_latest(fresh_state):
+            """State from the newest complete checkpoint in --ckpt-dir
+            (or ``fresh_state`` when none exists).  Factored so the
+            supervised mode (--resume auto) can re-run it after every
+            restart — the auto-resume leg of the skip/retry/restart
+            ladder."""
+            state = fresh_state
             from distributed_machine_learning_tpu.train.checkpoint import (
                 checkpoint_config,
                 latest_checkpoint,
@@ -402,6 +466,10 @@ def run_part(
                     state = jax.device_put(
                         state, NamedSharding(mesh, PartitionSpec())
                     )
+            return state
+
+        if args.resume:
+            state = restore_latest(state)
         strategy_kwargs = dict(strategy_kwargs or {})
         if args.wire_dtype and strategy_name == "ring":
             strategy_kwargs["wire_dtype"] = args.wire_dtype
@@ -429,6 +497,7 @@ def run_part(
             sync_bn=not unsync_bn,
             local_loss=bool(getattr(args, "local_loss", False))
             and mesh is not None,
+            guard_nonfinite=bool(getattr(args, "guard_nonfinite", False)),
         )
         eval_step = make_eval_step(model)
         if unsync_bn and state.batch_stats:
@@ -496,12 +565,49 @@ def run_part(
                 )
 
         place = (lambda i, l: shard_batch(mesh, i, l)) if mesh is not None else None
+        from distributed_machine_learning_tpu.runtime.faults import (
+            FaultInjector,
+        )
         from distributed_machine_learning_tpu.runtime.resilience import (
             PreemptionHandler,
             Watchdog,
             agree_stop,
             periodic_agree_stop,
         )
+
+        supervised = args.resume == "auto"
+        injector = FaultInjector.from_flags(
+            getattr(args, "faults", None), seed=SEED,
+            horizon=max(args.max_iters, 2),
+        )
+        mid_save = (
+            injector.mid_save_hook(events) if injector is not None else None
+        )
+        if (injector is not None and args.async_ckpt
+                and injector.has_kind("kill_ckpt")):
+            # The async writer defers the config file past the orbax
+            # commit, so there is no synchronous "between state and
+            # config" window to kill in — the fault would silently never
+            # fire, which is worse than refusing.
+            raise ValueError(
+                "kill_ckpt faults require the synchronous checkpoint "
+                "path (drop --async-ckpt)"
+            )
+        retry_policy = None
+        if getattr(args, "loader_retries", 0):
+            from distributed_machine_learning_tpu.data.retry import (
+                RetryPolicy,
+            )
+
+            retry_policy = RetryPolicy(max_retries=args.loader_retries)
+        show_resilience = (
+            supervised or injector is not None
+            or bool(getattr(args, "guard_nonfinite", False))
+            or bool(getattr(args, "loader_retries", 0))
+        )
+        # Per-step fault accounting costs a host sync per step; only pay
+        # it when some robustness feature can actually produce events.
+        loop_events = events if show_resilience else None
 
         preemption = PreemptionHandler().install()
         # Multi-host: every host must leave the step loop at the SAME
@@ -510,75 +616,179 @@ def run_part(
         # would tax every step with an allgather); the epoch tail agrees
         # unconditionally.
         in_loop_stop = periodic_agree_stop(lambda: preemption.requested)
-        if args.watchdog_timeout:
+        if args.watchdog_timeout and not supervised:
             watchdog = Watchdog(timeout_s=args.watchdog_timeout).start()
-        for _ in range(args.epochs):
+        # Epochs completed across supervised restarts: a restart resumes
+        # from the per-epoch checkpoint, so finished epochs stay done.
+        progress = {"epochs": 0}
+
+        def make_epoch_batches():
+            import itertools
+
             if distributed:
-                batches = dist_loader_cls(train_set, per_rank_batch, world)
+                base = dist_loader_cls(train_set, per_rank_batch, world)
             else:
-                batches = loader_cls(train_set, per_rank_batch)
-            if watchdog is not None:
-                # Reset the timer at the epoch boundary so the first
-                # step's XLA compile gets the full timeout window instead
-                # of whatever is left from the setup phase above.
-                watchdog.beat()
-            with trace(args.trace_dir):
-                state, _ = train_epoch(
-                    train_step, state, batches, place_batch=place,
-                    max_iters=args.max_iters, metrics=metrics,
-                    stop=in_loop_stop, watchdog=watchdog,
-                )
-            # One agreed decision governs the whole epoch tail — eval,
-            # checkpoint, and loop exit must diverge on NO host.
-            stopping = agree_stop(preemption.requested)
-            if not stopping:
-                eval_batches = BatchLoader(
-                    test_set, getattr(args, "eval_batch_size", EVAL_BATCH)
-                )
-                if args.eval_batches is not None:
-                    import itertools
+                base = loader_cls(train_set, per_rank_batch)
+            # Fault steps index the run's global batch ordinal; epochs
+            # are --max-iters batches under the reference protocol.
+            epoch_base = progress["epochs"] * args.max_iters
 
-                    eval_batches = itertools.islice(
-                        iter(eval_batches), args.eval_batches
+            def source(pos):
+                # Seekable by re-slicing: every loader here is
+                # deterministic, so skipping `pos - epoch_base` batches
+                # replays the exact stream (data/retry.py's contract).
+                it = itertools.islice(iter(base), pos - epoch_base, None)
+                if injector is not None:
+                    it = injector.wrap_batches(it, events, start=pos)
+                return it
+
+            if retry_policy is not None:
+                from distributed_machine_learning_tpu.data.retry import (
+                    retry_batches,
+                )
+
+                return retry_batches(
+                    source, retry_policy, events, start=epoch_base
+                )
+            return source(epoch_base)
+
+        def run_epochs(state, wd):
+            """The per-epoch train/eval/checkpoint cycle; returns
+            (state, stopped_early)."""
+            nonlocal ckpt_writer
+            while progress["epochs"] < args.epochs:
+                batches = make_epoch_batches()
+                if wd is not None:
+                    # Reset the timer at the epoch boundary so the first
+                    # step's XLA compile gets the full timeout window
+                    # instead of whatever is left from the setup phase.
+                    wd.beat()
+                with trace(args.trace_dir):
+                    state, _ = train_epoch(
+                        train_step, state, batches, place_batch=place,
+                        max_iters=args.max_iters, metrics=metrics,
+                        stop=in_loop_stop, watchdog=wd,
+                        events=loop_events,
                     )
-                if watchdog is not None:
-                    # Eval time is not step time — beat on the way IN so
-                    # a long eval (including its own compile) starts with
-                    # a full window, and again on the way out so the next
-                    # phase does too.
-                    watchdog.beat()
-                evaluate(eval_step, state, eval_batches)
-                if watchdog is not None:
-                    watchdog.beat()
-            if args.ckpt_dir:
-                from distributed_machine_learning_tpu.train.checkpoint import (
-                    AsyncCheckpointWriter,
-                    save_checkpoint,
-                )
+                # One agreed decision governs the whole epoch tail —
+                # eval, checkpoint, and loop exit must diverge on NO host.
+                stopping = agree_stop(preemption.requested)
+                if not stopping:
+                    eval_batches = BatchLoader(
+                        test_set, getattr(args, "eval_batch_size", EVAL_BATCH)
+                    )
+                    if args.eval_batches is not None:
+                        import itertools
 
-                if watchdog is not None:
-                    # Same on the way into the (possibly long, blocking)
-                    # checkpoint write as out of it.
-                    watchdog.beat()
-                if args.async_ckpt:
-                    if ckpt_writer is None:
-                        ckpt_writer = AsyncCheckpointWriter()
-                    path = ckpt_writer.save(args.ckpt_dir, state)
-                    rank0_print(f"Saving checkpoint to {path} (async)")
-                else:
-                    path = save_checkpoint(args.ckpt_dir, state)
-                    rank0_print(f"Saved checkpoint to {path}")
-                if watchdog is not None:
-                    watchdog.beat()
-            if stopping:
-                rank0_print(
-                    "preemption checkpoint complete; exiting cleanly "
-                    "(resume with --resume)"
-                    if args.ckpt_dir
-                    else "stop requested; exiting (no --ckpt-dir, so no "
-                         "checkpoint was written)"
+                        eval_batches = itertools.islice(
+                            iter(eval_batches), args.eval_batches
+                        )
+                    # Eval time is not step time: suspend the stall
+                    # clock so a long eval (including its own compile)
+                    # can't be declared a stall — under --resume auto a
+                    # declared stall costs a restart.
+                    with (wd.suspend() if wd is not None
+                          else contextlib.nullcontext()):
+                        evaluate(eval_step, state, eval_batches)
+                if args.ckpt_dir:
+                    from distributed_machine_learning_tpu.train.checkpoint import (  # noqa: E501
+                        AsyncCheckpointWriter,
+                        save_checkpoint,
+                    )
+
+                    # Same for the (possibly long, blocking) checkpoint
+                    # write: not step time — stop the stall clock.
+                    with (wd.suspend() if wd is not None
+                          else contextlib.nullcontext()):
+                        if args.async_ckpt:
+                            if ckpt_writer is None:
+                                ckpt_writer = AsyncCheckpointWriter()
+                            path = ckpt_writer.save(
+                                args.ckpt_dir, state,
+                                keep_last_n=getattr(args, "keep_last_n",
+                                                    None),
+                            )
+                            rank0_print(
+                                f"Saving checkpoint to {path} (async)"
+                            )
+                        else:
+                            path = save_checkpoint(
+                                args.ckpt_dir, state, mid_save_hook=mid_save,
+                                keep_last_n=getattr(args, "keep_last_n",
+                                                    None),
+                            )
+                            rank0_print(f"Saved checkpoint to {path}")
+                if stopping:
+                    events.preemptions += 1
+                    rank0_print(
+                        "preemption checkpoint complete; exiting cleanly "
+                        "(resume with --resume)"
+                        if args.ckpt_dir
+                        else "stop requested; exiting (no --ckpt-dir, so no "
+                             "checkpoint was written)"
+                    )
+                    return state, True
+                progress["epochs"] += 1
+            return state, False
+
+        if supervised:
+            # --resume auto: the supervised ladder — on a stall, crash,
+            # or injected death, restore the newest complete checkpoint
+            # and continue where the per-epoch progress left off, up to
+            # --max-restarts times (runtime/supervisor.py).
+            from distributed_machine_learning_tpu.runtime.supervisor import (
+                RaisingWatchdog,
+                run_attempts,
+            )
+
+            def attempt(restart_idx):
+                s = state
+                if restart_idx > 0:
+                    if ckpt_writer is not None:
+                        # Flush the async writer's pending config before
+                        # looking for the newest complete checkpoint:
+                        # without this, the last scheduled save is still
+                        # invisible to latest_checkpoint and the restart
+                        # would silently drop an epoch of finished work.
+                        try:
+                            ckpt_writer.wait()
+                        except Exception:
+                            pass  # torn save stays incomplete; restore
+                            # falls back to the previous complete one
+                    s = restore_latest(_maybe_stack(
+                        init_model_and_state(model, config=opt_config)
+                    ))
+                    # Re-derive finished-epoch progress from what was
+                    # actually RESTORED, never from the in-memory
+                    # counter: if the newest complete checkpoint is
+                    # older than the counter says (torn async save,
+                    # kill mid-write), trusting the counter would
+                    # silently drop the un-checkpointed epochs.
+                    # Rounds down under guard-skipped steps — an epoch
+                    # is re-run rather than skipped, which only costs
+                    # time, not correctness.
+                    progress["epochs"] = min(
+                        args.epochs,
+                        int(jax.device_get(s.step))
+                        // max(args.max_iters, 1),
+                    )
+                wd = (
+                    RaisingWatchdog(args.watchdog_timeout, events).start()
+                    if args.watchdog_timeout
+                    else None
                 )
-                break
+                try:
+                    out, _ = run_epochs(s, wd)
+                    return out
+                finally:
+                    if wd is not None:
+                        wd.stop()
+
+            state = run_attempts(
+                attempt, max_restarts=args.max_restarts, events=events
+            )
+        else:
+            state, _ = run_epochs(state, watchdog)
     finally:
         # Flush in finally so a crash/interrupt mid-run keeps the rows
         # already logged — the feature's main use is diagnosing bad runs.
@@ -591,6 +801,14 @@ def run_part(
             ckpt_writer.close()
         if preemption is not None:
             preemption.uninstall()
+        if show_resilience:
+            # Printed even on a crashed run (in finally): the counters
+            # are the diagnosis — silent robustness is no robustness.
+            from distributed_machine_learning_tpu.utils.summary import (
+                resilience_summary,
+            )
+
+            rank0_print(resilience_summary(events))
         if metrics is not None:
             metrics.save(args.metrics_file)
             rank0_print(

@@ -117,10 +117,32 @@ def make_parser():
                         "All schemes except the flat-vector fsdp (whose "
                         "FSDPState is not a TrainState; use fsdp_pl for "
                         "checkpointable ZeRO-3)")
-    p.add_argument("--resume", action="store_true",
+    p.add_argument("--resume", nargs="?", const="latest", default=None,
+                   choices=["latest", "auto"],
                    help="restore the latest checkpoint in --ckpt-dir "
                         "before training (same scheme + optimizer as "
-                        "the save)")
+                        "the save).  '--resume auto' supervises the run: "
+                        "a crash restores the newest complete checkpoint "
+                        "and retrains, up to --max-restarts times "
+                        "(runtime/supervisor.py; coarse-grained here — "
+                        "the LM path checkpoints once, at the end)")
+    p.add_argument("--max-restarts", dest="max_restarts", default=3, type=int,
+                   help="with --resume auto: restore-and-retry this many "
+                        "times before giving up")
+    p.add_argument("--guard-nonfinite", dest="guard_nonfinite",
+                   action="store_true",
+                   help="compile a non-finite-gradient guard into the "
+                        "train step: a NaN/Inf gradient skips that update "
+                        "(state unchanged, step not counted); "
+                        "dp/ring/ulysses schemes")
+    p.add_argument("--loss-scale", dest="loss_scale", default="none",
+                   choices=["none", "dynamic"],
+                   help="'dynamic' enables dynamic loss scaling for the "
+                        "bf16 path (train/lm_step.py): loss multiplied by "
+                        "an adaptive scale before backward, gradients "
+                        "unscaled after; overflow skips the update and "
+                        "halves the scale, 200 consecutive good steps "
+                        "double it; dp/ring/ulysses schemes")
     p.add_argument("--pp-schedule", dest="pp_schedule", default="1f1b",
                    choices=["1f1b", "gpipe", "interleaved"],
                    help="pipeline schedule (pp only): 1f1b interleaves "
@@ -292,6 +314,18 @@ def build(args):
             "fsdp_pl steps only (tp shards the lm_head, pp computes the "
             "loss on the last stage)"
         )
+    guard = bool(getattr(args, "guard_nonfinite", False))
+    dynamic_scale = getattr(args, "loss_scale", "none") == "dynamic"
+    if (guard or dynamic_scale) and args.parallel not in (
+        "dp", "ring", "ulysses"
+    ):
+        # Same pre-dispatch discipline as --pp-chunks: a robustness flag
+        # the chosen step doesn't implement must fail loudly, not
+        # silently train unguarded.
+        raise ValueError(
+            "--guard-nonfinite/--loss-scale apply to the replicated "
+            f"dp/ring/ulysses steps only (got --parallel {args.parallel})"
+        )
 
     if args.parallel in ("dp", "ring", "ulysses"):
         from distributed_machine_learning_tpu.train.lm_step import (
@@ -346,7 +380,9 @@ def build(args):
             model = TransformerLM(**{**common, "attn_impl": impl})
         state = init_lm_state(model, seed=SEED, config=opt_config)
         step = make_lm_train_step(model, mesh=mesh,
-                                  fused_ce_chunks=args.fused_ce_chunks)
+                                  fused_ce_chunks=args.fused_ce_chunks,
+                                  guard_nonfinite=guard,
+                                  dynamic_scale=dynamic_scale)
         place = lambda x, y: shard_lm_batch(mesh, x, y)
         return step, state, place, model, lambda st: st.params
 
@@ -765,7 +801,10 @@ def main(argv=None) -> None:
             run_layout = "pp-contiguous"
         else:
             run_layout = None
-        if args.resume:
+        def _resume(state):
+            """State from the newest complete checkpoint (or unchanged
+            when none exists) — re-runnable, so --resume auto can
+            restore after every supervised restart."""
             from distributed_machine_learning_tpu.train.checkpoint import (
                 checkpoint_config,
                 checkpoint_layout,
@@ -827,10 +866,18 @@ def main(argv=None) -> None:
                 # adjustment on resume).
                 restored = restored.replace(config=state.config)
 
+                from distributed_machine_learning_tpu.train.checkpoint import (  # noqa: E501
+                    fresh_buffers,
+                )
+
                 def _match_commitment(orig, new):
                     if getattr(orig, "committed", True):
                         return new
-                    return _jnp.asarray(jax.device_get(new))
+                    # fresh_buffers is load-bearing: donating the bare
+                    # asarray corrupts the heap when the host buffer
+                    # happens to be 64-byte aligned (zero-copied, then
+                    # freed with XLA's allocator) — see its docstring.
+                    return fresh_buffers(_jnp.asarray(jax.device_get(new)))
 
                 state = jax.tree_util.tree_map(
                     _match_commitment, state, restored
@@ -839,20 +886,60 @@ def main(argv=None) -> None:
                     f"Resumed from {latest} (step "
                     f"{int(jax.device_get(state.step))})"
                 )
+            return state
 
-        # The shared driver owns the measurement protocol (iter-0-excluded
-        # timing, loss cadence, summary format) — one copy for CNN and LM.
-        state, _ = train_epoch(
-            step, state, batches(), place_batch=place,
-            max_iters=args.max_iters,
-        )
-        if args.ckpt_dir:
-            from distributed_machine_learning_tpu.train.checkpoint import (
-                save_checkpoint,
+        if args.resume:
+            state = _resume(state)
+
+        def run_once(s):
+            """Train + final save; the unit a supervised restart retries.
+            The shared driver owns the measurement protocol (iter-0-
+            excluded timing, loss cadence, summary) — one copy for CNN
+            and LM."""
+            if getattr(args, "loss_scale", "none") == "dynamic":
+                from distributed_machine_learning_tpu.train.lm_step import (
+                    with_dynamic_scale,
+                )
+
+                s = with_dynamic_scale(s)
+            s, _ = train_epoch(
+                step, s, batches(), place_batch=place,
+                max_iters=args.max_iters,
+            )
+            from distributed_machine_learning_tpu.train.lm_step import (
+                unwrap_dynamic_scale,
             )
 
-            path = save_checkpoint(args.ckpt_dir, state, layout=run_layout)
-            rank0_print(f"Saved checkpoint to {path}")
+            s = unwrap_dynamic_scale(s)
+            if args.ckpt_dir:
+                from distributed_machine_learning_tpu.train.checkpoint import (
+                    save_checkpoint,
+                )
+
+                path = save_checkpoint(args.ckpt_dir, s, layout=run_layout)
+                rank0_print(f"Saved checkpoint to {path}")
+            return s
+
+        if args.resume == "auto":
+            # Coarse-grained supervision: on any crash, restore the
+            # newest complete checkpoint (possibly none — fresh start)
+            # and retrain, up to --max-restarts times.  The fine-grained
+            # cursor-exact machinery is runtime/supervisor.py::
+            # supervised_train; the CNN parts wire it per-epoch.
+            from distributed_machine_learning_tpu.runtime.supervisor import (
+                run_attempts,
+            )
+
+            def attempt(restart_idx):
+                s = state
+                if restart_idx > 0:
+                    _, fresh, *_ = build(args)
+                    s = _resume(fresh)
+                return run_once(s)
+
+            state = run_attempts(attempt, max_restarts=args.max_restarts)
+        else:
+            state = run_once(state)
         if args.eval_batches:
             from distributed_machine_learning_tpu.data.text import (
                 eval_windows,

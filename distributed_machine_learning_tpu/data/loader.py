@@ -36,6 +36,7 @@ class BatchLoader:
         batch_size: int,
         indices: np.ndarray | None = None,
         prefetch: int = 2,
+        retry=None,
     ):
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
@@ -45,43 +46,63 @@ class BatchLoader:
             np.arange(len(dataset)) if indices is None else np.asarray(indices)
         )
         self.prefetch = prefetch
+        # Optional data/retry.py::RetryPolicy: slicing is deterministic and
+        # seekable, so a transient dataset fault (remote storage, mmap IO)
+        # retries/skips instead of killing the epoch.
+        self.retry = retry
 
     def __len__(self) -> int:
         return (len(self.indices) + self.batch_size - 1) // self.batch_size
 
-    def _batches(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    def _batches(self, start: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Batches from absolute batch index ``start`` — the seekable
+        source the retry wrapper rebuilds after a failure."""
         imgs, labels = self.dataset.images, self.dataset.labels
-        for start in range(0, len(self.indices), self.batch_size):
-            idx = self.indices[start : start + self.batch_size]
+        for lo in range(start * self.batch_size, len(self.indices),
+                        self.batch_size):
+            idx = self.indices[lo : lo + self.batch_size]
             yield imgs[idx], labels[idx]
+
+    def _source(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        if self.retry is None:
+            return self._batches()
+        from distributed_machine_learning_tpu.data.retry import retry_batches
+
+        return retry_batches(self._batches, self.retry)
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         if self.prefetch <= 0:
-            yield from self._batches()
+            yield from self._source()
             return
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
         sentinel = object()
+        failure: list[BaseException] = []
 
-        def producer():
-            for batch in self._batches():
-                # Bounded put that aborts if the consumer goes away (the
-                # training loop breaks at its 40-iteration cap mid-epoch —
-                # part1/main.py:32-33 — so early abandonment is the norm).
-                while not stop.is_set():
-                    try:
-                        q.put(batch, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
-                    return
+        def _put(item) -> bool:
+            # Bounded put that aborts if the consumer goes away (the
+            # training loop breaks at its 40-iteration cap mid-epoch —
+            # part1/main.py:32-33 — so early abandonment is the norm).
             while not stop.is_set():
                 try:
-                    q.put(sentinel, timeout=0.1)
-                    return
+                    q.put(item, timeout=0.1)
+                    return True
                 except queue.Full:
                     continue
+            return False
+
+        def producer():
+            try:
+                for batch in self._source():
+                    if not _put(batch):
+                        return
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                # A producer death must reach the consumer: swallowing it
+                # here would leave the training loop blocked on q.get()
+                # forever — the exact silent-hang failure mode the
+                # resilience layer exists to eliminate.
+                failure.append(exc)
+            _put(sentinel)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -89,6 +110,8 @@ class BatchLoader:
             while True:
                 item = q.get()
                 if item is sentinel:
+                    if failure:
+                        raise failure[0]
                     break
                 yield item
         finally:

@@ -1,0 +1,291 @@
+"""Deterministic fault injection — prove the runtime survives, don't hope.
+
+The reference never sees a fault it can recover from: one stalled gloo
+rank deadlocks the other three forever (SURVEY.md §5), and nothing in
+its 908 LoC can even *produce* a controlled failure to test against.
+This module is the chaos half of the self-healing runtime
+(`runtime/supervisor.py` is the healing half): a seedable injector that
+forces each production fault class at a chosen step, so the
+skip/retry/restart ladder is exercised by tests instead of trusted on
+faith.
+
+Fault classes (spec grammar ``kind@step[:arg]``, comma-separated):
+
+- ``nan@K``       poison batch K's input with NaN → the jitted step's
+                  non-finite-gradient guard must skip the update
+                  (float-input pipelines only; token streams are
+                  integral and cannot carry a NaN).
+- ``raise@K``     raise :class:`InjectedFault` from the data iterator at
+                  batch K → the retrying data path (``data/retry.py``)
+                  must recreate the iterator and resume.
+- ``stall@K:S``   sleep S seconds before yielding batch K → the
+                  watchdog must declare a stall; the supervisor restarts
+                  from the latest checkpoint.
+- ``kill_ckpt@N`` die during the N-th (1-based) checkpoint save, after
+                  the state dir lands but before the config file — the
+                  crash window ``_is_complete`` exists for.  Default
+                  raises :class:`InjectedKill` (so an in-process
+                  supervisor can catch the crash boundary); ``:exit``
+                  calls ``os._exit(17)`` for external supervisors.
+
+``K`` may be ``?``: the step is drawn deterministically from ``seed``
+(same seed → same plan), so randomized chaos runs stay reproducible.
+
+Everything is OFF by default: an injector only exists when a spec is
+given (``--faults`` or the ``DML_FAULTS`` env var), and a fault fires
+exactly once.  All injection is host-side — the compiled step is never
+touched; faults enter through the data stream and the checkpoint path,
+the same doors real faults use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import numpy as np
+
+from distributed_machine_learning_tpu.utils.logging import rank0_print
+
+FAULTS_ENV = "DML_FAULTS"
+
+_KIND_ALIASES = {
+    "nan": "nan",
+    "nan_grad": "nan",
+    "raise": "raise",
+    "data_raise": "raise",
+    "stall": "stall",
+    "kill_ckpt": "kill_ckpt",
+    "kill": "kill_ckpt",
+}
+
+
+class InjectedFault(RuntimeError):
+    """A fault deliberately raised by the injector (data-path class)."""
+
+
+class InjectedKill(InjectedFault):
+    """A simulated process death mid-checkpoint.
+
+    Raised (instead of ``os._exit``) so an in-process supervisor can
+    observe the crash *boundary* — the half-written checkpoint is
+    already on disk when this propagates, exactly as if the process had
+    died there.
+    """
+
+
+@dataclasses.dataclass
+class FaultEvents:
+    """Counters for every robustness event — the observable surface.
+
+    A silent recovery is indistinguishable from a bug that never
+    triggered; every skip/retry/stall/restart increments a counter here,
+    and ``utils/summary.py::resilience_summary`` renders the table the
+    run prints.  Shared mutable state between the loop, the loaders, the
+    watchdog, and the supervisor (all same-thread or GIL-atomic
+    ``+= 1`` updates).
+    """
+
+    skipped_steps: int = 0      # non-finite-gradient guard skipped the update
+    scaler_backoffs: int = 0    # dynamic loss scale halved on overflow
+    scaler_growths: int = 0     # dynamic loss scale doubled after good steps
+    loader_retries: int = 0     # data iterator recreated after an exception
+    skipped_batches: int = 0    # batch dropped after exhausting its attempts
+    stalls: int = 0             # watchdog declared a stall episode
+    restarts: int = 0           # supervisor restored a checkpoint and retried
+    preemptions: int = 0        # SIGTERM turned into a clean checkpointed stop
+    ckpt_kills: int = 0         # injected death mid-checkpoint-save
+
+    def as_dict(self) -> dict[str, int]:
+        return dataclasses.asdict(self)
+
+    def total(self) -> int:
+        return sum(self.as_dict().values())
+
+
+@dataclasses.dataclass
+class _Fault:
+    kind: str
+    at: int            # batch index (data faults) / save ordinal (kill_ckpt)
+    arg: str | None = None
+    fired: bool = False
+
+
+class FaultInjector:
+    """Parses a fault spec and fires each fault exactly once.
+
+    One injector instance spans a whole supervised run — restarts and
+    data-path replays cross the same indices again, and the fired-once
+    latch is what keeps a recovered fault from re-firing forever.
+    """
+
+    def __init__(self, faults: list[_Fault]):
+        self._faults = faults
+        self._saves = 0
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0, horizon: int = 40
+              ) -> "FaultInjector":
+        """``"nan@2,raise@4,stall@7:2.5,kill_ckpt@1"`` → injector.
+
+        ``?`` steps draw from ``default_rng(seed)`` in ``[1, horizon)``,
+        in spec order — deterministic per (spec, seed).
+        """
+        if horizon < 2:
+            raise ValueError(f"horizon must be >= 2, got {horizon}")
+        rng = np.random.default_rng(seed)
+        faults = []
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "@" not in entry:
+                raise ValueError(
+                    f"bad fault entry {entry!r}: expected kind@step[:arg]"
+                )
+            kind, _, rest = entry.partition("@")
+            kind = kind.strip()
+            if kind not in _KIND_ALIASES:
+                raise ValueError(
+                    f"unknown fault kind {kind!r}; known: "
+                    f"{sorted(set(_KIND_ALIASES))}"
+                )
+            kind = _KIND_ALIASES[kind]
+            at_s, _, arg = rest.partition(":")
+            at_s = at_s.strip()
+            if at_s == "?":
+                at = int(rng.integers(1, horizon))
+            else:
+                try:
+                    at = int(at_s)
+                except ValueError:
+                    raise ValueError(
+                        f"bad fault step {at_s!r} in {entry!r} (an integer "
+                        "or '?')"
+                    ) from None
+            if at < 0:
+                raise ValueError(f"fault step must be >= 0, got {at}")
+            arg = arg.strip() or None
+            if kind == "stall":
+                float(arg if arg is not None else _default_stall(None))
+            if kind == "kill_ckpt":
+                if at < 1:
+                    raise ValueError(
+                        "kill_ckpt ordinal is 1-based (the first save is 1)"
+                    )
+                if arg not in (None, "exit"):
+                    raise ValueError(
+                        f"kill_ckpt arg must be 'exit' or absent, got {arg!r}"
+                    )
+            faults.append(_Fault(kind=kind, at=at, arg=arg))
+        return cls(faults)
+
+    @classmethod
+    def from_flags(cls, spec: str | None, seed: int = 0, horizon: int = 40
+                   ) -> "FaultInjector | None":
+        """Injector from an explicit spec, else the ``DML_FAULTS`` env
+        var, else None (the default: no injection machinery at all)."""
+        spec = spec or os.environ.get(FAULTS_ENV)
+        if not spec:
+            return None
+        return cls.parse(spec, seed=seed, horizon=horizon)
+
+    # -- data-path faults ----------------------------------------------
+    def wrap_batches(self, batches, events: FaultEvents | None = None,
+                     start: int = 0):
+        """Wrap a batch iterator; data faults fire at absolute index
+        ``start + j``.  Replays (retry fast-forward, post-restart) cross
+        fired indices without re-firing."""
+        for j, batch in enumerate(batches):
+            idx = start + j
+            for f in self._faults:
+                if f.fired or f.at != idx:
+                    continue
+                if f.kind == "stall":
+                    f.fired = True
+                    stall_s = float(f.arg) if f.arg else _default_stall(None)
+                    rank0_print(
+                        f"[faults] stalling {stall_s}s before batch {idx}"
+                    )
+                    time.sleep(stall_s)
+                elif f.kind == "raise":
+                    f.fired = True
+                    raise InjectedFault(f"injected loader fault at batch {idx}")
+                elif f.kind == "nan":
+                    f.fired = True
+                    rank0_print(f"[faults] poisoning batch {idx} with NaN")
+                    batch = _poison(batch)
+            yield batch
+
+    # -- checkpoint faults ---------------------------------------------
+    def mid_save_hook(self, events: FaultEvents | None = None):
+        """Hook for ``save_checkpoint(mid_save_hook=...)`` — called after
+        the state dir lands, before the config file.  Fires ``kill_ckpt``
+        on its save ordinal."""
+
+        def hook():
+            self._saves += 1
+            for f in self._faults:
+                if f.fired or f.kind != "kill_ckpt" or f.at != self._saves:
+                    continue
+                f.fired = True
+                if events is not None:
+                    events.ckpt_kills += 1
+                if f.arg == "exit":
+                    rank0_print(
+                        f"[faults] killing process mid-checkpoint "
+                        f"(save #{self._saves})"
+                    )
+                    os._exit(17)
+                raise InjectedKill(
+                    f"injected death mid-checkpoint (save #{self._saves}; "
+                    "state dir written, config file not)"
+                )
+
+        return hook
+
+    def has_kind(self, kind: str) -> bool:
+        """Whether the spec contains any fault of ``kind`` (fired or
+        not) — lets callers reject configurations where that fault
+        class could never fire (e.g. kill_ckpt under --async-ckpt)."""
+        kind = _KIND_ALIASES.get(kind, kind)
+        return any(f.kind == kind for f in self._faults)
+
+    def pending(self) -> list[str]:
+        """Human-readable unfired faults (for the run banner)."""
+        return [
+            f"{f.kind}@{f.at}" + (f":{f.arg}" if f.arg else "")
+            for f in self._faults
+            if not f.fired
+        ]
+
+
+def _default_stall(_) -> float:
+    return 2.0
+
+
+def _poison(batch):
+    """Replace the float-able input of an ``(x, y)`` batch with NaN.
+
+    The poisoned array rides the normal host→device path; ``normalize``
+    accepts float input, so NaN propagates through loss and gradients —
+    the blowup the guard must catch.  Integer token streams cannot carry
+    a NaN; that pipeline's guard is unit-tested at the step level
+    instead (``tests/test_resilience.py``).
+    """
+    x, *rest = batch
+    x = np.asarray(x)
+    if not np.issubdtype(x.dtype, np.floating) and not np.issubdtype(
+        x.dtype, np.integer
+    ):
+        raise TypeError(f"cannot poison batch of dtype {x.dtype}")
+    if np.issubdtype(x.dtype, np.integer) and x.ndim < 3:
+        raise TypeError(
+            "refusing to poison what looks like an integer token/label "
+            "array (the model indexes with it); nan faults need a "
+            "float-able input pipeline like the CNN image path"
+        )
+    poisoned = np.full(x.shape, np.nan, np.float32)
+    return (poisoned, *rest)

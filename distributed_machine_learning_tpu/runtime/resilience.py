@@ -27,6 +27,7 @@ never touch the compiled step, so they cost nothing on the device.
 
 from __future__ import annotations
 
+import contextlib
 import faulthandler
 import os
 import signal
@@ -71,6 +72,7 @@ class Watchdog:
         self.exit_code = exit_code
         self.poll_s = poll_s if poll_s is not None else min(timeout_s / 4, 1.0)
         self.stalled = False
+        self._suspended = 0
         self._last_beat = time.monotonic()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -79,9 +81,35 @@ class Watchdog:
         """Record liveness — call once per completed step."""
         self._last_beat = time.monotonic()
 
+    @contextlib.contextmanager
+    def suspend(self):
+        """Pause stall detection across an expected-long non-step phase
+        (checkpoint save, eval, trace dump).
+
+        Beating on the way in and out is not enough once the phase can
+        outlast ``timeout_s``: the stall would be declared *during* the
+        phase and — under a supervisor that escalates stalls to restarts
+        — a perfectly healthy run would burn a restart per checkpoint.
+        Suspension stops the clock instead; step time is the only time
+        the watchdog judges.  Re-entrant, and beats on exit so the next
+        step starts with a full window.
+        """
+        self._suspended += 1
+        try:
+            yield
+        finally:
+            # Beat BEFORE lifting suspension: the poll thread must never
+            # observe un-suspended state with the save still on the clock.
+            try:
+                self.beat()
+            finally:
+                self._suspended -= 1
+
     def _run(self) -> None:
         reported = False
         while not self._stop.wait(self.poll_s):
+            if self._suspended:
+                continue  # inside save/eval — the clock is stopped
             elapsed = time.monotonic() - self._last_beat
             if elapsed >= self.timeout_s:
                 if reported:

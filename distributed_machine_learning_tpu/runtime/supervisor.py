@@ -1,0 +1,326 @@
+"""Auto-resume supervision — compose detection, checkpoints, and retry
+into a run that survives.
+
+The pieces existed but nothing composed them (ISSUE: the reference
+deadlocks on the first fault; SURVEY.md §5): ``runtime/resilience.py``
+detects stalls and preemptions, ``train/checkpoint.py`` writes
+crash-consistent saves and ``latest_checkpoint`` skips incomplete ones,
+``train/loop.py`` stops at step boundaries.  This module is the ladder
+that joins them, the policy every flash-scale data-parallel run
+(PAPERS.md: arxiv 1811.05233, 1711.04325) ends up with:
+
+1. **skip** — a non-finite gradient skips one update (the guard inside
+   the jitted step, ``train/step.py``/``train/lm_step.py``);
+2. **retry** — a data-path exception recreates the iterator with
+   backoff (``data/retry.py``);
+3. **restart** — anything worse (stall, crash, death mid-checkpoint)
+   restores the newest *complete* checkpoint and continues, up to
+   ``max_restarts``.
+
+Exactness contract: checkpoints record the data *cursor* (batches
+consumed) alongside the step counter, and batch factories are
+cursor-keyed, so a restarted run replays exactly the stream the dead run
+would have seen — a supervised run with faults lands on the same final
+step count, and bit-identical params, as a fault-free run of the same
+seed minus the guard-skipped batches (``tests/test_resilience.py``
+asserts this end to end).
+
+Stall escalation is two-phase because a hung collective cannot be
+un-hung from inside: the watchdog *declares* the stall from its daemon
+thread (and can ``os._exit`` for external supervisors — the production
+policy); in-process, :class:`RaisingWatchdog` turns the next completed
+step boundary into a :class:`StallError` so a *transient* stall (slow
+storage, injected sleep) is healed by restart rather than silently
+absorbed into one long step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable
+
+import jax
+
+from distributed_machine_learning_tpu.runtime.faults import (
+    FaultEvents,
+    FaultInjector,
+)
+from distributed_machine_learning_tpu.runtime.resilience import Watchdog
+from distributed_machine_learning_tpu.utils.logging import rank0_print
+
+
+class StallError(RuntimeError):
+    """A watchdog-declared stall, surfaced at a step boundary so the
+    supervisor can restart from the latest checkpoint."""
+
+
+class RaisingWatchdog(Watchdog):
+    """A Watchdog whose ``beat`` raises :class:`StallError` once a stall
+    episode has been declared.
+
+    The base class can only report (its thread cannot interrupt a stuck
+    step); raising from ``beat`` moves the escalation into the training
+    thread at the first step boundary *after* the stall — state is
+    consistent there, so the supervisor can restore and retry.  A truly
+    infinite hang never reaches a beat; that case is the base class's
+    ``exit_code`` fail-fast + external supervisor territory.
+    """
+
+    def __init__(self, timeout_s: float, events: FaultEvents | None = None,
+                 poll_s: float | None = None):
+        def _on_stall(elapsed: float) -> None:
+            if events is not None:
+                events.stalls += 1
+            rank0_print(
+                f"[supervisor] stall: no step completed in {elapsed:.1f}s "
+                f"(timeout {timeout_s}s); will restart from the latest "
+                "checkpoint at the next step boundary"
+            )
+
+        super().__init__(timeout_s, on_stall=_on_stall, poll_s=poll_s)
+
+    def beat(self) -> None:
+        if self.stalled:
+            raise StallError(
+                f"step stalled past {self.timeout_s}s; restarting from "
+                "the latest checkpoint"
+            )
+        super().beat()
+
+
+def run_attempts(attempt: Callable[[int], object], *, max_restarts: int = 3,
+                 events: FaultEvents | None = None):
+    """Run ``attempt(restart_index)`` until it returns, restarting on any
+    Exception up to ``max_restarts`` times.
+
+    The generic retry primitive behind both :func:`supervised_train` and
+    the CLI's ``--resume auto``: ``attempt`` owns its own
+    restore-from-latest-checkpoint logic (it knows the model/template);
+    this owns the policy — count, log, give up loudly.
+    KeyboardInterrupt/SystemExit always propagate.
+    """
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    restarts = 0
+    while True:
+        try:
+            return attempt(restarts)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as exc:
+            if restarts >= max_restarts:
+                rank0_print(
+                    f"[supervisor] giving up after {restarts} restart(s): "
+                    f"{type(exc).__name__}: {exc}"
+                )
+                raise
+            restarts += 1
+            if events is not None:
+                events.restarts += 1
+            rank0_print(
+                f"[supervisor] attempt failed ({type(exc).__name__}: "
+                f"{exc}); restart {restarts}/{max_restarts} from the "
+                "latest complete checkpoint"
+            )
+
+
+def auto_resume(ckpt_dir, init_state, abstract_state=None):
+    """(state, cursor, resumed_path) — the newest complete checkpoint
+    under ``ckpt_dir`` restored against ``abstract_state`` (default: the
+    fresh ``init_state``), or ``(init_state, 0, None)`` when none exists.
+    Incomplete saves (crash/kill mid-write) are skipped by
+    ``latest_checkpoint`` — that fallback IS the resume guarantee."""
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        checkpoint_cursor,
+        latest_checkpoint,
+        restore_checkpoint,
+    )
+
+    latest = latest_checkpoint(ckpt_dir)
+    if latest is None:
+        return init_state, 0, None
+    state = restore_checkpoint(
+        latest, abstract_state=abstract_state or init_state
+    )
+    cursor = checkpoint_cursor(latest)
+    if cursor is None:
+        cursor = int(jax.device_get(state.step))
+    return state, cursor, latest
+
+
+def supervised_train(
+    train_step,
+    init_state,
+    make_batches: Callable[[int], object],
+    *,
+    target_steps: int,
+    ckpt_dir,
+    save_every: int = 100,
+    max_restarts: int = 3,
+    events: FaultEvents | None = None,
+    watchdog_timeout: float = 0.0,
+    injector: FaultInjector | None = None,
+    retry=None,
+    place_batch=None,
+    keep_last_n: int | None = None,
+    abstract_state=None,
+    stop=None,
+    loss_print_every: int = 10**9,
+):
+    """Run ``train_step`` to ``target_steps`` applied updates, surviving
+    faults: the full skip/retry/restart ladder in one call.
+
+    ``make_batches(cursor)`` must yield the batch stream from absolute
+    batch index ``cursor`` (deterministically — that seekability is what
+    makes restart replay exact).  Checkpoints land every ``save_every``
+    applied steps (cursor recorded), and the final state is saved at
+    ``target_steps``.  ``target_steps`` counts APPLIED updates: a
+    guard-skipped batch is consumed but retried with further data, so a
+    faulted run finishes at the same step count as a clean one.
+
+    ``retry``: a ``data/retry.RetryPolicy`` (None disables the retry
+    layer); ``injector``: a ``runtime/faults.FaultInjector`` for chaos
+    runs; ``stop``: zero-arg predicate (e.g. a ``PreemptionHandler``) —
+    True checkpoints and returns early, cleanly.
+
+    Returns the final state (a ``DynamicScaleState`` stays wrapped; its
+    inner TrainState is what checkpoints hold, and the loss scale resets
+    to its initial value after a restart — scale is ephemeral tuning
+    state, not training progress).
+    """
+    from distributed_machine_learning_tpu.data.retry import retry_batches
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        save_checkpoint,
+    )
+    from distributed_machine_learning_tpu.train.lm_step import (
+        DynamicScaleState,
+        unwrap_dynamic_scale,
+        with_dynamic_scale,
+    )
+    from distributed_machine_learning_tpu.train.loop import train_epoch
+
+    if target_steps < 1:
+        raise ValueError(f"target_steps must be >= 1, got {target_steps}")
+    if save_every < 1:
+        raise ValueError(f"save_every must be >= 1, got {save_every}")
+    events = events if events is not None else FaultEvents()
+    mid_save = injector.mid_save_hook(events) if injector is not None else None
+    scaled = isinstance(init_state, DynamicScaleState)
+    # Read the scaler's init values ONCE: the compiled step donates its
+    # input state, so after attempt 0 these arrays may be dead buffers.
+    init_scale = float(init_state.loss_scale) if scaled else None
+    growth_interval = init_state.growth_interval if scaled else None
+
+    def _rewrap(inner):
+        if not scaled:
+            return inner
+        return with_dynamic_scale(
+            inner, init_scale=init_scale, growth_interval=growth_interval
+        )
+
+    def _copy_state(tree):
+        """Fresh buffers for every leaf — an attempt must never train on
+        the caller's ``init_state`` directly: the jitted step donates its
+        input, and a later restart that falls back to the fresh state
+        (no complete checkpoint yet) would otherwise hand the step
+        already-donated buffers."""
+        from distributed_machine_learning_tpu.train.checkpoint import (
+            fresh_buffers,
+        )
+
+        return fresh_buffers(tree)
+
+    def _step_of(state) -> int:
+        return int(jax.device_get(state.step))
+
+    def attempt(restart_idx: int):
+        inner, cursor, resumed = auto_resume(
+            ckpt_dir,
+            unwrap_dynamic_scale(init_state),
+            abstract_state=unwrap_dynamic_scale(
+                abstract_state if abstract_state is not None else init_state
+            ),
+        )
+        if resumed is None:
+            inner = _copy_state(inner)
+        state = _rewrap(inner)
+        if resumed:
+            rank0_print(
+                f"[supervisor] resumed from {resumed} "
+                f"(step {_step_of(state)}, cursor {cursor})"
+            )
+        watchdog = (
+            RaisingWatchdog(watchdog_timeout, events).start()
+            if watchdog_timeout
+            else None
+        )
+        cursor_box = {"v": cursor}
+
+        def source(pos: int):
+            base = make_batches(pos)
+
+            def counted():
+                for j, batch in enumerate(base):
+                    cursor_box["v"] = pos + j + 1
+                    yield batch
+
+            it = counted()
+            if injector is not None:
+                it = injector.wrap_batches(it, events, start=pos)
+            return it
+
+        try:
+            while _step_of(state) < target_steps:
+                chunk_start = _step_of(state)
+                cursor_start = cursor_box["v"]
+                chunk_target = min(chunk_start + save_every, target_steps)
+                if retry is not None:
+                    batches = retry_batches(
+                        source, retry, events, start=cursor_box["v"]
+                    )
+                else:
+                    batches = source(cursor_box["v"])
+                state, _ = train_epoch(
+                    train_step,
+                    state,
+                    batches,
+                    place_batch=place_batch,
+                    max_iters=10**9,
+                    loss_print_every=loss_print_every,
+                    watchdog=watchdog,
+                    events=events,
+                    until_step=chunk_target,
+                    stop=stop,
+                )
+                # Saves are not steps: suspend the watchdog so a slow
+                # (but healthy) serialize can't be declared a stall.
+                with (watchdog.suspend() if watchdog is not None
+                      else contextlib.nullcontext()):
+                    save_checkpoint(
+                        ckpt_dir,
+                        unwrap_dynamic_scale(state),
+                        cursor=cursor_box["v"],
+                        mid_save_hook=mid_save,
+                        keep_last_n=keep_last_n,
+                    )
+                if stop is not None and stop():
+                    events.preemptions += 1
+                    rank0_print(
+                        "[supervisor] stop requested; checkpointed at "
+                        f"step {_step_of(state)} and exiting cleanly"
+                    )
+                    return state
+                if (_step_of(state) == chunk_start
+                        and cursor_box["v"] == cursor_start):
+                    raise RuntimeError(
+                        f"data stream exhausted at cursor "
+                        f"{cursor_box['v']} with step {chunk_start} < "
+                        f"target {target_steps}: make_batches must cover "
+                        "the run (skipped batches consume extra data)"
+                    )
+            return state
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+
+    return run_attempts(attempt, max_restarts=max_restarts, events=events)

@@ -22,12 +22,51 @@ import os
 from typing import Any
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from distributed_machine_learning_tpu.train.state import TrainState
 
 _CONFIG_FILE = "sgd_config.json"
 _STATE_DIR = "state"
+
+
+@jax.jit
+def _copy_arrays(arrays: list) -> list:
+    """Identity copy through XLA — every output is a jit-owned buffer.
+
+    Non-donating by construction, so the inputs are left intact.
+    """
+    import jax.numpy as jnp
+
+    return [jnp.asarray(a).copy() for a in arrays]
+
+
+def fresh_buffers(tree):
+    """Re-materialize every array leaf of ``tree`` into an XLA-owned
+    buffer (via a non-donating jitted copy); non-array leaves pass
+    through untouched.
+
+    The ONE sanctioned conversion before handing arrays to a
+    ``donate_argnums`` step.  Arrays from orbax/tensorstore restores, or
+    zero-copied host numpy (the CPU backend aliases any 64-byte-aligned
+    numpy buffer), are backed by memory XLA does not own; donating them
+    frees that memory with XLA's allocator — heap corruption that
+    segfaults at some LATER free.  Jit outputs are the same ownership
+    class init states come from, which donation handles correctly.
+    Uncommitted inputs stay uncommitted (the dp/ring shard_map paths
+    rely on this).  Used by :func:`restore_checkpoint`, the
+    supervisor's init-state copy, and the LM CLI's commitment fix-up.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = list(leaves)
+    idx = [i for i, x in enumerate(leaves)
+           if isinstance(x, (jax.Array, np.ndarray))]
+    if idx:
+        copied = _copy_arrays([leaves[i] for i in idx])
+        for i, c in zip(idx, copied):
+            out[i] = c
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _state_pytree(state: TrainState) -> dict:
@@ -42,7 +81,8 @@ def _state_pytree(state: TrainState) -> dict:
 
 
 def save_checkpoint(directory: str | os.PathLike, state: TrainState,
-                    layout: str | None = None) -> str:
+                    layout: str | None = None, cursor: int | None = None,
+                    mid_save_hook=None, keep_last_n: int | None = None) -> str:
     """Write `state` under `directory/step_<n>/`; returns the path written.
 
     Only process 0's metadata file is written once; array shards are saved
@@ -53,6 +93,21 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
     structure but permute the layers) — recorded so a resume under a
     different layout can be rejected instead of silently loading
     permuted weights (``checkpoint_layout``).
+
+    ``cursor``: optional data-stream position (batches consumed).  The
+    step counter alone under-counts it once the non-finite-gradient
+    guard has skipped a batch, so the supervisor records the true
+    position for exact replay (``checkpoint_cursor``).  Stored in the
+    config payload — written last — so a checkpoint is never complete
+    with a missing cursor.
+
+    ``mid_save_hook``: test/chaos hook called between the state write
+    and the config write — the crash window ``_is_complete`` guards
+    (``runtime/faults.py`` kills here to prove resume falls back).
+
+    ``keep_last_n``: if set, garbage-collect older checkpoints after
+    this save completes (``gc_checkpoints``) so supervised long runs
+    don't fill the disk.
     """
     directory = os.path.abspath(os.fspath(directory))
     step = int(jax.device_get(state.step))
@@ -62,6 +117,8 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
         # into the same --ckpt-dir) overwrites instead of raising.
         ckptr.save(os.path.join(path, _STATE_DIR), _state_pytree(state),
                    force=True)
+    if mid_save_hook is not None:
+        mid_save_hook()
     if jax.process_index() == 0:
         with open(os.path.join(path, _CONFIG_FILE), "w") as f:
             # Record the config class so restore rebuilds the right
@@ -71,8 +128,55 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
                        **dataclasses.asdict(state.config)}
             if layout is not None:
                 payload["__layout__"] = layout
+            if cursor is not None:
+                payload["__cursor__"] = int(cursor)
             json.dump(payload, f)
+        if keep_last_n is not None:
+            gc_checkpoints(directory, keep_last_n)
     return path
+
+
+def gc_checkpoints(directory: str | os.PathLike, keep_last_n: int
+                   ) -> list[str]:
+    """Delete old checkpoints, keeping the newest ``keep_last_n``
+    *complete* ones; returns the paths removed.
+
+    The newest complete checkpoint is never deleted (it is the resume
+    anchor — losing it turns every later fault into a from-scratch
+    restart).  Incomplete directories are removed only when a complete
+    checkpoint with a HIGHER step exists: an older incomplete dir is a
+    crash leftover, but a newer one may be an in-flight async save that
+    simply hasn't committed yet.
+    """
+    import shutil
+
+    if keep_last_n < 1:
+        raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+    directory = os.fspath(directory)
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("step_") and name[5:].isdigit():
+            steps.append(int(name[5:]))
+    complete = [
+        s for s in sorted(steps, reverse=True)
+        if _is_complete(os.path.join(directory, f"step_{s}"))
+    ]
+    keep = set(complete[:keep_last_n])
+    newest_complete = complete[0] if complete else None
+    removed = []
+    for s in steps:
+        if s in keep:
+            continue
+        is_complete = s in complete
+        if not is_complete and (newest_complete is None
+                                or s >= newest_complete):
+            continue  # possibly an in-flight save — leave it alone
+        path = os.path.join(directory, f"step_{s}")
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    return removed
 
 
 class AsyncCheckpointWriter:
@@ -90,31 +194,65 @@ class AsyncCheckpointWriter:
     Call :meth:`wait` before process exit (or rely on ``close``); a new
     ``save`` transparently waits for the previous one (orbax serializes
     saves on one thread).
+
+    Write-order invariant: the config file is deferred until
+    ``wait_until_finished`` of ITS OWN save has returned (flushed at the
+    next ``save``/``wait``/``close``).  Writing it eagerly would break
+    the ``_is_complete`` contract — a crash after the config landed but
+    before orbax committed the state dir... cannot happen (orbax renames
+    atomically), but the converse ordering CAN: an eager config plus a
+    crashed orbax *rename race* would present a complete-looking
+    checkpoint with no state.  More concretely: ``_is_complete``
+    documents "config written after the state dir", and the async path
+    must honor the same ordering the sync path does.  The cost is that
+    an async checkpoint becomes visible to ``latest_checkpoint`` only at
+    the next sync point — which is exactly when the caller can first
+    rely on it anyway.
     """
 
     def __init__(self):
         self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        self._pending: tuple[str, dict, str, int | None] | None = None
 
-    def save(self, directory: str | os.PathLike, state: TrainState) -> str:
+    def save(self, directory: str | os.PathLike, state: TrainState,
+             cursor: int | None = None,
+             keep_last_n: int | None = None) -> str:
         directory = os.path.abspath(os.fspath(directory))
         step = int(jax.device_get(state.step))
         path = os.path.join(directory, f"step_{step}")
+        # Flush the PREVIOUS save's config first: this also orders saves
+        # (orbax would serialize them anyway) and guarantees at most one
+        # pending config at a time.
+        self._flush_pending()
         self._ckptr.save(
             os.path.join(path, _STATE_DIR), _state_pytree(state), force=True
         )
         if jax.process_index() == 0:
-            os.makedirs(path, exist_ok=True)
-            with open(os.path.join(path, _CONFIG_FILE), "w") as f:
-                json.dump(
-                    {"__class__": type(state.config).__name__,
-                     **dataclasses.asdict(state.config)},
-                    f,
-                )
+            payload = {"__class__": type(state.config).__name__,
+                       **dataclasses.asdict(state.config)}
+            if cursor is not None:
+                payload["__cursor__"] = int(cursor)
+            self._pending = (path, payload, directory, keep_last_n)
         return path
 
-    def wait(self) -> None:
-        """Block until the in-flight save (if any) is fully on disk."""
+    def _flush_pending(self) -> None:
         self._ckptr.wait_until_finished()
+        if self._pending is not None:
+            path, payload, directory, keep_last_n = self._pending
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, _CONFIG_FILE), "w") as f:
+                json.dump(payload, f)
+            self._pending = None
+            # GC only after the save is complete: the just-flushed
+            # checkpoint is now the newest complete one and therefore
+            # protected, same as the sync path.
+            if keep_last_n is not None:
+                gc_checkpoints(directory, keep_last_n)
+
+    def wait(self) -> None:
+        """Block until the in-flight save (if any) is fully on disk AND
+        its config file (completeness marker) is written."""
+        self._flush_pending()
 
     def close(self) -> None:
         self.wait()
@@ -167,9 +305,21 @@ def checkpoint_config(path: str | os.PathLike):
 
     # "SGDConfig" default: checkpoints written before the class tag existed.
     payload.pop("__layout__", None)  # layout tag is checkpoint_layout's
+    payload.pop("__cursor__", None)  # data cursor is checkpoint_cursor's
     return config_class_by_name(payload.pop("__class__", "SGDConfig"))(
         **payload
     )
+
+
+def checkpoint_cursor(path: str | os.PathLike) -> int | None:
+    """The data-stream position (batches consumed) a checkpoint was saved
+    at, or None for checkpoints saved without one.  Diverges from the
+    step counter once the non-finite-gradient guard has skipped a batch;
+    the supervisor replays from the cursor so the post-restart stream is
+    exactly the pre-crash one."""
+    with open(os.path.join(os.fspath(path), _CONFIG_FILE)) as f:
+        cursor = json.load(f).get("__cursor__")
+    return None if cursor is None else int(cursor)
 
 
 def checkpoint_layout(path: str | os.PathLike) -> str | None:
@@ -217,6 +367,17 @@ def restore_checkpoint(
             tree = ckptr.restore(os.path.join(path, _STATE_DIR), args=restore_args)
         else:
             tree = ckptr.restore(os.path.join(path, _STATE_DIR))
+    # Re-materialize every leaf into an XLA-owned buffer (see
+    # fresh_buffers: restored tensorstore/zero-copy-aliased leaves fed
+    # to a donating step are a deferred heap corruption — this
+    # reproducibly segfaulted resume paths on CPU).  Host-side
+    # round-trips (np.array + device_put / jnp.asarray) do NOT work:
+    # they re-enter the zero-copy path whenever malloc hands back a
+    # 64-byte-aligned block, which is why the failure was flaky.  One
+    # copy of the state per restore is noise next to training; losing a
+    # run to a heap corruption after a restart is the exact failure the
+    # resilience layer exists to prevent.
+    tree = fresh_buffers(tree)
     config = checkpoint_config(path)
     return TrainState(
         params=tree["params"],

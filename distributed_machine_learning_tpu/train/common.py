@@ -17,6 +17,45 @@ from jax import lax
 from distributed_machine_learning_tpu.train.losses import cross_entropy_loss
 
 
+def tree_all_finite(tree) -> jax.Array:
+    """Scalar bool: every element of every leaf is finite.
+
+    The reduction the non-finite-gradient guard runs on the (synced)
+    gradients inside the compiled step — a handful of tiny ``isfinite``
+    reductions XLA fuses into the backward epilogue, so the guard costs
+    nothing measurable.  Computed on post-sync gradients: every device
+    reduces the identical values, so the skip decision is replicated by
+    construction and the cross-replica state invariant holds.
+    """
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+    out = finite[0]
+    for f in finite[1:]:
+        out = jnp.logical_and(out, f)
+    return out
+
+
+def guard_update(finite, new_state, old_state):
+    """Select ``new_state`` where the gradients were finite, else keep
+    ``old_state`` untouched (update skipped, step NOT incremented).
+
+    A ``jnp.where`` per leaf instead of ``lax.cond``: both branches are
+    already computed (the update is cheap next to the backward pass) and
+    ``where`` keeps the program branch-free — the only control flow TPUs
+    like.  The skipped step is observable on the host as an unchanged
+    step counter (``train/loop.py`` counts these into ``FaultEvents``).
+    """
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(finite, n, o), new_state, old_state
+    )
+
+
 def step_rng(rng, step_ctr, axis_name: str | None):
     """Per-step augmentation key; folds in the mesh position so each data
     shard draws independent crops/flips the way each reference node draws

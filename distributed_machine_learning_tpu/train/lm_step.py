@@ -19,9 +19,14 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from flax import struct
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_machine_learning_tpu.train.common import (
+    guard_update,
+    tree_all_finite,
+)
 from distributed_machine_learning_tpu.train.losses import lm_cross_entropy
 from distributed_machine_learning_tpu.train.optimizers import update_fn_for_config
 from distributed_machine_learning_tpu.train.state import TrainState
@@ -31,6 +36,71 @@ from distributed_machine_learning_tpu.runtime.mesh import (
 
 DATA_AXIS = "batch"
 SEQ_AXIS = "seq"
+
+# Dynamic loss-scale clamps: the scale never collapses below 1 (an
+# unscaled loss must always be representable) and never exceeds 2^24
+# (past that, fp32 gradient accumulation itself loses integer precision).
+_MIN_SCALE = 1.0
+_MAX_SCALE = 2.0**24
+
+
+@struct.dataclass
+class DynamicScaleState:
+    """A TrainState plus dynamic loss-scale bookkeeping.
+
+    The bf16 LM path underflows small gradients; the standard fix is to
+    multiply the loss by ``loss_scale`` before the backward pass, divide
+    the gradients by it after, and adapt: halve on overflow (non-finite
+    gradients — the update is skipped, riding the same guard path),
+    double after ``growth_interval`` consecutive good steps.  Kept as a
+    wrapper rather than new TrainState fields so every existing
+    checkpoint, scheme, and test keeps its pytree structure; the step
+    delegates (``step``/``params``/``config``) so drivers that only read
+    those fields (``train/loop.py``) work on either.
+    """
+
+    inner: TrainState
+    loss_scale: jax.Array   # f32 scalar
+    good_steps: jax.Array   # i32 scalar: consecutive finite-grad steps
+    growth_interval: int = struct.field(pytree_node=False, default=200)
+
+    @property
+    def step(self):
+        return self.inner.step
+
+    @property
+    def params(self):
+        return self.inner.params
+
+    @property
+    def config(self):
+        return self.inner.config
+
+
+def with_dynamic_scale(state: TrainState, init_scale: float = 2.0**15,
+                       growth_interval: int = 200) -> DynamicScaleState:
+    """Wrap a TrainState for ``make_lm_train_step(dynamic_scale=True)``."""
+    if init_scale < _MIN_SCALE or init_scale > _MAX_SCALE:
+        raise ValueError(
+            f"init_scale must be in [{_MIN_SCALE}, {_MAX_SCALE}], got "
+            f"{init_scale}"
+        )
+    if growth_interval < 1:
+        raise ValueError(
+            f"growth_interval must be >= 1, got {growth_interval}"
+        )
+    return DynamicScaleState(
+        inner=state,
+        loss_scale=jnp.asarray(init_scale, jnp.float32),
+        good_steps=jnp.zeros((), jnp.int32),
+        growth_interval=growth_interval,
+    )
+
+
+def unwrap_dynamic_scale(state):
+    """The plain TrainState inside (identity for an unwrapped state) —
+    for checkpointing/eval, which know nothing of the scaler."""
+    return state.inner if isinstance(state, DynamicScaleState) else state
 
 
 def lm_loss(model, params, tokens, targets,
@@ -63,7 +133,7 @@ def lm_loss(model, params, tokens, targets,
 
 
 def _lm_step_impl(model, state: TrainState, tokens, targets, *, axis_names,
-                  fused_ce_chunks: int | None = None):
+                  fused_ce_chunks: int | None = None, guard: bool = False):
     def loss_fn(params):
         return lm_loss(model, params, tokens, targets, fused_ce_chunks)
 
@@ -77,7 +147,65 @@ def _lm_step_impl(model, state: TrainState, tokens, targets, *, axis_names,
     new_state = state.replace(
         params=new_params, momentum=new_momentum, step=state.step + 1
     )
+    if guard:
+        # Non-finite gradients skip the update wholesale (step counter
+        # included); the non-finite loss still returns so the host can
+        # count the skip.  Post-pmean grads ⇒ replicated decision.
+        new_state = guard_update(tree_all_finite(grads), new_state, state)
     return new_state, loss
+
+
+def _lm_scaled_step_impl(model, sstate: DynamicScaleState, tokens, targets,
+                         *, axis_names, fused_ce_chunks: int | None = None):
+    """The dynamic-loss-scaled LM step (guard always on).
+
+    Loss is scaled BEFORE the backward pass (so bf16 gradients sit in
+    representable range), gradients unscaled after the cross-axis pmean;
+    overflow (any non-finite gradient) skips the update and halves the
+    scale, ``growth_interval`` consecutive good steps double it.
+    """
+    state = sstate.inner
+    scale = sstate.loss_scale
+
+    def loss_fn(params):
+        return (
+            lm_loss(model, params, tokens, targets, fused_ce_chunks)
+            * scale
+        )
+
+    scaled_loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    if axis_names:
+        grads = lax.pmean(grads, axis_names)
+        scaled_loss = lax.pmean(scaled_loss, axis_names)
+    grads = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) / scale).astype(g.dtype), grads
+    )
+    finite = tree_all_finite(grads)
+    new_params, new_momentum = update_fn_for_config(state.config)(
+        state.params, state.momentum, grads, state.config, step=state.step
+    )
+    new_inner = guard_update(
+        finite,
+        state.replace(params=new_params, momentum=new_momentum,
+                      step=state.step + 1),
+        state,
+    )
+    grown = sstate.good_steps + 1 >= sstate.growth_interval
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grown, jnp.minimum(scale * 2.0, _MAX_SCALE), scale),
+        jnp.maximum(scale * 0.5, _MIN_SCALE),
+    )
+    new_good = jnp.where(
+        finite, jnp.where(grown, 0, sstate.good_steps + 1), 0
+    )
+    new_sstate = DynamicScaleState(
+        inner=new_inner, loss_scale=new_scale, good_steps=new_good,
+        growth_interval=sstate.growth_interval,
+    )
+    # Report the UNSCALED loss (non-finite on overflow steps, which is
+    # how the host observes the backoff).
+    return new_sstate, scaled_loss / scale
 
 
 def make_lm_train_step(
@@ -86,6 +214,8 @@ def make_lm_train_step(
     data_axis: str = DATA_AXIS,
     seq_axis: str = SEQ_AXIS,
     fused_ce_chunks: int | None = None,
+    guard_nonfinite: bool = False,
+    dynamic_scale: bool = False,
 ):
     """Build ``step(state, tokens, targets) -> (state, loss)``.
 
@@ -98,15 +228,32 @@ def make_lm_train_step(
     ``fused_ce_chunks``: if set (>= 1), compute the loss fused with the
     lm_head over this many vocab chunks (``ops/fused_ce.py``) — the
     [B, L, vocab] logits are never materialized.
+
+    ``guard_nonfinite``: compile the non-finite-gradient guard into the
+    step — non-finite (post-pmean) gradients skip the update (state and
+    step counter unchanged) instead of poisoning the params.
+
+    ``dynamic_scale``: the bf16 path's dynamic loss scaling (implies the
+    guard).  The step then operates on a :class:`DynamicScaleState` —
+    wrap the initial state with :func:`with_dynamic_scale` and unwrap
+    with :func:`unwrap_dynamic_scale` for checkpointing/eval.  Overflow
+    halves the scale and skips the update; ``growth_interval``
+    consecutive good steps double it (clamped to [1, 2^24]).
     """
     if fused_ce_chunks is not None and fused_ce_chunks < 1:
         raise ValueError(
             f"fused_ce_chunks must be >= 1 (got {fused_ce_chunks}); "
             "use None for the unfused loss"
         )
+    if dynamic_scale:
+        base_impl = partial(_lm_scaled_step_impl, model,
+                            fused_ce_chunks=fused_ce_chunks)
+    else:
+        base_impl = partial(_lm_step_impl, model,
+                            fused_ce_chunks=fused_ce_chunks,
+                            guard=guard_nonfinite)
     if mesh is None:
-        impl = partial(_lm_step_impl, model, axis_names=(),
-                       fused_ce_chunks=fused_ce_chunks)
+        impl = partial(base_impl, axis_names=())
         return jax.jit(impl, donate_argnums=(0,))
 
     missing = [a for a in (data_axis, seq_axis) if a not in mesh.axis_names]
@@ -136,8 +283,7 @@ def make_lm_train_step(
             'attn_impl="ring"/"ring_flash"/"ulysses" or an axis_shape '
             "with seq size 1"
         )
-    impl = partial(_lm_step_impl, model, axis_names=axis_names,
-                   fused_ce_chunks=fused_ce_chunks)
+    impl = partial(base_impl, axis_names=axis_names)
     batch_spec = P(data_axis, seq_axis)
     sharded = _shard_map(
         impl,

@@ -58,6 +58,8 @@ def train_epoch(
     metrics=None,
     stop=None,
     watchdog=None,
+    events=None,
+    until_step: int | None = None,
 ) -> tuple[TrainState, IterationTimer]:
     """One epoch, reference-style: returns (state, timer).
 
@@ -69,9 +71,22 @@ def train_epoch(
     (e.g. a ``runtime/resilience.PreemptionHandler``) — True ends the
     epoch cleanly with state consistent, so the caller can checkpoint.
     ``watchdog``: optional ``runtime/resilience.Watchdog``; beaten once
-    per completed step.
+    per completed step, and once BEFORE the first batch is pulled — a
+    loader that hangs on batch 0 is then caught as a stall with a full
+    timeout window instead of hanging forever against a window already
+    spent on setup/compile.
+    ``events``: optional ``runtime/faults.FaultEvents``; counts steps the
+    non-finite-gradient guard skipped (step counter unchanged after a
+    consumed batch) and dynamic loss-scale adjustments.
+    ``until_step``: optional absolute step-counter target — the epoch
+    ends once ``state.step`` reaches it.  Unlike ``max_iters`` (a batch
+    cap) this counts *applied* updates, so guard-skipped steps are
+    retried with further batches — the supervisor's contract that a
+    faulted run still lands on the same final step count.
     """
     timer = timer or IterationTimer(skip_first=1)
+    if watchdog is not None:
+        watchdog.beat()
     for batch_idx, (images, labels) in enumerate(batches):
         if batch_idx == max_iters:  # part1/main.py:32-33
             break
@@ -80,12 +95,39 @@ def train_epoch(
                 f"stop requested; ending epoch after {batch_idx} iterations"
             )
             break
+        if events is not None:
+            step_before = int(jax.device_get(state.step))
+            # Read the value NOW: the jitted step donates its input
+            # state, so this buffer is dead after the call.
+            scale_before = getattr(state, "loss_scale", None)
+            if scale_before is not None:
+                scale_before = float(scale_before)
         timer.start()
         if place_batch is not None:
             images, labels = place_batch(images, labels)
         state, loss = train_step(state, images, labels)
         loss = jax.block_until_ready(loss)
         iter_time = timer.stop()
+        # One host sync serves both the skip accounting and the
+        # until_step check below — these reads serialize dispatch, so
+        # pay for them only when a consumer asked.
+        step_after = (
+            int(jax.device_get(state.step))
+            if events is not None or until_step is not None
+            else None
+        )
+        if events is not None:
+            # Account BEFORE the watchdog beat: a RaisingWatchdog beat
+            # escalates a declared stall into an exception, and a skip
+            # that landed on the same step must already be counted.
+            if step_after == step_before:
+                events.skipped_steps += 1
+            if scale_before is not None:
+                before, after = scale_before, float(state.loss_scale)
+                if after < before:
+                    events.scaler_backoffs += 1
+                elif after > before:
+                    events.scaler_growths += 1
         if watchdog is not None:
             watchdog.beat()
         if metrics is not None:
@@ -112,6 +154,8 @@ def train_epoch(
                 rank0_print(
                     f"Loss at {batch_idx + 1}th batch is {float(loss)}"
                 )
+        if until_step is not None and step_after >= until_step:
+            break
     rank0_print(timer.summary())  # part1/main.py:57-58
     return state, timer
 

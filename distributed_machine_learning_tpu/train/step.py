@@ -52,6 +52,7 @@ def _train_step_impl(
     accum_steps: int = 1,
     update_fn=None,
     local_loss: bool = False,
+    guard: bool = False,
 ):
     # Unsynced-BN quirk mode (reference part3: per-node running stats,
     # part3/model.py:24 + group25.pdf p.3-4): the replicated state holds
@@ -155,6 +156,19 @@ def _train_step_impl(
         batch_stats=new_stats,
         step=state.step + 1,
     )
+    if guard:
+        # Non-finite-gradient guard: a NaN/Inf anywhere in the (synced)
+        # gradients skips the whole update — params, momentum, BN stats,
+        # and the step counter all stay exactly as they were, so one bad
+        # batch costs one step, not the run.  Checked on the post-sync
+        # gradients (identical on every device), so the skip decision is
+        # replicated and cross-device state stays bit-identical.
+        from distributed_machine_learning_tpu.train.common import (
+            guard_update,
+            tree_all_finite,
+        )
+
+        new_state = guard_update(tree_all_finite(grads), new_state, state)
     if axis_name is not None:
         if local_loss:
             # Reference print-surface parity mode: each rank prints its
@@ -181,6 +195,7 @@ def make_train_step(
     jit: bool = True,
     optimizer: str | None = None,
     local_loss: bool = False,
+    guard_nonfinite: bool = False,
 ):
     """Build the jitted train step.
 
@@ -213,6 +228,14 @@ def make_train_step(
     callers that embed the step in a larger compiled program, e.g. the
     benchmark's ``lax.scan``-ed epoch (bench.py) where per-step dispatch
     would dominate on a remote/tunneled device.
+
+    ``guard_nonfinite``: compile the non-finite-gradient guard into the
+    step — an all-leaves ``isfinite`` reduction over the (synced)
+    gradients; when any gradient blew up, the update is skipped wholesale
+    (state unchanged, step NOT incremented) and the returned loss is the
+    non-finite value so the host can count the event
+    (``runtime/faults.FaultEvents.skipped_steps``).  Off by default:
+    reference-parity runs must not mask numeric bugs.
 
     Returns ``step(state, images_u8, labels) -> (state, loss)``.
     """
@@ -250,6 +273,7 @@ def make_train_step(
             clip_norm=clip_norm,
             accum_steps=accum_steps,
             update_fn=update_fn,
+            guard=guard_nonfinite,
         )
         return jax.jit(impl, donate_argnums=(0,)) if jit else impl
 
@@ -272,6 +296,7 @@ def make_train_step(
         accum_steps=accum_steps,
         update_fn=update_fn,
         local_loss=local_loss,
+        guard=guard_nonfinite,
     )
     state_spec = P()  # replicated
     batch_spec = P(axis_name)  # sharded along the data axis

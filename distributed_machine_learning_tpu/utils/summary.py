@@ -1,9 +1,14 @@
-"""Model summary banner — parameter table + totals.
+"""Run summary banners — parameter table + totals, and the resilience
+event table.
 
 The reference prints a torchsummary table for part1 (``part1/main.py:118``)
-whose ~9.2M-parameter total the report leans on (group25.pdf p.2).  This
-is the pytree-native equivalent: per-module parameter counts from the
-params tree itself, plus the totals line.
+whose ~9.2M-parameter total the report leans on (group25.pdf p.2).
+``model_summary`` is the pytree-native equivalent: per-module parameter
+counts from the params tree itself, plus the totals line.
+``resilience_summary`` is the same treatment for the self-healing
+runtime: every skip/retry/stall/restart counter from a supervised run,
+because a recovery nobody can see is indistinguishable from a fault
+that never fired.
 """
 
 from __future__ import annotations
@@ -39,6 +44,44 @@ def model_summary(params, title: str = "Model") -> str:
         "-" * 64,
         f"  {'Total params':<{width}} {total:>12,}",
         f"  {'Size (fp32)':<{width}} {total * 4 / 2**20:>10.2f} MB",
+        "-" * 64,
+    ]
+    return "\n".join(lines)
+
+
+_EVENT_LABELS = {
+    "skipped_steps": "updates skipped (non-finite grads)",
+    "scaler_backoffs": "loss-scale halvings (overflow)",
+    "scaler_growths": "loss-scale doublings",
+    "loader_retries": "data-loader retries",
+    "skipped_batches": "bad batches skipped",
+    "stalls": "watchdog stalls declared",
+    "restarts": "supervisor restarts",
+    "preemptions": "preemption stops",
+    "ckpt_kills": "injected mid-checkpoint kills",
+}
+
+
+def resilience_summary(events, title: str = "Resilience") -> str:
+    """The robustness counters table (``runtime/faults.FaultEvents``) in
+    the same banner style as ``model_summary`` — printed at the end of a
+    supervised/fault-injected run so recoveries are observable, not
+    silent.  All-zero counters render as a one-line clean bill."""
+    counts = events.as_dict()
+    width = 36
+    rows = [
+        f"  {_EVENT_LABELS.get(name, name):<{width}} {count:>8,}"
+        for name, count in counts.items()
+        if count
+    ]
+    if not rows:
+        return f"{title}: no fault events (clean run)"
+    lines = [
+        f"{title} summary",
+        "-" * 64,
+        *rows,
+        "-" * 64,
+        f"  {'Total events':<{width}} {sum(counts.values()):>8,}",
         "-" * 64,
     ]
     return "\n".join(lines)

@@ -156,3 +156,97 @@ def test_resume_plain_checkpoint_into_unsync_bn_quirk(tmp_path):
     # Resume the same run in quirk mode: restore must go through the
     # plain template then stack per-device stats rows.
     part3.main(common + ["--resume", "--unsync-bn"])
+
+
+def test_gc_checkpoints_keeps_newest_complete(tmp_path):
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        gc_checkpoints,
+    )
+
+    state = init_model_and_state(_tiny_model())
+    for s in (1, 2, 3):
+        save_checkpoint(tmp_path, state.replace(step=jnp.asarray(s,
+                                                                 jnp.int32)))
+    # An old incomplete dir (crash leftover) and a newer-than-newest one
+    # (possibly an in-flight async save).
+    (tmp_path / "step_0" / "state").mkdir(parents=True)
+    (tmp_path / "step_9" / "state").mkdir(parents=True)
+    removed = gc_checkpoints(tmp_path, keep_last_n=2)
+    names = {p.name for p in tmp_path.iterdir()}
+    assert {"step_2", "step_3"} <= names  # newest 2 complete kept
+    assert "step_1" not in names  # old complete beyond keep_last_n: gone
+    assert "step_0" not in names  # old crash leftover: gone
+    assert "step_9" in names  # newer incomplete: possibly in-flight, kept
+    assert len(removed) == 2
+    with pytest.raises(ValueError):
+        gc_checkpoints(tmp_path, keep_last_n=0)
+
+
+def test_save_checkpoint_keep_last_n_gc_inline(tmp_path):
+    state = init_model_and_state(_tiny_model())
+    for s in (1, 2, 3):
+        save_checkpoint(tmp_path, state.replace(step=jnp.asarray(s,
+                                                                 jnp.int32)),
+                        keep_last_n=1)
+    names = {p.name for p in tmp_path.iterdir()}
+    assert names == {"step_3"}  # each save GCs its predecessors
+
+
+def test_checkpoint_cursor_roundtrip(tmp_path):
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        checkpoint_config,
+        checkpoint_cursor,
+    )
+
+    state = init_model_and_state(_tiny_model(),
+                                 config=SGDConfig(learning_rate=0.05))
+    with_cursor = save_checkpoint(tmp_path / "a", state, cursor=17)
+    assert checkpoint_cursor(with_cursor) == 17
+    # The cursor tag must not leak into the optimizer config.
+    assert checkpoint_config(with_cursor) == SGDConfig(learning_rate=0.05)
+    without = save_checkpoint(tmp_path / "b", state)
+    assert checkpoint_cursor(without) is None
+
+
+def test_mid_save_crash_leaves_checkpoint_invisible(tmp_path):
+    # The kill-mid-checkpoint window: state dir written, config not.
+    # latest_checkpoint must fall back to the previous complete save.
+    state = init_model_and_state(_tiny_model())
+    complete = save_checkpoint(tmp_path, state)
+
+    def die():
+        raise RuntimeError("killed mid-save")
+
+    later = state.replace(step=jnp.asarray(5, jnp.int32))
+    with pytest.raises(RuntimeError):
+        save_checkpoint(tmp_path, later, mid_save_hook=die)
+    assert (tmp_path / "step_5" / "state").exists()  # torn save on disk
+    assert latest_checkpoint(tmp_path) == complete  # ...and invisible
+    # Re-saving the same step after the crash heals the torn directory.
+    healed = save_checkpoint(tmp_path, later)
+    assert latest_checkpoint(tmp_path) == healed
+
+
+def test_async_config_written_only_after_state_commit(tmp_path):
+    # The written-order invariant behind _is_complete, async path: the
+    # config file (completeness marker) must not exist until the orbax
+    # state write has committed — wait()/the next save flushes it.
+    import os
+
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        AsyncCheckpointWriter,
+        checkpoint_cursor,
+    )
+
+    state = init_model_and_state(_tiny_model())
+    with AsyncCheckpointWriter() as writer:
+        path = writer.save(tmp_path, state, cursor=4)
+        # Before the sync point the marker is ABSENT no matter how fast
+        # orbax finished: save() never writes it eagerly.
+        assert not os.path.exists(os.path.join(path, "sgd_config.json"))
+        assert latest_checkpoint(tmp_path) is None
+        writer.wait()
+        assert latest_checkpoint(tmp_path) == path
+        assert checkpoint_cursor(path) == 4
+    restored = restore_checkpoint(path, abstract_state=state)
+    assert int(restored.step) == int(state.step)

@@ -107,3 +107,32 @@ def test_lm_cli_ep_grouped_bounded_slots_runs(capsys):
                  "--batch-size", "8"])
     out = capsys.readouterr().out
     assert "Total execution time" in out
+
+
+def test_lm_cli_dynamic_loss_scale_runs(capsys):
+    main(TINY + ["--parallel", "dp", "--loss-scale", "dynamic"])
+    out = capsys.readouterr().out
+    assert "Total execution time" in out
+
+
+def test_lm_cli_guard_nonfinite_runs(capsys):
+    main(TINY + ["--parallel", "dp", "--guard-nonfinite"])
+    out = capsys.readouterr().out
+    assert "Total execution time" in out
+
+
+def test_lm_cli_robustness_flags_fail_fast_on_unsupported_scheme():
+    # fsdp_pl's step doesn't implement the guard: silently training
+    # unguarded would be worse than refusing.
+    with pytest.raises(ValueError, match="guard-nonfinite"):
+        main(TINY + ["--parallel", "fsdp_pl", "--loss-scale", "dynamic"])
+
+
+def test_lm_cli_resume_auto_restores_checkpoint(tmp_path, capsys):
+    ck = str(tmp_path / "ck")
+    main(TINY + ["--parallel", "dp", "--ckpt-dir", ck])
+    capsys.readouterr()
+    main(TINY + ["--parallel", "dp", "--ckpt-dir", ck, "--resume", "auto"])
+    out = capsys.readouterr().out
+    assert "Resumed from" in out
+    assert "Total execution time" in out
