@@ -67,8 +67,9 @@ def main() -> None:
 
     step = make_train_step(model, augment=True, jit=False)
     state = init_model_and_state(model)
+    tail: dict = {}
     best, _, _ = timed_scan_epoch(
-        step, state, dx, dy, reps=args.reps, chain=args.chain
+        step, state, dx, dy, reps=args.reps, chain=args.chain, stats=tail
     )
 
     imgs_per_sec = BATCH * TIMED_ITERS / best
@@ -84,6 +85,15 @@ def main() -> None:
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec",
         "vs_baseline": vs_baseline,
+        # Tail latency per ITERATION over every raw scan sample (chain
+        # points included): future BENCH_*.json rounds must report p95
+        # next to the best/mean (docs/PERF.md) — a straggler-free best
+        # hides exactly the steps a production run diagnoses by.
+        "iter_p50_s": round(tail["p50_s"] / TIMED_ITERS, 6),
+        "iter_p95_s": round(tail["p95_s"] / TIMED_ITERS, 6),
+        "iter_p99_s": round(tail["p99_s"] / TIMED_ITERS, 6),
+        "iter_max_s": round(tail["max_s"] / TIMED_ITERS, 6),
+        "tail_samples": tail["samples"],
     }
     if args.model.startswith("vgg"):
         from distributed_machine_learning_tpu.models.vgg import _cfg

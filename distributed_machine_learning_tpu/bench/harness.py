@@ -100,7 +100,8 @@ def two_point_dispatch(dispatch, fetch, reps: int, chain: int) -> float:
     return two_point_fit(timed, chain)
 
 
-def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1, chain: int = 1):
+def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1, chain: int = 1,
+                     stats: dict | None = None):
     """Time ``len(imgs)`` train steps as one compiled scan.
 
     ``step``: un-jitted ``(state, x, y) -> (state, loss)`` (build with
@@ -121,6 +122,16 @@ def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1, chain: int = 1):
     device time per 39-step scan.  The reference's own protocol has no
     such overhead to exclude — its timer wraps on-node compute only
     (part1/main.py:53-58).
+
+    ``stats``: optional dict, filled in place with the tail of the raw
+    measurements — ``p50_s``/``p95_s``/``p99_s``/``max_s`` per-scan
+    seconds plus ``samples`` — so bench result dicts report tail
+    latency alongside the best (BENCH_*.json rounds must carry p95 with
+    the mean; docs/PERF.md).  Computed over the LONGEST-chain regime
+    only: the 1-dispatch measurements each carry a full tunnel RTT that
+    the chained ones amortize chain-fold, so pooling the regimes would
+    make "p95" measure the RTT the two-point fit exists to cancel, not
+    step stragglers.
 
     Raises ``RuntimeError`` on a non-finite final loss — a benchmark
     number from a diverged run must never be reported.
@@ -143,6 +154,8 @@ def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1, chain: int = 1):
             "report a throughput number"
         )
 
+    samples: list[tuple[int, float]] = []  # (chain length, per-scan s)
+
     def timed(n_dispatches):
         """Best-of-reps seconds for n async same-epoch dispatches + 1 fetch."""
         best = float("inf")
@@ -151,8 +164,24 @@ def timed_scan_epoch(step, state, imgs, lbls, reps: int = 1, chain: int = 1):
             for _ in range(n_dispatches):
                 _, losses = run(state0, imgs, lbls)
             float(losses[-1])  # forces real device completion of the queue
-            best = min(best, time.perf_counter() - start)
+            elapsed = time.perf_counter() - start
+            samples.append((n_dispatches, elapsed / n_dispatches))
+            best = min(best, elapsed)
         return best
 
     best = two_point_fit(timed, chain)
+    if stats is not None:
+        from distributed_machine_learning_tpu.utils.timing import (
+            percentile_stats,
+        )
+
+        # Longest-chain regime only (see docstring): at chain=1 this is
+        # the single regime, overhead-inclusive by necessity.
+        longest = max(n for n, _ in samples)
+        per_scan = [s for n, s in samples if n == longest]
+        tail = percentile_stats(per_scan)
+        stats.update(
+            p50_s=tail["p50"], p95_s=tail["p95"], p99_s=tail["p99"],
+            max_s=tail["max"], samples=len(per_scan),
+        )
     return best, final_loss, out_state

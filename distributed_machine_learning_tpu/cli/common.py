@@ -51,6 +51,28 @@ def add_node_flags(parser: argparse.ArgumentParser) -> None:
                         help="number of processes")
 
 
+def add_telemetry_flags(parser: argparse.ArgumentParser) -> None:
+    """The streaming-telemetry flags, shared by the CNN parts and the LM
+    entrypoint (one definition, like ``add_node_flags``)."""
+    parser.add_argument("--telemetry-dir", dest="telemetry_dir",
+                        default=None, type=str,
+                        help="stream run telemetry here: metrics.jsonl "
+                             "(per-step rows, attempt-tagged, fsynced "
+                             "every --telemetry-flush-every rows — "
+                             "crash-safe, restarts append), trace.json "
+                             "(Chrome trace of driver phases: data_wait/"
+                             "place_batch/step_dispatch/device_block/"
+                             "checkpoint_save/eval/restart_attempt; open "
+                             "in ui.perfetto.dev), registry.json + "
+                             "metrics.prom (final counters/quantiles). "
+                             "Off by default: zero per-step cost")
+    parser.add_argument("--telemetry-flush-every",
+                        dest="telemetry_flush_every", default=20, type=int,
+                        help="flush+fsync the telemetry sinks every N "
+                             "rows/events (default 20); lower = smaller "
+                             "crash-loss window, more write syscalls")
+
+
 def make_flag_parser(description: str) -> argparse.ArgumentParser:
     """The reference's exact flag surface (part2/2a/main.py:210-218)."""
     parser = argparse.ArgumentParser(description=description)
@@ -131,7 +153,10 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                              "loop here (view with TensorBoard/Perfetto)")
     parser.add_argument("--metrics-file", default=None, type=str,
                         help="write per-step metrics (step, loss, iteration "
-                             "seconds) here; .csv for CSV, else JSONL")
+                             "seconds) here; .csv for CSV, else JSONL "
+                             "(JSONL streams to disk as rows land — a "
+                             "crash keeps everything already flushed)")
+    add_telemetry_flags(parser)
     parser.add_argument("--loader", default="auto",
                         choices=["auto", "python", "native"],
                         help="batch loader backend: 'native' is the C++ "
@@ -270,6 +295,11 @@ def parse_flags(parser: argparse.ArgumentParser, argv=None) -> argparse.Namespac
         parser.error(f"--grad-accum must be >= 1, got {args.grad_accum}")
     if args.warmup_steps < 0:
         parser.error(f"--warmup-steps must be >= 0, got {args.warmup_steps}")
+    if getattr(args, "telemetry_flush_every", 20) < 1:
+        parser.error(
+            f"--telemetry-flush-every must be >= 1, got "
+            f"{args.telemetry_flush_every}"
+        )
     if args.lr_schedule == "cosine":
         total = args.max_iters * args.epochs
         if args.warmup_steps >= total:
@@ -309,7 +339,41 @@ def run_part(
 
     from distributed_machine_learning_tpu.runtime.faults import FaultEvents
 
-    metrics = MetricsLogger() if args.metrics_file else None
+    # Streaming mode: rows hit the disk as they land (rank-0 gated,
+    # periodic fsync) instead of only at exit — a crash keeps history.
+    # Append only when this run CONTINUES prior work (--resume): a
+    # restart then extends the survivor rows.  A fresh run truncates,
+    # the historical semantics — appending would silently mix
+    # unrelated runs in one file.
+    metrics = (
+        MetricsLogger(path=args.metrics_file,
+                      flush_every=getattr(args, "telemetry_flush_every", 20),
+                      append=bool(args.resume))
+        if args.metrics_file else None
+    )
+    from distributed_machine_learning_tpu.telemetry import (
+        set_telemetry,
+        telemetry_from_flags,
+    )
+
+    telemetry = telemetry_from_flags(args)
+    prev_telemetry = None
+    if telemetry is not None:
+        # Installed process-wide so the deep layers (loader queue gauge,
+        # retry counters, checkpoint spans, FaultEvents mirror,
+        # supervisor restart spans) see it without signature threading.
+        prev_telemetry = set_telemetry(telemetry)
+        from distributed_machine_learning_tpu.models.vgg import _cfg
+        from distributed_machine_learning_tpu.utils.flops import (
+            vgg_train_flops_per_image,
+        )
+
+        if args.model.upper() in _cfg:
+            # MFU cost model (utils/flops.py); non-VGG models log
+            # throughput without MFU rather than against a wrong model.
+            telemetry.flops_per_example = vgg_train_flops_per_image(
+                _cfg[args.model.upper()]
+            )
     ctx = initialize_from_flags(args.master_ip, args.rank, args.num_nodes)
     preemption = None
     watchdog = None
@@ -668,7 +732,7 @@ def run_part(
                         train_step, state, batches, place_batch=place,
                         max_iters=args.max_iters, metrics=metrics,
                         stop=in_loop_stop, watchdog=wd,
-                        events=loop_events,
+                        events=loop_events, telemetry=telemetry,
                     )
                 # One agreed decision governs the whole epoch tail —
                 # eval, checkpoint, and loop exit must diverge on NO host.
@@ -688,6 +752,9 @@ def run_part(
                     # can't be declared a stall — under --resume auto a
                     # declared stall costs a restart.
                     with (wd.suspend() if wd is not None
+                          else contextlib.nullcontext()), \
+                         (telemetry.span("eval", epoch=progress["epochs"])
+                          if telemetry is not None
                           else contextlib.nullcontext()):
                         evaluate(eval_step, state, eval_batches)
                 if args.ckpt_dir:
@@ -812,6 +879,17 @@ def run_part(
         if metrics is not None:
             metrics.save(args.metrics_file)
             rank0_print(
-                f"Wrote {len(metrics.rows)} metric rows to {args.metrics_file}"
+                f"Wrote {metrics.count} metric rows to "
+                f"{args.metrics_file}"
+                + (" (streamed; append mode: prior runs' rows in the "
+                   "same file are preserved above this run's)"
+                   if metrics._sink is not None and metrics.append else
+                   " (streamed)" if metrics._sink is not None else "")
             )
+        if telemetry is not None:
+            # Uninstall BEFORE close so late events (shutdown paths) hit
+            # a closed sink never; then flush + terminate the trace.
+            set_telemetry(prev_telemetry)
+            telemetry.close()
+            rank0_print(f"Telemetry written to {args.telemetry_dir}")
         ctx.shutdown()  # dist.destroy_process_group parity (part2/2a/main.py:207)

@@ -58,10 +58,14 @@ from distributed_machine_learning_tpu.utils.logging import rank0_print
 def make_parser():
     import argparse
 
-    from distributed_machine_learning_tpu.cli.common import add_node_flags
+    from distributed_machine_learning_tpu.cli.common import (
+        add_node_flags,
+        add_telemetry_flags,
+    )
 
     p = argparse.ArgumentParser(description=__doc__)
     add_node_flags(p)
+    add_telemetry_flags(p)
     p.add_argument("--parallel", default="dp",
                    choices=["dp", "ring", "ulysses", "fsdp", "fsdp_pl",
                             "tp", "pp", "3d", "ep"])
@@ -695,7 +699,23 @@ def build(args):
 
 
 def main(argv=None) -> None:
-    args = make_parser().parse_args(argv)
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    if args.telemetry_flush_every < 1:
+        # Same parse-time validation the CNN parts get from parse_flags.
+        parser.error(
+            f"--telemetry-flush-every must be >= 1, got "
+            f"{args.telemetry_flush_every}"
+        )
+    from distributed_machine_learning_tpu.telemetry import (
+        set_telemetry,
+        telemetry_from_flags,
+    )
+
+    telemetry = telemetry_from_flags(args)
+    prev_telemetry = None
+    if telemetry is not None:
+        prev_telemetry = set_telemetry(telemetry)
     ctx = initialize_from_flags(args.master_ip, args.rank, args.num_nodes)
     try:
         rank0_print(
@@ -756,6 +776,28 @@ def main(argv=None) -> None:
                     f"corpus: {len(corpus)} tokens from {args.data_dir}"
                 )
         step, state, place, model, params_fn = build(args)
+        if telemetry is not None:
+            # MFU cost model: ~6·P/token + attention term
+            # (utils/flops.py).  Parameter count from the state when it
+            # exposes a params tree (every scheme but flat-fsdp, whose
+            # state is one sharded vector — throughput-only there).
+            params_tree = getattr(state, "params", None)
+            if params_tree is not None:
+                from distributed_machine_learning_tpu.utils.flops import (
+                    transformer_train_flops_per_token,
+                )
+
+                n_params = sum(
+                    int(np.prod(leaf.shape))
+                    for leaf in jax.tree_util.tree_leaves(params_tree)
+                    if hasattr(leaf, "shape")
+                )
+                telemetry.flops_per_token = (
+                    transformer_train_flops_per_token(
+                        n_params, args.n_layers, args.d_model,
+                        args.seq_len,
+                    )
+                )
         rng = np.random.default_rng(SEED)
 
         if corpus is not None:
@@ -1003,8 +1045,16 @@ def main(argv=None) -> None:
                                                            tiled=True)
             else:
                 params = jax.device_get(params)
-            evaluate_lm(make_lm_eval_step(model), params, ev)
+            import contextlib
+
+            with (telemetry.span("eval") if telemetry is not None
+                  else contextlib.nullcontext()):
+                evaluate_lm(make_lm_eval_step(model), params, ev)
     finally:
+        if telemetry is not None:
+            set_telemetry(prev_telemetry)
+            telemetry.close()
+            rank0_print(f"Telemetry written to {args.telemetry_dir}")
         ctx.shutdown()
 
 

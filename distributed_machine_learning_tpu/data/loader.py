@@ -106,9 +106,24 @@ class BatchLoader:
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
+        # Queue-depth gauge: sampled at every consumer get, so a
+        # telemetry timeline shows whether the prefetcher keeps ahead of
+        # the step (depth ~prefetch) or the loop is data-starved
+        # (depth ~0 — the data_wait spans will be wide at the same
+        # steps).  One module-level lookup per epoch, nothing per batch
+        # when telemetry is off.
+        from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+        tel = get_telemetry()
+        depth = (
+            tel.registry.gauge("data_queue_depth") if tel is not None
+            else None
+        )
         try:
             while True:
                 item = q.get()
+                if depth is not None:
+                    depth.set(q.qsize())
                 if item is sentinel:
                     if failure:
                         raise failure[0]

@@ -29,6 +29,16 @@ from typing import Callable, Iterable, Iterator
 from distributed_machine_learning_tpu.utils.logging import rank0_print
 
 
+def _mirror_retry_counter(kind: str) -> None:
+    """Registry counter for a retry event with no FaultEvents attached —
+    same naming as the FaultEvents mirror so dashboards see one series."""
+    from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if tel is not None:
+        tel.registry.counter("fault_events", kind=kind).inc()
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Bounds for :func:`retry_batches`.
@@ -99,6 +109,12 @@ def retry_batches(
             retries += 1
             if events is not None:
                 events.loader_retries += 1
+            else:
+                # No FaultEvents wired (bare BatchLoader(retry=...) use):
+                # the registry is then the only observer.  With events,
+                # the FaultEvents mirror (runtime/faults.py) already
+                # lands the count — counting here too would double it.
+                _mirror_retry_counter("loader_retries")
             if retries > policy.max_retries:
                 # Exhaustion is checked BEFORE the skip accounting: when
                 # a batch crosses its skip threshold on the same failure
@@ -114,6 +130,8 @@ def retry_batches(
             if attempts[pos] >= policy.max_attempts_per_batch:
                 if events is not None:
                     events.skipped_batches += 1
+                else:
+                    _mirror_retry_counter("skipped_batches")
                 rank0_print(
                     f"[data-retry] batch {pos} failed {attempts[pos]} "
                     f"time(s) ({type(exc).__name__}: {exc}); skipping it"

@@ -97,6 +97,33 @@ class FaultEvents:
     preemptions: int = 0        # SIGTERM turned into a clean checkpointed stop
     ckpt_kills: int = 0         # injected death mid-checkpoint-save
 
+    def __setattr__(self, name: str, value) -> None:
+        # Mirror every increment into the telemetry registry AS IT
+        # HAPPENS (``fault_events{kind=...}`` counters) — the end-of-run
+        # summary shows totals, but a restart wipes this object's host
+        # memory while the streamed registry survives; catching the
+        # write here instruments every `events.x += 1` site at once.
+        prev = self.__dict__.get(name)
+        object.__setattr__(self, name, value)
+        if isinstance(prev, int) and isinstance(value, int) and value > prev:
+            from distributed_machine_learning_tpu.telemetry import (
+                get_telemetry,
+            )
+
+            tel = get_telemetry()
+            if tel is not None:
+                tel.registry.counter("fault_events", kind=name).inc(
+                    value - prev
+                )
+                tel.tracer.instant(f"fault_{name}")
+                # Export NOW: the next thing after some of these events
+                # is a process death (kill_ckpt's os._exit mode) — a
+                # counter only in host memory at that point is lost,
+                # and the re-exec would rehydrate stale totals.  Fault
+                # events are rare; two atomic file writes each is
+                # noise.
+                tel.flush()
+
     def as_dict(self) -> dict[str, int]:
         return dataclasses.asdict(self)
 

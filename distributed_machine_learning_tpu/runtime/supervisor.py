@@ -99,12 +99,28 @@ def run_attempts(attempt: Callable[[int], object], *, max_restarts: int = 3,
     this owns the policy — count, log, give up loudly.
     KeyboardInterrupt/SystemExit always propagate.
     """
+    from distributed_machine_learning_tpu.telemetry import get_telemetry
+
     if max_restarts < 0:
         raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
     restarts = 0
     while True:
+        # Each attempt is one `restart_attempt` span in the trace and
+        # one attempt tag on every metrics row it produces — the chaos
+        # timeline's backbone: fault → failed span → next attempt's rows
+        # appended (never truncating the dead attempt's history).
+        tel = get_telemetry()
+        if tel is not None:
+            tel.set_attempt(tel.attempt if restarts == 0 else
+                            tel.attempt + 1)
         try:
-            return attempt(restarts)
+            # Tag with the TELEMETRY attempt (disk-resumed offset
+            # included), not the in-process restart index — spans and
+            # metrics rows must carry the same number or the timeline
+            # can't be correlated after a re-exec.
+            with (tel.span("restart_attempt", attempt=tel.attempt)
+                  if tel is not None else contextlib.nullcontext()):
+                return attempt(restarts)
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as exc:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time
 from typing import Any
 
 import jax
@@ -29,6 +30,34 @@ from distributed_machine_learning_tpu.train.state import TrainState
 
 _CONFIG_FILE = "sgd_config.json"
 _STATE_DIR = "state"
+
+
+def _tree_bytes(tree) -> int:
+    """Total array payload of a pytree — the telemetry "bytes" figure
+    for save/restore spans (shard-local on multi-host runs: each host
+    writes its own addressable shards)."""
+    return sum(
+        int(getattr(leaf, "nbytes", 0) or 0)
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def _record_ckpt_io(tel, kind: str, start_s: float, end_s: float,
+                    step: int, nbytes: int) -> None:
+    """Span + registry entries for one checkpoint save/restore.  Callers
+    guard on ``get_telemetry()`` BEFORE computing ``step``/``nbytes`` —
+    both cost a host sync / pytree walk that the telemetry-off default
+    must not pay."""
+    dur = end_s - start_s
+    tel.tracer.complete(f"checkpoint_{kind}", start_s, end_s, step=step,
+                        bytes=nbytes)
+    tel.registry.histogram(f"checkpoint_{kind}_seconds").observe(dur)
+    tel.registry.counter(f"checkpoint_{kind}_bytes_total").inc(nbytes)
+    tel.registry.counter(f"checkpoint_{kind}s_total").inc()
+    if dur > 0:
+        tel.registry.gauge(f"checkpoint_{kind}_mb_per_s").set(
+            nbytes / dur / 1e6
+        )
 
 
 @jax.jit
@@ -112,6 +141,7 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
     directory = os.path.abspath(os.fspath(directory))
     step = int(jax.device_get(state.step))
     path = os.path.join(directory, f"step_{step}")
+    t0 = time.perf_counter()
     with ocp.PyTreeCheckpointer() as ckptr:
         # force=True: re-saving the same step (e.g. rerunning a crashed job
         # into the same --ckpt-dir) overwrites instead of raising.
@@ -133,6 +163,14 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
             json.dump(payload, f)
         if keep_last_n is not None:
             gc_checkpoints(directory, keep_last_n)
+    # A save that died above (e.g. the injected kill) records no span —
+    # the torn attempt is visible as the fault instant + missing save.
+    from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if tel is not None:
+        _record_ckpt_io(tel, "save", t0, time.perf_counter(), step,
+                        _tree_bytes(_state_pytree(state)))
     return path
 
 
@@ -213,6 +251,11 @@ class AsyncCheckpointWriter:
     def __init__(self):
         self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
         self._pending: tuple[str, dict, str, int | None] | None = None
+        # (start_s, step, nbytes) of the in-flight save, when telemetry
+        # is on — recorded as a checkpoint_save span at the flush that
+        # commits it (the span covers dispatch → durable-on-disk, the
+        # honest window for an async save).
+        self._inflight_telemetry: tuple[float, int, int] | None = None
 
     def save(self, directory: str | os.PathLike, state: TrainState,
              cursor: int | None = None,
@@ -224,6 +267,15 @@ class AsyncCheckpointWriter:
         # (orbax would serialize them anyway) and guarantees at most one
         # pending config at a time.
         self._flush_pending()
+        from distributed_machine_learning_tpu.telemetry import (
+            get_telemetry,
+        )
+
+        if get_telemetry() is not None:
+            self._inflight_telemetry = (
+                time.perf_counter(), step,
+                _tree_bytes(_state_pytree(state)),
+            )
         self._ckptr.save(
             os.path.join(path, _STATE_DIR), _state_pytree(state), force=True
         )
@@ -237,6 +289,17 @@ class AsyncCheckpointWriter:
 
     def _flush_pending(self) -> None:
         self._ckptr.wait_until_finished()
+        if self._inflight_telemetry is not None:
+            from distributed_machine_learning_tpu.telemetry import (
+                get_telemetry,
+            )
+
+            t0, step, nbytes = self._inflight_telemetry
+            self._inflight_telemetry = None
+            tel = get_telemetry()
+            if tel is not None:
+                _record_ckpt_io(tel, "save", t0, time.perf_counter(),
+                                step, nbytes)
         if self._pending is not None:
             path, payload, directory, keep_last_n = self._pending
             os.makedirs(path, exist_ok=True)
@@ -353,6 +416,7 @@ def restore_checkpoint(
     it, arrays land unsharded on the default device.
     """
     path = os.path.abspath(os.fspath(path))
+    t0 = time.perf_counter()
     restore_args: Any = None
     if abstract_state is not None:
         template = jax.tree_util.tree_map(
@@ -379,6 +443,14 @@ def restore_checkpoint(
     # resilience layer exists to prevent.
     tree = fresh_buffers(tree)
     config = checkpoint_config(path)
+    from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if tel is not None:
+        _record_ckpt_io(
+            tel, "restore", t0, time.perf_counter(),
+            int(jax.device_get(tree["step"])), _tree_bytes(tree),
+        )
     return TrainState(
         params=tree["params"],
         momentum=tree["momentum"],

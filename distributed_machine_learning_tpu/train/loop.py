@@ -11,11 +11,13 @@ enqueue latency, not the step.
 
 from __future__ import annotations
 
+import time
 from typing import Iterable
 
 import jax
 import numpy as np
 
+from distributed_machine_learning_tpu.telemetry import get_telemetry
 from distributed_machine_learning_tpu.train.state import TrainState
 from distributed_machine_learning_tpu.utils.logging import rank0_print
 from distributed_machine_learning_tpu.utils.timing import IterationTimer
@@ -60,6 +62,7 @@ def train_epoch(
     watchdog=None,
     events=None,
     until_step: int | None = None,
+    telemetry=None,
 ) -> tuple[TrainState, IterationTimer]:
     """One epoch, reference-style: returns (state, timer).
 
@@ -83,11 +86,29 @@ def train_epoch(
     cap) this counts *applied* updates, so guard-skipped steps are
     retried with further batches — the supervisor's contract that a
     faulted run still lands on the same final step count.
+    ``telemetry``: optional ``telemetry.Telemetry``; defaults to the
+    process-wide install (``get_telemetry()``, None unless a CLI set
+    ``--telemetry-dir``).  When active, the old single timing bracket is
+    split into per-phase spans — ``data_wait`` / ``place_batch`` /
+    ``step_dispatch`` / ``device_block`` — streamed to the Chrome trace,
+    and each step logs an attempt-tagged metrics row (examples/s,
+    tokens/s, MFU when the CLI installed a FLOPs model).  When None
+    (the default) every telemetry branch is a single pointer test: no
+    allocations, no clock reads, no syscalls beyond today's loop.
     """
     timer = timer or IterationTimer(skip_first=1)
+    tel = telemetry if telemetry is not None else get_telemetry()
     if watchdog is not None:
         watchdog.beat()
-    for batch_idx, (images, labels) in enumerate(batches):
+    batches = iter(batches)
+    batch_idx = 0
+    while True:
+        t_fetch = time.perf_counter() if tel is not None else 0.0
+        try:
+            images, labels = next(batches)
+        except StopIteration:
+            break
+        t_got = time.perf_counter() if tel is not None else 0.0
         if batch_idx == max_iters:  # part1/main.py:32-33
             break
         if stop is not None and stop():
@@ -102,11 +123,24 @@ def train_epoch(
             scale_before = getattr(state, "loss_scale", None)
             if scale_before is not None:
                 scale_before = float(scale_before)
+        if tel is not None:
+            # Batch geometry BEFORE placement (sharding may hide it).
+            shape = getattr(images, "shape", None)
+            n_examples = int(shape[0]) if shape else 0
+            n_tokens = (
+                int(shape[0]) * int(shape[1])
+                if shape is not None and len(shape) == 2
+                else None
+            )
         timer.start()
+        t_place = time.perf_counter() if tel is not None else 0.0
         if place_batch is not None:
             images, labels = place_batch(images, labels)
+        t_dispatch = time.perf_counter() if tel is not None else 0.0
         state, loss = train_step(state, images, labels)
+        t_block = time.perf_counter() if tel is not None else 0.0
         loss = jax.block_until_ready(loss)
+        t_end = time.perf_counter() if tel is not None else 0.0
         iter_time = timer.stop()
         # One host sync serves both the skip accounting and the
         # until_step check below — these reads serialize dispatch, so
@@ -130,6 +164,56 @@ def train_epoch(
                     events.scaler_growths += 1
         if watchdog is not None:
             watchdog.beat()
+        if tel is not None:
+            step_no = (
+                step_after if step_after is not None
+                else int(jax.device_get(state.step))
+            )
+            tr = tel.tracer
+            tr.complete("data_wait", t_fetch, t_got, step=batch_idx)
+            if place_batch is not None:
+                tr.complete("place_batch", t_place, t_dispatch,
+                            step=batch_idx)
+            tr.complete("step_dispatch", t_dispatch, t_block,
+                        step=batch_idx)
+            tr.complete("device_block", t_block, t_end, step=batch_idx)
+            data_wait_s = t_got - t_fetch
+            # Mirror the timer's warm-up protocol: an iteration the
+            # timer excluded (XLA compile lands there) must not skew
+            # the histogram quantiles either — registry p99 and the
+            # printed summary percentiles describe the same population.
+            # The span and the (warmup-tagged) row still record it: the
+            # compile step belongs on the timeline, not in the tail.
+            warmup = timer._iter <= timer.skip_first
+            reg = tel.registry
+            reg.counter("steps_total").inc()
+            if not warmup:
+                reg.histogram("step_seconds").observe(iter_time)
+                reg.histogram("data_wait_seconds").observe(data_wait_s)
+            wall = iter_time + data_wait_s
+            examples_per_s = n_examples / wall if wall > 0 else 0.0
+            row = {
+                "batch": batch_idx,
+                "iter_s": iter_time,
+                "data_wait_s": data_wait_s,
+                **({"warmup": True} if warmup else {}),
+                "place_s": t_dispatch - t_place,
+                "dispatch_s": t_block - t_dispatch,
+                "block_s": t_end - t_block,
+                "examples_per_s": examples_per_s,
+            }
+            if n_tokens is not None:
+                tokens_per_s = n_tokens / wall if wall > 0 else 0.0
+                row["tokens_per_s"] = tokens_per_s
+                reg.gauge("tokens_per_s").set(tokens_per_s)
+            else:
+                tokens_per_s = None
+            reg.gauge("examples_per_s").set(examples_per_s)
+            mfu_val = tel.mfu_of(examples_per_s, tokens_per_s)
+            if mfu_val is not None:
+                row["mfu"] = mfu_val
+                reg.gauge("mfu").set(mfu_val)
+            tel.log_step(step_no, **row)
         if metrics is not None:
             metrics.log(
                 step=int(state.step),
@@ -156,6 +240,7 @@ def train_epoch(
                 )
         if until_step is not None and step_after >= until_step:
             break
+        batch_idx += 1
     rank0_print(timer.summary())  # part1/main.py:57-58
     return state, timer
 

@@ -54,21 +54,92 @@ def annotate(name: str):
 
 @dataclass
 class MetricsLogger:
-    """Accumulate per-step metric rows; flush to CSV / JSONL, rank-0 gated.
+    """Per-step metric rows; flush to CSV / JSONL, rank-0 gated.
 
     Rows are plain dicts; the column set is the union over rows (missing
     keys serialize empty in CSV, absent in JSONL).
+
+    Two modes.  **Buffered** (``path=None``, the historical default):
+    rows accumulate in memory and ``save()`` writes the whole file —
+    fine for benches that exit cleanly.  **Streaming** (``path=`` a
+    non-CSV target): rows are ALSO appended to the file as they land,
+    through a crash-safe sink (``telemetry/sink.py``: flush+fsync every
+    ``flush_every`` rows, rank-0 gated) — a crash keeps every flushed
+    row, and with ``append=True`` (the CLI sets it for ``--resume``
+    runs) a restart into the same path APPENDS to the survivor rows
+    instead of truncating them; fresh runs truncate, the historical
+    semantics.  ``save()``
+    to the streaming path is then just a final flush.  CSV cannot
+    stream (the header is the union of columns, unknowable until the
+    end), so ``.csv`` targets stay buffered.
+
+    In streaming mode ``rows`` stays EMPTY — the disk is the buffer
+    (duplicating a long run's history in host memory is the design the
+    sink replaces); ``count`` tracks rows logged in both modes, and
+    ``save()`` accepts only the streamed path.
     """
 
     rows: list[dict] = field(default_factory=list)
+    path: str | os.PathLike | None = None
+    flush_every: int = 20
+    append: bool = False
+    count: int = field(default=0, init=False)
+    _sink: object = field(default=None, init=False, repr=False)
+
+    def __post_init__(self):
+        if self.path is not None and not os.fspath(self.path).endswith(
+            ".csv"
+        ):
+            from distributed_machine_learning_tpu.telemetry.sink import (
+                JsonlSink,
+            )
+
+            # append=False (default) keeps the historical fresh-file
+            # semantics for unrelated reruns; the CLI passes append=True
+            # for resumed runs, where truncating would destroy the
+            # survivor rows the streaming mode exists to protect.
+            self._sink = JsonlSink(self.path, flush_every=self.flush_every,
+                                   append=self.append)
 
     def log(self, step: int, **metrics) -> None:
-        self.rows.append({"step": step, "time": time.time(), **metrics})
+        row = {"step": step, "time": time.time(), **metrics}
+        if self._sink is not None and "attempt" not in row:
+            # Streamed files append across runs (by design — restarts
+            # must not truncate history), so rows need a separator tag:
+            # borrow the telemetry attempt when one is installed, the
+            # same tag metrics.jsonl uses.
+            from distributed_machine_learning_tpu.telemetry import (
+                get_telemetry,
+            )
+
+            tel = get_telemetry()
+            if tel is not None:
+                row["attempt"] = tel.attempt
+        self.count += 1
+        if self._sink is not None:
+            self._sink.write(row)
+        else:
+            self.rows.append(row)
 
     def save(self, path: str | os.PathLike) -> None:
         """Write rows to `path`, format chosen by extension: ``.csv`` for
         CSV, anything else JSONL.  The single dispatch point for every
-        caller (CLI, bench, sweep)."""
+        caller (CLI, bench, sweep).  In streaming mode a save to the
+        streamed path flushes (the rows are already on disk) instead of
+        rewriting — rewriting would truncate prior attempts' appended
+        history, the exact loss this logger was rebuilt to prevent."""
+        if self._sink is not None:
+            if os.path.abspath(os.fspath(path)) != os.path.abspath(
+                os.fspath(self.path)
+            ):
+                raise ValueError(
+                    f"streaming MetricsLogger bound to {self.path}; "
+                    f"cannot save to {os.fspath(path)} (rows are on "
+                    "disk, not buffered)"
+                )
+            self._sink.touch()  # zero rows still leaves the file
+            self._sink.close()
+            return
         if os.fspath(path).endswith(".csv"):
             self.to_csv(path)
         else:

@@ -13,6 +13,35 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from typing import Sequence
+
+
+def percentile(times: Sequence[float], q: float) -> float:
+    """Exact ``q``-quantile (``q`` in [0, 1]) by linear interpolation
+    between order statistics (numpy's default method, stdlib-only so the
+    bench/tools layer can share it without dependencies)."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"q must be in [0, 1], got {q}")
+    if not times:
+        return 0.0
+    xs = sorted(times)
+    rank = q * (len(xs) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (rank - lo) * (xs[hi] - xs[lo])
+
+
+def percentile_stats(times: Sequence[float]) -> dict:
+    """{p50, p95, p99, max} of a sample — the tail-latency block every
+    timing surface (timer summary, bench result dicts) shares, because a
+    mean hides exactly the straggler steps production debugging needs
+    (ISSUE 2; arxiv 1811.05233's per-phase accounting)."""
+    return {
+        "p50": percentile(times, 0.50),
+        "p95": percentile(times, 0.95),
+        "p99": percentile(times, 0.99),
+        "max": max(times) if times else 0.0,
+    }
 
 
 @dataclass
@@ -52,9 +81,18 @@ class IterationTimer:
     def count(self) -> int:
         return len(self.times)
 
+    def percentiles(self) -> dict:
+        """{p50, p95, p99, max} over the accumulated iterations."""
+        return percentile_stats(self.times)
+
     def summary(self) -> str:
-        # Same print surface as the reference (part1/main.py:57-58).
+        # Same first two lines as the reference (part1/main.py:57-58);
+        # the tail line is ours — the reference's average hides the
+        # straggler iterations a per-step timeline exists to expose.
+        p = self.percentiles()
         return (
             f"Total execution time is : {self.total} seconds\n"
-            f"Average execution time is  : {self.average} seconds"
+            f"Average execution time is  : {self.average} seconds\n"
+            f"Iteration time p50/p95/p99/max : {p['p50']:.6f}/"
+            f"{p['p95']:.6f}/{p['p99']:.6f}/{p['max']:.6f} seconds"
         )

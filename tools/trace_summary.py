@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""Summarize a telemetry directory — no third-party imports, jax-free.
+
+Reads the artifacts a run's ``--telemetry-dir`` produced
+(``distributed_machine_learning_tpu/telemetry/``) and prints:
+
+- per-phase time shares from the Chrome trace's complete events
+  (data_wait / place_batch / step_dispatch / device_block /
+  checkpoint_save / eval / ...), the first diagnosis dimension for
+  stragglers and sync overhead;
+- the top-5 slowest steps from the metrics JSONL (attempt-tagged), with
+  their phase breakdown;
+- attempt/restart structure when the run was supervised.
+
+Tolerates the artifacts of a crash: a torn final JSONL line and an
+unterminated trace array are both read to the last complete record —
+this tool's main job is diagnosing runs that died.
+
+Usage:  python tools/trace_summary.py <telemetry-dir> [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# One source of truth for the tolerant readers: the modules that WRITE
+# the artifacts also own the readers that decode them (so the formats
+# cannot drift apart).  These imports are jax-free by construction (jax
+# only loads lazily inside the sinks' write paths) — this tool stays
+# runnable on a bare host; the path bootstrap makes it runnable from
+# anywhere, not just the repo root.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+from distributed_machine_learning_tpu.telemetry.sink import (  # noqa: E402
+    read_jsonl,
+)
+from distributed_machine_learning_tpu.telemetry.tracer import (  # noqa: E402
+    read_trace,
+)
+from distributed_machine_learning_tpu.utils.timing import (  # noqa: E402
+    percentile,
+)
+
+METRICS_FILE = "metrics.jsonl"
+TRACE_FILE = "trace.json"
+REGISTRY_FILE = "registry.json"
+
+# The per-step driver phases, in pipeline order (other spans —
+# checkpoint_save, eval, restart_attempt — are reported after these).
+STEP_PHASES = ("data_wait", "place_batch", "step_dispatch", "device_block")
+
+
+def summarize(telemetry_dir: str, top: int = 5) -> str:
+    lines: list[str] = []
+    trace_path = os.path.join(telemetry_dir, TRACE_FILE)
+    metrics_path = os.path.join(telemetry_dir, METRICS_FILE)
+
+    # -- per-phase shares from the trace --------------------------------
+    if os.path.isfile(trace_path):
+        events = [e for e in read_trace(trace_path)
+                  if isinstance(e, dict) and e.get("ph") == "X"]
+        by_name: dict[str, dict] = {}
+        for e in events:
+            d = by_name.setdefault(e.get("name", "?"),
+                                   {"dur": 0.0, "count": 0})
+            d["dur"] += float(e.get("dur", 0.0))
+            d["count"] += 1
+        phase_total = sum(
+            by_name.get(p, {"dur": 0.0})["dur"] for p in STEP_PHASES
+        )
+        lines.append(f"== Phase time shares ({trace_path}) ==")
+        if phase_total > 0:
+            for p in STEP_PHASES:
+                d = by_name.get(p)
+                if d is None:
+                    continue
+                share = 100.0 * d["dur"] / phase_total
+                lines.append(
+                    f"  {p:<14} {share:5.1f}%  "
+                    f"({d['dur'] / 1e6:.3f}s over {d['count']} spans)"
+                )
+        other = sorted(
+            (n for n in by_name if n not in STEP_PHASES),
+            key=lambda n: -by_name[n]["dur"],
+        )
+        for n in other:
+            d = by_name[n]
+            lines.append(
+                f"  {n:<14} ------  "
+                f"({d['dur'] / 1e6:.3f}s over {d['count']} spans)"
+            )
+        if not by_name:
+            lines.append("  (no complete events)")
+    else:
+        lines.append(f"== No trace at {trace_path} ==")
+
+    # -- slowest steps from the metrics stream --------------------------
+    if os.path.isfile(metrics_path):
+        all_rows = [r for r in read_jsonl(metrics_path)
+                    if isinstance(r, dict) and "iter_s" in r]
+        # Warm-up iterations (XLA compile; timer-excluded, row-tagged)
+        # would otherwise head every "slowest" list and own the tail.
+        rows = [r for r in all_rows if not r.get("warmup")]
+        n_warm = len(all_rows) - len(rows)
+        lines.append(f"== Steps ({metrics_path}) ==")
+        if rows:
+            iters = [float(r["iter_s"]) for r in rows]
+            attempts = sorted({int(r.get("attempt", 0)) for r in all_rows})
+            lines.append(
+                f"  {len(rows)} step rows over attempt(s) "
+                f"{','.join(map(str, attempts))}"
+                + (f" (+{n_warm} warm-up rows excluded)" if n_warm else "")
+                + f"; iter_s "
+                f"p50 {percentile(iters, 0.5):.6f}  "
+                f"p95 {percentile(iters, 0.95):.6f}  "
+                f"p99 {percentile(iters, 0.99):.6f}  "
+                f"max {max(iters):.6f}"
+            )
+            lines.append(f"  top-{top} slowest steps:")
+            slowest = sorted(rows, key=lambda r: -float(r["iter_s"]))[:top]
+            for r in slowest:
+                phases = "  ".join(
+                    f"{k}={float(r[k]):.6f}"
+                    for k in ("data_wait_s", "place_s", "dispatch_s",
+                              "block_s")
+                    if k in r
+                )
+                lines.append(
+                    f"    step {r.get('step', '?'):>6}  attempt "
+                    f"{r.get('attempt', 0)}  iter_s "
+                    f"{float(r['iter_s']):.6f}  {phases}"
+                )
+        else:
+            lines.append("  (no step rows)")
+    else:
+        lines.append(f"== No metrics at {metrics_path} ==")
+
+    # -- fault counters, if the registry snapshot landed ----------------
+    reg_path = os.path.join(telemetry_dir, REGISTRY_FILE)
+    if os.path.isfile(reg_path):
+        with open(reg_path) as f:
+            snap = json.load(f)
+        faults = [c for c in snap.get("counters", [])
+                  if c.get("name") == "fault_events"]
+        if faults:
+            lines.append(f"== Fault events ({reg_path}) ==")
+            for c in sorted(faults, key=lambda c: c["labels"].get("kind", "")):
+                lines.append(
+                    f"  {c['labels'].get('kind', '?'):<18} {c['value']}"
+                )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("telemetry_dir", help="directory a run's "
+                                              "--telemetry-dir pointed at")
+    parser.add_argument("--top", default=5, type=int,
+                        help="how many slowest steps to list (default 5)")
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.telemetry_dir):
+        print(f"not a directory: {args.telemetry_dir}", file=sys.stderr)
+        return 2
+    try:
+        print(summarize(args.telemetry_dir, top=args.top))
+    except BrokenPipeError:  # `| head` closed the pipe — not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
