@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import os
 
 import jax
 
@@ -73,10 +74,40 @@ def add_telemetry_flags(parser: argparse.ArgumentParser) -> None:
                              "crash-loss window, more write syscalls")
 
 
+def add_gang_flags(parser: argparse.ArgumentParser) -> None:
+    """Gang-coordination flags (``runtime/coordinator.py``): multi-host
+    runs that share a filesystem get heartbeat-based peer-failure
+    detection and coordinated abort, so one dead rank restarts the gang
+    instead of hanging it forever."""
+    parser.add_argument("--gang-dir", dest="gang_dir", default=None,
+                        type=str,
+                        help="shared directory for gang coordination "
+                             "(heartbeat files, abort latch, restore-"
+                             "point records — runtime/coordinator.py); "
+                             "enables peer-failure detection: a rank "
+                             "dead/stalled past --peer-timeout aborts "
+                             "the whole gang (exit 43) so an external "
+                             "gang supervisor (cli/gang.py, "
+                             "gang_supervise) can relaunch all ranks "
+                             "together from the agreed restore point. "
+                             "Off by default")
+    parser.add_argument("--heartbeat-interval", dest="heartbeat_interval",
+                        default=1.0, type=float,
+                        help="seconds between heartbeat-file writes "
+                             "(with --gang-dir; default 1.0)")
+    parser.add_argument("--peer-timeout", dest="peer_timeout",
+                        default=60.0, type=float,
+                        help="seconds without peer progress before this "
+                             "rank declares the gang dead and aborts "
+                             "(with --gang-dir; default 60; set it above "
+                             "the first step's XLA compile time)")
+
+
 def make_flag_parser(description: str) -> argparse.ArgumentParser:
     """The reference's exact flag surface (part2/2a/main.py:210-218)."""
     parser = argparse.ArgumentParser(description=description)
     add_node_flags(parser)
+    add_gang_flags(parser)
     parser.add_argument("--data-root", default="./data", type=str)
     parser.add_argument("--epochs", default=1, type=int)  # range(1): part1/main.py:123
     parser.add_argument("--compute-dtype", default="float32",
@@ -300,6 +331,28 @@ def parse_flags(parser: argparse.ArgumentParser, argv=None) -> argparse.Namespac
             f"--telemetry-flush-every must be >= 1, got "
             f"{args.telemetry_flush_every}"
         )
+    if getattr(args, "gang_dir", None):
+        hb = getattr(args, "heartbeat_interval", 1.0)
+        if hb <= 0:
+            parser.error(
+                f"--heartbeat-interval must be > 0, got {hb}"
+            )
+        if getattr(args, "peer_timeout", 60.0) <= 2 * hb:
+            parser.error(
+                "--peer-timeout must exceed two heartbeat intervals "
+                "(a single delayed write would read as a death)"
+            )
+        if getattr(args, "async_ckpt", False):
+            # Restore-point records are written when a save RETURNS
+            # complete; the async writer commits later, so no rank
+            # would ever record a step and the election would silently
+            # never elect — ranks could then resume from different
+            # steps after a gang restart.
+            parser.error(
+                "--gang-dir requires the synchronous checkpoint path "
+                "(drop --async-ckpt): the restore-point election needs "
+                "saves recorded at commit time"
+            )
     if args.lr_schedule == "cosine":
         total = args.max_iters * args.epochs
         if args.warmup_steps >= total:
@@ -378,6 +431,8 @@ def run_part(
     preemption = None
     watchdog = None
     ckpt_writer = None
+    coordinator = None
+    run_completed = False
     events = FaultEvents()
     show_resilience = False
     try:
@@ -436,7 +491,7 @@ def run_part(
 
             if not args.ckpt_dir:
                 raise ValueError("--resume requires --ckpt-dir")
-            latest = latest_checkpoint(args.ckpt_dir)
+            latest = latest_checkpoint(args.ckpt_dir, events=events)
             if latest is None:
                 rank0_print(f"No checkpoint under {args.ckpt_dir}; "
                             "starting from scratch.")
@@ -489,7 +544,8 @@ def run_part(
                         )
                         stack_after = True
                 state = restore_checkpoint(
-                    latest, abstract_state=restore_against
+                    latest, abstract_state=restore_against,
+                    files_verified=True,  # latest_checkpoint just swept
                 )
                 if stack_after:
                     state = _maybe_stack(state)
@@ -644,18 +700,36 @@ def run_part(
             getattr(args, "faults", None), seed=SEED,
             horizon=max(args.max_iters, 2),
         )
+        if injector is not None and getattr(args, "gang_dir", None):
+            # Gang mode: the exactly-once latch must survive the
+            # coordinated relaunch a fault causes — without the ledger
+            # every relaunched process re-parses the spec and re-fires
+            # the same fault until the restart budget is gone.
+            from distributed_machine_learning_tpu.runtime.faults import (
+                FAULT_LEDGER_FILE,
+            )
+
+            os.makedirs(args.gang_dir, exist_ok=True)
+            injector.attach_ledger(
+                os.path.join(args.gang_dir, FAULT_LEDGER_FILE)
+            )
         mid_save = (
             injector.mid_save_hook(events) if injector is not None else None
         )
+        post_save = (
+            injector.post_save_hook(events) if injector is not None else None
+        )
         if (injector is not None and args.async_ckpt
-                and injector.has_kind("kill_ckpt")):
+                and (injector.has_kind("kill_ckpt")
+                     or injector.has_kind("corrupt_ckpt"))):
             # The async writer defers the config file past the orbax
             # commit, so there is no synchronous "between state and
-            # config" window to kill in — the fault would silently never
+            # config" window to kill in, and it takes no post-save hook
+            # to corrupt through — either fault would silently never
             # fire, which is worse than refusing.
             raise ValueError(
-                "kill_ckpt faults require the synchronous checkpoint "
-                "path (drop --async-ckpt)"
+                "kill_ckpt/corrupt_ckpt faults require the synchronous "
+                "checkpoint path (drop --async-ckpt)"
             )
         retry_policy = None
         if getattr(args, "loader_retries", 0):
@@ -680,6 +754,55 @@ def run_part(
         # would tax every step with an allgather); the epoch tail agrees
         # unconditionally.
         in_loop_stop = periodic_agree_stop(lambda: preemption.requested)
+        if getattr(args, "gang_dir", None):
+            # Gang mode: heartbeat + peer-failure detection around the
+            # whole run (runtime/coordinator.py).  A dead/stalled peer
+            # aborts this process (exit 43) so an external gang
+            # supervisor relaunches every rank together — the agreement
+            # the in-process ladder above cannot provide once a rank is
+            # stuck inside a collective.
+            from distributed_machine_learning_tpu.runtime.coordinator import (  # noqa: E501
+                GangCoordinator,
+            )
+
+            coordinator = GangCoordinator(
+                args.gang_dir,
+                rank=jax.process_index(),
+                world=jax.process_count(),
+                heartbeat_interval_s=args.heartbeat_interval,
+                peer_timeout_s=args.peer_timeout,
+                events=events,
+            ).start()
+            show_resilience = True
+            if args.resume:
+                # A successful restore is this rank's proof that the
+                # restored checkpoint is whole — its half of the
+                # restore-point election, recorded even if no further
+                # save ever lands (gang_worker.py does the same).
+                coordinator.record_valid_step(
+                    int(jax.device_get(state.step))
+                )
+            base_in_loop_stop = in_loop_stop
+            # Warm-up suspension: the first step's XLA compile can
+            # outlast any sane peer timeout, and the stop predicate is
+            # polled BEFORE each step — so stay suspended (liveness
+            # still monitored, progress not judged) until the second
+            # poll, which can only happen after the first step (and its
+            # compile) completed.
+            warmup_cm = coordinator.suspend()
+            warmup_cm.__enter__()
+            warmup = {"polls": 0, "cm": warmup_cm}
+
+            def in_loop_stop(_base=base_in_loop_stop):
+                # The stop predicate is polled once per step on every
+                # rank — the natural place to record gang progress
+                # without threading the coordinator into the loop.
+                coordinator.beat()
+                warmup["polls"] += 1
+                if warmup["cm"] is not None and warmup["polls"] >= 2:
+                    warmup["cm"].__exit__(None, None, None)
+                    warmup["cm"] = None
+                return _base()
         if args.watchdog_timeout and not supervised:
             watchdog = Watchdog(timeout_s=args.watchdog_timeout).start()
         # Epochs completed across supervised restarts: a restart resumes
@@ -753,6 +876,8 @@ def run_part(
                     # declared stall costs a restart.
                     with (wd.suspend() if wd is not None
                           else contextlib.nullcontext()), \
+                         (coordinator.suspend() if coordinator is not None
+                          else contextlib.nullcontext()), \
                          (telemetry.span("eval", epoch=progress["epochs"])
                           if telemetry is not None
                           else contextlib.nullcontext()):
@@ -766,6 +891,8 @@ def run_part(
                     # Same for the (possibly long, blocking) checkpoint
                     # write: not step time — stop the stall clock.
                     with (wd.suspend() if wd is not None
+                          else contextlib.nullcontext()), \
+                         (coordinator.suspend() if coordinator is not None
                           else contextlib.nullcontext()):
                         if args.async_ckpt:
                             if ckpt_writer is None:
@@ -783,8 +910,19 @@ def run_part(
                                 args.ckpt_dir, state, mid_save_hook=mid_save,
                                 keep_last_n=getattr(args, "keep_last_n",
                                                     None),
+                                post_save_hook=post_save,
                             )
                             rank0_print(f"Saved checkpoint to {path}")
+                            if coordinator is not None:
+                                # This rank's half of the restore-point
+                                # election: the save returned, so the
+                                # checkpoint is locally verified.  (Async
+                                # saves commit later; they are recorded
+                                # only after the writer's flush, which
+                                # the gang path doesn't use yet.)
+                                coordinator.record_valid_step(
+                                    int(jax.device_get(state.step))
+                                )
                 if stopping:
                     events.preemptions += 1
                     rank0_print(
@@ -825,6 +963,10 @@ def run_part(
                     s = restore_latest(_maybe_stack(
                         init_model_and_state(model, config=opt_config)
                     ))
+                    if coordinator is not None:
+                        coordinator.record_valid_step(
+                            int(jax.device_get(s.step))
+                        )
                     # Re-derive finished-epoch progress from what was
                     # actually RESTORED, never from the in-memory
                     # counter: if the newest complete checkpoint is
@@ -856,6 +998,7 @@ def run_part(
             )
         else:
             state, _ = run_epochs(state, watchdog)
+        run_completed = True
     finally:
         # Flush in finally so a crash/interrupt mid-run keeps the rows
         # already logged — the feature's main use is diagnosing bad runs.
@@ -863,6 +1006,16 @@ def run_part(
             # Disarm before the (potentially long) final async-save
             # flush — a blocking close() with no beats is not a stall.
             watchdog.stop()
+        if coordinator is not None:
+            # Clean completion must publish done=True (finish): a
+            # frozen-but-not-done beat file reads as a death to peers
+            # still in their run tail.  A failed run deliberately does
+            # NOT publish done — the frozen file going stale is exactly
+            # how the gang learns this rank died.
+            if run_completed:
+                coordinator.finish()
+            else:
+                coordinator.stop()
         if ckpt_writer is not None:
             # Don't exit with a half-written async save in flight.
             ckpt_writer.close()

@@ -1,0 +1,174 @@
+"""Local gang launcher — N coordinated workers, one restart domain.
+
+The smallest end-to-end surface for the gang fault-tolerance stack::
+
+    python -m distributed_machine_learning_tpu.cli.gang \
+        --workers 4 --steps 12 --save-every 5 \
+        --ckpt-dir /tmp/run/ckpt --gang-dir /tmp/run/gang \
+        --faults kill_rank@1:7 --telemetry-dir /tmp/run/telemetry
+
+launches ``runtime/gang_worker.py`` once per rank (each its own OS
+process, lock-stepped through the beat-directory barrier, checkpointing
+into its own ``<ckpt-dir>/rank<r>`` — the per-host shard layout),
+supervises them with ``runtime/supervisor.py::gang_supervise``, and
+prints the resilience summary.  Worker logs land under
+``<gang-dir>/logs/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def scrubbed_worker_env(repo_root: str | None = None) -> dict:
+    """A worker environment safe for a fresh multi-process rendezvous:
+    force the CPU platform, drop any 8-way virtual-device split (each
+    worker must own exactly one device for the mesh to really span the
+    process boundary), and drop any sitecustomize that pre-initializes
+    jax.distributed for its own single-process session (it would swallow
+    the workers' N-process rendezvous)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    keep = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))
+    ]
+    if repo_root and not os.path.exists(
+            os.path.join(repo_root, "sitecustomize.py")):
+        # Re-adding the package's own root keeps workers importable —
+        # but never when that root itself carries the sitecustomize the
+        # scrub exists to drop (such layouts must expose the package on
+        # a clean path instead).
+        keep.insert(0, repo_root)
+    env["PYTHONPATH"] = os.pathsep.join(keep)
+    return env
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workers", type=int, default=4,
+                    help="gang size (one process, one CPU device each)")
+    ap.add_argument("--steps", type=int, default=12,
+                    help="training steps each worker must complete")
+    ap.add_argument("--save-every", type=int, default=5,
+                    help="checkpoint every N steps (plus a final save)")
+    ap.add_argument("--ckpt-dir", required=True,
+                    help="shared checkpoint directory (verified saves)")
+    ap.add_argument("--gang-dir", required=True,
+                    help="shared coordination directory (heartbeats, "
+                         "abort latch, restore-point records)")
+    ap.add_argument("--faults", default=None,
+                    help="fault spec forwarded to every worker, e.g. "
+                         "'kill_rank@1:7' (runtime/faults.py)")
+    ap.add_argument("--max-restarts", dest="max_restarts", type=int,
+                    default=3,
+                    help="coordinated gang relaunches before giving up")
+    ap.add_argument("--heartbeat-interval", dest="heartbeat_interval",
+                    type=float, default=0.25,
+                    help="seconds between heartbeat-file writes")
+    ap.add_argument("--peer-timeout", dest="peer_timeout", type=float,
+                    default=15.0,
+                    help="seconds without peer progress before the gang "
+                         "aborts and restarts together")
+    ap.add_argument("--telemetry-dir", dest="telemetry_dir", default=None,
+                    help="stream supervisor telemetry here (gang_restarts "
+                         "counter, gang_attempt spans); workers write "
+                         "their own telemetry under <dir>/rank<r>")
+    args = ap.parse_args(argv)
+    if args.workers < 1:
+        ap.error(f"--workers must be >= 1, got {args.workers}")
+    if args.peer_timeout <= 2 * args.heartbeat_interval:
+        ap.error("--peer-timeout must exceed two heartbeat intervals")
+
+    from distributed_machine_learning_tpu.runtime.faults import (
+        FaultEvents,
+        FaultInjector,
+    )
+    from distributed_machine_learning_tpu.runtime.supervisor import (
+        GangFailure,
+        gang_supervise,
+    )
+    from distributed_machine_learning_tpu.utils.summary import (
+        resilience_summary,
+    )
+
+    if args.faults:
+        try:  # validate before spawning anything
+            probe = FaultInjector.parse(args.faults,
+                                        horizon=max(args.steps, 2))
+        except ValueError as e:
+            ap.error(f"--faults: {e}")
+        bad_targets = {r for r in probe.targeted_ranks()
+                       if r >= args.workers}
+        if bad_targets:
+            ap.error(
+                f"--faults targets rank(s) {sorted(bad_targets)} but the "
+                f"gang only has ranks 0..{args.workers - 1} — the fault "
+                "would silently never fire"
+            )
+
+    telemetry = None
+    if args.telemetry_dir:
+        from distributed_machine_learning_tpu.telemetry import (
+            Telemetry,
+            set_telemetry,
+        )
+
+        telemetry = Telemetry(args.telemetry_dir)
+        set_telemetry(telemetry)
+
+    def worker_cmd(rank: int, attempt: int) -> list[str]:
+        del attempt  # the beat-directory protocol needs no fresh ports
+        cmd = [
+            sys.executable, "-m",
+            "distributed_machine_learning_tpu.runtime.gang_worker",
+            "--rank", str(rank), "--world", str(args.workers),
+            "--gang-dir", args.gang_dir, "--ckpt-dir", args.ckpt_dir,
+            "--steps", str(args.steps),
+            "--save-every", str(args.save_every),
+            "--heartbeat-interval", str(args.heartbeat_interval),
+            "--peer-timeout", str(args.peer_timeout),
+        ]
+        if args.faults:
+            cmd += ["--faults", args.faults]
+        if args.telemetry_dir:
+            cmd += ["--telemetry-dir",
+                    os.path.join(args.telemetry_dir, f"rank{rank}")]
+        return cmd
+
+    events = FaultEvents()
+    # The scrub may drop the very PYTHONPATH entry this package was
+    # imported from (a sitecustomize'd tree); re-adding the package's
+    # own root keeps the workers importable everywhere.
+    import distributed_machine_learning_tpu as _pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        _pkg.__file__
+    )))
+    try:
+        gang_supervise(
+            worker_cmd, args.workers, args.gang_dir,
+            ckpt_dirs=[os.path.join(args.ckpt_dir, f"rank{r}")
+                       for r in range(args.workers)],
+            max_restarts=args.max_restarts,
+            events=events, env=scrubbed_worker_env(pkg_root),
+            log_dir=os.path.join(args.gang_dir, "logs"),
+        )
+    except GangFailure as e:
+        print(f"gang failed: {e}", file=sys.stderr, flush=True)
+        print(resilience_summary(events), flush=True)
+        return 1
+    finally:
+        if telemetry is not None:
+            telemetry.close()
+    print(resilience_summary(events), flush=True)
+    print(f"gang of {args.workers} finished {args.steps} steps "
+          f"({events.gang_restarts} coordinated restart(s))", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

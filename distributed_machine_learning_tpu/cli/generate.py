@@ -117,7 +117,7 @@ def _restore_lm_params(ckpt_dir: str, n_layers: int):
     latest = latest_checkpoint(ckpt_dir)
     if latest is None:
         raise FileNotFoundError(f"no complete checkpoint under {ckpt_dir}")
-    params = restore_checkpoint(latest).params
+    params = restore_checkpoint(latest, files_verified=True).params
     if "blocks" in params:
         from distributed_machine_learning_tpu.parallel.pipeline_interleaved import (  # noqa: E501
             parse_interleaved_layout,

@@ -899,7 +899,8 @@ def main(argv=None) -> None:
                 # relocatable arrays.
                 import jax.numpy as _jnp
 
-                restored = restore_checkpoint(latest, abstract_state=state)
+                restored = restore_checkpoint(latest, abstract_state=state,
+                                              files_verified=True)
                 # This run's hyperparameters win (same semantics as the
                 # CNN path): carrying the current config also keeps the
                 # static config leaves identical for the tree_map below,

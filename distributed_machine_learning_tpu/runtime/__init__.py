@@ -3,5 +3,14 @@ from distributed_machine_learning_tpu.runtime.distributed import (
     initialize_from_flags,
     DistributedContext,
 )
+from distributed_machine_learning_tpu.runtime.coordinator import (
+    GANG_ABORT_EXIT,
+    GangCoordinator,
+    elect_restore_step,
+)
 
-__all__ = ["make_mesh", "BATCH_AXIS", "initialize_from_flags", "DistributedContext"]
+__all__ = [
+    "make_mesh", "BATCH_AXIS", "initialize_from_flags",
+    "DistributedContext", "GangCoordinator", "GANG_ABORT_EXIT",
+    "elect_restore_step",
+]

@@ -1,0 +1,556 @@
+"""Gang coordination: heartbeats, peer-failure detection, coordinated
+abort, and the restore-point election.
+
+PR 1's supervisor heals a *single* process; a real data-parallel gang
+(``runtime/distributed.py``, the reference's 4-node gloo cluster) fails
+differently: one rank dies or stalls mid-collective and every other
+rank blocks forever inside gloo/ICI with no Python frame to raise from.
+Nothing inside the process can un-hang it — the only cure is for the
+*survivors* to notice, abort hard, and for a gang supervisor
+(``runtime/supervisor.py::gang_supervise``) to relaunch everyone
+together from a checkpoint every rank agrees on.
+
+The medium is a shared directory (``gang_dir``) because it is the one
+channel both local multi-process gangs and TPU pods reliably share (a
+pod's workers mount common storage; collectives are exactly the thing
+we cannot trust during a failure).  Three file families live there:
+
+- ``beat_rank<r>.json`` — rank r's heartbeat.  A daemon thread rewrites
+  it every ``heartbeat_interval_s`` with the age of the rank's last
+  *training progress* (``beat()`` calls from the step loop).  File
+  mtime going stale means the process died; a fresh file whose
+  ``beat_age`` exceeds the timeout means the process is alive but stuck
+  (hung collective, wedged loader).  ``suspend()`` marks expected-long
+  non-step phases (checkpoint save, eval, compile, rendezvous) so they
+  are not judged as stalls — liveness detection keeps running.
+- ``restore_rank<r>.json`` — rank r's restore-point record: every
+  checkpoint step it has locally verified (saved successfully or
+  restored from).  The election (``elect_restore_step``) intersects all
+  ranks' records and picks the highest step every rank agrees on —
+  the only step where a coordinated relaunch is guaranteed to find all
+  shards of one consistent checkpoint.
+- ``abort.json`` — the coordinated-abort latch.  The first rank to
+  declare a peer dead writes it (atomically, first writer wins) and
+  exits with :data:`GANG_ABORT_EXIT`; every other rank's monitor sees
+  the file and exits too, so the whole gang tears down within one
+  heartbeat interval instead of hanging on the dead peer.
+
+Everything here is host-side stdlib (files + one daemon thread per
+rank): the compiled step and the collectives are never touched, and a
+rank blocked inside a collective can still be aborted because
+``os._exit`` works from the monitor thread.
+
+Telemetry (PR 2): ``gang_heartbeat_age_s{rank=...}`` gauges track every
+peer's progress age; ``gang_peer_failures`` counts declarations; all
+abort events flush before exit so the post-mortem trace survives.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+# Exit code of a coordinated gang abort — distinct from an injected rank
+# death (runtime/faults.py::KILL_RANK_EXIT) so logs show who was the
+# victim and who pulled the cord.
+GANG_ABORT_EXIT = 43
+
+ABORT_FILE = "abort.json"
+_BEAT_PREFIX = "beat_rank"
+_RESTORE_PREFIX = "restore_rank"
+
+
+def _beat_path(gang_dir: str, rank: int) -> str:
+    return os.path.join(gang_dir, f"{_BEAT_PREFIX}{rank}.json")
+
+
+def _restore_path(gang_dir: str, rank: int) -> str:
+    return os.path.join(gang_dir, f"{_RESTORE_PREFIX}{rank}.json")
+
+
+def _write_atomic(path: str, payload: dict) -> None:
+    # Tmp name unique per process AND thread: the monitor thread and the
+    # main thread (finish()) may both be writing this rank's beat file.
+    tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def read_abort(gang_dir: str | os.PathLike) -> dict | None:
+    """The abort latch's payload, or None when no abort was declared.
+    Tolerates a torn write (another rank mid-``os.replace``) by treating
+    it as not-yet-declared — the next poll sees the complete file."""
+    try:
+        with open(os.path.join(os.fspath(gang_dir), ABORT_FILE)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def declare_abort(gang_dir: str | os.PathLike, reason: str,
+                  by_rank: int, peer: int | None = None) -> bool:
+    """Write the abort latch; returns True if THIS call won the race
+    (False: someone already declared — their reason stands)."""
+    path = os.path.join(os.fspath(gang_dir), ABORT_FILE)
+    payload = {"reason": reason, "by_rank": by_rank, "time": time.time()}
+    if peer is not None:
+        payload["peer"] = peer
+    try:
+        with open(path, "x") as f:
+            json.dump(payload, f)
+        return True
+    except FileExistsError:
+        return False
+
+
+def clear_gang_state(gang_dir: str | os.PathLike,
+                     restore_records: bool = False) -> None:
+    """Remove the previous attempt's beats and abort latch (and, for a
+    fresh run, the restore-point records and the fired-fault ledger).
+    Restore records and the ledger survive between restart attempts by
+    design: the records ARE the election input, and the ledger is what
+    keeps an already-fired fault from re-firing in the relaunch."""
+    from distributed_machine_learning_tpu.runtime.faults import (
+        FAULT_LEDGER_FILE,
+    )
+
+    gang_dir = os.fspath(gang_dir)
+    if not os.path.isdir(gang_dir):
+        os.makedirs(gang_dir, exist_ok=True)
+        return
+    for name in os.listdir(gang_dir):
+        if (name == ABORT_FILE or name.startswith(_BEAT_PREFIX)
+                or (restore_records
+                    and (name.startswith(_RESTORE_PREFIX)
+                         or name == FAULT_LEDGER_FILE))):
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(gang_dir, name))
+
+
+def read_restore_record(gang_dir: str | os.PathLike, rank: int
+                        ) -> set[int] | None:
+    """The set of checkpoint steps rank ``rank`` has verified, or None
+    when the rank never recorded one (fresh start / died pre-save)."""
+    try:
+        with open(_restore_path(os.fspath(gang_dir), rank)) as f:
+            payload = json.load(f)
+        return {int(s) for s in payload.get("steps", [])}
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def _as_dirs(ckpt_dirs) -> list[str]:
+    if ckpt_dirs is None:
+        return []
+    if isinstance(ckpt_dirs, (str, os.PathLike)):
+        return [os.fspath(ckpt_dirs)]
+    return [os.fspath(d) for d in ckpt_dirs]
+
+
+def elect_restore_step(gang_dir: str | os.PathLike, world: int,
+                       ckpt_dirs=None) -> int | None:
+    """The highest checkpoint step EVERY rank has verified (the
+    intersection of all restore-point records), or None when no common
+    step exists — the gang then starts from scratch / whatever the
+    fallback chain finds.
+
+    ``ckpt_dirs``: one shared checkpoint directory, or one per rank
+    (per-host shard layouts).  When given, candidate steps are
+    additionally filtered through the on-disk validity check
+    (``validate_checkpoint``) in EVERY directory, so an
+    agreed-but-since-corrupted checkpoint is never elected.
+    """
+    gang_dir = os.fspath(gang_dir)
+    common: set[int] | None = None
+    for rank in range(world):
+        steps = read_restore_record(gang_dir, rank)
+        if steps is None:
+            return None  # a rank with no record can't agree on anything
+        common = steps if common is None else (common & steps)
+    if not common:
+        return None
+    dirs = _as_dirs(ckpt_dirs)
+    if not dirs:
+        return max(common)
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        validate_checkpoint,
+    )
+
+    # Highest-first with short-circuit: only the winner matters, and
+    # validate_checkpoint is a full content hash — hashing every
+    # commonly-recorded step in every rank dir would put
+    # O(total checkpoint bytes x ranks) of read I/O on the restart
+    # critical path for no better answer.
+    for s in sorted(common, reverse=True):
+        if all(not validate_checkpoint(os.path.join(d, f"step_{s}"))
+               for d in dirs):
+            return s
+    return None
+
+
+def enforce_restore_point(ckpt_dirs, step: int | None) -> list[str]:
+    """Quarantine every complete checkpoint newer than the elected
+    ``step`` (in each of ``ckpt_dirs``) so a relaunched gang's fallback
+    chain resolves to the SAME restore point on every rank; returns the
+    paths quarantined.  A newer checkpoint that not every rank verified
+    may be torn on some host — restoring it would diverge the gang.
+    ``step=None`` quarantines nothing (no agreement ⇒ the fallback
+    chain decides)."""
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        _is_complete,
+        quarantine_checkpoint,
+        quarantine_reason,
+    )
+
+    if step is None:
+        return []
+    quarantined = []
+    for ckpt_dir in _as_dirs(ckpt_dirs):
+        if not os.path.isdir(ckpt_dir):
+            continue
+        for name in os.listdir(ckpt_dir):
+            if not (name.startswith("step_") and name[5:].isdigit()):
+                continue
+            s = int(name[5:])
+            path = os.path.join(ckpt_dir, name)
+            if s <= step or not _is_complete(path):
+                continue
+            if quarantine_reason(path) is not None:
+                continue
+            quarantine_checkpoint(
+                path,
+                f"gang restore-point election: step {s} is newer than "
+                f"the agreed restore point {step}",
+            )
+            quarantined.append(path)
+    return quarantined
+
+
+class GangCoordinator:
+    """One rank's view of the gang: writes its own heartbeat, watches
+    every peer's, and aborts the process (loudly, via the shared latch)
+    when a peer dies or stalls past ``peer_timeout_s``.
+
+    Usage (one per worker process)::
+
+        coord = GangCoordinator(gang_dir, rank=r, world=n,
+                                peer_timeout_s=30).start()
+        with coord.suspend():
+            ...rendezvous / compile...
+        for batch in batches:
+            ...train step...
+            coord.beat(step)
+            ...checkpoint inside coord.suspend(); then
+            coord.record_valid_step(step)...
+        coord.stop()
+
+    ``on_abort``: test hook replacing ``os._exit`` (receives the
+    reason); production leaves it None — a hung collective can only be
+    escaped by process death, which is exactly what the gang supervisor
+    expects.  ``check_self=True`` also self-declares when this rank's
+    own progress stalls past the timeout (the stalled rank usually
+    notices first: its monitor thread keeps running while the main
+    thread sleeps/hangs).
+    """
+
+    def __init__(self, gang_dir: str | os.PathLike, rank: int, world: int,
+                 *, heartbeat_interval_s: float = 1.0,
+                 peer_timeout_s: float = 30.0,
+                 exit_code: int = GANG_ABORT_EXIT,
+                 events=None, check_self: bool = True, on_abort=None):
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        if not 0 <= rank < world:
+            raise ValueError(f"rank must be in [0, {world}), got {rank}")
+        if heartbeat_interval_s <= 0:
+            raise ValueError(
+                f"heartbeat_interval_s must be > 0, got "
+                f"{heartbeat_interval_s}"
+            )
+        if peer_timeout_s <= 2 * heartbeat_interval_s:
+            raise ValueError(
+                f"peer_timeout_s ({peer_timeout_s}) must exceed two "
+                f"heartbeat intervals ({heartbeat_interval_s} each): a "
+                "single delayed write would otherwise read as a death"
+            )
+        self.gang_dir = os.fspath(gang_dir)
+        os.makedirs(self.gang_dir, exist_ok=True)
+        self.rank = rank
+        self.world = world
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.peer_timeout_s = peer_timeout_s
+        self.exit_code = exit_code
+        self.events = events
+        self.check_self = check_self
+        self.on_abort = on_abort
+        self.aborted: str | None = None  # reason, once declared/observed
+        self._seq = 0
+        self._step = 0
+        self._done = False
+        self._suspended = 0
+        self._last_beat = time.monotonic()
+        self._valid_steps: set[int] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._write_lock = threading.Lock()
+        # peer -> ((mtime_ns, size), monotonic time this monitor first
+        # saw that signature) — the skew-free staleness basis.
+        self._peer_seen: dict[int, tuple[tuple[int, int], float]] = {}
+        self._started_at = time.monotonic()
+
+    # -- liveness/progress surface --------------------------------------
+    def beat(self, step: int | None = None) -> None:
+        """Record training progress — call once per completed step.
+        In-memory only (no IO on the step path); the monitor thread
+        publishes it at the heartbeat interval."""
+        self._last_beat = time.monotonic()
+        if step is not None:
+            self._step = int(step)
+
+    @contextlib.contextmanager
+    def suspend(self):
+        """Mark an expected-long non-step phase (checkpoint save, eval,
+        compile, rendezvous): peers keep checking that this process is
+        ALIVE (the heartbeat file keeps refreshing) but stop judging its
+        progress age.  Re-entrant; beats on exit."""
+        self._suspended += 1
+        try:
+            yield
+        finally:
+            try:
+                self.beat()
+            finally:
+                self._suspended -= 1
+
+    def peer_state(self, peer: int) -> dict | None:
+        """The peer's latest heartbeat payload, or None (never wrote /
+        torn write)."""
+        try:
+            with open(_beat_path(self.gang_dir, peer)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def wait_for_peers(self, step: int, poll_s: float = 0.05) -> bool:
+        """Block until every peer's published step reaches ``step`` (or
+        the peer finished its run) — a lock-step barrier over the beat
+        directory.
+
+        This is the harness's stand-in for a synchronous collective
+        where real cross-process collectives are unavailable (the CI
+        host's CPU backend): it hangs exactly when a collective would —
+        a dead or stalled peer never publishes the step — and is freed
+        the same way: the monitor thread declares the peer and aborts
+        this process.  Deliberately does NOT suspend the stall clock:
+        time spent starved at the barrier is exactly what the detector
+        must judge.  Returns False only in test mode (``on_abort`` set)
+        once an abort was observed; production never returns False
+        (the abort exits the process)."""
+        while True:
+            if self.aborted is not None:
+                return False
+            ready = True
+            for peer in range(self.world):
+                if peer == self.rank:
+                    continue
+                payload = self.peer_state(peer)
+                if payload is None or (
+                        not payload.get("done")
+                        and int(payload.get("step", -1)) < step):
+                    ready = False
+                    break
+            if ready:
+                return True
+            time.sleep(poll_s)
+
+    def finish(self) -> None:
+        """Publish clean completion and stop the monitor: a rank that
+        finished its run must read as healthy forever (its heartbeat
+        file will never refresh again), not as a death to declare."""
+        self._done = True
+        self._write_beat()
+        self.stop()
+
+    def record_valid_step(self, step: int) -> None:
+        """Publish that this rank verified checkpoint ``step`` (its save
+        returned, or it restored from it) — the rank's half of the
+        restore-point election.  Written through the beat directory
+        immediately: the record must survive this process dying at any
+        later moment.
+
+        MERGES with the record already on disk: a relaunched process
+        starts with an empty in-memory set, and overwriting would drop
+        the previously agreed steps from this rank's record — the
+        election would then lose its only common point the moment any
+        rank saved once after a restart."""
+        self._valid_steps.add(int(step))
+        prior = read_restore_record(self.gang_dir, self.rank)
+        if prior:
+            self._valid_steps |= prior
+        _write_atomic(
+            _restore_path(self.gang_dir, self.rank),
+            {"rank": self.rank, "steps": sorted(self._valid_steps),
+             "time": time.time()},
+        )
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "GangCoordinator":
+        if self._thread is not None:
+            raise RuntimeError("coordinator already started")
+        os.makedirs(self.gang_dir, exist_ok=True)
+        self._started_at = time.monotonic()
+        self._last_beat = time.monotonic()
+        self._write_beat()
+        self._thread = threading.Thread(
+            target=self._run, name=f"gang-coordinator-r{self.rank}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "GangCoordinator":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- internals -------------------------------------------------------
+    def _write_beat(self) -> None:
+        with self._write_lock:
+            self._write_beat_locked()
+
+    def _write_beat_locked(self) -> None:
+        now = time.monotonic()
+        self._seq += 1
+        _write_atomic(_beat_path(self.gang_dir, self.rank), {
+            "rank": self.rank,
+            "seq": self._seq,
+            "step": self._step,
+            "beat_age": now - self._last_beat,
+            "suspended": bool(self._suspended),
+            "done": self._done,
+            "time": time.time(),
+        })
+
+    def _telemetry(self):
+        from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+        return get_telemetry()
+
+    def _abort(self, reason: str, peer: int | None = None) -> None:
+        """Declare (or join) the gang abort and kill this process."""
+        won = declare_abort(self.gang_dir, reason, self.rank, peer=peer)
+        self.aborted = reason
+        if won and self.events is not None and peer is not None:
+            self.events.peer_failures += 1
+        tel = self._telemetry()
+        if tel is not None:
+            if won:
+                tel.registry.counter("gang_peer_failures").inc()
+            tel.tracer.instant("gang_abort", reason=reason)
+            tel.flush()
+        print(
+            f"[gang] rank {self.rank} aborting: {reason} "
+            f"(exit {self.exit_code})",
+            flush=True,
+        )
+        if self.on_abort is not None:
+            self.on_abort(reason)
+            return
+        os._exit(self.exit_code)
+
+    def _check_peer(self, peer: int, now: float, tel) -> str | None:
+        """None if the peer looks healthy, else the failure reason.
+
+        Staleness is judged by LOCALLY-OBSERVED change (when did THIS
+        monitor last see the peer's beat file advance, on this host's
+        monotonic clock), never by comparing wall clocks to filesystem
+        mtimes: on the shared mounts pods actually use, cross-host
+        clock/mtime skew of a minute is routine and would otherwise
+        read as instant death (or mask a real one)."""
+        path = _beat_path(self.gang_dir, peer)
+        try:
+            st = os.stat(path)
+            sig = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            # Never beat at all: allow a full timeout from gang start
+            # (the peer may still be exec'ing / rendezvousing).
+            if now - self._started_at > self.peer_timeout_s:
+                return (f"rank {peer} never wrote a heartbeat within "
+                        f"{self.peer_timeout_s}s of gang start")
+            return None
+        seen = self._peer_seen.get(peer)
+        if seen is None or seen[0] != sig:
+            self._peer_seen[peer] = (sig, now)
+            file_age = 0.0
+        else:
+            file_age = now - seen[1]
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            payload = None  # torn read mid-replace: alive by change-sig
+        if payload is not None and payload.get("done"):
+            return None  # finished cleanly: healthy forever (file frozen)
+        if file_age > self.peer_timeout_s:
+            return (f"rank {peer} heartbeat file last changed "
+                    f"{file_age:.1f}s ago (timeout {self.peer_timeout_s}s)"
+                    ": process dead")
+        if payload is None or payload.get("suspended"):
+            return None
+        progress_age = file_age + float(payload.get("beat_age", 0.0))
+        if tel is not None:
+            tel.registry.gauge(
+                "gang_heartbeat_age_s", rank=str(peer)
+            ).set(progress_age)
+        # Stalls are judged at 1.5x the death timeout: when one rank
+        # dies, every survivor blocked on it is ALSO progress-starved —
+        # the extra half-window lets the true cause (the dead peer's
+        # stale file) win the declaration race, so the abort reason
+        # names the victim, not a symptom.
+        if progress_age > 1.5 * self.peer_timeout_s:
+            return (f"rank {peer} made no step progress for "
+                    f"{progress_age:.1f}s (stall timeout "
+                    f"{1.5 * self.peer_timeout_s:.1f}s): stalled (hung "
+                    "collective or wedged input)")
+        return None
+
+    def _run(self) -> None:
+        poll_s = min(self.heartbeat_interval_s, self.peer_timeout_s / 4)
+        while not self._stop.wait(poll_s):
+            self._write_beat()
+            abort = read_abort(self.gang_dir)
+            if abort is not None:
+                self._abort(
+                    f"joining gang abort declared by rank "
+                    f"{abort.get('by_rank')}: {abort.get('reason')}"
+                )
+                return
+            now = time.monotonic()
+            tel = self._telemetry()
+            if (self.check_self and not self._suspended
+                    and now - self._last_beat > 1.5 * self.peer_timeout_s):
+                self._abort(
+                    f"rank {self.rank} (self) made no step progress for "
+                    f"{now - self._last_beat:.1f}s "
+                    f"(stall timeout {1.5 * self.peer_timeout_s:.1f}s)",
+                    peer=self.rank,
+                )
+                return
+            for peer in range(self.world):
+                if peer == self.rank:
+                    continue
+                reason = self._check_peer(peer, now, tel)
+                if reason is not None:
+                    self._abort(reason, peer=peer)
+                    return

@@ -28,6 +28,26 @@ Fault classes (spec grammar ``kind@step[:arg]``, comma-separated):
                   supervisor can catch the crash boundary); ``:exit``
                   calls ``os._exit(17)`` for external supervisors.
 
+Multi-process (gang) fault classes — the failure modes only a real
+worker gang can exhibit, proven by ``runtime/coordinator.py`` +
+``gang_supervise``:
+
+- ``kill_rank@R:K``    process with rank R calls ``os._exit`` (code
+                       :data:`KILL_RANK_EXIT`) right before batch K —
+                       the dead-peer case that leaves the other ranks
+                       blocked in a collective until the gang
+                       heartbeat detector aborts them.
+- ``stall_rank@R:K:S`` rank R sleeps S seconds before batch K while
+                       the others wait in the collective — the
+                       stalled-peer (not dead, just stuck) case.
+- ``corrupt_ckpt@N[:F]`` after the N-th (1-based) checkpoint save
+                       fully commits, flip bytes in one of its saved
+                       array files (the largest payload file, or the
+                       first whose relative path contains substring
+                       F) — bit rot the checkpoint manifest chain
+                       (``train/checkpoint.py``) must catch and fall
+                       back from.
+
 ``K`` may be ``?``: the step is drawn deterministically from ``seed``
 (same seed → same plan), so randomized chaos runs stay reproducible.
 
@@ -41,6 +61,7 @@ the same doors real faults use.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import time
 
@@ -50,6 +71,17 @@ from distributed_machine_learning_tpu.utils.logging import rank0_print
 
 FAULTS_ENV = "DML_FAULTS"
 
+# Exit code of an injected rank death — distinct from the gang abort
+# code (runtime/coordinator.py::GANG_ABORT_EXIT) so a post-mortem can
+# tell the victim from the ranks that detected it.
+KILL_RANK_EXIT = 21
+
+# Cross-process fired-fault ledger (one JSON line per firing), kept in
+# the gang directory: a gang relaunch re-execs every worker, and without
+# the ledger each fresh process would re-parse the spec and re-fire the
+# same kill forever — no number of restarts could ever finish the run.
+FAULT_LEDGER_FILE = "faults_fired.jsonl"
+
 _KIND_ALIASES = {
     "nan": "nan",
     "nan_grad": "nan",
@@ -58,6 +90,9 @@ _KIND_ALIASES = {
     "stall": "stall",
     "kill_ckpt": "kill_ckpt",
     "kill": "kill_ckpt",
+    "kill_rank": "kill_rank",
+    "stall_rank": "stall_rank",
+    "corrupt_ckpt": "corrupt_ckpt",
 }
 
 
@@ -96,6 +131,13 @@ class FaultEvents:
     restarts: int = 0           # supervisor restored a checkpoint and retried
     preemptions: int = 0        # SIGTERM turned into a clean checkpointed stop
     ckpt_kills: int = 0         # injected death mid-checkpoint-save
+    rank_kills: int = 0         # injected hard rank death (kill_rank)
+    rank_stalls: int = 0        # injected rank stall (stall_rank)
+    ckpt_corruptions: int = 0   # injected post-save byte flips (corrupt_ckpt)
+    peer_failures: int = 0      # gang detector declared a dead/stalled peer
+    gang_restarts: int = 0      # gang supervisor relaunched all workers
+    ckpt_verify_failures: int = 0  # checkpoint failed manifest verification
+    ckpt_fallbacks: int = 0     # restore fell back past an invalid checkpoint
 
     def __setattr__(self, name: str, value) -> None:
         # Mirror every increment into the telemetry registry AS IT
@@ -134,9 +176,11 @@ class FaultEvents:
 @dataclasses.dataclass
 class _Fault:
     kind: str
-    at: int            # batch index (data faults) / save ordinal (kill_ckpt)
+    at: int            # batch index (data faults) / save ordinal (*_ckpt)
     arg: str | None = None
+    rank: int | None = None  # target process (kill_rank / stall_rank only)
     fired: bool = False
+    index: int = -1    # position in the spec (the ledger's stable key)
 
 
 class FaultInjector:
@@ -145,17 +189,76 @@ class FaultInjector:
     One injector instance spans a whole supervised run — restarts and
     data-path replays cross the same indices again, and the fired-once
     latch is what keeps a recovered fault from re-firing forever.
+
+    ``rank``: this process's rank for the rank-targeted fault classes;
+    None (the default) reads ``jax.process_index()`` lazily at fire
+    time, so every worker of a gang can parse the same spec and only
+    the targeted one fires.
     """
 
-    def __init__(self, faults: list[_Fault]):
+    def __init__(self, faults: list[_Fault], rank: int | None = None):
         self._faults = faults
+        for i, f in enumerate(self._faults):
+            f.index = i
         self._saves = 0
+        self._post_saves = 0
+        self._ledger_path: str | None = None
+        self.rank = rank
+
+    def _process_rank(self) -> int:
+        if self.rank is not None:
+            return self.rank
+        import jax
+
+        return jax.process_index()
+
+    def attach_ledger(self, path: str | os.PathLike) -> "FaultInjector":
+        """Make the fired-once latch survive process relaunches: every
+        firing appends a line here, and attaching replays the lines —
+        faults THIS RANK already fired stay fired in the fresh process.
+        (Only the acting rank is latched from the ledger: other ranks
+        never act on those entries anyway, and per-rank fault state —
+        e.g. each rank's own save ordinals — must not cross ranks.)"""
+        self._ledger_path = os.fspath(path)
+        try:
+            with open(self._ledger_path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return self
+        me = self._process_rank()
+        for line in lines:
+            try:
+                entry = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn final line (a kill mid-append)
+            i = entry.get("index")
+            if (isinstance(i, int) and 0 <= i < len(self._faults)
+                    and entry.get("rank") == me
+                    and entry.get("kind") == self._faults[i].kind):
+                self._faults[i].fired = True
+        return self
+
+    def _mark_fired(self, f: _Fault, acted: bool = True) -> None:
+        """Latch ``f``; when this process actually ACTED on it (not just
+        observed a non-target rank's index pass by), persist the firing
+        to the ledger — fsynced before returning, because the very next
+        statement may be ``os._exit``."""
+        f.fired = True
+        if not acted or self._ledger_path is None:
+            return
+        entry = {"index": f.index, "kind": f.kind, "at": f.at,
+                 "rank": self._process_rank(), "time": time.time()}
+        with open(self._ledger_path, "a") as fh:
+            fh.write(json.dumps(entry) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
 
     # -- construction ---------------------------------------------------
     @classmethod
-    def parse(cls, spec: str, seed: int = 0, horizon: int = 40
-              ) -> "FaultInjector":
-        """``"nan@2,raise@4,stall@7:2.5,kill_ckpt@1"`` → injector.
+    def parse(cls, spec: str, seed: int = 0, horizon: int = 40,
+              rank: int | None = None) -> "FaultInjector":
+        """``"nan@2,raise@4,stall@7:2.5,kill_ckpt@1,kill_rank@1:7"`` →
+        injector.
 
         ``?`` steps draw from ``default_rng(seed)`` in ``[1, horizon)``,
         in spec order — deterministic per (spec, seed).
@@ -163,6 +266,22 @@ class FaultInjector:
         if horizon < 2:
             raise ValueError(f"horizon must be >= 2, got {horizon}")
         rng = np.random.default_rng(seed)
+
+        def parse_at(at_s: str, entry: str) -> int:
+            at_s = at_s.strip()
+            if at_s == "?":
+                return int(rng.integers(1, horizon))
+            try:
+                at = int(at_s)
+            except ValueError:
+                raise ValueError(
+                    f"bad fault step {at_s!r} in {entry!r} (an integer "
+                    "or '?')"
+                ) from None
+            if at < 0:
+                raise ValueError(f"fault step must be >= 0, got {at}")
+            return at
+
         faults = []
         for entry in spec.split(","):
             entry = entry.strip()
@@ -180,44 +299,60 @@ class FaultInjector:
                     f"{sorted(set(_KIND_ALIASES))}"
                 )
             kind = _KIND_ALIASES[kind]
-            at_s, _, arg = rest.partition(":")
-            at_s = at_s.strip()
-            if at_s == "?":
-                at = int(rng.integers(1, horizon))
-            else:
+            if kind in ("kill_rank", "stall_rank"):
+                # Rank-targeted grammar: kind@RANK:STEP[:ARG].
+                parts = [p.strip() for p in rest.split(":")]
+                want = 2 if kind == "kill_rank" else 3
+                if len(parts) != want:
+                    raise ValueError(
+                        f"bad {kind} entry {entry!r}: expected "
+                        + (f"{kind}@rank:step" if want == 2
+                           else f"{kind}@rank:step:seconds")
+                    )
                 try:
-                    at = int(at_s)
+                    target = int(parts[0])
                 except ValueError:
                     raise ValueError(
-                        f"bad fault step {at_s!r} in {entry!r} (an integer "
-                        "or '?')"
+                        f"bad {kind} rank {parts[0]!r} in {entry!r}"
                     ) from None
-            if at < 0:
-                raise ValueError(f"fault step must be >= 0, got {at}")
+                if target < 0:
+                    raise ValueError(
+                        f"{kind} rank must be >= 0, got {target}"
+                    )
+                at = parse_at(parts[1], entry)
+                arg = parts[2] if want == 3 else None
+                if kind == "stall_rank":
+                    float(arg)  # validate at parse time
+                faults.append(
+                    _Fault(kind=kind, at=at, arg=arg, rank=target)
+                )
+                continue
+            at_s, _, arg = rest.partition(":")
+            at = parse_at(at_s, entry)
             arg = arg.strip() or None
             if kind == "stall":
                 float(arg if arg is not None else _default_stall(None))
-            if kind == "kill_ckpt":
+            if kind in ("kill_ckpt", "corrupt_ckpt"):
                 if at < 1:
                     raise ValueError(
-                        "kill_ckpt ordinal is 1-based (the first save is 1)"
+                        f"{kind} ordinal is 1-based (the first save is 1)"
                     )
-                if arg not in (None, "exit"):
+                if kind == "kill_ckpt" and arg not in (None, "exit"):
                     raise ValueError(
                         f"kill_ckpt arg must be 'exit' or absent, got {arg!r}"
                     )
             faults.append(_Fault(kind=kind, at=at, arg=arg))
-        return cls(faults)
+        return cls(faults, rank=rank)
 
     @classmethod
-    def from_flags(cls, spec: str | None, seed: int = 0, horizon: int = 40
-                   ) -> "FaultInjector | None":
+    def from_flags(cls, spec: str | None, seed: int = 0, horizon: int = 40,
+                   rank: int | None = None) -> "FaultInjector | None":
         """Injector from an explicit spec, else the ``DML_FAULTS`` env
         var, else None (the default: no injection machinery at all)."""
         spec = spec or os.environ.get(FAULTS_ENV)
         if not spec:
             return None
-        return cls.parse(spec, seed=seed, horizon=horizon)
+        return cls.parse(spec, seed=seed, horizon=horizon, rank=rank)
 
     # -- data-path faults ----------------------------------------------
     def wrap_batches(self, batches, events: FaultEvents | None = None,
@@ -230,18 +365,46 @@ class FaultInjector:
             for f in self._faults:
                 if f.fired or f.at != idx:
                     continue
-                if f.kind == "stall":
-                    f.fired = True
+                if f.kind in ("kill_rank", "stall_rank"):
+                    # Every rank latches the fault at its index; only the
+                    # targeted rank acts — so a gang sharing one spec
+                    # fires it exactly once, on the right process.
+                    if self._process_rank() != f.rank:
+                        self._mark_fired(f, acted=False)
+                        continue
+                    if f.kind == "kill_rank":
+                        if events is not None:
+                            events.rank_kills += 1
+                        self._mark_fired(f)
+                        print(
+                            f"[faults] rank {f.rank} exiting hard "
+                            f"(os._exit({KILL_RANK_EXIT})) before batch "
+                            f"{idx}",
+                            flush=True,
+                        )
+                        os._exit(KILL_RANK_EXIT)
+                    stall_s = float(f.arg)
+                    if events is not None:
+                        events.rank_stalls += 1
+                    self._mark_fired(f)
+                    print(
+                        f"[faults] rank {f.rank} stalling {stall_s}s "
+                        f"before batch {idx}",
+                        flush=True,
+                    )
+                    time.sleep(stall_s)
+                elif f.kind == "stall":
+                    self._mark_fired(f)
                     stall_s = float(f.arg) if f.arg else _default_stall(None)
                     rank0_print(
                         f"[faults] stalling {stall_s}s before batch {idx}"
                     )
                     time.sleep(stall_s)
                 elif f.kind == "raise":
-                    f.fired = True
+                    self._mark_fired(f)
                     raise InjectedFault(f"injected loader fault at batch {idx}")
                 elif f.kind == "nan":
-                    f.fired = True
+                    self._mark_fired(f)
                     rank0_print(f"[faults] poisoning batch {idx} with NaN")
                     batch = _poison(batch)
             yield batch
@@ -257,7 +420,7 @@ class FaultInjector:
             for f in self._faults:
                 if f.fired or f.kind != "kill_ckpt" or f.at != self._saves:
                     continue
-                f.fired = True
+                self._mark_fired(f)
                 if events is not None:
                     events.ckpt_kills += 1
                 if f.arg == "exit":
@@ -273,6 +436,38 @@ class FaultInjector:
 
         return hook
 
+    def post_save_hook(self, events: FaultEvents | None = None):
+        """Hook for ``save_checkpoint(post_save_hook=...)`` — called with
+        the checkpoint path after the save fully commits.  Fires
+        ``corrupt_ckpt`` on its save ordinal: flips bytes in one saved
+        array file (``corrupt_checkpoint_data``), simulating the bit
+        rot / torn shard the manifest verification chain exists to
+        catch."""
+
+        def hook(path):
+            self._post_saves += 1
+            for f in self._faults:
+                if (f.fired or f.kind != "corrupt_ckpt"
+                        or f.at != self._post_saves):
+                    continue
+                self._mark_fired(f)
+                target = corrupt_checkpoint_data(path, match=f.arg)
+                if events is not None:
+                    events.ckpt_corruptions += 1
+                rank0_print(
+                    f"[faults] corrupted {target} in {path} "
+                    f"(save #{self._post_saves})"
+                )
+
+        return hook
+
+    def targeted_ranks(self) -> set[int]:
+        """Every rank some fault targets (kill_rank/stall_rank) — lets a
+        launcher reject targets outside the gang before spawning it
+        (a mistyped rank would otherwise turn a chaos run into a
+        silently fault-free one)."""
+        return {f.rank for f in self._faults if f.rank is not None}
+
     def has_kind(self, kind: str) -> bool:
         """Whether the spec contains any fault of ``kind`` (fired or
         not) — lets callers reject configurations where that fault
@@ -282,15 +477,60 @@ class FaultInjector:
 
     def pending(self) -> list[str]:
         """Human-readable unfired faults (for the run banner)."""
-        return [
-            f"{f.kind}@{f.at}" + (f":{f.arg}" if f.arg else "")
-            for f in self._faults
-            if not f.fired
-        ]
+        out = []
+        for f in self._faults:
+            if f.fired:
+                continue
+            head = (f"{f.kind}@{f.rank}:{f.at}" if f.rank is not None
+                    else f"{f.kind}@{f.at}")
+            out.append(head + (f":{f.arg}" if f.arg else ""))
+        return out
 
 
 def _default_stall(_) -> float:
     return 2.0
+
+
+def corrupt_checkpoint_data(path: str | os.PathLike, match: str | None = None,
+                            nbytes: int = 16) -> str:
+    """Flip ``nbytes`` bytes in the middle of one saved array file under
+    ``path/state`` — the largest payload file, or the first whose
+    relative path contains ``match``.  Returns the relative path
+    corrupted.  The manifest is left untouched: the point is exactly
+    that the bytes on disk no longer match it."""
+    path = os.fspath(path)
+    state_dir = os.path.join(path, "state")
+    candidates = []
+    for root, _, files in os.walk(state_dir):
+        for name in files:
+            if name.startswith("_"):
+                continue  # metadata; payload lives in the d/ dirs
+            fp = os.path.join(root, name)
+            rel = os.path.relpath(fp, path)
+            if match and match not in rel:
+                continue
+            candidates.append((os.path.getsize(fp), rel, fp))
+    if not candidates:
+        raise FileNotFoundError(
+            f"no array file to corrupt under {state_dir}"
+            + (f" matching {match!r}" if match else "")
+        )
+    size, rel, fp = max(candidates)
+    offset = max(0, size // 2 - nbytes // 2)
+    with open(fp, "r+b") as f:
+        f.seek(offset)
+        chunk = f.read(min(nbytes, max(size - offset, 1)))
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    # The simulated rot must also invalidate the GC validation memo —
+    # otherwise GC would keep trusting the pre-flip hash and could
+    # anchor the keep window on the garbage this fault just created.
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        forget_validated,
+    )
+
+    forget_validated(path)
+    return rel
 
 
 def _poison(batch):

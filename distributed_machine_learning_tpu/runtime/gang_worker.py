@@ -1,0 +1,207 @@
+"""One rank of a coordinated local gang — the end-to-end chaos harness.
+
+Run as a subprocess by ``gang_supervise`` (``cli/gang.py`` launches it;
+``tests/test_gang.py`` asserts on it): each of N OS processes trains
+lock-step SGD steps with real verified checkpoints
+(``train/checkpoint.py``) in a PER-RANK checkpoint directory
+(``<ckpt-root>/rank<r>`` — the per-host-shards layout of a pod run,
+which is what makes the restore-point election load-bearing: validity
+is each rank's own view), and wires the gang coordinator
+(``runtime/coordinator.py``) around the loop: heartbeats per step,
+suspensions around compile/saves, a restore-point record after every
+verified save.
+
+Lock-step is enforced by ``GangCoordinator.wait_for_peers`` — a barrier
+over the beat directory — rather than a cross-process XLA collective:
+the CI host's CPU backend cannot run multi-process XLA computations
+(the same env drift that fails ``tests/test_multihost.py`` here), and
+the barrier reproduces the exact failure semantics this subsystem
+exists for: when a peer dies or stalls, the survivors BLOCK, and only
+the peer-failure detector's coordinated abort frees them.  On real TPU
+pods the blocking collective is the psum itself and the identical
+coordinator sits around it (``cli/common.py``'s ``--gang-dir`` path).
+
+The chaos contract this worker proves (ISSUE 3's acceptance bar): with
+``--faults kill_rank@1:7`` on a 4-worker gang, rank 1 dies hard at step
+7, the survivors block at the next barrier, their peer detectors abort
+the gang, ``gang_supervise`` relaunches everyone from the elected
+restore point, and the final parameters are **bit-identical** to a
+fault-free run on every rank — the per-step batch is keyed on the
+absolute step index, so a resumed gang replays exactly the stream the
+dead gang would have seen.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _data_for_step(step: int) -> "object":
+    """The batch for an absolute step index — deterministic in ``step``
+    alone, so every rank (and every restart attempt) agrees on it."""
+    import numpy as np
+
+    rng = np.random.default_rng(10_000 + step)
+    return rng.standard_normal((4, 8)).astype(np.float32)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--gang-dir", required=True)
+    ap.add_argument("--ckpt-dir", required=True,
+                    help="checkpoint ROOT; this rank writes under "
+                         "<ckpt-dir>/rank<r> (per-host shard layout)")
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--save-every", type=int, default=5)
+    ap.add_argument("--faults", default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--heartbeat-interval", type=float, default=0.25)
+    ap.add_argument("--peer-timeout", type=float, default=15.0)
+    ap.add_argument("--step-sleep", type=float, default=0.02)
+    ap.add_argument("--telemetry-dir", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_machine_learning_tpu.runtime.coordinator import (
+        GangCoordinator,
+    )
+    from distributed_machine_learning_tpu.runtime.faults import (
+        FaultEvents,
+        FaultInjector,
+    )
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        checkpoint_cursor,
+        latest_checkpoint,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from distributed_machine_learning_tpu.train.state import TrainState
+    from distributed_machine_learning_tpu.utils.summary import (
+        resilience_summary,
+    )
+
+    telemetry = None
+    if args.telemetry_dir:
+        from distributed_machine_learning_tpu.telemetry import (
+            Telemetry,
+            set_telemetry,
+        )
+
+        telemetry = Telemetry(args.telemetry_dir)
+        set_telemetry(telemetry)
+
+    ckpt_dir = os.path.join(args.ckpt_dir, f"rank{args.rank}")
+    events = FaultEvents()
+    injector = FaultInjector.from_flags(
+        args.faults, seed=args.seed, horizon=max(args.steps, 2),
+        rank=args.rank,
+    )
+    if injector is not None:
+        from distributed_machine_learning_tpu.runtime.faults import (
+            FAULT_LEDGER_FILE,
+        )
+
+        os.makedirs(args.gang_dir, exist_ok=True)
+        # The exactly-once latch must survive the relaunch this very
+        # fault will cause — without the ledger every attempt re-fires
+        # the same kill and the gang can never finish.
+        injector.attach_ledger(
+            os.path.join(args.gang_dir, FAULT_LEDGER_FILE)
+        )
+    coord = GangCoordinator(
+        args.gang_dir, rank=args.rank, world=args.world,
+        heartbeat_interval_s=args.heartbeat_interval,
+        peer_timeout_s=args.peer_timeout, events=events,
+    ).start()
+
+    with coord.suspend():
+        state = TrainState.create(
+            params={"w": jnp.zeros((8,), jnp.float32)}
+        )
+        start = 0
+        latest = latest_checkpoint(ckpt_dir, events=events)
+        if latest is not None:
+            state = restore_checkpoint(latest, abstract_state=state,
+                                       files_verified=True)
+            restored_step = int(jax.device_get(state.step))
+            cursor = checkpoint_cursor(latest)
+            start = cursor if cursor is not None else restored_step
+            # The restore is this rank's proof the checkpoint is whole —
+            # record it so the next election can agree on it even if no
+            # further save ever lands.
+            coord.record_valid_step(restored_step)
+            print(f"resumed {latest} step {restored_step}", flush=True)
+
+        @jax.jit
+        def step_fn(state, xs):
+            # Every rank computes the same mean-gradient update from the
+            # same step-keyed batch — the value a psum over the gang
+            # would produce, so replicated params stay bit-identical
+            # across ranks (asserted by digest below).
+            g = xs.mean(0)
+            w = state.params["w"] - 0.1 * (g + 0.01 * state.params["w"])
+            return state.replace(params={"w": w}, step=state.step + 1)
+
+        # AOT-compile inside the suspension: the first step's compile
+        # must not read as a stall under short chaos-test timeouts.
+        compiled = step_fn.lower(state, _data_for_step(start)).compile()
+        # Publish the resumed position BEFORE the first barrier: peers
+        # wait for our published step, and a gang resuming at step k
+        # would otherwise deadlock at barrier k with everyone still
+        # publishing step 0.
+        coord.beat(step=start)
+
+    print(f"ready rank={args.rank} start={start}", flush=True)
+    post_save = injector.post_save_hook(events) if injector else None
+    batches = range(start, args.steps)
+    if injector is not None:
+        batches = injector.wrap_batches(batches, events, start=start)
+
+    for idx in batches:
+        # The lock-step barrier: the stand-in for the synchronous
+        # collective — blocks until every peer has published step idx
+        # (a dead peer blocks us here until the detector aborts the
+        # gang, exactly like a hung psum).
+        if not coord.wait_for_peers(idx):
+            break  # test mode only; production aborts the process
+        state = compiled(state, _data_for_step(idx))
+        jax.block_until_ready(state.params["w"])
+        coord.beat(step=idx + 1)
+        if args.rank == 0:
+            print(f"step {idx}", flush=True)
+        if (idx + 1) % args.save_every == 0 or idx + 1 == args.steps:
+            # Saves are liveness, not progress: suspend the stall clock
+            # exactly as the watchdog path does.
+            with coord.suspend():
+                save_checkpoint(
+                    ckpt_dir, state, cursor=idx + 1,
+                    post_save_hook=post_save,
+                )
+            coord.record_valid_step(int(jax.device_get(state.step)))
+        if args.step_sleep:
+            time.sleep(args.step_sleep)
+
+    digest = hashlib.sha256(
+        np.ascontiguousarray(np.asarray(state.params["w"])).tobytes()
+    ).hexdigest()[:16]
+    print(f"final_step {int(jax.device_get(state.step))}", flush=True)
+    print(f"final {digest}", flush=True)
+    if events.total():
+        print(resilience_summary(events), flush=True)
+    coord.finish()
+    if telemetry is not None:
+        telemetry.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -32,11 +32,20 @@ policy); in-process, :class:`RaisingWatchdog` turns the next completed
 step boundary into a :class:`StallError` so a *transient* stall (slow
 storage, injected sleep) is healed by restart rather than silently
 absorbed into one long step.
+
+Everything above heals one process.  :func:`gang_supervise` is the
+multi-host rung: a gang of worker processes coordinated through
+``runtime/coordinator.py`` (heartbeats, peer-failure detection,
+coordinated abort) is restarted *as a group* from the restore point
+every rank agrees on — the failure mode where one dead rank would
+otherwise leave the others blocked in a collective forever.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+import time
 from typing import Callable
 
 import jax
@@ -140,23 +149,190 @@ def run_attempts(attempt: Callable[[int], object], *, max_restarts: int = 3,
             )
 
 
-def auto_resume(ckpt_dir, init_state, abstract_state=None):
-    """(state, cursor, resumed_path) — the newest complete checkpoint
+class GangFailure(RuntimeError):
+    """The gang kept failing after exhausting its restarts."""
+
+    def __init__(self, message: str, returncodes: list[int | None]):
+        super().__init__(message)
+        self.returncodes = returncodes
+
+
+def _drain_gang(procs, grace_s: float) -> list[int | None]:
+    """Terminate (then kill) every still-running worker; returns the
+    final returncodes."""
+    for p in procs:
+        if p.poll() is None:
+            with contextlib.suppress(OSError):
+                p.terminate()
+    deadline = time.monotonic() + grace_s
+    for p in procs:
+        if p.poll() is None:
+            timeout = max(deadline - time.monotonic(), 0.1)
+            try:
+                p.wait(timeout=timeout)
+            except Exception:
+                with contextlib.suppress(OSError):
+                    p.kill()
+                with contextlib.suppress(Exception):
+                    p.wait(timeout=5)
+    return [p.poll() for p in procs]
+
+
+def gang_supervise(worker_cmd, world: int, gang_dir,
+                   *, ckpt_dirs=None, max_restarts: int = 3,
+                   events: FaultEvents | None = None,
+                   poll_s: float = 0.2, grace_s: float = 10.0,
+                   env=None, log_dir=None) -> list[int]:
+    """Run a gang of ``world`` worker processes to completion, restarting
+    ALL of them together on any failure — the multi-host analogue of
+    :func:`run_attempts`.
+
+    ``worker_cmd(rank, attempt)`` returns the argv for one worker (the
+    ``attempt`` parameter lets the caller pick a fresh coordination-
+    service port per relaunch — the dead attempt's port may linger in
+    TIME_WAIT).  Workers coordinate through ``gang_dir`` via
+    ``runtime/coordinator.py``: heartbeat files, the abort latch, and
+    restore-point records.
+
+    The restart protocol, in order:
+
+    1. any worker exiting nonzero (a died rank, or survivors taking the
+       coordinated abort exit) fails the attempt; the rest are
+       terminated so no orphan keeps the next rendezvous port busy;
+    2. the restore-point election (``elect_restore_step``) picks the
+       highest checkpoint step EVERY rank verified — and checkpoints
+       newer than it are quarantined (``enforce_restore_point``) so
+       each relaunched worker's fallback chain resolves to the same
+       restore point.  ``ckpt_dirs``: one shared checkpoint directory
+       or one per rank (per-host shard layouts);
+    3. the whole gang is relaunched (``gang_restarts`` counter, one
+       ``gang_attempt`` span per try), up to ``max_restarts`` times.
+
+    Returns the final returncodes (all zero) on success; raises
+    :class:`GangFailure` after the restart budget is spent.
+
+    ``log_dir``: when given, each worker's stdout+stderr streams to
+    ``rank<r>.attempt<k>.log`` there — the gang post-mortem surface.
+    """
+    import subprocess
+
+    from distributed_machine_learning_tpu.runtime.coordinator import (
+        clear_gang_state,
+        elect_restore_step,
+        enforce_restore_point,
+        read_abort,
+    )
+    from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    # A fresh supervision run: stale beats/aborts AND restore records
+    # from any earlier run in the same gang_dir would poison detection
+    # and the election.
+    clear_gang_state(gang_dir, restore_records=True)
+    if log_dir is not None:
+        os.makedirs(log_dir, exist_ok=True)
+    restarts = 0
+    while True:
+        # Between attempts: clear the dead attempt's beats and abort
+        # latch, but KEEP restore records — they are the election input.
+        clear_gang_state(gang_dir)
+        if restarts > 0 and ckpt_dirs is not None:
+            elected = elect_restore_step(gang_dir, world,
+                                         ckpt_dirs=ckpt_dirs)
+            quarantined = enforce_restore_point(ckpt_dirs, elected)
+            rank0_print(
+                f"[gang] restore-point election: step "
+                f"{elected if elected is not None else '<none>'}"
+                + (f"; quarantined {len(quarantined)} newer "
+                   f"checkpoint(s)" if quarantined else "")
+            )
+        tel = get_telemetry()
+        span = (tel.span("gang_attempt", attempt=restarts, world=world)
+                if tel is not None else contextlib.nullcontext())
+        procs, logs = [], []
+        try:
+            with span:
+                for rank in range(world):
+                    out = None
+                    if log_dir is not None:
+                        out = open(
+                            os.path.join(
+                                log_dir,
+                                f"rank{rank}.attempt{restarts}.log",
+                            ),
+                            "ab",
+                        )
+                    logs.append(out)
+                    procs.append(subprocess.Popen(
+                        worker_cmd(rank, restarts),
+                        stdout=out,
+                        stderr=subprocess.STDOUT if out is not None
+                        else None,
+                        env=env,
+                    ))
+                failed = None
+                while failed is None:
+                    codes = [p.poll() for p in procs]
+                    bad = [(r, c) for r, c in enumerate(codes)
+                           if c not in (None, 0)]
+                    if bad:
+                        failed = bad
+                        break
+                    if all(c == 0 for c in codes):
+                        return list(codes)  # the gang finished cleanly
+                    time.sleep(poll_s)
+        finally:
+            final_codes = _drain_gang(procs, grace_s)
+            for out in logs:
+                if out is not None:
+                    out.close()
+        abort = read_abort(gang_dir)
+        why = (f"rank {failed[0][0]} exited {failed[0][1]}"
+               + (f"; abort declared by rank {abort.get('by_rank')}: "
+                  f"{abort.get('reason')}" if abort else ""))
+        if restarts >= max_restarts:
+            rank0_print(
+                f"[gang] giving up after {restarts} restart(s): {why}"
+            )
+            raise GangFailure(
+                f"gang failed after {restarts} restart(s): {why}",
+                final_codes,
+            )
+        restarts += 1
+        if events is not None:
+            events.gang_restarts += 1
+        if tel is not None:
+            tel.registry.counter("gang_restarts").inc()
+            tel.flush()
+        rank0_print(
+            f"[gang] {why}; coordinated restart {restarts}/{max_restarts}"
+        )
+
+
+def auto_resume(ckpt_dir, init_state, abstract_state=None, events=None):
+    """(state, cursor, resumed_path) — the newest *valid* checkpoint
     under ``ckpt_dir`` restored against ``abstract_state`` (default: the
     fresh ``init_state``), or ``(init_state, 0, None)`` when none exists.
-    Incomplete saves (crash/kill mid-write) are skipped by
-    ``latest_checkpoint`` — that fallback IS the resume guarantee."""
+    Incomplete saves (crash/kill mid-write) and corrupt ones (manifest
+    digest mismatch — quarantined with ``.invalid``) are skipped by
+    ``latest_checkpoint``'s fallback chain — that chain IS the resume
+    guarantee.  ``events``: optional FaultEvents; verification failures
+    and fallbacks are counted there as well as in telemetry."""
     from distributed_machine_learning_tpu.train.checkpoint import (
         checkpoint_cursor,
         latest_checkpoint,
         restore_checkpoint,
     )
 
-    latest = latest_checkpoint(ckpt_dir)
+    latest = latest_checkpoint(ckpt_dir, events=events)
     if latest is None:
         return init_state, 0, None
     state = restore_checkpoint(
-        latest, abstract_state=abstract_state or init_state
+        latest, abstract_state=abstract_state or init_state,
+        files_verified=True,  # the chain above just ran the file sweep
     )
     cursor = checkpoint_cursor(latest)
     if cursor is None:
@@ -221,6 +397,8 @@ def supervised_train(
         raise ValueError(f"save_every must be >= 1, got {save_every}")
     events = events if events is not None else FaultEvents()
     mid_save = injector.mid_save_hook(events) if injector is not None else None
+    post_save = (injector.post_save_hook(events) if injector is not None
+                 else None)
     scaled = isinstance(init_state, DynamicScaleState)
     # Read the scaler's init values ONCE: the compiled step donates its
     # input state, so after attempt 0 these arrays may be dead buffers.
@@ -256,6 +434,7 @@ def supervised_train(
             abstract_state=unwrap_dynamic_scale(
                 abstract_state if abstract_state is not None else init_state
             ),
+            events=events,
         )
         if resumed is None:
             inner = _copy_state(inner)
@@ -318,6 +497,7 @@ def supervised_train(
                         cursor=cursor_box["v"],
                         mid_save_hook=mid_save,
                         keep_last_n=keep_last_n,
+                        post_save_hook=post_save,
                     )
                 if stop is not None and stop():
                     events.preemptions += 1

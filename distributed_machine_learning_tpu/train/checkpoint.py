@@ -17,9 +17,11 @@ host arrays when no template is given).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import time
+import zlib
 from typing import Any
 
 import jax
@@ -30,6 +32,264 @@ from distributed_machine_learning_tpu.train.state import TrainState
 
 _CONFIG_FILE = "sgd_config.json"
 _STATE_DIR = "state"
+_MANIFEST_FILE = "manifest.json"
+_INVALID_MARKER = ".invalid"
+
+# Absolute checkpoint paths this process has already fully hashed clean
+# during GC — complete checkpoints are immutable, so GC (which runs on
+# the training thread after every save) trusts one full hash per path
+# and falls back to cheap marker/completeness checks afterwards.  A
+# re-save over the same step discards the entry, as do quarantining and
+# the fault injector's byte-flipper (``forget_validated``).  Content
+# that rots on disk after its one hash — outside those doors — is still
+# caught where it matters: at restore time, and by any fresh process's
+# first full check.
+_GC_VALIDATED: set[str] = set()
+
+
+def forget_validated(path: str | os.PathLike) -> None:
+    """Drop ``path`` from the in-process GC validation memo — called by
+    anything that mutates a committed checkpoint's bytes (re-saves,
+    quarantine verdicts, the chaos injector's bit-flipper), so GC can
+    never anchor the keep window on data known to have changed since
+    its one full hash."""
+    _GC_VALIDATED.discard(os.path.abspath(os.fspath(path)))
+
+
+class CheckpointVerifyError(RuntimeError):
+    """A checkpoint failed end-to-end content verification (manifest
+    missing a file, byte-size drift, digest mismatch, or a quarantine
+    marker left by an earlier failure).  Raised instead of silently
+    materializing garbage into a TrainState."""
+
+
+def _bump(name: str, events=None) -> None:
+    """Increment the named telemetry counter (``ckpt_verify_failures`` /
+    ``ckpt_fallbacks``) and, when given, the matching FaultEvents field —
+    every verification event must be observable (PR 2's contract)."""
+    from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if tel is not None:
+        tel.registry.counter(name).inc()
+    if events is not None and hasattr(events, name):
+        setattr(events, name, getattr(events, name) + 1)
+
+
+# -- manifest: per-leaf + per-file content digests -------------------------
+def _file_digest(path: str) -> tuple[str, int]:
+    """(sha256 hexdigest, byte size) of a file, streamed."""
+    h = hashlib.sha256()
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            n += len(chunk)
+            h.update(chunk)
+    return h.hexdigest(), n
+
+
+def _state_files(path: str) -> list[str]:
+    """Every file under the orbax state dir, as paths relative to the
+    checkpoint root — the on-disk surface the manifest covers."""
+    state_dir = os.path.join(path, _STATE_DIR)
+    out = []
+    for root, _, files in os.walk(state_dir):
+        for name in files:
+            out.append(os.path.relpath(os.path.join(root, name), path))
+    return sorted(out)
+
+
+def _keystr(keypath) -> str:
+    """``(DictKey('params'), DictKey('kernel'))`` → ``params/kernel`` —
+    stable, human-readable leaf names for the manifest."""
+    parts = []
+    for k in keypath:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return "/".join(parts)
+
+
+def _leaf_readable(leaf) -> bool:
+    if isinstance(leaf, np.ndarray):
+        return True
+    if isinstance(leaf, jax.Array):
+        return leaf.is_fully_addressable or leaf.is_fully_replicated
+    return False
+
+
+def _leaf_entries(tree) -> dict:
+    """Per-leaf content digests of an in-memory state pytree: crc32,
+    sha256, byte size, dtype, shape.  Computed from the arrays
+    themselves (not the files) so verification is end to end — a flip
+    anywhere between save and restore is caught at restore time.  Leaves
+    not readable from this process (multi-host shards that are neither
+    addressable nor replicated) are recorded unverified rather than
+    skipped silently."""
+    entries = {}
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for keypath, leaf in leaves:
+        name = _keystr(keypath)
+        if not _leaf_readable(leaf):
+            entries[name] = {"unverified": "not addressable from the "
+                                           "manifest-writing process"}
+            continue
+        arr = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+        raw = arr.tobytes()
+        entries[name] = {
+            "sha256": hashlib.sha256(raw).hexdigest(),
+            "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+            "bytes": len(raw),
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+        }
+    return entries
+
+
+def write_checkpoint_manifest(path: str | os.PathLike, tree=None,
+                              leaf_entries: dict | None = None) -> dict:
+    """Hash every file under ``path/state`` (and, when ``tree`` or
+    precomputed ``leaf_entries`` are given, every array leaf) into
+    ``path/manifest.json`` (atomic replace).  Returns the manifest.
+
+    Written between the state dir and the config file, so a complete
+    checkpoint (``_is_complete``) always carries its manifest — and a
+    kill before the manifest leaves the checkpoint incomplete, never
+    complete-but-unverifiable.
+    """
+    path = os.path.abspath(os.fspath(path))
+    files = {}
+    for rel in _state_files(path):
+        sha, nbytes = _file_digest(os.path.join(path, rel))
+        files[rel] = {"sha256": sha, "bytes": nbytes}
+    manifest = {
+        "version": 1,
+        "files": files,
+        "leaves": (leaf_entries if leaf_entries is not None
+                   else _leaf_entries(tree) if tree is not None else {}),
+    }
+    tmp = os.path.join(path, _MANIFEST_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, os.path.join(path, _MANIFEST_FILE))
+    return manifest
+
+
+def checkpoint_manifest(path: str | os.PathLike) -> dict | None:
+    """The manifest a checkpoint was saved with, or None for pre-manifest
+    (legacy) checkpoints."""
+    try:
+        with open(os.path.join(os.fspath(path), _MANIFEST_FILE)) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+# -- quarantine: known-bad checkpoints are marked, not re-probed ----------
+def quarantine_reason(path: str | os.PathLike) -> str | None:
+    """The reason a checkpoint was quarantined (``.invalid`` marker), or
+    None for an unmarked one."""
+    try:
+        with open(os.path.join(os.fspath(path), _INVALID_MARKER)) as f:
+            payload = json.load(f)
+        return str(payload.get("reason", "unknown"))
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError):
+        return "unreadable quarantine marker"
+
+
+def quarantine_checkpoint(path: str | os.PathLike, reason: str) -> None:
+    """Mark a checkpoint dir known-bad (``.invalid`` marker with the
+    reason).  The fallback chain and every reader skip marked dirs
+    without re-reading their data; GC may delete them once a newer valid
+    checkpoint exists.  Idempotent and race-safe (atomic replace — on a
+    shared filesystem every rank writes the same verdict)."""
+    path = os.fspath(path)
+    forget_validated(path)
+    tmp = os.path.join(path, _INVALID_MARKER + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump({"reason": reason, "time": time.time()}, f)
+    os.replace(tmp, os.path.join(path, _INVALID_MARKER))
+
+
+def _verify_manifest_files(path: str, manifest: dict) -> list[str]:
+    """Problems found checking the on-disk files against ``manifest``
+    (empty list = all files present, sized, and digest-identical)."""
+    problems = []
+    for rel, entry in manifest.get("files", {}).items():
+        fp = os.path.join(path, rel)
+        if not os.path.isfile(fp):
+            problems.append(f"missing file {rel}")
+            continue
+        size = os.path.getsize(fp)
+        if size != entry["bytes"]:
+            problems.append(
+                f"size mismatch {rel}: {size} != {entry['bytes']}"
+            )
+            continue
+        sha, _ = _file_digest(fp)
+        if sha != entry["sha256"]:
+            problems.append(f"digest mismatch {rel}")
+    return problems
+
+
+def _verify_restored_leaves(tree, leaf_manifest: dict) -> list[str]:
+    """Problems comparing restored array leaves to the manifest's
+    per-leaf digests (empty = every verifiable leaf matches byte for
+    byte).  Leaves recorded unverified, restored with a different dtype
+    (a deliberate cast template), or not readable from this process
+    (sharded multi-host restores) are skipped — content verification
+    covers exactly the leaves whose saved bytes this process can see
+    again."""
+    restored = {
+        _keystr(kp): leaf
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+    problems = []
+    for name, entry in leaf_manifest.items():
+        if "sha256" not in entry:
+            continue  # recorded unverified at save time
+        leaf = restored.get(name)
+        if leaf is None or not _leaf_readable(leaf):
+            continue
+        arr = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+        if str(arr.dtype) != entry["dtype"]:
+            continue  # cast restore: saved bytes are not comparable
+        raw = arr.tobytes()
+        if len(raw) != entry["bytes"]:
+            problems.append(
+                f"leaf {name}: {len(raw)} bytes != {entry['bytes']}"
+            )
+        elif (zlib.crc32(raw) & 0xFFFFFFFF) != entry["crc32"] or (
+                hashlib.sha256(raw).hexdigest() != entry["sha256"]):
+            problems.append(f"leaf {name}: content digest mismatch")
+    return problems
+
+
+def validate_checkpoint(path: str | os.PathLike) -> list[str]:
+    """Why this checkpoint cannot be restored — empty list means valid.
+
+    The single validity predicate shared by the fallback chain
+    (``latest_checkpoint``), GC (``gc_checkpoints``), the gang
+    supervisor's restore-point election, and ``tools/ckpt_verify.py``:
+    quarantine marker, completeness (state dir + config), and manifest
+    file digests.  Pre-manifest checkpoints validate on completeness
+    alone (legacy compatibility)."""
+    path = os.path.abspath(os.fspath(path))
+    reason = quarantine_reason(path)
+    if reason is not None:
+        return [f"quarantined: {reason}"]
+    if not _is_complete(path):
+        return ["incomplete: state dir or config file missing"]
+    try:
+        manifest = checkpoint_manifest(path)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"manifest unreadable: {e}"]
+    if manifest is None:
+        return []  # legacy checkpoint: complete == valid
+    return _verify_manifest_files(path, manifest)
 
 
 def _tree_bytes(tree) -> int:
@@ -111,7 +371,8 @@ def _state_pytree(state: TrainState) -> dict:
 
 def save_checkpoint(directory: str | os.PathLike, state: TrainState,
                     layout: str | None = None, cursor: int | None = None,
-                    mid_save_hook=None, keep_last_n: int | None = None) -> str:
+                    mid_save_hook=None, keep_last_n: int | None = None,
+                    post_save_hook=None) -> str:
     """Write `state` under `directory/step_<n>/`; returns the path written.
 
     Only process 0's metadata file is written once; array shards are saved
@@ -137,19 +398,39 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
     ``keep_last_n``: if set, garbage-collect older checkpoints after
     this save completes (``gc_checkpoints``) so supervised long runs
     don't fill the disk.
+
+    ``post_save_hook``: test/chaos hook called with the written path
+    after the checkpoint is fully committed (state + manifest + config)
+    — the bit-rot window ``runtime/faults.py``'s ``corrupt_ckpt`` fault
+    flips bytes in, proving the verification chain catches it.
+
+    Verification: before the config file (the completeness marker)
+    lands, a ``manifest.json`` records a sha256 + byte size for every
+    file under the state dir and a crc32/sha256/size/dtype/shape for
+    every array leaf — ``restore_checkpoint`` verifies both ends, and
+    ``latest_checkpoint`` falls back past checkpoints that no longer
+    match.
     """
     directory = os.path.abspath(os.fspath(directory))
     step = int(jax.device_get(state.step))
     path = os.path.join(directory, f"step_{step}")
+    _GC_VALIDATED.discard(path)  # a re-save invalidates the GC memo
     t0 = time.perf_counter()
+    tree = _state_pytree(state)
     with ocp.PyTreeCheckpointer() as ckptr:
         # force=True: re-saving the same step (e.g. rerunning a crashed job
         # into the same --ckpt-dir) overwrites instead of raising.
-        ckptr.save(os.path.join(path, _STATE_DIR), _state_pytree(state),
-                   force=True)
+        ckptr.save(os.path.join(path, _STATE_DIR), tree, force=True)
     if mid_save_hook is not None:
         mid_save_hook()
     if jax.process_index() == 0:
+        # A re-save over a quarantined dir is a fresh checkpoint: the
+        # old verdict must not outlive the data it judged.
+        try:
+            os.remove(os.path.join(path, _INVALID_MARKER))
+        except FileNotFoundError:
+            pass
+        write_checkpoint_manifest(path, tree)
         with open(os.path.join(path, _CONFIG_FILE), "w") as f:
             # Record the config class so restore rebuilds the right
             # optimizer config (LARSConfig carries extra fields that
@@ -161,8 +442,14 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
             if cursor is not None:
                 payload["__cursor__"] = int(cursor)
             json.dump(payload, f)
+        # The manifest was just computed from these very bytes: the GC
+        # below (and every later pass) must not immediately re-hash
+        # them on the training thread.
+        _GC_VALIDATED.add(path)
         if keep_last_n is not None:
             gc_checkpoints(directory, keep_last_n)
+        if post_save_hook is not None:
+            post_save_hook(path)
     # A save that died above (e.g. the injected kill) records no span —
     # the torn attempt is visible as the fault instant + missing save.
     from distributed_machine_learning_tpu.telemetry import get_telemetry
@@ -170,46 +457,77 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
     tel = get_telemetry()
     if tel is not None:
         _record_ckpt_io(tel, "save", t0, time.perf_counter(), step,
-                        _tree_bytes(_state_pytree(state)))
+                        _tree_bytes(tree))
     return path
 
 
 def gc_checkpoints(directory: str | os.PathLike, keep_last_n: int
                    ) -> list[str]:
     """Delete old checkpoints, keeping the newest ``keep_last_n``
-    *complete* ones; returns the paths removed.
+    *valid* ones; returns the paths removed.
 
-    The newest complete checkpoint is never deleted (it is the resume
-    anchor — losing it turns every later fault into a from-scratch
-    restart).  Incomplete directories are removed only when a complete
-    checkpoint with a HIGHER step exists: an older incomplete dir is a
-    crash leftover, but a newer one may be an in-flight async save that
-    simply hasn't committed yet.
+    Validity is the fallback chain's check (``validate_checkpoint``):
+    complete, unquarantined, manifest digests intact.  The newest valid
+    checkpoint is never deleted (it is the resume anchor — losing it
+    turns every later fault into a from-scratch restart), and a corrupt
+    NEWEST dir therefore cannot trick GC into retaining only garbage:
+    the corrupt dir doesn't count, so the newest intact one stays
+    protected.  Non-valid directories (crash leftovers, quarantined
+    dirs) are removed only when a valid checkpoint with a HIGHER step
+    exists: an older one is garbage, but a newer one may be an in-flight
+    async save that simply hasn't committed yet — or the only copy of
+    anything, corrupt or not.
     """
     import shutil
 
     if keep_last_n < 1:
         raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
-    directory = os.fspath(directory)
+    directory = os.path.abspath(os.fspath(directory))
     if not os.path.isdir(directory):
         return []
     steps = []
     for name in os.listdir(directory):
         if name.startswith("step_") and name[5:].isdigit():
             steps.append(int(name[5:]))
-    complete = [
-        s for s in sorted(steps, reverse=True)
-        if _is_complete(os.path.join(directory, f"step_{s}"))
-    ]
-    keep = set(complete[:keep_last_n])
-    newest_complete = complete[0] if complete else None
+    # Walk newest-first, validating only until the keep window is full:
+    # everything older gets deleted (valid or not) once keep_last_n
+    # valid checkpoints exist above it, so hashing it would be wasted
+    # I/O — this runs on the training thread after every save.  A
+    # complete dir that fails its digests is quarantined ON DISCOVERY
+    # (same as the fallback chain), so later GC passes short-circuit on
+    # the marker instead of re-hashing known-bad data forever; a dir
+    # this process already hashed clean is trusted on cheap checks
+    # alone (``_GC_VALIDATED`` — checkpoints are immutable once
+    # complete, and restore-time verification remains the authoritative
+    # content check for anything that rots after its one full hash).
+    keep: set[int] = set()
+    newest_valid: int | None = None
+    validated_bad: set[int] = set()
+    for s in sorted(steps, reverse=True):
+        if len(keep) >= keep_last_n:
+            break
+        path = os.path.join(directory, f"step_{s}")
+        if (path in _GC_VALIDATED and _is_complete(path)
+                and quarantine_reason(path) is None):
+            problems: list[str] = []
+        else:
+            problems = validate_checkpoint(path)
+        if not problems:
+            _GC_VALIDATED.add(path)
+            keep.add(s)
+            if newest_valid is None:
+                newest_valid = s
+            continue
+        validated_bad.add(s)
+        if (_is_complete(path) and quarantine_reason(path) is None):
+            quarantine_checkpoint(path, "; ".join(problems))
+            _bump("ckpt_verify_failures")
     removed = []
     for s in steps:
         if s in keep:
             continue
-        is_complete = s in complete
-        if not is_complete and (newest_complete is None
-                                or s >= newest_complete):
+        if s in validated_bad and (newest_valid is None
+                                   or s >= newest_valid):
             continue  # possibly an in-flight save — leave it alone
         path = os.path.join(directory, f"step_{s}")
         shutil.rmtree(path, ignore_errors=True)
@@ -250,7 +568,7 @@ class AsyncCheckpointWriter:
 
     def __init__(self):
         self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
-        self._pending: tuple[str, dict, str, int | None] | None = None
+        self._pending: tuple[str, dict, str, int | None, dict] | None = None
         # (start_s, step, nbytes) of the in-flight save, when telemetry
         # is on — recorded as a checkpoint_save span at the flush that
         # commits it (the span covers dispatch → durable-on-disk, the
@@ -276,15 +594,23 @@ class AsyncCheckpointWriter:
                 time.perf_counter(), step,
                 _tree_bytes(_state_pytree(state)),
             )
+        _GC_VALIDATED.discard(path)  # a re-save invalidates the GC memo
+        tree = _state_pytree(state)
         self._ckptr.save(
-            os.path.join(path, _STATE_DIR), _state_pytree(state), force=True
+            os.path.join(path, _STATE_DIR), tree, force=True
         )
         if jax.process_index() == 0:
             payload = {"__class__": type(state.config).__name__,
                        **dataclasses.asdict(state.config)}
             if cursor is not None:
                 payload["__cursor__"] = int(cursor)
-            self._pending = (path, payload, directory, keep_last_n)
+            # Per-leaf digests are computed NOW, while the caller's
+            # arrays are still alive (the next train step may donate
+            # them); the per-FILE half of the manifest can only be
+            # hashed at flush time, once orbax has committed the state
+            # dir.
+            self._pending = (path, payload, directory, keep_last_n,
+                             _leaf_entries(tree))
         return path
 
     def _flush_pending(self) -> None:
@@ -301,10 +627,20 @@ class AsyncCheckpointWriter:
                 _record_ckpt_io(tel, "save", t0, time.perf_counter(),
                                 step, nbytes)
         if self._pending is not None:
-            path, payload, directory, keep_last_n = self._pending
+            path, payload, directory, keep_last_n, leaf_entries = (
+                self._pending
+            )
             os.makedirs(path, exist_ok=True)
+            try:
+                os.remove(os.path.join(path, _INVALID_MARKER))
+            except FileNotFoundError:
+                pass
+            # Same write order as the sync path: manifest before the
+            # config file, so complete always implies verifiable.
+            write_checkpoint_manifest(path, leaf_entries=leaf_entries)
             with open(os.path.join(path, _CONFIG_FILE), "w") as f:
                 json.dump(payload, f)
+            _GC_VALIDATED.add(path)  # manifest just hashed these bytes
             self._pending = None
             # GC only after the save is complete: the just-flushed
             # checkpoint is now the newest complete one and therefore
@@ -338,10 +674,20 @@ def _is_complete(path: str) -> bool:
     )
 
 
-def latest_checkpoint(directory: str | os.PathLike) -> str | None:
-    """Highest-step *complete* `step_<n>` subdirectory of `directory`, or
-    None.  Incomplete checkpoints (crash mid-save) are skipped so resume
-    falls back to the newest complete one."""
+def latest_checkpoint(directory: str | os.PathLike,
+                      events=None) -> str | None:
+    """Highest-step *valid* `step_<n>` subdirectory of `directory`, or
+    None — a fallback CHAIN, not a single probe.
+
+    Walking down from the newest step: incomplete checkpoints (crash
+    mid-save, in-flight async save) are skipped silently as before;
+    already-quarantined dirs are skipped without touching their data;
+    and a complete checkpoint whose manifest digests no longer match
+    (bit flip, truncation, torn shard) is quarantined with an
+    ``.invalid`` marker and skipped — each such discovery counts one
+    ``ckpt_verify_failures`` and one ``ckpt_fallbacks`` — so resume
+    lands on the newest checkpoint that is actually restorable instead
+    of crashing on (or silently restoring) garbage."""
     directory = os.fspath(directory)
     if not os.path.isdir(directory):
         return None
@@ -351,15 +697,44 @@ def latest_checkpoint(directory: str | os.PathLike) -> str | None:
             steps.append(int(name[5:]))
     for step in sorted(steps, reverse=True):
         path = os.path.join(directory, f"step_{step}")
-        if _is_complete(path):
-            return path
+        if quarantine_reason(path) is not None:
+            continue  # known bad: counted when first quarantined
+        if not _is_complete(path):
+            continue  # crash leftover or in-flight save — never marked
+        problems = validate_checkpoint(path)
+        if problems:
+            quarantine_checkpoint(path, "; ".join(problems))
+            _bump("ckpt_verify_failures", events)
+            _bump("ckpt_fallbacks", events)
+            from distributed_machine_learning_tpu.utils.logging import (
+                rank0_print,
+            )
+
+            rank0_print(
+                f"[checkpoint] {path} failed verification "
+                f"({problems[0]}{' …' if len(problems) > 1 else ''}); "
+                "quarantined, falling back to the previous valid "
+                "checkpoint"
+            )
+            continue
+        return path
     return None
 
 
 def checkpoint_config(path: str | os.PathLike):
     """The optimizer config instance a checkpoint was saved with — lets a
     resume build its abstract template with the *saved* momentum layout
-    (AdamW's moment dict vs SGD's buffer tree) before restoring."""
+    (AdamW's moment dict vs SGD's buffer tree) before restoring.
+
+    Quarantined checkpoints raise :class:`CheckpointVerifyError` without
+    opening any data file: resume-time probing must never read
+    known-bad checkpoints."""
+    reason = quarantine_reason(path)
+    if reason is not None:
+        raise CheckpointVerifyError(
+            f"checkpoint {os.fspath(path)} is quarantined ({reason}); "
+            "refusing to read its config"
+        )
     with open(os.path.join(os.fspath(path), _CONFIG_FILE)) as f:
         payload = json.load(f)
     from distributed_machine_learning_tpu.train.optimizers import (
@@ -379,15 +754,28 @@ def checkpoint_cursor(path: str | os.PathLike) -> int | None:
     at, or None for checkpoints saved without one.  Diverges from the
     step counter once the non-finite-gradient guard has skipped a batch;
     the supervisor replays from the cursor so the post-restart stream is
-    exactly the pre-crash one."""
-    with open(os.path.join(os.fspath(path), _CONFIG_FILE)) as f:
-        cursor = json.load(f).get("__cursor__")
+    exactly the pre-crash one.
+
+    Quarantined (``.invalid``-marked) and torn checkpoints read as None
+    rather than raising or returning garbage — callers fall back to the
+    state's own step counter, which is always safe (it merely replays a
+    few extra batches)."""
+    if quarantine_reason(path) is not None:
+        return None
+    try:
+        with open(os.path.join(os.fspath(path), _CONFIG_FILE)) as f:
+            cursor = json.load(f).get("__cursor__")
+    except (OSError, json.JSONDecodeError):
+        return None
     return None if cursor is None else int(cursor)
 
 
 def checkpoint_layout(path: str | os.PathLike) -> str | None:
     """The parameter-layout tag a checkpoint was saved with (see
-    ``save_checkpoint``); None for plain layouts or pre-tag checkpoints."""
+    ``save_checkpoint``); None for plain layouts, pre-tag checkpoints,
+    and quarantined dirs (whose data must not be probed)."""
+    if quarantine_reason(path) is not None:
+        return None
     with open(os.path.join(os.fspath(path), _CONFIG_FILE)) as f:
         return json.load(f).get("__layout__")
 
@@ -407,15 +795,46 @@ def checkpoint_array_shapes(path: str | os.PathLike) -> dict:
 
 
 def restore_checkpoint(
-    path: str | os.PathLike, abstract_state: TrainState | None = None
+    path: str | os.PathLike, abstract_state: TrainState | None = None,
+    *, files_verified: bool = False,
 ) -> TrainState:
     """Load the TrainState saved at `path` (a `step_<n>` directory).
 
     `abstract_state` (e.g. the freshly initialized state, possibly with
     sharded arrays) restores each leaf with matching dtype/sharding; without
     it, arrays land unsharded on the default device.
+
+    Verification is end to end: the on-disk files are checked against
+    the manifest BEFORE orbax touches them, and every restored leaf's
+    content digest is checked against the manifest's per-leaf
+    crc32/sha256 BEFORE the state is materialized for training — a
+    mismatch quarantines the checkpoint and raises
+    :class:`CheckpointVerifyError` instead of silently training on
+    garbage.  Pre-manifest checkpoints restore unverified (legacy).
+
+    ``files_verified=True`` skips the pre-restore file sweep: for
+    callers that just received ``path`` from ``latest_checkpoint`` (the
+    chain ran the identical sha256 pass moments ago) the second sweep
+    would double resume-time read I/O for nothing — gang restart
+    latency rides directly against the peers' stall window.  The
+    post-restore per-leaf content check still runs either way.
     """
     path = os.path.abspath(os.fspath(path))
+    reason = quarantine_reason(path)
+    if reason is not None:
+        raise CheckpointVerifyError(
+            f"checkpoint {path} is quarantined ({reason})"
+        )
+    manifest = checkpoint_manifest(path)
+    if manifest is not None and not files_verified:
+        problems = _verify_manifest_files(path, manifest)
+        if problems:
+            quarantine_checkpoint(path, "; ".join(problems))
+            _bump("ckpt_verify_failures")
+            raise CheckpointVerifyError(
+                f"checkpoint {path} failed file verification: "
+                + "; ".join(problems[:3])
+            )
     t0 = time.perf_counter()
     restore_args: Any = None
     if abstract_state is not None:
@@ -431,6 +850,15 @@ def restore_checkpoint(
             tree = ckptr.restore(os.path.join(path, _STATE_DIR), args=restore_args)
         else:
             tree = ckptr.restore(os.path.join(path, _STATE_DIR))
+    if manifest is not None and manifest.get("leaves"):
+        problems = _verify_restored_leaves(tree, manifest["leaves"])
+        if problems:
+            quarantine_checkpoint(path, "; ".join(problems))
+            _bump("ckpt_verify_failures")
+            raise CheckpointVerifyError(
+                f"checkpoint {path} failed content verification after "
+                "restore: " + "; ".join(problems[:3])
+            )
     # Re-materialize every leaf into an XLA-owned buffer (see
     # fresh_buffers: restored tensorstore/zero-copy-aliased leaves fed
     # to a donating step are a deferred heap corruption — this

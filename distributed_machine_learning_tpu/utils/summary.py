@@ -59,6 +59,13 @@ _EVENT_LABELS = {
     "restarts": "supervisor restarts",
     "preemptions": "preemption stops",
     "ckpt_kills": "injected mid-checkpoint kills",
+    "rank_kills": "injected rank deaths",
+    "rank_stalls": "injected rank stalls",
+    "ckpt_corruptions": "injected checkpoint corruptions",
+    "peer_failures": "gang peers declared dead/stalled",
+    "gang_restarts": "gang coordinated restarts",
+    "ckpt_verify_failures": "checkpoints failing verification",
+    "ckpt_fallbacks": "restores fell back past bad checkpoints",
 }
 
 

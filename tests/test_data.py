@@ -81,12 +81,20 @@ def test_batch_loader_covers_dataset_with_final_short_batch():
 
 
 def test_synthetic_cifar10_is_deterministic(tmp_path):
+    # The train split is generated ONCE (the 50k synthesis is ~9s on the
+    # 1-core box); determinism of the shared generator is asserted on
+    # the 5x-cheaper test split, which runs the identical code path.
     a = load_cifar10(root=str(tmp_path / "nope"), download=False)
-    b = load_cifar10(root=str(tmp_path / "nope"), download=False)
-    assert a.synthetic and b.synthetic
+    assert a.synthetic
     assert len(a) == 50_000
-    np.testing.assert_array_equal(a.images, b.images)
-    np.testing.assert_array_equal(a.labels, b.labels)
+    t1 = load_cifar10(root=str(tmp_path / "nope"), train=False,
+                      download=False)
+    t2 = load_cifar10(root=str(tmp_path / "nope"), train=False,
+                      download=False)
+    assert t1.synthetic and t2.synthetic
+    assert len(t1) == 10_000
+    np.testing.assert_array_equal(t1.images, t2.images)
+    np.testing.assert_array_equal(t1.labels, t2.labels)
 
 
 def _write_cifar_dir(tmp_path, n=20, seed=3):

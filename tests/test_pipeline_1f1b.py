@@ -41,7 +41,13 @@ def _batch(batch=8, seq=12):
     return jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
 
 
-@pytest.mark.parametrize("m", [2, 8])
+@pytest.mark.parametrize(
+    "m",
+    # m=2 is the default-run keystone; m=8 is the deep variant (own
+    # ~8s XLA compile) and joins the existing many-microbatches slow
+    # case under -m "".
+    [2, pytest.param(8, marks=pytest.mark.slow)],
+)
 def test_1f1b_matches_gpipe(m):
     """M < P and M == P: identical loss and updates, multiple steps."""
     model = _model()

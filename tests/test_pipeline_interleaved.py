@@ -62,8 +62,18 @@ def test_stack_roundtrip():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-@pytest.mark.parametrize("m,v", [(4, 2), (8, 2), (6, 2)],
-                         ids=["m=p", "m=2p", "m-ragged"])
+@pytest.mark.parametrize(
+    "m,v",
+    [
+        (4, 2),
+        # Deep variants (each is its own serial XLA compile on the
+        # 1-core box, ~10-14s apiece): the m=p keystone stays in the
+        # default run, the multiple-of-P and ragged cases ride -m "".
+        pytest.param(8, 2, marks=pytest.mark.slow),
+        pytest.param(6, 2, marks=pytest.mark.slow),
+    ],
+    ids=["m=p", "m=2p", "m-ragged"],
+)
 def test_interleaved_matches_gpipe(m, v):
     """Same loss and updates as GPipe for M==P, M a multiple of P, and a
     ragged M (masked partial group)."""

@@ -71,9 +71,15 @@ def test_registry_covers_both_families():
         get_model("alexnet")
 
 
+@pytest.mark.slow
 def test_resnet18_distributed_train_step(mesh8):
     """ResNet-18 through the full part3 path on the 8-device mesh: ring
-    all-reduce, axis-synced BN, SGD — the BASELINE.json headline config."""
+    all-reduce, axis-synced BN, SGD — the BASELINE.json headline config.
+
+    Slow-marked as a full-size-model duplicate (pytest.ini policy): the
+    ResNet-18 model itself and the distributed part3 step are each
+    covered by cheaper default-run tests; this 15s compile composes
+    them at full size."""
     from distributed_machine_learning_tpu.cli.common import init_model_and_state
     from distributed_machine_learning_tpu.parallel.strategies import get_strategy
     from distributed_machine_learning_tpu.train.step import (

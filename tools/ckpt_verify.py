@@ -1,0 +1,171 @@
+#!/usr/bin/env python3
+"""Verify checkpoints against their manifests — stdlib only, no JAX.
+
+Usage::
+
+    python tools/ckpt_verify.py PATH [--quiet]
+
+``PATH`` may be a single ``step_<n>`` checkpoint directory or any
+directory containing them (a run's ``--ckpt-dir``, or a gang's
+per-rank root ``.../ckpt/rank<r>/`` — the scan is recursive).  For each
+checkpoint: completeness (state dir + config), the quarantine marker,
+and every file's sha256 + byte size against ``manifest.json``
+(``train/checkpoint.py`` writes it between the state dir and the config
+file).  Prints per-file status and the per-leaf digest table the
+manifest records (leaf *content* re-verification needs the array
+runtime, so it happens at restore time — ``restore_checkpoint`` — not
+here).  Exits nonzero on any mismatch, quarantined dir, or incomplete
+checkpoint; legacy (pre-manifest) checkpoints report UNVERIFIABLE
+without failing the run.
+
+Deliberately dependency-free (hashlib + json + os): this is the tool an
+operator runs on a storage node at 3am to decide whether a run can be
+resumed, where the training environment may not even be installed.  The
+on-disk format it checks is defined by ``train/checkpoint.py``; the two
+must stay in sync.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+CONFIG_FILE = "sgd_config.json"
+STATE_DIR = "state"
+MANIFEST_FILE = "manifest.json"
+INVALID_MARKER = ".invalid"
+
+
+def sha256_of(path: str) -> tuple[str, int]:
+    h = hashlib.sha256()
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            n += len(chunk)
+            h.update(chunk)
+    return h.hexdigest(), n
+
+
+def find_step_dirs(root: str) -> list[str]:
+    """Every ``step_<n>`` directory under ``root`` (or ``root`` itself),
+    sorted by path then step for stable output."""
+    root = os.path.abspath(root)
+    name = os.path.basename(root)
+    if name.startswith("step_") and name[5:].isdigit():
+        return [root]
+    found = []
+    for dirpath, dirnames, _ in os.walk(root):
+        for d in sorted(dirnames):
+            if d.startswith("step_") and d[5:].isdigit():
+                found.append(os.path.join(dirpath, d))
+        # don't descend into checkpoints themselves
+        dirnames[:] = [d for d in dirnames
+                       if not (d.startswith("step_") and d[5:].isdigit())]
+    return sorted(found, key=lambda p: (os.path.dirname(p),
+                                        int(os.path.basename(p)[5:])))
+
+
+def verify_step_dir(path: str, quiet: bool) -> tuple[bool, str]:
+    """(ok, status line) for one checkpoint; prints detail unless quiet."""
+    rel = path
+
+    def emit(line: str) -> None:
+        if not quiet:
+            print(line)
+
+    marker = os.path.join(path, INVALID_MARKER)
+    if os.path.exists(marker):
+        try:
+            with open(marker) as f:
+                reason = json.load(f).get("reason", "unknown")
+        except (OSError, json.JSONDecodeError):
+            reason = "unreadable marker"
+        return False, f"QUARANTINED {rel}  ({reason})"
+    complete = (os.path.isdir(os.path.join(path, STATE_DIR))
+                and os.path.isfile(os.path.join(path, CONFIG_FILE)))
+    if not complete:
+        return False, f"INCOMPLETE  {rel}  (state dir or config missing)"
+    manifest_path = os.path.join(path, MANIFEST_FILE)
+    if not os.path.isfile(manifest_path):
+        return True, f"UNVERIFIABLE {rel}  (legacy checkpoint: no manifest)"
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return False, f"BAD-MANIFEST {rel}  ({e})"
+
+    bad = 0
+    files = manifest.get("files", {})
+    for relf, entry in sorted(files.items()):
+        fp = os.path.join(path, relf)
+        if not os.path.isfile(fp):
+            emit(f"  MISSING  {relf}")
+            bad += 1
+            continue
+        size = os.path.getsize(fp)
+        if size != entry.get("bytes"):
+            emit(f"  SIZE     {relf}  {size} != {entry.get('bytes')}")
+            bad += 1
+            continue
+        sha, _ = sha256_of(fp)
+        if sha != entry.get("sha256"):
+            emit(f"  CORRUPT  {relf}  (sha256 mismatch)")
+            bad += 1
+    leaves = manifest.get("leaves", {})
+    if leaves and not quiet:
+        emit(f"  {len(files)} file(s) checked; recorded leaves:")
+        width = max((len(n) for n in leaves), default=0)
+        for name, entry in sorted(leaves.items()):
+            if "sha256" not in entry:
+                emit(f"    {name:<{width}}  "
+                     f"UNVERIFIED ({entry.get('unverified', '?')})")
+                continue
+            shape = "x".join(str(d) for d in entry.get("shape", [])) or "()"
+            status = "ok" if bad == 0 else "suspect"
+            emit(f"    {name:<{width}}  {shape:>12}  "
+                 f"{entry.get('dtype', '?'):>9}  "
+                 f"{entry.get('bytes', 0):>10,}B  "
+                 f"crc32={entry.get('crc32', 0):>10}  "
+                 f"sha256={entry['sha256'][:12]}  [{status}]")
+    if bad:
+        return False, f"CORRUPT     {rel}  ({bad} bad file(s))"
+    return True, (f"OK          {rel}  ({len(files)} files, "
+                  f"{len(leaves)} leaves verified against manifest)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="verify checkpoint manifests (stdlib only)"
+    )
+    ap.add_argument("path", help="a step_<n> dir, or a directory "
+                                 "containing them (scanned recursively)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="one status line per checkpoint, no detail")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.path):
+        print(f"ckpt_verify: no such path: {args.path}", file=sys.stderr)
+        return 2
+    dirs = find_step_dirs(args.path)
+    if not dirs:
+        print(f"ckpt_verify: no step_<n> checkpoints under {args.path}",
+              file=sys.stderr)
+        return 2
+    failures = 0
+    for d in dirs:
+        ok, status = verify_step_dir(d, args.quiet)
+        print(status)
+        if not ok:
+            failures += 1
+    print(f"{len(dirs)} checkpoint(s), {failures} invalid")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
