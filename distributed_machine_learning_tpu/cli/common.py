@@ -484,6 +484,8 @@ def run_part(
             ladder."""
             state = fresh_state
             from distributed_machine_learning_tpu.train.checkpoint import (
+                NoRestorableCheckpointError,
+                checkpoint_chain_report,
                 checkpoint_config,
                 latest_checkpoint,
                 restore_checkpoint,
@@ -493,6 +495,24 @@ def run_part(
                 raise ValueError("--resume requires --ckpt-dir")
             latest = latest_checkpoint(args.ckpt_dir, events=events)
             if latest is None:
+                report = checkpoint_chain_report(args.ckpt_dir)
+                if any(v.startswith("quarantined") for _, v in report):
+                    # Real checkpoints existed and every one was
+                    # CONDEMNED (quarantined — bad digests, or a gang
+                    # election verdict): silently training from scratch
+                    # over a dir full of condemned checkpoints is how
+                    # runs lose weeks — fail loudly with the
+                    # per-candidate verdicts.  Incomplete-only leftovers
+                    # (a crash during the first save) still start from
+                    # scratch silently: that IS the resume guarantee.
+                    lines = "\n".join(f"  {p}: {v}" for p, v in report)
+                    raise NoRestorableCheckpointError(
+                        f"--resume: no restorable checkpoint under "
+                        f"{args.ckpt_dir} — every candidate in the "
+                        f"fallback chain is unusable:\n{lines}\n"
+                        "(remove --resume, or point --ckpt-dir at a "
+                        "clean directory, to start from scratch)"
+                    )
                 rank0_print(f"No checkpoint under {args.ckpt_dir}; "
                             "starting from scratch.")
             else:

@@ -13,6 +13,13 @@ into its own ``<ckpt-dir>/rank<r>`` — the per-host shard layout),
 supervises them with ``runtime/supervisor.py::gang_supervise``, and
 prints the resilience summary.  Worker logs land under
 ``<gang-dir>/logs/``.
+
+Elastic by default: a rank that is gone for good (``lose_rank@r:k``
+fired, or ``--rank-restart-budget`` spent) shrinks the gang to the
+survivors instead of stranding the job — down to ``--min-world``
+workers (default 1; 0 disables shrinking), with the per-host batch
+rescaled so the ``--global-batch`` (and the LR schedule) is preserved
+and every example still consumed exactly once per step.
 """
 
 from __future__ import annotations
@@ -60,12 +67,31 @@ def main(argv=None) -> int:
     ap.add_argument("--gang-dir", required=True,
                     help="shared coordination directory (heartbeats, "
                          "abort latch, restore-point records)")
+    ap.add_argument("--global-batch", dest="global_batch", type=int,
+                    default=24,
+                    help="examples per global step batch; each rank "
+                         "consumes its exact shard, so a shrink "
+                         "rescales the per-host batch while the global "
+                         "batch (and LR schedule) is preserved")
     ap.add_argument("--faults", default=None,
                     help="fault spec forwarded to every worker, e.g. "
-                         "'kill_rank@1:7' (runtime/faults.py)")
+                         "'kill_rank@1:7' or 'lose_rank@1:7' "
+                         "(runtime/faults.py)")
     ap.add_argument("--max-restarts", dest="max_restarts", type=int,
                     default=3,
                     help="coordinated gang relaunches before giving up")
+    ap.add_argument("--min-world", dest="min_world", type=int, default=1,
+                    help="smallest gang the supervisor may shrink to "
+                         "when a rank is unrecoverable (lose_rank fired "
+                         "or per-rank budget spent); 0 disables "
+                         "shrinking — an unrecoverable rank then fails "
+                         "the job")
+    ap.add_argument("--rank-restart-budget", dest="rank_restart_budget",
+                    type=int, default=None,
+                    help="failures attributable to one rank before it "
+                         "is declared unrecoverable (default: "
+                         "unlimited; lose_rank marks a rank "
+                         "unrecoverable regardless)")
     ap.add_argument("--heartbeat-interval", dest="heartbeat_interval",
                     type=float, default=0.25,
                     help="seconds between heartbeat-file writes")
@@ -82,6 +108,11 @@ def main(argv=None) -> int:
         ap.error(f"--workers must be >= 1, got {args.workers}")
     if args.peer_timeout <= 2 * args.heartbeat_interval:
         ap.error("--peer-timeout must exceed two heartbeat intervals")
+    if not 0 <= args.min_world <= args.workers:
+        ap.error(f"--min-world must be in [0, {args.workers}], got "
+                 f"{args.min_world}")
+    if args.global_batch < 1:
+        ap.error(f"--global-batch must be >= 1, got {args.global_batch}")
 
     from distributed_machine_learning_tpu.runtime.faults import (
         FaultEvents,
@@ -120,15 +151,22 @@ def main(argv=None) -> int:
         telemetry = Telemetry(args.telemetry_dir)
         set_telemetry(telemetry)
 
-    def worker_cmd(rank: int, attempt: int) -> list[str]:
-        del attempt  # the beat-directory protocol needs no fresh ports
+    def worker_cmd(rank: int, attempt: int, world: int,
+                   orig_rank: int) -> list[str]:
+        # Elastic signature: the supervisor passes the CURRENT world
+        # size (a shrink reduces it) and the rank's original identity
+        # (its checkpoint dir and consumption ledger follow it across
+        # renumberings).  No fresh ports needed: the beat-directory
+        # protocol is portless.
         cmd = [
             sys.executable, "-m",
             "distributed_machine_learning_tpu.runtime.gang_worker",
-            "--rank", str(rank), "--world", str(args.workers),
+            "--rank", str(rank), "--world", str(world),
+            "--orig-rank", str(orig_rank), "--attempt", str(attempt),
             "--gang-dir", args.gang_dir, "--ckpt-dir", args.ckpt_dir,
             "--steps", str(args.steps),
             "--save-every", str(args.save_every),
+            "--global-batch", str(args.global_batch),
             "--heartbeat-interval", str(args.heartbeat_interval),
             "--peer-timeout", str(args.peer_timeout),
         ]
@@ -136,7 +174,7 @@ def main(argv=None) -> int:
             cmd += ["--faults", args.faults]
         if args.telemetry_dir:
             cmd += ["--telemetry-dir",
-                    os.path.join(args.telemetry_dir, f"rank{rank}")]
+                    os.path.join(args.telemetry_dir, f"rank{orig_rank}")]
         return cmd
 
     events = FaultEvents()
@@ -149,11 +187,13 @@ def main(argv=None) -> int:
         _pkg.__file__
     )))
     try:
-        gang_supervise(
+        final_codes = gang_supervise(
             worker_cmd, args.workers, args.gang_dir,
             ckpt_dirs=[os.path.join(args.ckpt_dir, f"rank{r}")
                        for r in range(args.workers)],
             max_restarts=args.max_restarts,
+            rank_restart_budget=args.rank_restart_budget,
+            min_world=args.min_world if args.min_world > 0 else None,
             events=events, env=scrubbed_worker_env(pkg_root),
             log_dir=os.path.join(args.gang_dir, "logs"),
         )
@@ -164,9 +204,11 @@ def main(argv=None) -> int:
     finally:
         if telemetry is not None:
             telemetry.close()
+    final_world = len(final_codes)
     print(resilience_summary(events), flush=True)
-    print(f"gang of {args.workers} finished {args.steps} steps "
-          f"({events.gang_restarts} coordinated restart(s))", flush=True)
+    print(f"gang of {args.workers} finished {args.steps} steps at "
+          f"world size {final_world} ({events.gang_restarts} coordinated "
+          f"restart(s), {events.gang_shrinks} shrink(s))", flush=True)
     return 0
 
 

@@ -42,3 +42,38 @@ def shard_indices(
     if total > num_samples:
         indices = np.concatenate([indices, indices[: total - num_samples]])
     return indices[rank::num_replicas]
+
+
+def exact_shard_indices(
+    num_samples: int,
+    rank: int,
+    num_replicas: int,
+    shuffle: bool = False,
+    seed: int = 69143,
+    epoch: int = 0,
+) -> np.ndarray:
+    """Indices this rank consumes under an EXACT partition: no wrap
+    padding, so across all ranks every index appears exactly once
+    (per-rank counts differ by at most one when ``num_replicas`` does
+    not divide ``num_samples``).
+
+    The elastic-rebalance primitive: when a gang shrinks from N to M
+    survivors, re-evaluating this with ``num_replicas=M`` redistributes
+    the epoch so every example is still visited exactly once —
+    :func:`shard_indices`'s DistributedSampler padding would instead
+    visit the wrapped head twice, which is fine for parity with torch
+    but breaks the exactly-once accounting an elastic epoch must keep.
+    Shuffle semantics match :func:`shard_indices` (generator seeded
+    ``seed + epoch``), so the GLOBAL epoch order is identical for every
+    world size — only the assignment of indices to ranks changes.
+    """
+    if not 0 <= rank < num_replicas:
+        raise ValueError(
+            f"rank {rank} out of range for {num_replicas} replicas"
+        )
+    if shuffle:
+        rng = np.random.default_rng(seed + epoch)
+        indices = rng.permutation(num_samples)
+    else:
+        indices = np.arange(num_samples)
+    return indices[rank::num_replicas]

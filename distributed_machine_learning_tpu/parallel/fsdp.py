@@ -49,6 +49,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_machine_learning_tpu.data.augment import augment_batch, normalize
 from distributed_machine_learning_tpu.runtime.mesh import (
     BATCH_AXIS,
+    padded_len,
     shard_map_no_check as _shard_map,
 )
 from distributed_machine_learning_tpu.train.common import make_loss_fn, step_rng
@@ -80,7 +81,9 @@ class FSDPState:
 
 
 def _padded_len(n_elems: int, n_dev: int) -> int:
-    return -(-n_elems // n_dev) * n_dev
+    # Canonical definition lives in runtime/mesh.py so the checkpoint
+    # resharder recomputes the same partition boundaries.
+    return padded_len(n_elems, n_dev)
 
 
 def flat_mean_grad_shard(

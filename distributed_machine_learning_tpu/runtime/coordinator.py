@@ -62,6 +62,12 @@ ABORT_FILE = "abort.json"
 _BEAT_PREFIX = "beat_rank"
 _RESTORE_PREFIX = "restore_rank"
 
+# Per-rank consumed-example ledgers written by runtime/gang_worker.py
+# (the elastic exactly-once audit trail).  Cleared with the fault
+# ledger at fresh-run init — but NOT across restarts or shrinks, where
+# they are the whole-run history a post-mortem reads.
+CONSUMED_PREFIX = "consumed_rank"
+
 
 def _beat_path(gang_dir: str, rank: int) -> str:
     return os.path.join(gang_dir, f"{_BEAT_PREFIX}{rank}.json")
@@ -108,25 +114,35 @@ def declare_abort(gang_dir: str | os.PathLike, reason: str,
 
 
 def clear_gang_state(gang_dir: str | os.PathLike,
-                     restore_records: bool = False) -> None:
+                     restore_records: bool = False,
+                     fault_ledger: bool | None = None) -> None:
     """Remove the previous attempt's beats and abort latch (and, for a
     fresh run, the restore-point records and the fired-fault ledger).
     Restore records and the ledger survive between restart attempts by
     design: the records ARE the election input, and the ledger is what
-    keeps an already-fired fault from re-firing in the relaunch."""
+    keeps an already-fired fault from re-firing in the relaunch.
+
+    ``fault_ledger`` decouples the ledger from the records (default:
+    follows ``restore_records``): a gang SHRINK renumbers ranks, so the
+    old numbering's restore records must go — but the ledger must stay,
+    or every already-fired fault would re-fire on whichever survivor
+    inherited the fired rank's number."""
     from distributed_machine_learning_tpu.runtime.faults import (
         FAULT_LEDGER_FILE,
     )
 
+    if fault_ledger is None:
+        fault_ledger = restore_records
     gang_dir = os.fspath(gang_dir)
     if not os.path.isdir(gang_dir):
         os.makedirs(gang_dir, exist_ok=True)
         return
     for name in os.listdir(gang_dir):
         if (name == ABORT_FILE or name.startswith(_BEAT_PREFIX)
-                or (restore_records
-                    and (name.startswith(_RESTORE_PREFIX)
-                         or name == FAULT_LEDGER_FILE))):
+                or (restore_records and name.startswith(_RESTORE_PREFIX))
+                or (fault_ledger
+                    and (name == FAULT_LEDGER_FILE
+                         or name.startswith(CONSUMED_PREFIX)))):
             with contextlib.suppress(OSError):
                 os.remove(os.path.join(gang_dir, name))
 
@@ -152,7 +168,7 @@ def _as_dirs(ckpt_dirs) -> list[str]:
 
 
 def elect_restore_step(gang_dir: str | os.PathLike, world: int,
-                       ckpt_dirs=None) -> int | None:
+                       ckpt_dirs=None, ranks=None) -> int | None:
     """The highest checkpoint step EVERY rank has verified (the
     intersection of all restore-point records), or None when no common
     step exists — the gang then starts from scratch / whatever the
@@ -163,10 +179,16 @@ def elect_restore_step(gang_dir: str | os.PathLike, world: int,
     additionally filtered through the on-disk validity check
     (``validate_checkpoint``) in EVERY directory, so an
     agreed-but-since-corrupted checkpoint is never elected.
+
+    ``ranks``: the ranks whose agreement matters (default: all of
+    ``range(world)``).  The shrink-to-survivors path elects among the
+    SURVIVORS only — a permanently lost rank can never verify anything
+    again, and demanding its vote would strand the gang at step None
+    forever.
     """
     gang_dir = os.fspath(gang_dir)
     common: set[int] | None = None
-    for rank in range(world):
+    for rank in (range(world) if ranks is None else ranks):
         steps = read_restore_record(gang_dir, rank)
         if steps is None:
             return None  # a rank with no record can't agree on anything

@@ -37,6 +37,18 @@ worker gang can exhibit, proven by ``runtime/coordinator.py`` +
                        the dead-peer case that leaves the other ranks
                        blocked in a collective until the gang
                        heartbeat detector aborts them.
+- ``lose_rank@R:K``    like ``kill_rank`` (exit :data:`LOSE_RANK_EXIT`)
+                       but PERMANENT: the ledger entry it writes marks
+                       rank R's restart budget exhausted, so the gang
+                       supervisor must shrink to the survivors
+                       (``gang_supervise(min_world=...)``) instead of
+                       relaunching the rank — the dead-host case, not
+                       the crashed-process case.
+
+Rank targeting uses the ORIGINAL (launch-time) numbering: the gang
+worker keys its injector on ``--orig-rank``, so a spec keeps aiming at
+the same host after a shrink renumbers the survivors, and ledger
+entries carry stable ids the supervisor reads without mapping.
 - ``stall_rank@R:K:S`` rank R sleeps S seconds before batch K while
                        the others wait in the collective — the
                        stalled-peer (not dead, just stuck) case.
@@ -76,6 +88,11 @@ FAULTS_ENV = "DML_FAULTS"
 # tell the victim from the ranks that detected it.
 KILL_RANK_EXIT = 21
 
+# Exit code of an injected PERMANENT rank loss (lose_rank): the rank is
+# gone for good — its ledger entry marks the restart budget exhausted,
+# and an elastic supervisor shrinks the gang instead of relaunching it.
+LOSE_RANK_EXIT = 23
+
 # Cross-process fired-fault ledger (one JSON line per firing), kept in
 # the gang directory: a gang relaunch re-execs every worker, and without
 # the ledger each fresh process would re-parse the spec and re-fire the
@@ -91,6 +108,7 @@ _KIND_ALIASES = {
     "kill_ckpt": "kill_ckpt",
     "kill": "kill_ckpt",
     "kill_rank": "kill_rank",
+    "lose_rank": "lose_rank",
     "stall_rank": "stall_rank",
     "corrupt_ckpt": "corrupt_ckpt",
 }
@@ -132,10 +150,13 @@ class FaultEvents:
     preemptions: int = 0        # SIGTERM turned into a clean checkpointed stop
     ckpt_kills: int = 0         # injected death mid-checkpoint-save
     rank_kills: int = 0         # injected hard rank death (kill_rank)
+    rank_losses: int = 0        # injected PERMANENT rank loss (lose_rank)
     rank_stalls: int = 0        # injected rank stall (stall_rank)
     ckpt_corruptions: int = 0   # injected post-save byte flips (corrupt_ckpt)
     peer_failures: int = 0      # gang detector declared a dead/stalled peer
     gang_restarts: int = 0      # gang supervisor relaunched all workers
+    gang_shrinks: int = 0       # gang continued at a smaller world size
+    reshard_restores: int = 0   # checkpoint restored onto a different world
     ckpt_verify_failures: int = 0  # checkpoint failed manifest verification
     ckpt_fallbacks: int = 0     # restore fell back past an invalid checkpoint
 
@@ -299,10 +320,10 @@ class FaultInjector:
                     f"{sorted(set(_KIND_ALIASES))}"
                 )
             kind = _KIND_ALIASES[kind]
-            if kind in ("kill_rank", "stall_rank"):
+            if kind in ("kill_rank", "lose_rank", "stall_rank"):
                 # Rank-targeted grammar: kind@RANK:STEP[:ARG].
                 parts = [p.strip() for p in rest.split(":")]
-                want = 2 if kind == "kill_rank" else 3
+                want = 3 if kind == "stall_rank" else 2
                 if len(parts) != want:
                     raise ValueError(
                         f"bad {kind} entry {entry!r}: expected "
@@ -365,24 +386,34 @@ class FaultInjector:
             for f in self._faults:
                 if f.fired or f.at != idx:
                     continue
-                if f.kind in ("kill_rank", "stall_rank"):
+                if f.kind in ("kill_rank", "lose_rank", "stall_rank"):
                     # Every rank latches the fault at its index; only the
                     # targeted rank acts — so a gang sharing one spec
                     # fires it exactly once, on the right process.
                     if self._process_rank() != f.rank:
                         self._mark_fired(f, acted=False)
                         continue
-                    if f.kind == "kill_rank":
+                    if f.kind in ("kill_rank", "lose_rank"):
+                        code = (KILL_RANK_EXIT if f.kind == "kill_rank"
+                                else LOSE_RANK_EXIT)
                         if events is not None:
-                            events.rank_kills += 1
+                            if f.kind == "kill_rank":
+                                events.rank_kills += 1
+                            else:
+                                events.rank_losses += 1
+                        # The ledger entry doubles as the rank's
+                        # budget-exhausted marker for lose_rank: the
+                        # supervisor reads it (ledger_lost_ranks) and
+                        # shrinks instead of relaunching this rank.
                         self._mark_fired(f)
                         print(
                             f"[faults] rank {f.rank} exiting hard "
-                            f"(os._exit({KILL_RANK_EXIT})) before batch "
-                            f"{idx}",
+                            f"(os._exit({code}), "
+                            f"{'permanent loss' if f.kind == 'lose_rank' else 'crash'}"
+                            f") before batch {idx}",
                             flush=True,
                         )
-                        os._exit(KILL_RANK_EXIT)
+                        os._exit(code)
                     stall_s = float(f.arg)
                     if events is not None:
                         events.rank_stalls += 1
@@ -489,6 +520,40 @@ class FaultInjector:
 
 def _default_stall(_) -> float:
     return 2.0
+
+
+def ledger_entries(path: str | os.PathLike) -> list[dict]:
+    """Every parseable firing recorded in a fired-fault ledger (absent
+    file = empty; a torn final line — a kill mid-append — is skipped,
+    matching ``attach_ledger``)."""
+    try:
+        with open(os.fspath(path)) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        try:
+            entry = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(entry, dict):
+            out.append(entry)
+    return out
+
+
+def ledger_lost_ranks(path: str | os.PathLike) -> set[int]:
+    """Ranks whose ``lose_rank`` fault has fired, per the ledger — the
+    marker the gang supervisor reads to declare a rank's restart budget
+    exhausted (the fault IS the dead-host event; relaunching the rank
+    would just re-lose it).  Rank ids are in the ORIGINAL numbering
+    (stable across shrink renumberings — the gang worker keys its
+    injector on ``--orig-rank``), so callers only intersect with the
+    ranks still active."""
+    return {
+        int(e["rank"]) for e in ledger_entries(path)
+        if e.get("kind") == "lose_rank" and isinstance(e.get("rank"), int)
+    }
 
 
 def corrupt_checkpoint_data(path: str | os.PathLike, match: str | None = None,

@@ -1,12 +1,12 @@
 """One rank of a coordinated local gang — the end-to-end chaos harness.
 
 Run as a subprocess by ``gang_supervise`` (``cli/gang.py`` launches it;
-``tests/test_gang.py`` asserts on it): each of N OS processes trains
-lock-step SGD steps with real verified checkpoints
-(``train/checkpoint.py``) in a PER-RANK checkpoint directory
-(``<ckpt-root>/rank<r>`` — the per-host-shards layout of a pod run,
-which is what makes the restore-point election load-bearing: validity
-is each rank's own view), and wires the gang coordinator
+``tests/test_gang.py`` / ``tests/test_elastic.py`` assert on it): each
+of N OS processes trains lock-step SGD steps with real verified
+checkpoints (``train/checkpoint.py``) in a PER-RANK checkpoint
+directory (``<ckpt-root>/rank<orig>`` — the per-host-shards layout of a
+pod run, which is what makes the restore-point election load-bearing:
+validity is each rank's own view), and wires the gang coordinator
 (``runtime/coordinator.py``) around the loop: heartbeats per step,
 suspensions around compile/saves, a restore-point record after every
 verified save.
@@ -21,45 +21,69 @@ the peer-failure detector's coordinated abort frees them.  On real TPU
 pods the blocking collective is the psum itself and the identical
 coordinator sits around it (``cli/common.py``'s ``--gang-dir`` path).
 
-The chaos contract this worker proves (ISSUE 3's acceptance bar): with
-``--faults kill_rank@1:7`` on a 4-worker gang, rank 1 dies hard at step
-7, the survivors block at the next barrier, their peer detectors abort
-the gang, ``gang_supervise`` relaunches everyone from the elected
-restore point, and the final parameters are **bit-identical** to a
-fault-free run on every rank — the per-step batch is keyed on the
-absolute step index, so a resumed gang replays exactly the stream the
-dead gang would have seen.
+Elastic semantics (ISSUE 5): the worker is WORLD-SIZE-AWARE.  Each
+step's GLOBAL batch is a fixed ``--global-batch`` examples keyed on the
+absolute step index alone, and a rank consumes only its shard of it —
+``data/sharding.py::exact_shard_indices(B, rank, world)`` — logging the
+consumed example ids to ``consumed_rank<orig>.jsonl`` in the gang dir.
+When the supervisor shrinks the gang from N to M survivors, relaunched
+workers re-evaluate their shards at world M: the per-host batch grows
+from B/N to B/M (the global batch — and therefore the effective LR
+schedule — is preserved), and every example is still consumed exactly
+once per step.  The gradient each rank applies is the mean over the
+global batch in canonical order — the value the psum over ANY
+world-size partition of it produces — so params stay bit-identical
+across ranks, across restarts, and across world sizes (the loss-curve
+continuity the chaos test asserts).  Checkpoints are saved with a dp
+``ShardSpec`` recording the world size and restored through
+``reshard_restore``, which tolerates (and counts) a world-size change.
 """
 
 from __future__ import annotations
 
 import argparse
 import hashlib
+import json
 import os
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def _data_for_step(step: int) -> "object":
-    """The batch for an absolute step index — deterministic in ``step``
-    alone, so every rank (and every restart attempt) agrees on it."""
+def _global_batch_for_step(step: int, batch: int) -> "object":
+    """The global batch for an absolute step index — deterministic in
+    ``step`` alone, so every rank, every restart attempt, and every
+    world size agrees on it.  Row ``j`` is global example id
+    ``step * batch + j``."""
     import numpy as np
 
     rng = np.random.default_rng(10_000 + step)
-    return rng.standard_normal((4, 8)).astype(np.float32)
+    return rng.standard_normal((batch, 8)).astype(np.float32)
 
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rank", type=int, required=True)
     ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--orig-rank", type=int, default=None,
+                    help="rank identity in the ORIGINAL (pre-shrink) "
+                         "numbering; owns the checkpoint dir and the "
+                         "consumed-example ledger (default: --rank)")
+    ap.add_argument("--attempt", type=int, default=0,
+                    help="supervisor attempt number (tags consumption "
+                         "records so post-mortems can tell replays apart)")
     ap.add_argument("--gang-dir", required=True)
     ap.add_argument("--ckpt-dir", required=True,
                     help="checkpoint ROOT; this rank writes under "
-                         "<ckpt-dir>/rank<r> (per-host shard layout)")
+                         "<ckpt-dir>/rank<orig> (per-host shard layout)")
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--save-every", type=int, default=5)
+    ap.add_argument("--global-batch", type=int, default=24,
+                    help="examples per GLOBAL step batch; each rank "
+                         "consumes its exact shard (B/world), so a "
+                         "shrink rescales the per-host batch while the "
+                         "global batch — and the LR schedule — is "
+                         "preserved")
     ap.add_argument("--faults", default=None)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--heartbeat-interval", type=float, default=0.25)
@@ -67,11 +91,15 @@ def main(argv=None) -> None:
     ap.add_argument("--step-sleep", type=float, default=0.02)
     ap.add_argument("--telemetry-dir", default=None)
     args = ap.parse_args(argv)
+    orig_rank = args.rank if args.orig_rank is None else args.orig_rank
 
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from distributed_machine_learning_tpu.data.sharding import (
+        exact_shard_indices,
+    )
     from distributed_machine_learning_tpu.runtime.coordinator import (
         GangCoordinator,
     )
@@ -79,10 +107,12 @@ def main(argv=None) -> None:
         FaultEvents,
         FaultInjector,
     )
+    from distributed_machine_learning_tpu.runtime.mesh import ShardSpec
     from distributed_machine_learning_tpu.train.checkpoint import (
+        checkpoint_chain_report,
         checkpoint_cursor,
         latest_checkpoint,
-        restore_checkpoint,
+        reshard_restore,
         save_checkpoint,
     )
     from distributed_machine_learning_tpu.train.state import TrainState
@@ -100,11 +130,15 @@ def main(argv=None) -> None:
         telemetry = Telemetry(args.telemetry_dir)
         set_telemetry(telemetry)
 
-    ckpt_dir = os.path.join(args.ckpt_dir, f"rank{args.rank}")
+    ckpt_dir = os.path.join(args.ckpt_dir, f"rank{orig_rank}")
     events = FaultEvents()
+    # Fault targeting is keyed on the ORIGINAL rank identity: a spec
+    # written against the launch-time numbering must keep aiming at the
+    # same host after a shrink renumbers the survivors — and the ledger
+    # then records stable ids the supervisor can read without mapping.
     injector = FaultInjector.from_flags(
         args.faults, seed=args.seed, horizon=max(args.steps, 2),
-        rank=args.rank,
+        rank=orig_rank,
     )
     if injector is not None:
         from distributed_machine_learning_tpu.runtime.faults import (
@@ -124,6 +158,32 @@ def main(argv=None) -> None:
         peer_timeout_s=args.peer_timeout, events=events,
     ).start()
 
+    # This rank's share of every step's global batch under the CURRENT
+    # world size — the shard a shrink rebalances.  exact partition: the
+    # union over ranks is every example exactly once, padding-free.
+    from distributed_machine_learning_tpu.runtime.coordinator import (
+        CONSUMED_PREFIX,
+    )
+
+    local_ids = exact_shard_indices(args.global_batch, args.rank,
+                                    args.world)
+    consumed_path = os.path.join(
+        args.gang_dir, f"{CONSUMED_PREFIX}{orig_rank}.jsonl"
+    )
+
+    def record_consumed(step: int) -> None:
+        """One line per completed step: which global example ids THIS
+        rank consumed, under which (attempt, world) — the exactly-once
+        audit trail the elastic chaos test checks."""
+        with open(consumed_path, "a") as f:
+            f.write(json.dumps({
+                "attempt": args.attempt, "world": args.world,
+                "rank": args.rank, "orig_rank": orig_rank, "step": step,
+                "ids": [int(step) * args.global_batch + int(j)
+                        for j in local_ids],
+            }) + "\n")
+            f.flush()
+
     with coord.suspend():
         state = TrainState.create(
             params={"w": jnp.zeros((8,), jnp.float32)}
@@ -131,8 +191,13 @@ def main(argv=None) -> None:
         start = 0
         latest = latest_checkpoint(ckpt_dir, events=events)
         if latest is not None:
-            state = restore_checkpoint(latest, abstract_state=state,
-                                       files_verified=True)
+            # reshard_restore tolerates a checkpoint saved under a
+            # DIFFERENT world size (the shrink case) — dp params carry
+            # no padding, so this is a verified plain restore plus a
+            # reshard_restores count when the worlds differ.
+            state, _spec = reshard_restore(latest, world=args.world,
+                                           events=events,
+                                           files_verified=True)
             restored_step = int(jax.device_get(state.step))
             cursor = checkpoint_cursor(latest)
             start = cursor if cursor is not None else restored_step
@@ -141,27 +206,42 @@ def main(argv=None) -> None:
             # further save ever lands.
             coord.record_valid_step(restored_step)
             print(f"resumed {latest} step {restored_step}", flush=True)
+        else:
+            report = checkpoint_chain_report(ckpt_dir)
+            if report:
+                # Candidates exist but none is restorable: say WHY per
+                # candidate (the satellite fix for the bare "no
+                # checkpoint found") before training from scratch —
+                # the supervisor log is the post-mortem surface.
+                print(f"no restorable checkpoint under {ckpt_dir}:",
+                      flush=True)
+                for p, verdict in report:
+                    print(f"  {p}: {verdict}", flush=True)
 
         @jax.jit
         def step_fn(state, xs):
-            # Every rank computes the same mean-gradient update from the
-            # same step-keyed batch — the value a psum over the gang
-            # would produce, so replicated params stay bit-identical
-            # across ranks (asserted by digest below).
+            # The mean gradient over the GLOBAL batch in canonical
+            # order — the value a psum over the per-rank shards would
+            # produce under ANY world size, so replicated params stay
+            # bit-identical across ranks, restarts, and shrinks
+            # (asserted by digest below).
             g = xs.mean(0)
             w = state.params["w"] - 0.1 * (g + 0.01 * state.params["w"])
             return state.replace(params={"w": w}, step=state.step + 1)
 
         # AOT-compile inside the suspension: the first step's compile
         # must not read as a stall under short chaos-test timeouts.
-        compiled = step_fn.lower(state, _data_for_step(start)).compile()
+        compiled = step_fn.lower(
+            state, _global_batch_for_step(start, args.global_batch)
+        ).compile()
         # Publish the resumed position BEFORE the first barrier: peers
         # wait for our published step, and a gang resuming at step k
         # would otherwise deadlock at barrier k with everyone still
         # publishing step 0.
         coord.beat(step=start)
 
-    print(f"ready rank={args.rank} start={start}", flush=True)
+    print(f"ready rank={args.rank} orig={orig_rank} world={args.world} "
+          f"start={start}", flush=True)
     post_save = injector.post_save_hook(events) if injector else None
     batches = range(start, args.steps)
     if injector is not None:
@@ -174,8 +254,10 @@ def main(argv=None) -> None:
         # gang, exactly like a hung psum).
         if not coord.wait_for_peers(idx):
             break  # test mode only; production aborts the process
-        state = compiled(state, _data_for_step(idx))
+        state = compiled(state,
+                         _global_batch_for_step(idx, args.global_batch))
         jax.block_until_ready(state.params["w"])
+        record_consumed(idx)
         coord.beat(step=idx + 1)
         if args.rank == 0:
             print(f"step {idx}", flush=True)
@@ -186,6 +268,7 @@ def main(argv=None) -> None:
                 save_checkpoint(
                     ckpt_dir, state, cursor=idx + 1,
                     post_save_hook=post_save,
+                    shard_spec=ShardSpec("dp", world=args.world),
                 )
             coord.record_valid_step(int(jax.device_get(state.step)))
         if args.step_sleep:
@@ -195,6 +278,7 @@ def main(argv=None) -> None:
         np.ascontiguousarray(np.asarray(state.params["w"])).tobytes()
     ).hexdigest()[:16]
     print(f"final_step {int(jax.device_get(state.step))}", flush=True)
+    print(f"final_world {args.world}", flush=True)
     print(f"final {digest}", flush=True)
     if events.total():
         print(resilience_summary(events), flush=True)

@@ -12,6 +12,8 @@ touching callers.
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 import jax
 from jax.sharding import Mesh
@@ -22,6 +24,97 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_impl
 
 BATCH_AXIS = "batch"
+
+# The state layouts a checkpoint can be saved under (and resharded
+# between worlds within): replicated data parallelism, ZeRO-1
+# (params replicated / momentum sharded), and ZeRO-3/FSDP (both
+# sharded).  The flat-shard layouts pad their vectors to a multiple of
+# the world size, which is exactly what a world-size change must redo.
+SHARD_LAYOUTS = ("dp", "zero1", "fsdp")
+
+
+def padded_len(n_elems: int, world: int) -> int:
+    """Length of the flat param/momentum vectors after padding to a
+    multiple of ``world`` — the canonical definition shared by the
+    flat-shard schemes (``parallel/fsdp.py``, ``parallel/zero1.py``)
+    and the checkpoint resharder, so partition boundaries recompute
+    identically everywhere."""
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    return -(-n_elems // world) * world
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """How a training state is laid out across a data-parallel world —
+    the metadata a checkpoint must carry for a restore onto a
+    *different* world size to be possible.
+
+    ``layout``: one of :data:`SHARD_LAYOUTS`.  ``world``: the data-axis
+    size the state was built for.  ``n_elems``: the unpadded length of
+    the flat param/momentum vectors (zero1/fsdp — the *logical* array a
+    reshard preserves bit-for-bit; None for dp, whose leaves carry no
+    padding).
+    """
+
+    layout: str
+    world: int
+    n_elems: int | None = None
+
+    def __post_init__(self):
+        if self.layout not in SHARD_LAYOUTS:
+            raise ValueError(
+                f"unknown layout {self.layout!r}; known: {SHARD_LAYOUTS}"
+            )
+        if self.world < 1:
+            raise ValueError(f"world must be >= 1, got {self.world}")
+        if self.layout != "dp" and self.n_elems is None:
+            raise ValueError(
+                f"layout {self.layout!r} needs n_elems (the unpadded "
+                "flat length) to recompute partition boundaries"
+            )
+
+    @property
+    def padded(self) -> int | None:
+        """The padded flat length under this spec, or None for dp."""
+        return None if self.n_elems is None else padded_len(
+            self.n_elems, self.world
+        )
+
+    def with_world(self, world: int) -> "ShardSpec":
+        """The same layout re-laid-out for a different world size."""
+        return dataclasses.replace(self, world=world)
+
+    def as_dict(self) -> dict:
+        return {"layout": self.layout, "world": self.world,
+                "n_elems": self.n_elems}
+
+    @classmethod
+    def from_dict(cls, payload: dict) -> "ShardSpec":
+        return cls(
+            layout=str(payload["layout"]), world=int(payload["world"]),
+            n_elems=(None if payload.get("n_elems") is None
+                     else int(payload["n_elems"])),
+        )
+
+
+def repad_flat(flat: np.ndarray, n_elems: int, world: int) -> np.ndarray:
+    """Re-lay-out one flat padded vector for a new world size: keep the
+    logical prefix ``flat[:n_elems]`` bit-for-bit, recompute the padded
+    length for ``world``, and zero-fill the new tail.  The whole of a
+    zero1/fsdp reshard is this, applied per flat leaf — padding is the
+    only world-size-dependent part of the layout."""
+    flat = np.asarray(flat)
+    if flat.ndim != 1:
+        raise ValueError(f"expected a flat vector, got shape {flat.shape}")
+    if flat.shape[0] < n_elems:
+        raise ValueError(
+            f"flat vector of {flat.shape[0]} elements cannot hold "
+            f"n_elems={n_elems} logical values"
+        )
+    out = np.zeros((padded_len(n_elems, world),), dtype=flat.dtype)
+    out[:n_elems] = flat[:n_elems]
+    return out
 
 
 def shard_map_no_check(f, *, mesh, in_specs, out_specs, manual_axes=None):

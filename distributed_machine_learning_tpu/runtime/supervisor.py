@@ -178,41 +178,90 @@ def _drain_gang(procs, grace_s: float) -> list[int | None]:
     return [p.poll() for p in procs]
 
 
+def _worker_cmd_arity(worker_cmd) -> int:
+    """How many of ``(rank, attempt, world, orig_rank)`` the caller's
+    ``worker_cmd`` accepts (2-4; ``*args`` takes all four).  Keeps the
+    legacy two-argument closures working while elastic launchers opt in
+    to the world-size/original-rank parameters a shrink needs."""
+    import inspect
+
+    try:
+        params = inspect.signature(worker_cmd).parameters
+    except (TypeError, ValueError):
+        return 2
+    if any(p.kind == p.VAR_POSITIONAL for p in params.values()):
+        return 4
+    # Count only positionally-fillable parameters: keyword-only and
+    # **kwargs must not inflate the arity (a legacy closure with
+    # trailing keyword-only options is still a two-argument worker_cmd).
+    positional = sum(
+        1 for p in params.values()
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+    )
+    return min(max(positional, 2), 4)
+
+
 def gang_supervise(worker_cmd, world: int, gang_dir,
                    *, ckpt_dirs=None, max_restarts: int = 3,
+                   rank_restart_budget: int | None = None,
+                   min_world: int | None = None,
                    events: FaultEvents | None = None,
                    poll_s: float = 0.2, grace_s: float = 10.0,
                    env=None, log_dir=None) -> list[int]:
     """Run a gang of ``world`` worker processes to completion, restarting
     ALL of them together on any failure — the multi-host analogue of
-    :func:`run_attempts`.
+    :func:`run_attempts` — and, when allowed, SHRINKING past ranks that
+    are gone for good.
 
-    ``worker_cmd(rank, attempt)`` returns the argv for one worker (the
-    ``attempt`` parameter lets the caller pick a fresh coordination-
-    service port per relaunch — the dead attempt's port may linger in
-    TIME_WAIT).  Workers coordinate through ``gang_dir`` via
-    ``runtime/coordinator.py``: heartbeat files, the abort latch, and
-    restore-point records.
+    ``worker_cmd(rank, attempt[, world[, orig_rank]])`` returns the argv
+    for one worker (the ``attempt`` parameter lets the caller pick a
+    fresh coordination-service port per relaunch; ``world`` is the
+    CURRENT gang size, which a shrink reduces; ``orig_rank`` is the
+    rank's identity in the original numbering — its checkpoint
+    directory follows it across renumberings).  Two-argument closures
+    keep working; elastic launchers accept all four.  Workers
+    coordinate through ``gang_dir`` via ``runtime/coordinator.py``:
+    heartbeat files, the abort latch, and restore-point records.
 
     The restart protocol, in order:
 
     1. any worker exiting nonzero (a died rank, or survivors taking the
        coordinated abort exit) fails the attempt; the rest are
        terminated so no orphan keeps the next rendezvous port busy;
-    2. the restore-point election (``elect_restore_step``) picks the
-       highest checkpoint step EVERY rank verified — and checkpoints
-       newer than it are quarantined (``enforce_restore_point``) so
-       each relaunched worker's fallback chain resolves to the same
-       restore point.  ``ckpt_dirs``: one shared checkpoint directory
-       or one per rank (per-host shard layouts);
-    3. the whole gang is relaunched (``gang_restarts`` counter, one
-       ``gang_attempt`` span per try), up to ``max_restarts`` times.
+    2. the failure is ATTRIBUTED: ranks that exited on their own with a
+       non-abort code, plus the peer named by the abort latch, each
+       count one failure against their per-rank budget
+       (``rank_restart_budget``; None = unlimited).  A rank whose
+       budget is spent — or whose ``lose_rank`` fault is recorded in
+       the fired-fault ledger (the dead-host marker) — is declared
+       UNRECOVERABLE;
+    3. with no unrecoverable ranks: the restore-point election
+       (``elect_restore_step``) picks the highest checkpoint step EVERY
+       rank verified — checkpoints newer than it are quarantined
+       (``enforce_restore_point``) so each relaunched worker's fallback
+       chain resolves to the same restore point — and the whole gang is
+       relaunched at the same size (``gang_restarts`` counter, one
+       ``gang_attempt`` span per try), up to ``max_restarts`` times;
+    4. with unrecoverable ranks and ``min_world`` set: the gang
+       SHRINKS to the survivors — the election runs over the survivors'
+       records only, newer checkpoints are quarantined in the
+       survivors' directories, the old numbering's restore records are
+       dropped (the ledger is KEPT: renumbered survivors must not
+       re-fire latched faults), and the gang relaunches at world size
+       M < N with survivors renumbered ``0..M-1`` in original-rank
+       order (``gang_shrinks`` counter + ``gang_shrink`` trace
+       instant).  Shrinking below ``min_world`` — or any unrecoverable
+       rank when ``min_world`` is None — raises :class:`GangFailure`.
 
-    Returns the final returncodes (all zero) on success; raises
-    :class:`GangFailure` after the restart budget is spent.
+    ``ckpt_dirs``: one shared checkpoint directory or one per ORIGINAL
+    rank (per-host shard layouts); after a shrink, each survivor keeps
+    its own directory.  Returns the final returncodes (all zero, one
+    per surviving rank) on success; raises :class:`GangFailure` after
+    the restart budget is spent.
 
     ``log_dir``: when given, each worker's stdout+stderr streams to
-    ``rank<r>.attempt<k>.log`` there — the gang post-mortem surface.
+    ``rank<r>.attempt<k>.log`` there (current-numbering rank) — the
+    gang post-mortem surface.
     """
     import subprocess
 
@@ -222,40 +271,70 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         enforce_restore_point,
         read_abort,
     )
+    from distributed_machine_learning_tpu.runtime.coordinator import (
+        GANG_ABORT_EXIT,
+    )
+    from distributed_machine_learning_tpu.runtime.faults import (
+        FAULT_LEDGER_FILE,
+        ledger_lost_ranks,
+    )
     from distributed_machine_learning_tpu.telemetry import get_telemetry
 
     if world < 1:
         raise ValueError(f"world must be >= 1, got {world}")
     if max_restarts < 0:
         raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    if min_world is not None and not 1 <= min_world <= world:
+        raise ValueError(
+            f"min_world must be in [1, {world}], got {min_world}"
+        )
+    if rank_restart_budget is not None and rank_restart_budget < 0:
+        raise ValueError(
+            f"rank_restart_budget must be >= 0, got {rank_restart_budget}"
+        )
+    cmd_arity = _worker_cmd_arity(worker_cmd)
+    if min_world is not None and cmd_arity < 3:
+        raise ValueError(
+            "shrinking (min_world) requires a worker_cmd that accepts "
+            "the current world size — use worker_cmd(rank, attempt, "
+            "world[, orig_rank]); a legacy two-argument closure would "
+            "relaunch workers that still assume the original world"
+        )
     # A fresh supervision run: stale beats/aborts AND restore records
     # from any earlier run in the same gang_dir would poison detection
     # and the election.
     clear_gang_state(gang_dir, restore_records=True)
     if log_dir is not None:
         os.makedirs(log_dir, exist_ok=True)
+    shared_ckpt = ckpt_dirs is None or isinstance(ckpt_dirs,
+                                                  (str, os.PathLike))
+
+    def dirs_for(origs):
+        if ckpt_dirs is None:
+            return None
+        if shared_ckpt:
+            return ckpt_dirs
+        return [ckpt_dirs[o] for o in origs]
+
+    # position = current rank, value = original rank: the identity map
+    # a shrink compacts.  Failure counts and checkpoint directories key
+    # on the ORIGINAL rank, which survives renumbering.
+    active = list(range(world))
+    fail_counts = {r: 0 for r in range(world)}
+    ledger_path = os.path.join(os.fspath(gang_dir), FAULT_LEDGER_FILE)
     restarts = 0
     while True:
-        # Between attempts: clear the dead attempt's beats and abort
-        # latch, but KEEP restore records — they are the election input.
-        clear_gang_state(gang_dir)
-        if restarts > 0 and ckpt_dirs is not None:
-            elected = elect_restore_step(gang_dir, world,
-                                         ckpt_dirs=ckpt_dirs)
-            quarantined = enforce_restore_point(ckpt_dirs, elected)
-            rank0_print(
-                f"[gang] restore-point election: step "
-                f"{elected if elected is not None else '<none>'}"
-                + (f"; quarantined {len(quarantined)} newer "
-                   f"checkpoint(s)" if quarantined else "")
-            )
+        cur_world = len(active)
         tel = get_telemetry()
-        span = (tel.span("gang_attempt", attempt=restarts, world=world)
+        if tel is not None:
+            tel.registry.gauge("gang_world_size").set(cur_world)
+        span = (tel.span("gang_attempt", attempt=restarts,
+                         world=cur_world)
                 if tel is not None else contextlib.nullcontext())
         procs, logs = [], []
         try:
             with span:
-                for rank in range(world):
+                for rank in range(cur_world):
                     out = None
                     if log_dir is not None:
                         out = open(
@@ -266,8 +345,10 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                             "ab",
                         )
                     logs.append(out)
+                    argv = worker_cmd(*(rank, restarts, cur_world,
+                                        active[rank])[:cmd_arity])
                     procs.append(subprocess.Popen(
-                        worker_cmd(rank, restarts),
+                        argv,
                         stdout=out,
                         stderr=subprocess.STDOUT if out is not None
                         else None,
@@ -293,6 +374,25 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         why = (f"rank {failed[0][0]} exited {failed[0][1]}"
                + (f"; abort declared by rank {abort.get('by_rank')}: "
                   f"{abort.get('reason')}" if abort else ""))
+        # -- failure attribution (original-rank identities) -------------
+        # Only self-exits count — ranks the drain terminated, and ranks
+        # that took the coordinated abort exit, are casualties of the
+        # victim, not victims themselves.
+        victims_cur = {r for r, c in failed if c != GANG_ABORT_EXIT}
+        peer = abort.get("peer") if abort else None
+        if isinstance(peer, int) and 0 <= peer < cur_world:
+            victims_cur.add(peer)
+        for r in victims_cur:
+            fail_counts[active[r]] += 1
+        # lose_rank firings mark their rank's budget exhausted outright
+        # (the dead-host event).  The ledger records ORIGINAL-rank ids
+        # (the gang worker keys its injector on --orig-rank), so the
+        # entries stay valid across renumberings — ranks already shrunk
+        # away just filter out of the active set.
+        unrecoverable = ledger_lost_ranks(ledger_path) & set(active)
+        if rank_restart_budget is not None:
+            unrecoverable |= {o for o in active
+                              if fail_counts[o] > rank_restart_budget}
         if restarts >= max_restarts:
             rank0_print(
                 f"[gang] giving up after {restarts} restart(s): {why}"
@@ -307,6 +407,66 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         if tel is not None:
             tel.registry.counter("gang_restarts").inc()
             tel.flush()
+        if unrecoverable:
+            survivors = [o for o in active if o not in unrecoverable]
+            lost_s = sorted(unrecoverable)
+            if min_world is None or len(survivors) < min_world:
+                raise GangFailure(
+                    f"rank(s) {lost_s} unrecoverable (budget exhausted "
+                    f"or lose_rank fired) and the gang cannot shrink "
+                    f"to {len(survivors)} worker(s)"
+                    + ("" if min_world is None
+                       else f" (min_world {min_world})"),
+                    final_codes,
+                )
+            # Elect among the SURVIVORS' records (keyed by the failed
+            # attempt's numbering) before renumbering discards them.
+            surv_cur = [active.index(o) for o in survivors]
+            elected = elect_restore_step(
+                gang_dir, cur_world, ckpt_dirs=dirs_for(survivors),
+                ranks=surv_cur,
+            )
+            quarantined = enforce_restore_point(dirs_for(survivors),
+                                                elected)
+            # Renumbering invalidates rank-keyed restore records; the
+            # fired-fault ledger is KEPT — the survivor inheriting a
+            # fired rank number must stay latched.
+            clear_gang_state(gang_dir, restore_records=True,
+                             fault_ledger=False)
+            if events is not None:
+                events.gang_shrinks += 1
+            if tel is not None:
+                tel.registry.counter("gang_shrinks").inc()
+                tel.registry.gauge("gang_world_size").set(len(survivors))
+                tel.tracer.instant(
+                    "gang_shrink", from_world=cur_world,
+                    to_world=len(survivors), lost=lost_s,
+                )
+                tel.flush()
+            rank0_print(
+                f"[gang] {why}; rank(s) {lost_s} unrecoverable — "
+                f"shrinking to {len(survivors)} survivor(s) "
+                f"(restore point "
+                f"{elected if elected is not None else '<none>'}"
+                + (f", quarantined {len(quarantined)} newer "
+                   f"checkpoint(s)" if quarantined else "")
+                + f"); restart {restarts}/{max_restarts}"
+            )
+            active = survivors
+            continue
+        # Between same-size attempts: clear the dead attempt's beats and
+        # abort latch, but KEEP restore records — the election input.
+        clear_gang_state(gang_dir)
+        if ckpt_dirs is not None:
+            elected = elect_restore_step(gang_dir, cur_world,
+                                         ckpt_dirs=dirs_for(active))
+            quarantined = enforce_restore_point(dirs_for(active), elected)
+            rank0_print(
+                f"[gang] restore-point election: step "
+                f"{elected if elected is not None else '<none>'}"
+                + (f"; quarantined {len(quarantined)} newer "
+                   f"checkpoint(s)" if quarantined else "")
+            )
         rank0_print(
             f"[gang] {why}; coordinated restart {restarts}/{max_restarts}"
         )

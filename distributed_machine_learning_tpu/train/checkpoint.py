@@ -28,6 +28,12 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from distributed_machine_learning_tpu.runtime.mesh import (
+    BATCH_AXIS,
+    ShardSpec,
+    padded_len,
+    repad_flat,
+)
 from distributed_machine_learning_tpu.train.state import TrainState
 
 _CONFIG_FILE = "sgd_config.json"
@@ -119,14 +125,43 @@ def _leaf_readable(leaf) -> bool:
     return False
 
 
-def _leaf_entries(tree) -> dict:
+# Leaf names (prefixes) that hold flat world-padded vectors under the
+# zero1/fsdp layouts — the leaves whose manifest digests must cover the
+# LOGICAL array (the unpadded prefix) so verification survives a
+# reshard onto a different world size.
+_FLAT_LEAF_PREFIXES = ("param_flat", "param_shards", "momentum_shards")
+
+
+def _logical_elems(name: str, leaf, spec: ShardSpec | None) -> int | None:
+    """The unpadded logical length of ``leaf`` under ``spec``, or None
+    for leaves that carry no world-dependent padding (every dp leaf,
+    and the replicated stats/step/rng of the flat-shard layouts)."""
+    if (spec is None or spec.layout == "dp" or spec.n_elems is None
+            or getattr(leaf, "ndim", None) != 1):
+        return None
+    if not any(name == p or name.startswith(p + "/")
+               for p in _FLAT_LEAF_PREFIXES):
+        return None
+    if leaf.shape[0] != padded_len(spec.n_elems, spec.world):
+        return None
+    return spec.n_elems
+
+
+def _leaf_entries(tree, spec: ShardSpec | None = None) -> dict:
     """Per-leaf content digests of an in-memory state pytree: crc32,
     sha256, byte size, dtype, shape.  Computed from the arrays
     themselves (not the files) so verification is end to end — a flip
     anywhere between save and restore is caught at restore time.  Leaves
     not readable from this process (multi-host shards that are neither
     addressable nor replicated) are recorded unverified rather than
-    skipped silently."""
+    skipped silently.
+
+    Under a flat-shard ``spec`` (zero1/fsdp), the digests of the padded
+    flat leaves cover the LOGICAL prefix (``arr[:n_elems]``), recorded
+    with a ``logical_elems`` field — a checkpoint restored onto a
+    different world size re-pads those leaves, and only the logical
+    content is invariant across worlds.  The file-level manifest half
+    still covers the physical bytes as written."""
     entries = {}
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     for keypath, leaf in leaves:
@@ -136,7 +171,9 @@ def _leaf_entries(tree) -> dict:
                                            "manifest-writing process"}
             continue
         arr = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
-        raw = arr.tobytes()
+        logical = _logical_elems(name, arr, spec)
+        digest_arr = arr if logical is None else arr[:logical]
+        raw = digest_arr.tobytes()
         entries[name] = {
             "sha256": hashlib.sha256(raw).hexdigest(),
             "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
@@ -144,11 +181,14 @@ def _leaf_entries(tree) -> dict:
             "dtype": str(arr.dtype),
             "shape": list(arr.shape),
         }
+        if logical is not None:
+            entries[name]["logical_elems"] = logical
     return entries
 
 
 def write_checkpoint_manifest(path: str | os.PathLike, tree=None,
-                              leaf_entries: dict | None = None) -> dict:
+                              leaf_entries: dict | None = None,
+                              shard_spec: ShardSpec | None = None) -> dict:
     """Hash every file under ``path/state`` (and, when ``tree`` or
     precomputed ``leaf_entries`` are given, every array leaf) into
     ``path/manifest.json`` (atomic replace).  Returns the manifest.
@@ -157,6 +197,11 @@ def write_checkpoint_manifest(path: str | os.PathLike, tree=None,
     checkpoint (``_is_complete``) always carries its manifest — and a
     kill before the manifest leaves the checkpoint incomplete, never
     complete-but-unverifiable.
+
+    ``shard_spec``: the layout/world the state was saved under —
+    recorded in the manifest (and mirrored in the config payload) so
+    offline tools and reshard restores know how to recompute partition
+    boundaries, and flat-leaf digests cover the logical arrays.
     """
     path = os.path.abspath(os.fspath(path))
     files = {}
@@ -167,8 +212,11 @@ def write_checkpoint_manifest(path: str | os.PathLike, tree=None,
         "version": 1,
         "files": files,
         "leaves": (leaf_entries if leaf_entries is not None
-                   else _leaf_entries(tree) if tree is not None else {}),
+                   else _leaf_entries(tree, shard_spec)
+                   if tree is not None else {}),
     }
+    if shard_spec is not None:
+        manifest["shard_spec"] = shard_spec.as_dict()
     tmp = os.path.join(path, _MANIFEST_FILE + ".tmp")
     with open(tmp, "w") as f:
         json.dump(manifest, f, indent=1)
@@ -257,6 +305,18 @@ def _verify_restored_leaves(tree, leaf_manifest: dict) -> list[str]:
         arr = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
         if str(arr.dtype) != entry["dtype"]:
             continue  # cast restore: saved bytes are not comparable
+        logical = entry.get("logical_elems")
+        if logical is not None:
+            # Flat-padded leaf: the digest covers the logical prefix,
+            # which is what survives a reshard onto a different world
+            # size (the restored padding may be longer or shorter).
+            if arr.ndim != 1 or arr.shape[0] < logical:
+                problems.append(
+                    f"leaf {name}: shape {arr.shape} cannot hold "
+                    f"{logical} logical elements"
+                )
+                continue
+            arr = np.ascontiguousarray(arr[:logical])
         raw = arr.tobytes()
         if len(raw) != entry["bytes"]:
             problems.append(
@@ -358,8 +418,31 @@ def fresh_buffers(tree):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def _state_pytree(state: TrainState) -> dict:
-    """The array-valued part of TrainState (SGDConfig is static metadata)."""
+def _state_pytree(state) -> dict:
+    """The array-valued part of a training state (the config dataclass
+    is static metadata).  Handles the three checkpointable layouts by
+    their leaf fields (duck-typed — the Zero1State/FSDPState dataclasses
+    live in ``parallel/`` and must not be imported here): a replicated
+    ``TrainState`` (dp), ``Zero1State`` (``param_flat``), and
+    ``FSDPState`` (``param_shards``).  The flat-shard trees keep their
+    own leaf names so a restore can never silently unflatten the wrong
+    layout."""
+    if hasattr(state, "param_shards"):  # FSDPState
+        return {
+            "param_shards": state.param_shards,
+            "momentum_shards": state.momentum_shards,
+            "batch_stats": state.batch_stats,
+            "step": state.step,
+            "rng": state.rng,
+        }
+    if hasattr(state, "param_flat"):  # Zero1State
+        return {
+            "param_flat": state.param_flat,
+            "momentum_shards": state.momentum_shards,
+            "batch_stats": state.batch_stats,
+            "step": state.step,
+            "rng": state.rng,
+        }
     return {
         "params": state.params,
         "momentum": state.momentum,
@@ -369,11 +452,63 @@ def _state_pytree(state: TrainState) -> dict:
     }
 
 
-def save_checkpoint(directory: str | os.PathLike, state: TrainState,
+def state_layout(state) -> str:
+    """The :data:`~..runtime.mesh.SHARD_LAYOUTS` name of a state's
+    type — the layout half of the ShardSpec a save should carry."""
+    if hasattr(state, "param_shards"):
+        return "fsdp"
+    if hasattr(state, "param_flat"):
+        return "zero1"
+    return "dp"
+
+
+def _check_shard_spec(state, shard_spec: ShardSpec | None) -> None:
+    """A flat-shard state saved without (or with a mismatched) spec is
+    unrestorable-by-construction — fail the save, not the restore."""
+    layout = state_layout(state)
+    if shard_spec is None:
+        if layout != "dp":
+            raise ValueError(
+                f"saving a {layout} state requires a shard_spec "
+                "(world size + unpadded flat length); without it the "
+                "padded vectors cannot be resharded or verified"
+            )
+        return
+    if shard_spec.layout != layout:
+        raise ValueError(
+            f"shard_spec.layout={shard_spec.layout!r} does not match "
+            f"the state's layout {layout!r}"
+        )
+    if layout == "dp":
+        return
+    # The spec's (world, n_elems) must describe THIS state's padded
+    # vectors exactly: a mismatch would record no logical digests (or
+    # wrong ones), and a later reshard would silently truncate real
+    # parameter values to the claimed n_elems.
+    flat = (state.param_shards if layout == "fsdp" else state.param_flat)
+    expect = padded_len(shard_spec.n_elems, shard_spec.world)
+    if getattr(flat, "ndim", None) != 1 or flat.shape[0] != expect:
+        raise ValueError(
+            f"shard_spec {shard_spec} expects a flat vector of "
+            f"{expect} elements (padded_len({shard_spec.n_elems}, "
+            f"{shard_spec.world})), but the state's is "
+            f"{getattr(flat, 'shape', None)} — wrong world or n_elems "
+            "would silently drop parameter data on reshard"
+        )
+
+
+def save_checkpoint(directory: str | os.PathLike, state,
                     layout: str | None = None, cursor: int | None = None,
                     mid_save_hook=None, keep_last_n: int | None = None,
-                    post_save_hook=None) -> str:
+                    post_save_hook=None,
+                    shard_spec: ShardSpec | None = None) -> str:
     """Write `state` under `directory/step_<n>/`; returns the path written.
+
+    ``state`` may be a replicated :class:`TrainState` (dp) or one of the
+    flat-shard states (``parallel/zero1.py::Zero1State``,
+    ``parallel/fsdp.py::FSDPState``); the latter REQUIRE a matching
+    ``shard_spec`` — their padded flat vectors are meaningless without
+    the world size and unpadded length that produced them.
 
     Only process 0's metadata file is written once; array shards are saved
     by every host (orbax handles the multi-host coordination).
@@ -404,6 +539,12 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
     — the bit-rot window ``runtime/faults.py``'s ``corrupt_ckpt`` fault
     flips bytes in, proving the verification chain catches it.
 
+    ``shard_spec``: the layout/world the state is laid out for
+    (``runtime/mesh.py::ShardSpec``) — recorded in the manifest and the
+    config payload so the checkpoint can be restored onto a DIFFERENT
+    world size (``reshard_restore``) with its flat-leaf digests
+    verified against the logical arrays.
+
     Verification: before the config file (the completeness marker)
     lands, a ``manifest.json`` records a sha256 + byte size for every
     file under the state dir and a crc32/sha256/size/dtype/shape for
@@ -412,6 +553,7 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
     match.
     """
     directory = os.path.abspath(os.fspath(directory))
+    _check_shard_spec(state, shard_spec)
     step = int(jax.device_get(state.step))
     path = os.path.join(directory, f"step_{step}")
     _GC_VALIDATED.discard(path)  # a re-save invalidates the GC memo
@@ -430,7 +572,7 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
             os.remove(os.path.join(path, _INVALID_MARKER))
         except FileNotFoundError:
             pass
-        write_checkpoint_manifest(path, tree)
+        write_checkpoint_manifest(path, tree, shard_spec=shard_spec)
         with open(os.path.join(path, _CONFIG_FILE), "w") as f:
             # Record the config class so restore rebuilds the right
             # optimizer config (LARSConfig carries extra fields that
@@ -441,6 +583,8 @@ def save_checkpoint(directory: str | os.PathLike, state: TrainState,
                 payload["__layout__"] = layout
             if cursor is not None:
                 payload["__cursor__"] = int(cursor)
+            if shard_spec is not None:
+                payload["__shard_spec__"] = shard_spec.as_dict()
             json.dump(payload, f)
         # The manifest was just computed from these very bytes: the GC
         # below (and every later pass) must not immediately re-hash
@@ -568,17 +712,19 @@ class AsyncCheckpointWriter:
 
     def __init__(self):
         self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
-        self._pending: tuple[str, dict, str, int | None, dict] | None = None
+        self._pending: tuple | None = None
         # (start_s, step, nbytes) of the in-flight save, when telemetry
         # is on — recorded as a checkpoint_save span at the flush that
         # commits it (the span covers dispatch → durable-on-disk, the
         # honest window for an async save).
         self._inflight_telemetry: tuple[float, int, int] | None = None
 
-    def save(self, directory: str | os.PathLike, state: TrainState,
+    def save(self, directory: str | os.PathLike, state,
              cursor: int | None = None,
-             keep_last_n: int | None = None) -> str:
+             keep_last_n: int | None = None,
+             shard_spec: ShardSpec | None = None) -> str:
         directory = os.path.abspath(os.fspath(directory))
+        _check_shard_spec(state, shard_spec)
         step = int(jax.device_get(state.step))
         path = os.path.join(directory, f"step_{step}")
         # Flush the PREVIOUS save's config first: this also orders saves
@@ -604,13 +750,15 @@ class AsyncCheckpointWriter:
                        **dataclasses.asdict(state.config)}
             if cursor is not None:
                 payload["__cursor__"] = int(cursor)
+            if shard_spec is not None:
+                payload["__shard_spec__"] = shard_spec.as_dict()
             # Per-leaf digests are computed NOW, while the caller's
             # arrays are still alive (the next train step may donate
             # them); the per-FILE half of the manifest can only be
             # hashed at flush time, once orbax has committed the state
             # dir.
             self._pending = (path, payload, directory, keep_last_n,
-                             _leaf_entries(tree))
+                             _leaf_entries(tree, shard_spec), shard_spec)
         return path
 
     def _flush_pending(self) -> None:
@@ -627,9 +775,8 @@ class AsyncCheckpointWriter:
                 _record_ckpt_io(tel, "save", t0, time.perf_counter(),
                                 step, nbytes)
         if self._pending is not None:
-            path, payload, directory, keep_last_n, leaf_entries = (
-                self._pending
-            )
+            (path, payload, directory, keep_last_n, leaf_entries,
+             shard_spec) = self._pending
             os.makedirs(path, exist_ok=True)
             try:
                 os.remove(os.path.join(path, _INVALID_MARKER))
@@ -637,7 +784,8 @@ class AsyncCheckpointWriter:
                 pass
             # Same write order as the sync path: manifest before the
             # config file, so complete always implies verifiable.
-            write_checkpoint_manifest(path, leaf_entries=leaf_entries)
+            write_checkpoint_manifest(path, leaf_entries=leaf_entries,
+                                      shard_spec=shard_spec)
             with open(os.path.join(path, _CONFIG_FILE), "w") as f:
                 json.dump(payload, f)
             _GC_VALIDATED.add(path)  # manifest just hashed these bytes
@@ -744,9 +892,31 @@ def checkpoint_config(path: str | os.PathLike):
     # "SGDConfig" default: checkpoints written before the class tag existed.
     payload.pop("__layout__", None)  # layout tag is checkpoint_layout's
     payload.pop("__cursor__", None)  # data cursor is checkpoint_cursor's
+    payload.pop("__shard_spec__", None)  # spec is checkpoint_shard_spec's
     return config_class_by_name(payload.pop("__class__", "SGDConfig"))(
         **payload
     )
+
+
+def checkpoint_shard_spec(path: str | os.PathLike) -> ShardSpec | None:
+    """The :class:`~..runtime.mesh.ShardSpec` a checkpoint was saved
+    under, or None for spec-less checkpoints (legacy saves, and plain
+    dp saves that never recorded one — both restore as replicated dp).
+    Quarantined and torn checkpoints read as None: known-bad data is
+    never probed for metadata."""
+    if quarantine_reason(path) is not None:
+        return None
+    try:
+        with open(os.path.join(os.fspath(path), _CONFIG_FILE)) as f:
+            payload = json.load(f).get("__shard_spec__")
+    except (OSError, json.JSONDecodeError):
+        return None
+    if payload is None:
+        return None
+    try:
+        return ShardSpec.from_dict(payload)
+    except (KeyError, TypeError, ValueError):
+        return None
 
 
 def checkpoint_cursor(path: str | os.PathLike) -> int | None:
@@ -886,4 +1056,249 @@ def restore_checkpoint(
         step=tree["step"],
         rng=tree["rng"],
         config=config,
+    )
+
+
+# -- elastic restore: reshard a checkpoint onto a different world ----------
+def _host_state_tree(path: str) -> dict:
+    """The saved state tree as host numpy arrays, restored at the SAVED
+    shapes regardless of this process's device topology — the neutral
+    form a reshard slices and re-pads.  (A plain orbax restore would
+    re-apply the saved sharding, which need not exist on the restoring
+    host: the elastic case is precisely a different topology.)"""
+    state_dir = os.path.join(path, _STATE_DIR)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        meta = ckptr.metadata(state_dir)
+        tree = getattr(meta, "item_metadata", meta)
+        tree = tree.tree if hasattr(tree, "tree") else tree
+        restore_args = jax.tree_util.tree_map(
+            lambda m: ocp.RestoreArgs(restore_type=np.ndarray), tree
+        )
+        template = jax.tree_util.tree_map(
+            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype), tree
+        )
+        return ckptr.restore(
+            state_dir,
+            args=ocp.args.PyTreeRestore(item=template,
+                                        restore_args=restore_args),
+        )
+
+
+def reshard_restore(
+    path: str | os.PathLike, *, world: int | None = None, mesh=None,
+    axis_name: str = BATCH_AXIS, events=None, files_verified: bool = False,
+):
+    """Restore the checkpoint at ``path`` onto a (possibly different)
+    world size — the elastic half of the restore surface.
+
+    The checkpoint carries the :class:`~..runtime.mesh.ShardSpec` it was
+    saved under (``checkpoint_shard_spec``); this restores the LOGICAL
+    state and re-lays it out for the target world:
+
+    - ``dp``: leaves carry no world-dependent padding — a plain restore,
+      replicated onto ``mesh`` when given;
+    - ``zero1``/``fsdp``: the flat padded vectors are restored at their
+      saved shapes (host-side, topology-independent), verified against
+      the manifest's LOGICAL leaf digests, then sliced to ``n_elems``
+      and re-padded for the target world — partition boundaries are
+      recomputed, content is preserved bit for bit.
+
+    Target selection: ``mesh`` (its ``axis_name`` size wins), else
+    ``world``, else the saved world (a plain same-layout restore).
+    Layout conversion is NOT attempted: a zero1 checkpoint restores as a
+    ``Zero1State``, fsdp as ``FSDPState``, dp as ``TrainState`` (the
+    flat layouts don't record the unravel needed to rebuild a params
+    tree).  Returns ``(state, spec)`` with ``spec`` re-aimed at the
+    target world.  A restore whose target differs from the saved world
+    counts one ``reshard_restores`` (telemetry + FaultEvents).
+
+    Spec-less (legacy / plain dp) checkpoints restore as dp at any
+    target — they were never padded, so every world size fits.
+    """
+    path = os.path.abspath(os.fspath(path))
+    reason = quarantine_reason(path)
+    if reason is not None:
+        raise CheckpointVerifyError(
+            f"checkpoint {path} is quarantined ({reason})"
+        )
+    manifest = checkpoint_manifest(path)
+    if manifest is not None and not files_verified:
+        problems = _verify_manifest_files(path, manifest)
+        if problems:
+            quarantine_checkpoint(path, "; ".join(problems))
+            _bump("ckpt_verify_failures", events)
+            raise CheckpointVerifyError(
+                f"checkpoint {path} failed file verification: "
+                + "; ".join(problems[:3])
+            )
+    spec = checkpoint_shard_spec(path)
+    saved_spec = spec if spec is not None else ShardSpec("dp", world=1)
+    if mesh is not None:
+        target_world = int(mesh.shape[axis_name])
+    elif world is not None:
+        target_world = int(world)
+    else:
+        target_world = saved_spec.world
+    # Spec-less checkpoints were never world-padded: nothing to reshard.
+    resharding = spec is not None and target_world != saved_spec.world
+    t0 = time.perf_counter()
+
+    if saved_spec.layout == "dp":
+        state = restore_checkpoint(path, files_verified=True)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            state = jax.device_put(
+                state, NamedSharding(mesh, PartitionSpec())
+            )
+    else:
+        tree = _host_state_tree(path)
+        if manifest is not None and manifest.get("leaves"):
+            problems = _verify_restored_leaves(tree, manifest["leaves"])
+            if problems:
+                quarantine_checkpoint(path, "; ".join(problems))
+                _bump("ckpt_verify_failures", events)
+                raise CheckpointVerifyError(
+                    f"checkpoint {path} failed content verification "
+                    "after restore: " + "; ".join(problems[:3])
+                )
+        n_elems = saved_spec.n_elems
+        config = checkpoint_config(path)
+
+        def _repad(a):
+            return repad_flat(a, n_elems, target_world)
+
+        flat_key = ("param_shards" if saved_spec.layout == "fsdp"
+                    else "param_flat")
+        param_vec = _repad(tree[flat_key])
+        momentum = jax.tree_util.tree_map(_repad, tree["momentum_shards"])
+        batch_stats = tree.get("batch_stats") or {}
+        step, rng = tree["step"], tree["rng"]
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharded = NamedSharding(mesh, P(axis_name))
+            replicated = NamedSharding(mesh, P())
+            # zero1 keeps params replicated; fsdp shards them too.
+            param_vec = jax.device_put(
+                param_vec,
+                sharded if saved_spec.layout == "fsdp" else replicated,
+            )
+            momentum = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharded), momentum
+            )
+            batch_stats = jax.device_put(batch_stats, replicated)
+            step = jax.device_put(step, replicated)
+            rng = jax.device_put(rng, replicated)
+        else:
+            # Host numpy leaves must still become XLA-owned buffers —
+            # the flat-shard steps donate their input state (see
+            # fresh_buffers).
+            param_vec, momentum, batch_stats, step, rng = fresh_buffers(
+                (param_vec, momentum, batch_stats, step, rng)
+            )
+        if saved_spec.layout == "fsdp":
+            from distributed_machine_learning_tpu.parallel.fsdp import (
+                FSDPState,
+            )
+
+            state = FSDPState(
+                param_shards=param_vec, momentum_shards=momentum,
+                batch_stats=batch_stats, step=step, rng=rng, config=config,
+            )
+        else:
+            from distributed_machine_learning_tpu.parallel.zero1 import (
+                Zero1State,
+            )
+
+            state = Zero1State(
+                param_flat=param_vec, momentum_shards=momentum,
+                batch_stats=batch_stats, step=step, rng=rng, config=config,
+            )
+
+    if resharding:
+        _bump("reshard_restores", events)
+        from distributed_machine_learning_tpu.utils.logging import (
+            rank0_print,
+        )
+
+        rank0_print(
+            f"[checkpoint] resharded {path} ({saved_spec.layout}) from "
+            f"world {saved_spec.world} onto world {target_world}"
+        )
+    from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if tel is not None:
+        # The dp branch delegated to restore_checkpoint, which already
+        # recorded this restore's span/bytes/counter — recording again
+        # here would double every dp restore in the I/O accounting.
+        if saved_spec.layout != "dp":
+            _record_ckpt_io(
+                tel, "restore", t0, time.perf_counter(),
+                int(jax.device_get(state.step)),
+                _tree_bytes(_state_pytree(state)),
+            )
+        if resharding:
+            tel.tracer.instant(
+                "reshard_restore", layout=saved_spec.layout,
+                from_world=saved_spec.world, to_world=target_world,
+            )
+    return state, saved_spec.with_world(target_world)
+
+
+# -- fallback-chain diagnostics -------------------------------------------
+class NoRestorableCheckpointError(CheckpointVerifyError):
+    """Every candidate in the fallback chain is unusable (quarantined,
+    incomplete, or digest-mismatched).  The message lists each candidate
+    with its verdict — the 3am operator must see WHY resume is
+    impossible, not a bare "no checkpoint found"."""
+
+
+def checkpoint_chain_report(directory: str | os.PathLike
+                            ) -> list[tuple[str, str]]:
+    """(path, verdict) for every ``step_<n>`` candidate under
+    ``directory``, newest first — ``"valid"``, ``"quarantined: <why>"``,
+    ``"incomplete: ..."``, or the first digest problem.  The diagnostic
+    behind :class:`NoRestorableCheckpointError`; also useful on its own
+    for status tooling."""
+    directory = os.fspath(directory)
+    out: list[tuple[str, str]] = []
+    if not os.path.isdir(directory):
+        return out
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("step_") and name[5:].isdigit():
+            steps.append(int(name[5:]))
+    for step in sorted(steps, reverse=True):
+        path = os.path.join(directory, f"step_{step}")
+        reason = quarantine_reason(path)
+        if reason is not None:
+            verdict = f"quarantined: {reason}"
+        else:
+            problems = validate_checkpoint(path)
+            verdict = "valid" if not problems else problems[0]
+        out.append((path, verdict))
+    return out
+
+
+def require_latest_checkpoint(directory: str | os.PathLike,
+                              events=None) -> str:
+    """``latest_checkpoint`` for callers that cannot proceed without
+    one: returns the newest valid checkpoint path, or raises
+    :class:`NoRestorableCheckpointError` whose message reports every
+    candidate directory with its quarantine/validity verdict."""
+    latest = latest_checkpoint(directory, events=events)
+    if latest is not None:
+        return latest
+    report = checkpoint_chain_report(directory)
+    if not report:
+        raise NoRestorableCheckpointError(
+            f"no checkpoint under {os.fspath(directory)} (no step_<n> "
+            "directories exist)"
+        )
+    lines = "\n".join(f"  {p}: {v}" for p, v in report)
+    raise NoRestorableCheckpointError(
+        f"no restorable checkpoint under {os.fspath(directory)} — every "
+        f"candidate in the fallback chain is unusable:\n{lines}"
     )

@@ -60,10 +60,13 @@ _EVENT_LABELS = {
     "preemptions": "preemption stops",
     "ckpt_kills": "injected mid-checkpoint kills",
     "rank_kills": "injected rank deaths",
+    "rank_losses": "injected permanent rank losses",
     "rank_stalls": "injected rank stalls",
     "ckpt_corruptions": "injected checkpoint corruptions",
     "peer_failures": "gang peers declared dead/stalled",
     "gang_restarts": "gang coordinated restarts",
+    "gang_shrinks": "gang shrinks to survivors",
+    "reshard_restores": "restores resharded across world sizes",
     "ckpt_verify_failures": "checkpoints failing verification",
     "ckpt_fallbacks": "restores fell back past bad checkpoints",
 }

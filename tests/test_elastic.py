@@ -1,0 +1,667 @@
+"""Elastic gang recovery (ISSUE 5): topology-change-tolerant checkpoint
+resharding and shrink-to-survivors continuation.
+
+Fast half: ShardSpec/repad invariants, the exact (padding-free) data
+partition, reshard round-trip property tests (save@N → restore@M →
+save@M → restore@N bit-identical logical state) across the dp, zero1,
+and fsdp layouts for both the VGG (SGD) and LM (AdamW) states, logical
+manifest digests surviving resharding, the survivor-scoped election,
+the ledger-driven lose_rank budget, the all-quarantined chain report,
+and the offline reshard/verify tools.
+
+Slow half (``slow`` + ``faultinject``): the acceptance chaos proof — a
+4-worker gang with ``lose_rank@1:7`` finishes as a 3-worker gang with
+exactly one shrink event, exact-once example consumption post-shrink,
+and a final checkpoint that restores bit-exactly onto world sizes 1, 3,
+and 4.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.data.sharding import (
+    exact_shard_indices,
+    shard_indices,
+)
+from distributed_machine_learning_tpu.models.vgg import VGGTest
+from distributed_machine_learning_tpu.models.transformer import TransformerLM
+from distributed_machine_learning_tpu.parallel.fsdp import shard_fsdp_state
+from distributed_machine_learning_tpu.parallel.zero1 import shard_zero1_state
+from distributed_machine_learning_tpu.runtime.coordinator import (
+    GangCoordinator,
+    clear_gang_state,
+    elect_restore_step,
+)
+from distributed_machine_learning_tpu.runtime.faults import (
+    FAULT_LEDGER_FILE,
+    FaultEvents,
+    FaultInjector,
+    corrupt_checkpoint_data,
+    ledger_lost_ranks,
+)
+from distributed_machine_learning_tpu.runtime.mesh import (
+    ShardSpec,
+    padded_len,
+    repad_flat,
+)
+from distributed_machine_learning_tpu.runtime.supervisor import (
+    GangFailure,
+    gang_supervise,
+)
+from distributed_machine_learning_tpu.train.adamw import AdamWConfig
+from distributed_machine_learning_tpu.train.checkpoint import (
+    CheckpointVerifyError,
+    NoRestorableCheckpointError,
+    checkpoint_chain_report,
+    checkpoint_manifest,
+    checkpoint_shard_spec,
+    latest_checkpoint,
+    quarantine_checkpoint,
+    require_latest_checkpoint,
+    reshard_restore,
+    save_checkpoint,
+    state_layout,
+    validate_checkpoint,
+)
+from distributed_machine_learning_tpu.train.sgd import SGDConfig
+from distributed_machine_learning_tpu.train.state import TrainState
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+# ---------------------------------------------------------------------------
+# ShardSpec / repad_flat / exact_shard_indices
+# ---------------------------------------------------------------------------
+
+
+def test_shard_spec_validation_and_roundtrip():
+    spec = ShardSpec("fsdp", world=4, n_elems=10)
+    assert spec.padded == 12
+    assert spec.with_world(3) == ShardSpec("fsdp", 3, n_elems=10)
+    assert ShardSpec.from_dict(spec.as_dict()) == spec
+    dp = ShardSpec("dp", world=2)
+    assert dp.padded is None
+    with pytest.raises(ValueError):
+        ShardSpec("tensor", world=2)
+    with pytest.raises(ValueError):
+        ShardSpec("dp", world=0)
+    with pytest.raises(ValueError):
+        ShardSpec("zero1", world=2)  # flat layouts need n_elems
+
+
+def test_repad_flat_preserves_logical_prefix():
+    flat = np.arange(12, dtype=np.float32)  # 10 logical + 2 pad @ world 4
+    out = repad_flat(flat, 10, 3)
+    assert out.shape == (padded_len(10, 3),) == (12,)
+    assert np.array_equal(out[:10], flat[:10])
+    assert np.all(out[10:] == 0)
+    back = repad_flat(out, 10, 4)
+    assert np.array_equal(back[:10], flat[:10])
+    with pytest.raises(ValueError):
+        repad_flat(np.zeros((4,)), 10, 2)  # can't hold the logical prefix
+    with pytest.raises(ValueError):
+        repad_flat(np.zeros((4, 4)), 10, 2)  # not flat
+
+
+@pytest.mark.parametrize("num,world", [(24, 1), (24, 3), (24, 4),
+                                       (10, 3), (7, 8)])
+def test_exact_shard_indices_partition_exactly_once(num, world):
+    """The elastic-rebalance invariant: across ranks every index appears
+    exactly once, with NO wrap padding — unlike shard_indices."""
+    all_ids = [i for r in range(world)
+               for i in exact_shard_indices(num, r, world)]
+    assert sorted(all_ids) == list(range(num))
+    sizes = [len(exact_shard_indices(num, r, world)) for r in range(world)]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_exact_shard_indices_shuffle_is_world_invariant():
+    """Shuffling permutes the GLOBAL epoch order identically for every
+    world size — only the assignment of indices to ranks changes."""
+    full = exact_shard_indices(24, 0, 1, shuffle=True, epoch=3)
+    spread = np.empty(24, dtype=full.dtype)
+    for r in range(3):
+        spread[r::3] = exact_shard_indices(24, r, 3, shuffle=True, epoch=3)
+    assert np.array_equal(full, spread)
+    # Matches the torch-compatible sampler's permutation seed.
+    assert np.array_equal(full, shard_indices(24, 0, 1, shuffle=True,
+                                              epoch=3))
+
+
+# ---------------------------------------------------------------------------
+# Reshard round trips: dp / zero1 / fsdp x VGG (SGD) / LM (AdamW)
+# ---------------------------------------------------------------------------
+
+
+def _vgg_state():
+    model = VGGTest()
+    variables = model.init(jax.random.PRNGKey(69143),
+                           jnp.zeros((1, 32, 32, 3)))
+    return TrainState.create(
+        params=jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), variables["params"]
+        ),
+        batch_stats=variables.get("batch_stats"),
+        rng=jax.random.PRNGKey(7),
+        config=SGDConfig(),
+    )
+
+
+def _lm_state():
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=1, n_heads=2)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return TrainState.create(params=params, rng=jax.random.PRNGKey(9),
+                             config=AdamWConfig())
+
+
+@pytest.fixture(scope="module")
+def base_states():
+    return {"vgg": _vgg_state(), "lm": _lm_state()}
+
+
+def _logical_flat(state, spec: ShardSpec):
+    """(param logical vector, momentum logical tree) of a flat-shard
+    state — the invariant a reshard must preserve bit for bit."""
+    key = "param_shards" if spec.layout == "fsdp" else "param_flat"
+    vec = np.asarray(getattr(state, key))[:spec.n_elems]
+    mom = jax.tree_util.tree_map(
+        lambda a: np.asarray(a)[:spec.n_elems], state.momentum_shards
+    )
+    return vec, mom
+
+
+@pytest.mark.parametrize("model_name", ["vgg", "lm"])
+@pytest.mark.parametrize("layout", ["dp", "zero1", "fsdp"])
+def test_reshard_roundtrip_bit_identical(tmp_path, base_states, mesh8,
+                                         mesh4, layout, model_name):
+    """save@8 → restore@4 → save@4 → restore@8: the logical state is
+    bit-identical after the double reshard, for every layout and both
+    the CNN (SGD momentum tree) and LM (AdamW moment dict) states."""
+    base = base_states[model_name]
+    if layout == "dp":
+        p1 = save_checkpoint(tmp_path / "a", base,
+                             shard_spec=ShardSpec("dp", world=8))
+        mid, spec_mid = reshard_restore(p1, world=4)
+        p2 = save_checkpoint(tmp_path / "b", mid, shard_spec=spec_mid)
+        back, spec_back = reshard_restore(p2, world=8)
+        assert spec_back == ShardSpec("dp", world=8)
+        for a, b in zip(jax.tree_util.tree_leaves(base.params),
+                        jax.tree_util.tree_leaves(back.params)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        return
+    shard = shard_zero1_state if layout == "zero1" else shard_fsdp_state
+    state8, _, n_elems = shard(base, mesh8)
+    assert state_layout(state8) == layout
+    spec8 = ShardSpec(layout, world=8, n_elems=n_elems)
+    p1 = save_checkpoint(tmp_path / "a", state8, shard_spec=spec8)
+    assert checkpoint_shard_spec(p1) == spec8
+
+    state4, spec4 = reshard_restore(p1, mesh=mesh4)
+    assert spec4 == spec8.with_world(4)
+    assert type(state4).__name__ == type(state8).__name__
+    assert np.asarray(state4.step).shape == ()
+    p2 = save_checkpoint(tmp_path / "b", state4, shard_spec=spec4)
+    state8b, spec8b = reshard_restore(p2, mesh=mesh8)
+    assert spec8b == spec8
+
+    vec0, mom0 = _logical_flat(state8, spec8)
+    vec1, mom1 = _logical_flat(state8b, spec8b)
+    assert np.array_equal(vec0, vec1)
+    for a, b in zip(jax.tree_util.tree_leaves(mom0),
+                    jax.tree_util.tree_leaves(mom1)):
+        assert np.array_equal(a, b)
+    # The logical manifest digests are identical across the two worlds:
+    # corruption detection survives resharding.
+    leaves1 = checkpoint_manifest(p1)["leaves"]
+    leaves2 = checkpoint_manifest(p2)["leaves"]
+    flat_key = "param_shards" if layout == "fsdp" else "param_flat"
+    assert leaves1[flat_key]["logical_elems"] == n_elems
+    assert leaves1[flat_key]["sha256"] == leaves2[flat_key]["sha256"]
+    assert leaves1[flat_key]["bytes"] == leaves2[flat_key]["bytes"]
+
+
+def test_reshard_to_ragged_world_without_mesh(tmp_path, base_states,
+                                              mesh8):
+    """A world that does not divide the element count (and no mesh to
+    place onto) still round-trips the logical state exactly."""
+    state8, _, n = shard_zero1_state(base_states["lm"], mesh8)
+    spec = ShardSpec("zero1", world=8, n_elems=n)
+    p = save_checkpoint(tmp_path, state8, shard_spec=spec)
+    ev = FaultEvents()
+    state3, spec3 = reshard_restore(p, world=3, events=ev)
+    assert ev.reshard_restores == 1
+    assert state3.param_flat.shape == (padded_len(n, 3),)
+    assert np.array_equal(np.asarray(state3.param_flat)[:n],
+                          np.asarray(state8.param_flat)[:n])
+    assert spec3.world == 3
+
+
+def test_reshard_detects_corruption_across_worlds(tmp_path, base_states,
+                                                  mesh8):
+    """A byte flip in the saved payload is caught by the LOGICAL leaf
+    digests even when restoring onto a different world size."""
+    state8, _, n = shard_fsdp_state(base_states["vgg"], mesh8)
+    p = save_checkpoint(tmp_path, state8,
+                        shard_spec=ShardSpec("fsdp", world=8, n_elems=n))
+    corrupt_checkpoint_data(p)
+    with pytest.raises(CheckpointVerifyError):
+        reshard_restore(p, world=4)
+
+
+def test_sharded_save_requires_matching_spec(tmp_path, base_states,
+                                             mesh8):
+    state8, _, n = shard_fsdp_state(base_states["vgg"], mesh8)
+    with pytest.raises(ValueError):
+        save_checkpoint(tmp_path, state8)  # flat layout, no spec
+    with pytest.raises(ValueError):
+        save_checkpoint(tmp_path, state8,
+                        shard_spec=ShardSpec("zero1", 8, n_elems=n))
+    # A spec whose (world, n_elems) does not describe THIS state's
+    # padded vectors would silently truncate parameters on reshard —
+    # rejected at save time.
+    with pytest.raises(ValueError):
+        save_checkpoint(tmp_path, state8,
+                        shard_spec=ShardSpec("fsdp", 8, n_elems=n - 8))
+    with pytest.raises(ValueError):
+        save_checkpoint(tmp_path, state8,
+                        shard_spec=ShardSpec("fsdp", 4, n_elems=n))
+
+
+def test_legacy_checkpoint_reshards_as_dp(tmp_path):
+    """Spec-less (pre-elastic) checkpoints restore at any world: they
+    were never world-padded."""
+    state = TrainState.create(params={"w": jnp.arange(4, dtype=jnp.float32)})
+    p = save_checkpoint(tmp_path, state)
+    assert checkpoint_shard_spec(p) is None
+    restored, spec = reshard_restore(p, world=5)
+    assert spec.layout == "dp" and spec.world == 5
+    assert np.array_equal(np.asarray(restored.params["w"]),
+                          np.asarray(state.params["w"]))
+
+
+# ---------------------------------------------------------------------------
+# All-quarantined fallback chain: the per-candidate verdict report
+# ---------------------------------------------------------------------------
+
+
+def test_chain_report_and_require_latest(tmp_path):
+    state = TrainState.create(params={"w": jnp.zeros((4,), jnp.float32)})
+    p0 = save_checkpoint(tmp_path, state)
+    p1 = save_checkpoint(tmp_path, state.replace(step=state.step + 5))
+    assert require_latest_checkpoint(tmp_path) == p1
+    quarantine_checkpoint(p0, "torn on host 2")
+    quarantine_checkpoint(p1, "gang election verdict")
+    report = checkpoint_chain_report(tmp_path)
+    assert [os.path.basename(p) for p, _ in report] == ["step_5", "step_0"]
+    assert all(v.startswith("quarantined") for _, v in report)
+    with pytest.raises(NoRestorableCheckpointError) as err:
+        require_latest_checkpoint(tmp_path)
+    msg = str(err.value)
+    # Every candidate is named with its quarantine reason — not a bare
+    # "no checkpoint found".
+    assert "step_5" in msg and "gang election verdict" in msg
+    assert "step_0" in msg and "torn on host 2" in msg
+    with pytest.raises(NoRestorableCheckpointError) as err:
+        require_latest_checkpoint(tmp_path / "empty")
+    assert "no step_<n> directories exist" in str(err.value)
+
+
+# ---------------------------------------------------------------------------
+# Survivor-scoped election + ledger retention across a shrink
+# ---------------------------------------------------------------------------
+
+
+def test_elect_restore_step_among_survivors(tmp_path):
+    coords = [GangCoordinator(tmp_path, rank=r, world=3,
+                              heartbeat_interval_s=0.1, peer_timeout_s=0.5)
+              for r in range(3)]
+    coords[0].record_valid_step(5)
+    coords[2].record_valid_step(5)
+    coords[0].record_valid_step(10)
+    coords[2].record_valid_step(10)
+    # Rank 1 never recorded anything (it is the dead one): the full
+    # election cannot agree, the survivor election can.
+    assert elect_restore_step(tmp_path, 3) is None
+    assert elect_restore_step(tmp_path, 3, ranks=[0, 2]) == 10
+
+
+def test_clear_gang_state_keeps_ledger_across_shrink(tmp_path):
+    c = GangCoordinator(tmp_path, rank=0, world=1,
+                        heartbeat_interval_s=0.1, peer_timeout_s=0.5)
+    c.record_valid_step(5)
+    ledger = tmp_path / FAULT_LEDGER_FILE
+    ledger.write_text(json.dumps(
+        {"index": 0, "kind": "lose_rank", "at": 7, "rank": 1}) + "\n")
+    consumed = tmp_path / "consumed_rank0.jsonl"
+    consumed.write_text("{}\n")
+    # The shrink clear: records go (rank numbering changes); the ledger
+    # stays (renumbered survivors must not re-fire latched faults) and
+    # so does the consumption audit trail (whole-run history).
+    clear_gang_state(tmp_path, restore_records=True, fault_ledger=False)
+    assert not list(tmp_path.glob("restore_rank*"))
+    assert ledger.exists() and consumed.exists()
+    assert ledger_lost_ranks(ledger) == {1}
+    clear_gang_state(tmp_path, restore_records=True)  # fresh run: all gone
+    assert not ledger.exists()
+    assert not consumed.exists()  # stale audit trails don't pollute
+    assert ledger_lost_ranks(ledger) == set()
+
+
+def test_lose_rank_grammar_and_targeting():
+    inj = FaultInjector.parse("lose_rank@1:7", rank=0)
+    assert inj.pending() == ["lose_rank@1:7"]
+    # Non-target rank: latched without acting.
+    assert list(inj.wrap_batches(range(9), FaultEvents())) == list(range(9))
+    assert inj.pending() == []
+    with pytest.raises(ValueError):
+        FaultInjector.parse("lose_rank@7")  # missing rank
+    with pytest.raises(ValueError):
+        FaultInjector.parse("lose_rank@1:7:2.0")  # too many fields
+
+
+# ---------------------------------------------------------------------------
+# gang_supervise: budget attribution + shrink (stub workers, no jax)
+# ---------------------------------------------------------------------------
+
+
+def _stub_worker_cmd(tmp_path, body: str):
+    """A worker argv factory whose subprocess runs ``body`` with RANK /
+    ATTEMPT / WORLD / ORIG env-style format substitutions — cheap
+    processes, no jax import."""
+
+    def worker_cmd(rank, attempt, world, orig_rank):
+        code = body.format(rank=rank, attempt=attempt, world=world,
+                           orig=orig_rank, root=str(tmp_path))
+        return [sys.executable, "-c", code]
+
+    return worker_cmd
+
+
+def test_gang_supervise_shrinks_on_lose_rank_ledger(tmp_path):
+    """Attempt 0: rank 1 writes a lose_rank ledger entry and dies hard;
+    the supervisor must shrink to [0, 2] (renumbered 0..1) and the
+    relaunched gang finishes — with the shrink counted."""
+    gang = tmp_path / "gang"
+    body = (
+        "import json, os, sys\n"
+        "rank, attempt, world, orig = {rank}, {attempt}, {world}, {orig}\n"
+        "open(os.path.join({root!r}, 'seen.jsonl'), 'a').write(\n"
+        "    json.dumps(dict(rank=rank, attempt=attempt, world=world,\n"
+        "                    orig=orig)) + '\\n')\n"
+        "if attempt == 0 and rank == 1:\n"
+        "    with open(os.path.join({root!r}, 'gang',\n"
+        "                           'faults_fired.jsonl'), 'a') as f:\n"
+        "        f.write(json.dumps(dict(index=0, kind='lose_rank',\n"
+        "                                at=7, rank=1)) + '\\n')\n"
+        "    os._exit(23)\n"
+        "sys.exit(0)\n"
+    )
+    events = FaultEvents()
+    codes = gang_supervise(
+        _stub_worker_cmd(tmp_path, body), 3, gang,
+        min_world=1, events=events, poll_s=0.05, max_restarts=2,
+    )
+    assert codes == [0, 0]
+    assert events.gang_shrinks == 1 and events.gang_restarts == 1
+    seen = [json.loads(line)
+            for line in (tmp_path / "seen.jsonl").read_text().splitlines()]
+    final = [s for s in seen if s["attempt"] == 1]
+    # Survivors renumbered 0..1 in original order, world shrunk to 2.
+    assert sorted((s["rank"], s["orig"]) for s in final) == [(0, 0), (1, 2)]
+    assert all(s["world"] == 2 for s in final)
+
+
+def test_gang_supervise_budget_exhaustion_without_shrink_fails(tmp_path):
+    """rank_restart_budget with shrinking disabled: an unrecoverable
+    rank is terminal, not an infinite relaunch loop."""
+    body = (
+        "import os, sys\n"
+        "os._exit(9) if {rank} == 1 else sys.exit(0)\n"
+    )
+    events = FaultEvents()
+    with pytest.raises(GangFailure) as err:
+        gang_supervise(
+            _stub_worker_cmd(tmp_path, body), 2, tmp_path / "gang",
+            rank_restart_budget=0, events=events, poll_s=0.05,
+            max_restarts=5,
+        )
+    assert "unrecoverable" in str(err.value)
+    assert events.gang_shrinks == 0
+
+
+def test_gang_supervise_legacy_two_arg_worker_cmd(tmp_path):
+    """Pre-elastic closures (rank, attempt) keep working — including
+    ones with trailing keyword-only options, which must not be
+    mistaken for elastic (world-accepting) signatures."""
+
+    def worker_cmd(rank, attempt):
+        return [sys.executable, "-c", "import sys; sys.exit(0)"]
+
+    assert gang_supervise(worker_cmd, 2, tmp_path / "gang",
+                          poll_s=0.05) == [0, 0]
+
+    def kw_cmd(rank, attempt, *, verbose=False, **extra):
+        return [sys.executable, "-c", "import sys; sys.exit(0)"]
+
+    assert gang_supervise(kw_cmd, 2, tmp_path / "gang2",
+                          poll_s=0.05) == [0, 0]
+    # And a shrink-enabled run refuses a closure that can't be told
+    # the post-shrink world size.
+    with pytest.raises(ValueError):
+        gang_supervise(worker_cmd, 2, tmp_path / "gang3", min_world=1)
+
+
+# ---------------------------------------------------------------------------
+# Offline tools: ckpt_reshard + ckpt_verify --json
+# ---------------------------------------------------------------------------
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ckpt_reshard_tool_rewrites_layout(tmp_path, base_states, mesh8,
+                                           capsys):
+    state8, _, n = shard_zero1_state(base_states["lm"], mesh8)
+    src = tmp_path / "src"
+    save_checkpoint(src, state8, cursor=11,
+                    shard_spec=ShardSpec("zero1", world=8, n_elems=n))
+    tool = _load_tool("ckpt_reshard")
+    rc = tool.main([str(src), str(tmp_path / "dst"), "--world", "5"])
+    assert rc == 0, capsys.readouterr().err
+    dst = os.path.join(tmp_path, "dst", "step_0")
+    assert validate_checkpoint(dst) == []
+    spec = checkpoint_shard_spec(dst)
+    assert spec == ShardSpec("zero1", world=5, n_elems=n)
+    restored, _ = reshard_restore(dst, world=8)
+    assert np.array_equal(np.asarray(restored.param_flat)[:n],
+                          np.asarray(state8.param_flat)[:n])
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        checkpoint_cursor,
+    )
+
+    assert checkpoint_cursor(dst) == 11  # config payload carried over
+    # An unrestorable source reports per-candidate verdicts, rc 1.
+    quarantine_checkpoint(src / "step_0", "test verdict")
+    rc = tool.main([str(src), str(tmp_path / "dst2"), "--world", "3"])
+    captured = capsys.readouterr()
+    assert rc == 1 and "test verdict" in captured.err
+
+
+def test_ckpt_verify_json_summary(tmp_path):
+    state = TrainState.create(params={"w": jnp.zeros((8,), jnp.float32)})
+    save_checkpoint(tmp_path, state,
+                    shard_spec=ShardSpec("dp", world=4))
+    p1 = save_checkpoint(tmp_path, state.replace(step=state.step + 5))
+    corrupt_checkpoint_data(p1)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ckpt_verify.py"),
+         str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    payload = json.loads(res.stdout)
+    assert payload["total"] == 2 and payload["invalid"] == 1
+    by_name = {os.path.basename(c["path"]): c
+               for c in payload["checkpoints"]}
+    assert by_name["step_0"]["ok"] is True
+    assert by_name["step_0"]["shard_spec"] == {"layout": "dp", "world": 4,
+                                               "n_elems": None}
+    assert by_name["step_5"]["ok"] is False
+    assert by_name["step_5"]["status"] == "CORRUPT"
+    assert by_name["step_5"]["bad_files"]
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the 4-worker gang shrinking to 3 survivors (multi-process)
+# ---------------------------------------------------------------------------
+
+
+def _run_gang(root, *, faults=None, workers=4, steps=12, save_every=5,
+              peer_timeout=6.0, telemetry=False, timeout=280,
+              extra=()):
+    from distributed_machine_learning_tpu.cli.gang import (
+        scrubbed_worker_env,
+    )
+
+    cmd = [
+        sys.executable, "-m", "distributed_machine_learning_tpu.cli.gang",
+        "--workers", str(workers), "--steps", str(steps),
+        "--save-every", str(save_every),
+        "--ckpt-dir", os.path.join(root, "ckpt"),
+        "--gang-dir", os.path.join(root, "gang"),
+        "--peer-timeout", str(peer_timeout),
+        *extra,
+    ]
+    if faults:
+        cmd += ["--faults", faults]
+    if telemetry:
+        cmd += ["--telemetry-dir", os.path.join(root, "telemetry")]
+    return subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout,
+        env=scrubbed_worker_env(REPO), cwd=REPO,
+    )
+
+
+def _consumed_records(root):
+    gang = os.path.join(root, "gang")
+    recs = []
+    for name in os.listdir(gang):
+        if name.startswith("consumed_rank"):
+            with open(os.path.join(gang, name)) as f:
+                for line in f:
+                    recs.append(json.loads(line))
+    return recs
+
+
+@pytest.mark.slow
+@pytest.mark.faultinject
+def test_gang_shrinks_to_survivors_on_lose_rank(tmp_path):
+    """ISSUE 5's acceptance bar: with lose_rank@1:7 on a 4-worker gang,
+    rank 1 is lost for good at step 7, the supervisor shrinks to the 3
+    survivors (exactly one shrink event), every training example is
+    still consumed exactly once per step post-shrink (at the rebalanced
+    world-3 shard assignment and rescaled per-host batch), and the
+    final checkpoint restores bit-exactly onto world sizes 1, 3, and 4
+    — verified via the manifest leaf digests."""
+    root = str(tmp_path / "chaos")
+    res = _run_gang(root, faults="lose_rank@1:7", telemetry=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "shrinking to 3 survivor(s)" in res.stdout
+    assert "world size 3" in res.stdout
+    assert "1 shrink(s)" in res.stdout
+
+    # Exactly one shrink event, visible as a counter (not just a log).
+    with open(os.path.join(root, "telemetry", "registry.json")) as f:
+        snapshot = json.load(f)
+    counters = {c["name"]: c["value"] for c in snapshot["counters"]}
+    assert counters["gang_shrinks"] == 1
+    assert counters["gang_restarts"] == 1
+    gauges = {g["name"]: g["value"] for g in snapshot.get("gauges", [])}
+    assert gauges.get("gang_world_size") == 3
+
+    # The loss occurred: rank 1's attempt-0 log records the hard exit,
+    # and no attempt-1 log exists for a 4th rank.
+    logs = os.path.join(root, "gang", "logs")
+    with open(os.path.join(logs, "rank1.attempt0.log")) as f:
+        assert "permanent loss" in f.read()
+    assert not os.path.exists(os.path.join(logs, "rank3.attempt1.log"))
+
+    # Exact-once consumption per step, judged in the attempt that
+    # finally completed each step: world 4 before the fault, world 3
+    # after the shrink — every global example id exactly once.
+    B = 24
+    by_step: dict[int, list] = {}
+    for r in _consumed_records(root):
+        by_step.setdefault(r["step"], []).append(r)
+    assert sorted(by_step) == list(range(12))
+    saw_world3 = False
+    for step, rows in by_step.items():
+        final_attempt = max(r["attempt"] for r in rows)
+        final = [r for r in rows if r["attempt"] == final_attempt]
+        ids = sorted(i for r in final for i in r["ids"])
+        assert ids == list(range(step * B, (step + 1) * B)), (
+            f"step {step}: examples not consumed exactly once"
+        )
+        worlds = {r["world"] for r in final}
+        assert len(worlds) == 1
+        if worlds == {3}:
+            saw_world3 = True
+            # Rescaled per-host batch: 24/3 = 8 examples per rank.
+            assert {len(r["ids"]) for r in final} == {8}
+    assert saw_world3, "no step was consumed at the shrunken world size"
+
+    # The final checkpoint restores bit-exactly onto 1, 3, and 4
+    # workers; reshard_restore verifies the manifest leaf digests
+    # against the logical arrays on every one of these restores.
+    digests = {}
+    for orig_rank in (0, 2, 3):
+        latest = latest_checkpoint(
+            os.path.join(root, "ckpt", f"rank{orig_rank}")
+        )
+        assert latest is not None and latest.endswith("step_12")
+        for w in (1, 3, 4):
+            state, spec = reshard_restore(latest, world=w)
+            assert spec.world == w
+            digests[(orig_rank, w)] = hashlib.sha256(
+                np.ascontiguousarray(
+                    np.asarray(state.params["w"])
+                ).tobytes()
+            ).hexdigest()
+    assert len(set(digests.values())) == 1, digests
+    # And the workers' own final-param digests agree across ranks.
+    finals = set()
+    for name in os.listdir(logs):
+        with open(os.path.join(logs, name)) as f:
+            for line in f:
+                if line.startswith("final "):
+                    finals.add(line.split()[1])
+    assert len(finals) == 1
+
+    # Every rank's checkpoint chain verifies end to end — via the JSON
+    # summary the supervisor/CI consumes.
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ckpt_verify.py"),
+         os.path.join(root, "ckpt"), "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert json.loads(res.stdout)["invalid"] == 0

@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Offline checkpoint resharder — rewrite a checkpoint from world-size N
+to M, re-emitting a valid manifest.
+
+Usage::
+
+    python tools/ckpt_reshard.py SRC DST --world M
+
+``SRC`` is a single ``step_<n>`` checkpoint directory, or a directory
+containing them (the newest *valid* one is picked, same fallback chain
+as resume).  The resharded checkpoint lands under ``DST/step_<n>`` with
+its manifest, config payload (cursor/layout preserved), and
+``ShardSpec`` re-aimed at world ``M`` — ``tools/ckpt_verify.py`` (and
+every restore path) accepts it like any native save.
+
+This is the operator's tool for the planned half of elasticity: a job
+about to move from an N-host to an M-host reservation reshards its
+checkpoint ONCE, offline, instead of paying the reshard on the critical
+restart path of every rank.  The unplanned half (a shrink mid-run) uses
+the same machinery in-process (``train/checkpoint.py::reshard_restore``).
+
+Needs jax + the package (flat zero1/fsdp vectors are re-laid-out
+host-side and re-saved through orbax); for a verify-only pass that runs
+where training isn't installed, use the stdlib-only
+``tools/ckpt_verify.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="reshard a checkpoint to a different world size"
+    )
+    ap.add_argument("src", help="a step_<n> checkpoint dir, or a dir "
+                               "containing them (newest valid wins)")
+    ap.add_argument("dst", help="output checkpoint ROOT (the resharded "
+                               "checkpoint lands at DST/step_<n>)")
+    ap.add_argument("--world", type=int, required=True,
+                    help="target world size")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the per-leaf progress line")
+    args = ap.parse_args(argv)
+    if args.world < 1:
+        print(f"ckpt_reshard: --world must be >= 1, got {args.world}",
+              file=sys.stderr)
+        return 2
+    if not os.path.isdir(args.src):
+        print(f"ckpt_reshard: no such directory: {args.src}",
+              file=sys.stderr)
+        return 2
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Runnable straight from a checkout (python tools/ckpt_reshard.py):
+    # the package root is the parent of this script's directory.
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        NoRestorableCheckpointError,
+        checkpoint_cursor,
+        checkpoint_layout,
+        checkpoint_shard_spec,
+        require_latest_checkpoint,
+        reshard_restore,
+        save_checkpoint,
+        validate_checkpoint,
+    )
+
+    name = os.path.basename(os.path.abspath(args.src))
+    if name.startswith("step_") and name[5:].isdigit():
+        src = os.path.abspath(args.src)
+        problems = validate_checkpoint(src)
+        if problems:
+            print(f"ckpt_reshard: {src} is not restorable: "
+                  + "; ".join(problems), file=sys.stderr)
+            return 1
+    else:
+        try:
+            src = require_latest_checkpoint(args.src)
+        except NoRestorableCheckpointError as e:
+            print(f"ckpt_reshard: {e}", file=sys.stderr)
+            return 1
+
+    saved_spec = checkpoint_shard_spec(src)
+    state, spec = reshard_restore(src, world=args.world,
+                                  files_verified=True)
+    if not args.quiet:
+        frm = (f"{saved_spec.layout} world {saved_spec.world}"
+               if saved_spec is not None else "spec-less (dp)")
+        print(f"resharding {src} [{frm}] -> world {args.world}")
+    path = save_checkpoint(
+        args.dst, state,
+        layout=checkpoint_layout(src),
+        cursor=checkpoint_cursor(src),
+        shard_spec=spec,
+    )
+    problems = validate_checkpoint(path)
+    if problems:
+        print(f"ckpt_reshard: re-emitted checkpoint failed its own "
+              f"manifest: {'; '.join(problems)}", file=sys.stderr)
+        return 1
+    print(f"wrote {path} ({spec.layout}, world {spec.world})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

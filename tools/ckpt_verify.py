@@ -3,7 +3,7 @@
 
 Usage::
 
-    python tools/ckpt_verify.py PATH [--quiet]
+    python tools/ckpt_verify.py PATH [--quiet] [--json]
 
 ``PATH`` may be a single ``step_<n>`` checkpoint directory or any
 directory containing them (a run's ``--ckpt-dir``, or a gang's
@@ -71,9 +71,17 @@ def find_step_dirs(root: str) -> list[str]:
                                         int(os.path.basename(p)[5:])))
 
 
-def verify_step_dir(path: str, quiet: bool) -> tuple[bool, str]:
-    """(ok, status line) for one checkpoint; prints detail unless quiet."""
+def verify_step_dir(path: str, quiet: bool) -> tuple[bool, str, dict]:
+    """(ok, status line, json record) for one checkpoint; prints detail
+    unless quiet.  The record is the machine half of the verdict —
+    supervisors/CI consume it through ``--json`` instead of parsing the
+    human lines."""
     rel = path
+
+    def result(ok: bool, status: str, detail: str, **extra):
+        record = {"path": path, "ok": ok, "status": status,
+                  "detail": detail, **extra}
+        return ok, f"{status:<11} {rel}  ({detail})", record
 
     def emit(line: str) -> None:
         if not quiet:
@@ -86,36 +94,41 @@ def verify_step_dir(path: str, quiet: bool) -> tuple[bool, str]:
                 reason = json.load(f).get("reason", "unknown")
         except (OSError, json.JSONDecodeError):
             reason = "unreadable marker"
-        return False, f"QUARANTINED {rel}  ({reason})"
+        return result(False, "QUARANTINED", reason)
     complete = (os.path.isdir(os.path.join(path, STATE_DIR))
                 and os.path.isfile(os.path.join(path, CONFIG_FILE)))
     if not complete:
-        return False, f"INCOMPLETE  {rel}  (state dir or config missing)"
+        return result(False, "INCOMPLETE", "state dir or config missing")
     manifest_path = os.path.join(path, MANIFEST_FILE)
     if not os.path.isfile(manifest_path):
-        return True, f"UNVERIFIABLE {rel}  (legacy checkpoint: no manifest)"
+        return result(True, "UNVERIFIABLE",
+                      "legacy checkpoint: no manifest")
     try:
         with open(manifest_path) as f:
             manifest = json.load(f)
     except (OSError, json.JSONDecodeError) as e:
-        return False, f"BAD-MANIFEST {rel}  ({e})"
+        return result(False, "BAD-MANIFEST", str(e))
 
     bad = 0
+    bad_files = []
     files = manifest.get("files", {})
     for relf, entry in sorted(files.items()):
         fp = os.path.join(path, relf)
         if not os.path.isfile(fp):
             emit(f"  MISSING  {relf}")
+            bad_files.append({"file": relf, "problem": "missing"})
             bad += 1
             continue
         size = os.path.getsize(fp)
         if size != entry.get("bytes"):
             emit(f"  SIZE     {relf}  {size} != {entry.get('bytes')}")
+            bad_files.append({"file": relf, "problem": "size mismatch"})
             bad += 1
             continue
         sha, _ = sha256_of(fp)
         if sha != entry.get("sha256"):
             emit(f"  CORRUPT  {relf}  (sha256 mismatch)")
+            bad_files.append({"file": relf, "problem": "sha256 mismatch"})
             bad += 1
     leaves = manifest.get("leaves", {})
     if leaves and not quiet:
@@ -127,16 +140,23 @@ def verify_step_dir(path: str, quiet: bool) -> tuple[bool, str]:
                      f"UNVERIFIED ({entry.get('unverified', '?')})")
                 continue
             shape = "x".join(str(d) for d in entry.get("shape", [])) or "()"
+            logical = entry.get("logical_elems")
             status = "ok" if bad == 0 else "suspect"
             emit(f"    {name:<{width}}  {shape:>12}  "
                  f"{entry.get('dtype', '?'):>9}  "
                  f"{entry.get('bytes', 0):>10,}B  "
                  f"crc32={entry.get('crc32', 0):>10}  "
-                 f"sha256={entry['sha256'][:12]}  [{status}]")
+                 f"sha256={entry['sha256'][:12]}  "
+                 + (f"logical={logical}  " if logical is not None else "")
+                 + f"[{status}]")
+    extra = {"files": len(files), "leaves": len(leaves),
+             "shard_spec": manifest.get("shard_spec")}
     if bad:
-        return False, f"CORRUPT     {rel}  ({bad} bad file(s))"
-    return True, (f"OK          {rel}  ({len(files)} files, "
-                  f"{len(leaves)} leaves verified against manifest)")
+        return result(False, "CORRUPT", f"{bad} bad file(s)",
+                      bad_files=bad_files, **extra)
+    return result(True, "OK",
+                  f"{len(files)} files, {len(leaves)} leaves verified "
+                  "against manifest", **extra)
 
 
 def main(argv=None) -> int:
@@ -147,23 +167,46 @@ def main(argv=None) -> int:
                                  "containing them (scanned recursively)")
     ap.add_argument("--quiet", action="store_true",
                     help="one status line per checkpoint, no detail")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON summary to "
+                         "stdout instead of the human report — the "
+                         "form supervisors/CI consume (same exit code)")
     args = ap.parse_args(argv)
 
     if not os.path.exists(args.path):
-        print(f"ckpt_verify: no such path: {args.path}", file=sys.stderr)
+        if args.json:
+            print(json.dumps({"error": f"no such path: {args.path}",
+                              "checkpoints": [], "total": 0,
+                              "invalid": 0}))
+        else:
+            print(f"ckpt_verify: no such path: {args.path}",
+                  file=sys.stderr)
         return 2
     dirs = find_step_dirs(args.path)
     if not dirs:
-        print(f"ckpt_verify: no step_<n> checkpoints under {args.path}",
-              file=sys.stderr)
+        if args.json:
+            print(json.dumps({
+                "error": f"no step_<n> checkpoints under {args.path}",
+                "checkpoints": [], "total": 0, "invalid": 0,
+            }))
+        else:
+            print(f"ckpt_verify: no step_<n> checkpoints under "
+                  f"{args.path}", file=sys.stderr)
         return 2
     failures = 0
+    records = []
     for d in dirs:
-        ok, status = verify_step_dir(d, args.quiet)
-        print(status)
+        ok, status, record = verify_step_dir(d, args.quiet or args.json)
+        records.append(record)
+        if not args.json:
+            print(status)
         if not ok:
             failures += 1
-    print(f"{len(dirs)} checkpoint(s), {failures} invalid")
+    if args.json:
+        print(json.dumps({"checkpoints": records, "total": len(dirs),
+                          "invalid": failures}, indent=1))
+    else:
+        print(f"{len(dirs)} checkpoint(s), {failures} invalid")
     return 1 if failures else 0
 
 
