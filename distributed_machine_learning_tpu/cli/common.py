@@ -811,13 +811,36 @@ def run_part(
             # compile) completed.
             warmup_cm = coordinator.suspend()
             warmup_cm.__enter__()
-            warmup = {"polls": 0, "cm": warmup_cm}
+            warmup = {"polls": 0, "cm": warmup_cm, "last": None,
+                      "suspends": coordinator.suspensions}
 
             def in_loop_stop(_base=base_in_loop_stop):
+                import time as _time
+
                 # The stop predicate is polled once per step on every
                 # rank — the natural place to record gang progress
-                # without threading the coordinator into the loop.
-                coordinator.beat()
+                # without threading the coordinator into the loop.  The
+                # inter-poll delta is one completed step, so past
+                # warm-up each poll also feeds the heartbeat metric
+                # snapshot (rolling step time) the gang straggler
+                # detector compares across ranks.  A delta only counts
+                # when NO suspension happened inside it: compile, eval
+                # and checkpoint saves all run under coordinator
+                # .suspend(), and an interval that swallowed one is not
+                # a step time — feeding it would poison the rolling
+                # mean for a whole window and fire false straggler
+                # verdicts (`suspensions` is the entry counter the
+                # coordinator keeps for exactly this comparison).
+                now = _time.perf_counter()
+                spans = coordinator.suspensions
+                if (warmup["cm"] is None and warmup["last"] is not None
+                        and spans == warmup["suspends"]):
+                    coordinator.observe_step(warmup["polls"],
+                                             now - warmup["last"])
+                else:
+                    coordinator.beat()
+                warmup["last"] = now
+                warmup["suspends"] = spans
                 warmup["polls"] += 1
                 if warmup["cm"] is not None and warmup["polls"] >= 2:
                     warmup["cm"].__exit__(None, None, None)

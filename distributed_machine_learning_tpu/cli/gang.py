@@ -20,6 +20,16 @@ survivors instead of stranding the job — down to ``--min-world``
 workers (default 1; 0 disables shrinking), with the per-host batch
 rescaled so the ``--global-batch`` (and the LR schedule) is preserved
 and every example still consumed exactly once per step.
+
+Observable by default (ISSUE 6): the gang telemetry plane lands under
+``<gang-dir>/telemetry`` — supervisor counters/spans at canonical
+names, each worker's stream rank-suffixed beside them — with live
+straggler detection (``--straggler-multiple``/
+``--straggler-consecutive``) feeding ``gang_straggler{rank}`` counters,
+the ``gang_skew_ratio`` gauge, and the ``gang_health.jsonl`` advisory
+ledger; the run ends with a cross-rank skew summary.  Post-mortem:
+``tools/gang_status.py <gang-dir>`` and ``tools/trace_merge.py
+<gang-dir>/telemetry``.  ``--no-telemetry`` turns it all off.
 """
 
 from __future__ import annotations
@@ -100,9 +110,24 @@ def main(argv=None) -> int:
                     help="seconds without peer progress before the gang "
                          "aborts and restarts together")
     ap.add_argument("--telemetry-dir", dest="telemetry_dir", default=None,
-                    help="stream supervisor telemetry here (gang_restarts "
-                         "counter, gang_attempt spans); workers write "
-                         "their own telemetry under <dir>/rank<r>")
+                    help="the gang telemetry plane (default: "
+                         "<gang-dir>/telemetry): supervisor metrics "
+                         "under canonical names, each worker under "
+                         "rank-suffixed ones (metrics.rank<r>.jsonl) — "
+                         "read back by telemetry/aggregator.py, "
+                         "tools/gang_status.py, tools/trace_merge.py")
+    ap.add_argument("--no-telemetry", dest="no_telemetry",
+                    action="store_true",
+                    help="disable the default-on gang telemetry")
+    ap.add_argument("--straggler-multiple", dest="straggler_multiple",
+                    type=float, default=4.0,
+                    help="flag a rank whose effective step time exceeds "
+                         "this multiple of the gang median (advisory "
+                         "detection only)")
+    ap.add_argument("--straggler-consecutive",
+                    dest="straggler_consecutive", type=int, default=3,
+                    help="consecutive over-threshold observations "
+                         "before a straggler verdict")
     args = ap.parse_args(argv)
     if args.workers < 1:
         ap.error(f"--workers must be >= 1, got {args.workers}")
@@ -113,6 +138,11 @@ def main(argv=None) -> int:
                  f"{args.min_world}")
     if args.global_batch < 1:
         ap.error(f"--global-batch must be >= 1, got {args.global_batch}")
+    if args.straggler_multiple <= 1.0:
+        ap.error("--straggler-multiple must be > 1 (a rank at the "
+                 "median is not a straggler)")
+    if args.straggler_consecutive < 1:
+        ap.error("--straggler-consecutive must be >= 1")
 
     from distributed_machine_learning_tpu.runtime.faults import (
         FaultEvents,
@@ -141,14 +171,20 @@ def main(argv=None) -> int:
                 "would silently never fire"
             )
 
+    # The gang telemetry plane is ON by default: the supervisor writes
+    # canonical filenames at the root, each worker rank-suffixed ones
+    # beside them — one directory, no append collisions, readable as a
+    # cross-rank whole by telemetry/aggregator.py and the tools.
     telemetry = None
-    if args.telemetry_dir:
+    tel_dir = args.telemetry_dir or os.path.join(args.gang_dir,
+                                                 "telemetry")
+    if not args.no_telemetry:
         from distributed_machine_learning_tpu.telemetry import (
             Telemetry,
             set_telemetry,
         )
 
-        telemetry = Telemetry(args.telemetry_dir)
+        telemetry = Telemetry(tel_dir)
         set_telemetry(telemetry)
 
     def worker_cmd(rank: int, attempt: int, world: int,
@@ -172,9 +208,13 @@ def main(argv=None) -> int:
         ]
         if args.faults:
             cmd += ["--faults", args.faults]
-        if args.telemetry_dir:
-            cmd += ["--telemetry-dir",
-                    os.path.join(args.telemetry_dir, f"rank{orig_rank}")]
+        if args.no_telemetry:
+            cmd += ["--no-telemetry"]
+        else:
+            # Workers share ONE telemetry dir; their default instance
+            # tag (rank<orig>) keeps the streams collision-safe and
+            # stable across shrink renumberings.
+            cmd += ["--telemetry-dir", tel_dir]
         return cmd
 
     events = FaultEvents()
@@ -196,6 +236,8 @@ def main(argv=None) -> int:
             min_world=args.min_world if args.min_world > 0 else None,
             events=events, env=scrubbed_worker_env(pkg_root),
             log_dir=os.path.join(args.gang_dir, "logs"),
+            straggler_multiple=args.straggler_multiple,
+            straggler_consecutive=args.straggler_consecutive,
         )
     except GangFailure as e:
         print(f"gang failed: {e}", file=sys.stderr, flush=True)
@@ -209,7 +251,39 @@ def main(argv=None) -> int:
     print(f"gang of {args.workers} finished {args.steps} steps at "
           f"world size {final_world} ({events.gang_restarts} coordinated "
           f"restart(s), {events.gang_shrinks} shrink(s))", flush=True)
+    if not args.no_telemetry:
+        _print_gang_rollup(tel_dir, args)
     return 0
+
+
+def _print_gang_rollup(tel_dir: str, args) -> None:
+    """Post-run cross-rank summary from the per-rank streams — the
+    one-line answer to "was anyone slow?" plus pointers to the deeper
+    tools.  Best-effort: a rollup failure must never fail the run it
+    summarizes."""
+    try:
+        from distributed_machine_learning_tpu.telemetry.aggregator import (
+            aggregate_gang_metrics,
+        )
+
+        rollup = aggregate_gang_metrics(
+            tel_dir, multiple=args.straggler_multiple,
+            consecutive=args.straggler_consecutive,
+        )
+    except Exception as e:  # diagnostics-only path
+        print(f"[gang] cross-rank rollup unavailable: {e}", flush=True)
+        return
+    if not rollup.ranks:
+        return
+    print(f"cross-rank step-time skew (slowest/median): "
+          f"p95 {rollup.skew['p95']:.2f}x  max {rollup.skew['max']:.2f}x"
+          f" over {len(rollup.steps)} step(s), "
+          f"{len(rollup.ranks)} rank stream(s)", flush=True)
+    for v in rollup.stragglers:
+        print(f"  straggler (offline): rank {v['rank']} at step "
+              f"{v['step']} ({v['ratio']:.1f}x median)", flush=True)
+    print(f"inspect: python tools/gang_status.py {args.gang_dir}  |  "
+          f"python tools/trace_merge.py {tel_dir}", flush=True)
 
 
 if __name__ == "__main__":

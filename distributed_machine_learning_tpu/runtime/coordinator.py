@@ -43,10 +43,22 @@ rank blocked inside a collective can still be aborted because
 Telemetry (PR 2): ``gang_heartbeat_age_s{rank=...}`` gauges track every
 peer's progress age; ``gang_peer_failures`` counts declarations; all
 abort events flush before exit so the post-mortem trace survives.
+
+Observability plane (ISSUE 6): heartbeats are ENRICHED — each beat
+carries a compact metric snapshot (current step, rolling step time
+over the last ``metrics_window`` completed steps, last per-phase
+breakdown) published by :meth:`GangCoordinator.observe_step`, so
+liveness and progress travel on one channel and the gang supervisor's
+straggler detector (``telemetry/aggregator.py``) reads the whole
+gang's health from the beat directory alone.  Advisory verdicts and
+restart/shrink events land in ``gang_health.jsonl``
+(:func:`append_health_event`), the whole-run ledger
+``tools/gang_status.py`` renders.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
@@ -68,6 +80,15 @@ _RESTORE_PREFIX = "restore_rank"
 # they are the whole-run history a post-mortem reads.
 CONSUMED_PREFIX = "consumed_rank"
 
+# The gang health ledger: one JSON line per advisory event the gang
+# supervisor records (straggler verdicts, restarts, shrinks) — the
+# durable half of the observability plane, read back by
+# ``telemetry/aggregator.py::read_health_events`` and
+# ``tools/gang_status.py``.  Whole-run history like the consumption
+# ledgers: survives restarts and shrinks, cleared only at fresh-run
+# init.
+GANG_HEALTH_FILE = "gang_health.jsonl"
+
 
 def _beat_path(gang_dir: str, rank: int) -> str:
     return os.path.join(gang_dir, f"{_BEAT_PREFIX}{rank}.json")
@@ -84,6 +105,19 @@ def _write_atomic(path: str, payload: dict) -> None:
     with open(tmp, "w") as f:
         json.dump(payload, f)
     os.replace(tmp, path)
+
+
+def append_health_event(gang_dir: str | os.PathLike, kind: str,
+                        **fields) -> None:
+    """Record one advisory event in the gang health ledger — flushed
+    immediately (the next supervisor action may be tearing the gang
+    down, and a verdict only in host memory at that point is lost)."""
+    payload = {"kind": kind, "time": time.time(), **fields}
+    gang_dir = os.fspath(gang_dir)
+    os.makedirs(gang_dir, exist_ok=True)
+    with open(os.path.join(gang_dir, GANG_HEALTH_FILE), "a") as f:
+        f.write(json.dumps(payload) + "\n")
+        f.flush()
 
 
 def read_abort(gang_dir: str | os.PathLike) -> dict | None:
@@ -142,6 +176,7 @@ def clear_gang_state(gang_dir: str | os.PathLike,
                 or (restore_records and name.startswith(_RESTORE_PREFIX))
                 or (fault_ledger
                     and (name == FAULT_LEDGER_FILE
+                         or name == GANG_HEALTH_FILE
                          or name.startswith(CONSUMED_PREFIX)))):
             with contextlib.suppress(OSError):
                 os.remove(os.path.join(gang_dir, name))
@@ -283,7 +318,8 @@ class GangCoordinator:
                  *, heartbeat_interval_s: float = 1.0,
                  peer_timeout_s: float = 30.0,
                  exit_code: int = GANG_ABORT_EXIT,
-                 events=None, check_self: bool = True, on_abort=None):
+                 events=None, check_self: bool = True, on_abort=None,
+                 metrics_window: int = 8):
         if world < 1:
             raise ValueError(f"world must be >= 1, got {world}")
         if not 0 <= rank < world:
@@ -314,8 +350,21 @@ class GangCoordinator:
         self._step = 0
         self._done = False
         self._suspended = 0
+        self.suspensions = 0
         self._last_beat = time.monotonic()
         self._valid_steps: set[int] = set()
+        if metrics_window < 1:
+            raise ValueError(
+                f"metrics_window must be >= 1, got {metrics_window}"
+            )
+        # The heartbeat metric snapshot (ISSUE 6): liveness and
+        # progress travel on the same channel, so the supervisor's
+        # straggler detector needs no second file family.  Appends are
+        # GIL-atomic; the monitor thread reads a list() copy.
+        self._step_times: collections.deque[float] = collections.deque(
+            maxlen=metrics_window
+        )
+        self._phases: dict = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._write_lock = threading.Lock()
@@ -333,12 +382,29 @@ class GangCoordinator:
         if step is not None:
             self._step = int(step)
 
+    def observe_step(self, step: int, step_time_s: float,
+                     phases: dict | None = None) -> None:
+        """Record one completed step's wall time (and optional
+        per-phase breakdown, ``{"barrier_wait_s": ..., ...}``) and
+        beat.  The rolling mean over the last ``metrics_window`` steps
+        rides every heartbeat as a compact metric snapshot — the
+        signal the gang supervisor's straggler detector compares
+        across ranks without touching any rank's metrics stream."""
+        self._step_times.append(float(step_time_s))
+        if phases:
+            self._phases = {str(k): float(v) for k, v in phases.items()}
+        self.beat(step)
+
     @contextlib.contextmanager
     def suspend(self):
         """Mark an expected-long non-step phase (checkpoint save, eval,
         compile, rendezvous): peers keep checking that this process is
         ALIVE (the heartbeat file keeps refreshing) but stop judging its
-        progress age.  Re-entrant; beats on exit."""
+        progress age.  Re-entrant; beats on exit.  ``suspensions``
+        counts entries monotonically, so interval-based step timers
+        (``cli/common.py``'s stop-predicate deltas) can tell a pure
+        step apart from one whose interval swallowed an eval or save."""
+        self.suspensions += 1
         self._suspended += 1
         try:
             yield
@@ -454,7 +520,7 @@ class GangCoordinator:
     def _write_beat_locked(self) -> None:
         now = time.monotonic()
         self._seq += 1
-        _write_atomic(_beat_path(self.gang_dir, self.rank), {
+        payload = {
             "rank": self.rank,
             "seq": self._seq,
             "step": self._step,
@@ -462,7 +528,16 @@ class GangCoordinator:
             "suspended": bool(self._suspended),
             "done": self._done,
             "time": time.time(),
-        })
+        }
+        times = list(self._step_times)
+        if times:
+            payload["metrics"] = {
+                "step_time_s": sum(times) / len(times),
+                "last_step_time_s": times[-1],
+                "steps_timed": len(times),
+                "phases": self._phases,
+            }
+        _write_atomic(_beat_path(self.gang_dir, self.rank), payload)
 
     def _telemetry(self):
         from distributed_machine_learning_tpu.telemetry import get_telemetry

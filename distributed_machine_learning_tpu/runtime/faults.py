@@ -154,6 +154,7 @@ class FaultEvents:
     rank_stalls: int = 0        # injected rank stall (stall_rank)
     ckpt_corruptions: int = 0   # injected post-save byte flips (corrupt_ckpt)
     peer_failures: int = 0      # gang detector declared a dead/stalled peer
+    stragglers: int = 0         # advisory: rank flagged slow vs gang median
     gang_restarts: int = 0      # gang supervisor relaunched all workers
     gang_shrinks: int = 0       # gang continued at a smaller world size
     reshard_restores: int = 0   # checkpoint restored onto a different world

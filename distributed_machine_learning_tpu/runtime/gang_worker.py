@@ -37,6 +37,17 @@ across ranks, across restarts, and across world sizes (the loss-curve
 continuity the chaos test asserts).  Checkpoints are saved with a dp
 ``ShardSpec`` recording the world size and restored through
 ``reshard_restore``, which tolerates (and counts) a world-size change.
+
+Observability (ISSUE 6): per-rank telemetry is ON by default — each
+rank streams attempt-tagged step rows, phase spans
+(``barrier_wait``/``compute``) and trace instants into the shared
+``<gang-dir>/telemetry`` under collision-safe rank-suffixed filenames
+(``metrics.rank<orig>.jsonl``, ...), and publishes a rolling
+step-time snapshot on every heartbeat via
+``GangCoordinator.observe_step`` — the inputs to
+``telemetry/aggregator.py``'s cross-rank rollups, the supervisor's
+straggler detector, and the ``gang_status``/``trace_merge`` tools.
+Disable with ``--no-telemetry``.
 """
 
 from __future__ import annotations
@@ -45,6 +56,7 @@ import argparse
 import hashlib
 import json
 import os
+import signal
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -89,9 +101,28 @@ def main(argv=None) -> None:
     ap.add_argument("--heartbeat-interval", type=float, default=0.25)
     ap.add_argument("--peer-timeout", type=float, default=15.0)
     ap.add_argument("--step-sleep", type=float, default=0.02)
-    ap.add_argument("--telemetry-dir", default=None)
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="per-rank telemetry home (default: "
+                         "<gang-dir>/telemetry — the gang plane "
+                         "telemetry/aggregator.py reads)")
+    ap.add_argument("--telemetry-instance", default=None,
+                    help="artifact filename tag (default rank<orig>): "
+                         "N ranks sharing one telemetry dir write "
+                         "metrics.rank<r>.jsonl etc. so appends never "
+                         "interleave")
+    ap.add_argument("--no-telemetry", action="store_true",
+                    help="disable the default-on per-rank telemetry")
     args = ap.parse_args(argv)
     orig_rank = args.rank if args.orig_rank is None else args.orig_rank
+
+    # A drain/preemption SIGTERM becomes a SystemExit raised at the next
+    # bytecode: the exception path below flushes telemetry before dying,
+    # so the terminated attempt's rows and spans survive for the
+    # post-mortem instead of dying in the sink buffer.
+    def _on_term(sig, frame):
+        raise SystemExit(128 + sig)
+
+    signal.signal(signal.SIGTERM, _on_term)
 
     import jax
     import jax.numpy as jnp
@@ -120,15 +151,32 @@ def main(argv=None) -> None:
         resilience_summary,
     )
 
+    # Telemetry is ON by default (ISSUE 6): every rank streams into the
+    # shared <gang-dir>/telemetry with a rank-suffixed instance tag, so
+    # the per-rank artifacts land collision-free in ONE directory the
+    # aggregator / gang_status / trace_merge tools read as a gang plane.
     telemetry = None
-    if args.telemetry_dir:
+    if not args.no_telemetry:
         from distributed_machine_learning_tpu.telemetry import (
             Telemetry,
             set_telemetry,
         )
 
-        telemetry = Telemetry(args.telemetry_dir)
+        tel_dir = args.telemetry_dir or os.path.join(args.gang_dir,
+                                                     "telemetry")
+        instance = (args.telemetry_instance
+                    if args.telemetry_instance is not None
+                    else f"rank{orig_rank}")
+        telemetry = Telemetry(tel_dir, instance=instance or None)
         set_telemetry(telemetry)
+        # Attempt tags must match the supervisor's numbering so the
+        # merged timeline lines up across ranks (set_attempt never
+        # moves backwards — a resumed stream keeps its disk offset).
+        telemetry.set_attempt(args.attempt)
+        telemetry.tracer.instant(
+            "gang_worker_start", rank=args.rank, orig_rank=orig_rank,
+            world=args.world, attempt=args.attempt,
+        )
 
     ckpt_dir = os.path.join(args.ckpt_dir, f"rank{orig_rank}")
     events = FaultEvents()
@@ -247,32 +295,63 @@ def main(argv=None) -> None:
     if injector is not None:
         batches = injector.wrap_batches(batches, events, start=start)
 
-    for idx in batches:
-        # The lock-step barrier: the stand-in for the synchronous
-        # collective — blocks until every peer has published step idx
-        # (a dead peer blocks us here until the detector aborts the
-        # gang, exactly like a hung psum).
-        if not coord.wait_for_peers(idx):
-            break  # test mode only; production aborts the process
-        state = compiled(state,
-                         _global_batch_for_step(idx, args.global_batch))
-        jax.block_until_ready(state.params["w"])
-        record_consumed(idx)
-        coord.beat(step=idx + 1)
-        if args.rank == 0:
-            print(f"step {idx}", flush=True)
-        if (idx + 1) % args.save_every == 0 or idx + 1 == args.steps:
-            # Saves are liveness, not progress: suspend the stall clock
-            # exactly as the watchdog path does.
-            with coord.suspend():
-                save_checkpoint(
-                    ckpt_dir, state, cursor=idx + 1,
-                    post_save_hook=post_save,
-                    shard_spec=ShardSpec("dp", world=args.world),
-                )
-            coord.record_valid_step(int(jax.device_get(state.step)))
-        if args.step_sleep:
-            time.sleep(args.step_sleep)
+    try:
+        for idx in batches:
+            t_start = time.perf_counter()
+            # The lock-step barrier: the stand-in for the synchronous
+            # collective — blocks until every peer has published step
+            # idx (a dead peer blocks us here until the detector aborts
+            # the gang, exactly like a hung psum).
+            if not coord.wait_for_peers(idx):
+                break  # test mode only; production aborts the process
+            t_barrier = time.perf_counter()
+            state = compiled(
+                state, _global_batch_for_step(idx, args.global_batch)
+            )
+            jax.block_until_ready(state.params["w"])
+            t_end = time.perf_counter()
+            record_consumed(idx)
+            iter_s = t_end - t_start
+            phases = {"barrier_wait_s": t_barrier - t_start,
+                      "compute_s": t_end - t_barrier}
+            # One call publishes progress AND the heartbeat metric
+            # snapshot (rolling step time + phase breakdown) the
+            # supervisor's straggler detector compares across ranks.
+            coord.observe_step(idx + 1, iter_s, phases)
+            if telemetry is not None:
+                telemetry.tracer.complete("barrier_wait", t_start,
+                                          t_barrier, step=idx)
+                telemetry.tracer.complete("compute", t_barrier, t_end,
+                                          step=idx)
+                reg = telemetry.registry
+                reg.counter("steps_total").inc()
+                reg.histogram("step_seconds").observe(iter_s)
+                eps = len(local_ids) / iter_s if iter_s > 0 else 0.0
+                reg.gauge("examples_per_s").set(eps)
+                telemetry.log_step(idx, iter_s=iter_s, **phases,
+                                   examples_per_s=eps, rank=args.rank,
+                                   orig_rank=orig_rank, world=args.world)
+            if args.rank == 0:
+                print(f"step {idx}", flush=True)
+            if (idx + 1) % args.save_every == 0 or idx + 1 == args.steps:
+                # Saves are liveness, not progress: suspend the stall
+                # clock exactly as the watchdog path does.
+                with coord.suspend():
+                    save_checkpoint(
+                        ckpt_dir, state, cursor=idx + 1,
+                        post_save_hook=post_save,
+                        shard_spec=ShardSpec("dp", world=args.world),
+                    )
+                coord.record_valid_step(int(jax.device_get(state.step)))
+            if args.step_sleep:
+                time.sleep(args.step_sleep)
+    except SystemExit:
+        # Drained/preempted (the SIGTERM handler above): flush the
+        # attempt's telemetry so its rows and spans reach disk, but
+        # never finish() — a terminated rank is not a finished rank.
+        if telemetry is not None:
+            telemetry.flush()
+        raise
 
     digest = hashlib.sha256(
         np.ascontiguousarray(np.asarray(state.params["w"])).tobytes()
@@ -284,6 +363,11 @@ def main(argv=None) -> None:
         print(resilience_summary(events), flush=True)
     coord.finish()
     if telemetry is not None:
+        telemetry.tracer.instant(
+            "gang_worker_finish", rank=args.rank, orig_rank=orig_rank,
+            world=args.world, attempt=args.attempt,
+            step=int(jax.device_get(state.step)),
+        )
         telemetry.close()
 
 

@@ -157,9 +157,86 @@ class GangFailure(RuntimeError):
         self.returncodes = returncodes
 
 
-def _drain_gang(procs, grace_s: float) -> list[int | None]:
+def _gang_health_check(gang_dir, sampler, detector, active, events, tel,
+                       attempt: int, state: dict) -> None:
+    """One advisory health pass over the gang's heartbeat snapshots —
+    the straggler half of the observability plane (ISSUE 6).
+
+    Feeds the per-rank effective step times (``HeartbeatSampler``:
+    rolling mean, inflated by in-flight time only for the ranks the
+    lock-step barrier is actually waiting on) into the shared
+    ``StragglerDetector``.  Observations are throttled to at most one
+    per gang-median step time (never faster than the poll), so
+    ``consecutive`` keeps its offline meaning — K consecutive *steps*,
+    not K poll ticks — on gangs whose steps outpace the poll.
+    Detection only, this PR: verdicts become
+    ``gang_straggler{rank=...}`` counters, the ``gang_skew_ratio``
+    gauge, ``FaultEvents.stragglers``, a ``gang_health.jsonl`` ledger
+    entry, and a supervisor log line — never an abort (the peer-timeout
+    machinery owns life-and-death; this names the slow rank *before*
+    that machinery has to).  Rank ids in verdicts/counters use the
+    ORIGINAL numbering (``active[cur_rank]``), the identity that
+    survives shrinks.
+    """
+    from distributed_machine_learning_tpu.runtime.coordinator import (
+        append_health_event,
+    )
+    from distributed_machine_learning_tpu.telemetry.aggregator import (
+        median,
+    )
+
+    samples = sampler.sample(gang_dir)
+    stimes = [s.step_time_s for s in samples.values()
+              if s.step_time_s is not None]
+    now = time.monotonic()
+    if stimes and now - state.get("last_feed", 0.0) < median(stimes):
+        return
+    state["last_feed"] = now
+    feed = {r: s.eff_step_time_s for r, s in samples.items()
+            if not s.done and not s.suspended}
+    verdicts = detector.update(feed)
+    if tel is not None and detector.skew_ratio:
+        tel.registry.gauge("gang_skew_ratio").set(detector.skew_ratio)
+    for v in verdicts:
+        orig = active[v.rank] if 0 <= v.rank < len(active) else v.rank
+        if events is not None:
+            events.stragglers += 1
+        if tel is not None:
+            tel.registry.counter("gang_straggler", rank=str(orig)).inc()
+            tel.tracer.instant("gang_straggler", rank=orig,
+                               ratio=round(v.ratio, 2))
+            tel.flush()
+        step = samples[v.rank].step if v.rank in samples else None
+        append_health_event(
+            gang_dir, "straggler", rank=orig, cur_rank=v.rank,
+            attempt=attempt, step=step, ratio=round(v.ratio, 3),
+            value_s=v.value_s, median_s=v.median_s,
+        )
+        rank0_print(
+            f"[gang] straggler advisory: rank {orig} step time "
+            f"{v.value_s:.3f}s is {v.ratio:.1f}x the gang median "
+            f"{v.median_s:.3f}s ({v.streak} consecutive observations; "
+            "detection only — peer-timeout policy unchanged)"
+        )
+
+
+def _drain_gang(procs, grace_s: float,
+                join_s: float = 2.0) -> list[int | None]:
     """Terminate (then kill) every still-running worker; returns the
-    final returncodes."""
+    final returncodes.
+
+    Before terminating, waits up to ``join_s`` for the survivors to
+    exit on their own: when one rank dies, the others' monitors join
+    the coordinated abort within a heartbeat poll — and that self-exit
+    path FLUSHES their telemetry (the abort handler's ``tel.flush()``),
+    while a SIGTERM racing it would drop every buffered row and span
+    of the attempt being diagnosed.  Workers that are genuinely hung
+    still get terminated (then killed) on the old schedule.
+    """
+    deadline = time.monotonic() + join_s
+    while (time.monotonic() < deadline
+           and any(p.poll() is None for p in procs)):
+        time.sleep(0.05)
     for p in procs:
         if p.poll() is None:
             with contextlib.suppress(OSError):
@@ -207,7 +284,9 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                    min_world: int | None = None,
                    events: FaultEvents | None = None,
                    poll_s: float = 0.2, grace_s: float = 10.0,
-                   env=None, log_dir=None) -> list[int]:
+                   env=None, log_dir=None,
+                   straggler_multiple: float = 4.0,
+                   straggler_consecutive: int = 3) -> list[int]:
     """Run a gang of ``world`` worker processes to completion, restarting
     ALL of them together on any failure — the multi-host analogue of
     :func:`run_attempts` — and, when allowed, SHRINKING past ranks that
@@ -262,6 +341,16 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
     ``log_dir``: when given, each worker's stdout+stderr streams to
     ``rank<r>.attempt<k>.log`` there (current-numbering rank) — the
     gang post-mortem surface.
+
+    Advisory health (ISSUE 6): every poll also runs the straggler
+    detector over the heartbeat metric snapshots — a rank whose
+    effective step time exceeds ``straggler_multiple`` x the gang
+    median for ``straggler_consecutive`` observations is flagged
+    (``gang_straggler{rank}`` counter, ``gang_skew_ratio`` gauge,
+    ``FaultEvents.stragglers``, a ``gang_health.jsonl`` entry, and a
+    log line) WITHOUT any change to restart policy — the flag names
+    the culprit before the peer-timeout abort has to guess, and is the
+    hook a later backup-worker/elastic-grow policy will consume.
     """
     import subprocess
 
@@ -274,11 +363,18 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
     from distributed_machine_learning_tpu.runtime.coordinator import (
         GANG_ABORT_EXIT,
     )
+    from distributed_machine_learning_tpu.runtime.coordinator import (
+        append_health_event,
+    )
     from distributed_machine_learning_tpu.runtime.faults import (
         FAULT_LEDGER_FILE,
         ledger_lost_ranks,
     )
     from distributed_machine_learning_tpu.telemetry import get_telemetry
+    from distributed_machine_learning_tpu.telemetry.aggregator import (
+        HeartbeatSampler,
+        StragglerDetector,
+    )
 
     if world < 1:
         raise ValueError(f"world must be >= 1, got {world}")
@@ -331,6 +427,12 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         span = (tel.span("gang_attempt", attempt=restarts,
                          world=cur_world)
                 if tel is not None else contextlib.nullcontext())
+        # Fresh per attempt: the beat files were just cleared, and a
+        # straggler episode must not carry a streak across a relaunch.
+        sampler = HeartbeatSampler()
+        detector = StragglerDetector(multiple=straggler_multiple,
+                                     consecutive=straggler_consecutive)
+        health_state: dict = {}
         procs, logs = [], []
         try:
             with span:
@@ -365,6 +467,21 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                     if all(c == 0 for c in codes):
                         return list(codes)  # the gang finished cleanly
                     time.sleep(poll_s)
+                    if health_state.get("broken"):
+                        continue
+                    try:
+                        _gang_health_check(gang_dir, sampler, detector,
+                                           active, events, tel,
+                                           restarts, health_state)
+                    except Exception as exc:
+                        # Advisory means advisory: a broken health pass
+                        # (disk-full health ledger, torn dir) must not
+                        # take down the gang it observes.
+                        health_state["broken"] = True
+                        rank0_print(
+                            "[gang] health advisory disabled for this "
+                            f"attempt: {type(exc).__name__}: {exc}"
+                        )
         finally:
             final_codes = _drain_gang(procs, grace_s)
             for out in logs:
@@ -407,6 +524,11 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         if tel is not None:
             tel.registry.counter("gang_restarts").inc()
             tel.flush()
+        # The health ledger keeps the restart/shrink history the status
+        # tool renders (beat files and the abort latch are about to be
+        # cleared; this line is what survives).
+        append_health_event(gang_dir, "restart", attempt=restarts,
+                            world=cur_world, why=why)
         if unrecoverable:
             survivors = [o for o in active if o not in unrecoverable]
             lost_s = sorted(unrecoverable)
@@ -443,6 +565,11 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                     to_world=len(survivors), lost=lost_s,
                 )
                 tel.flush()
+            append_health_event(
+                gang_dir, "shrink", attempt=restarts,
+                from_world=cur_world, to_world=len(survivors),
+                lost=lost_s, restore_step=elected,
+            )
             rank0_print(
                 f"[gang] {why}; rank(s) {lost_s} unrecoverable — "
                 f"shrinking to {len(survivors)} survivor(s) "

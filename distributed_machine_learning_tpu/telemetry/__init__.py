@@ -55,19 +55,47 @@ from distributed_machine_learning_tpu.telemetry.tracer import (
     SpanTracer,
     read_trace,
 )
+from distributed_machine_learning_tpu.telemetry.aggregator import (
+    GangRollup,
+    HeartbeatSampler,
+    StragglerDetector,
+    StragglerVerdict,
+    aggregate_gang_metrics,
+    discover_rank_streams,
+    publish_rollup,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "JsonlSink", "read_jsonl", "write_prometheus",
     "SpanTracer", "read_trace",
+    "GangRollup", "HeartbeatSampler", "StragglerDetector",
+    "StragglerVerdict", "aggregate_gang_metrics",
+    "discover_rank_streams", "publish_rollup",
     "Telemetry", "telemetry_from_flags",
-    "get_telemetry", "set_telemetry",
+    "get_telemetry", "set_telemetry", "instance_file",
 ]
 
 METRICS_FILE = "metrics.jsonl"
 TRACE_FILE = "trace.json"
 REGISTRY_FILE = "registry.json"
 PROM_FILE = "metrics.prom"
+
+
+def instance_file(name: str, instance: str | None) -> str:
+    """``metrics.jsonl`` + instance ``rank2`` -> ``metrics.rank2.jsonl``.
+
+    The collision-safety contract: two processes pointed at the SAME
+    telemetry directory must never append to the same stream (append
+    interleaving welds their rows into garbage neither reader
+    tolerates), so each gets an instance tag spliced in front of the
+    extension.  ``None`` keeps the canonical single-process names."""
+    if not instance:
+        return name
+    if "/" in instance or os.sep in instance:
+        raise ValueError(f"instance must be a bare tag, got {instance!r}")
+    stem, dot, ext = name.rpartition(".")
+    return f"{stem}.{instance}{dot}{ext}" if dot else f"{name}.{instance}"
 
 
 def _last_attempt_on_disk(path: str) -> int | None:
@@ -130,13 +158,23 @@ class Telemetry:
     ``attempt`` starts after the last attempt already on disk (a
     supervisor re-exec into the same directory appends as attempt N+1);
     in-process restarts advance it via :meth:`set_attempt`.
+
+    ``instance``: a per-process tag (e.g. ``rank2``) spliced into every
+    artifact filename (``metrics.rank2.jsonl``, ``trace.rank2.json``,
+    ...) so N processes can share one telemetry directory without their
+    appends ever interleaving — the gang layout
+    ``telemetry/aggregator.py`` reads back as one cross-rank plane.
     """
 
     def __init__(self, out_dir: str | os.PathLike, flush_every: int = 20,
-                 enabled: bool | None = None, fsync: bool = True):
+                 enabled: bool | None = None, fsync: bool = True,
+                 instance: str | None = None):
         self.out_dir = os.fspath(out_dir)
+        self.instance = instance or None
         self.registry = MetricsRegistry()
-        metrics_path = os.path.join(self.out_dir, METRICS_FILE)
+        metrics_path = os.path.join(
+            self.out_dir, instance_file(METRICS_FILE, self.instance)
+        )
         prior = _last_attempt_on_disk(metrics_path)
         self.attempt = 0 if prior is None else prior + 1
         if prior is not None:
@@ -146,18 +184,21 @@ class Telemetry:
             # the append-not-truncate contract of the other artifacts.
             # Gauges are instantaneous and histogram snapshots hold only
             # quantiles (not bucket counts), so those restart.
-            _rehydrate_counters(
-                os.path.join(self.out_dir, REGISTRY_FILE), self.registry
-            )
+            _rehydrate_counters(self._artifact(REGISTRY_FILE),
+                                self.registry)
         self.metrics = JsonlSink(metrics_path, flush_every=flush_every,
                                  fsync=fsync, enabled=enabled)
-        self.tracer = SpanTracer(os.path.join(self.out_dir, TRACE_FILE),
+        self.tracer = SpanTracer(self._artifact(TRACE_FILE),
                                  flush_every=flush_every, enabled=enabled)
         # Optional cost model for MFU: the CLI sets whichever it knows.
         self.flops_per_example: float | None = None
         self.flops_per_token: float | None = None
         self.peak_tflops: float | None = None
         self._closed = False
+
+    def _artifact(self, name: str) -> str:
+        return os.path.join(self.out_dir,
+                            instance_file(name, self.instance))
 
     # -- per-step surface ------------------------------------------------
     def log_step(self, step: int, **metrics) -> None:
@@ -211,13 +252,12 @@ class Telemetry:
         if not self.metrics.enabled:
             return
         os.makedirs(self.out_dir, exist_ok=True)
-        snap_path = os.path.join(self.out_dir, REGISTRY_FILE)
+        snap_path = self._artifact(REGISTRY_FILE)
         tmp = snap_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(self.registry.snapshot(), f, indent=1)
         os.replace(tmp, snap_path)
-        write_prometheus(os.path.join(self.out_dir, PROM_FILE),
-                         self.registry)
+        write_prometheus(self._artifact(PROM_FILE), self.registry)
 
     def close(self) -> None:
         if self._closed:

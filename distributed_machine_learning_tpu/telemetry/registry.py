@@ -207,12 +207,22 @@ class MetricsRegistry:
         One ``# TYPE`` line per metric FAMILY (name), with every label
         series grouped under it — the exposition format allows at most
         one TYPE per family, and promtool rejects duplicates.
+
+        Label VALUES are escaped per the exposition format (backslash,
+        double-quote, newline): an abort reason or fault spec carried
+        as a label would otherwise break the line grammar and take the
+        whole textfile down with it — the scrape that fails is exactly
+        the post-mortem one.
         """
+
+        def esc(v) -> str:
+            return (str(v).replace("\\", r"\\").replace('"', r"\"")
+                    .replace("\n", r"\n"))
 
         def fmt(name, labels, value, extra_labels=()):
             pairs = [*labels, *extra_labels]
-            lab = ("{" + ",".join(f'{k}="{v}"' for k, v in pairs) + "}"
-                   if pairs else "")
+            lab = ("{" + ",".join(f'{k}="{esc(v)}"' for k, v in pairs)
+                   + "}" if pairs else "")
             return f"{name}{lab} {value}"
 
         with self._lock:

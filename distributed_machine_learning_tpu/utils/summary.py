@@ -64,6 +64,7 @@ _EVENT_LABELS = {
     "rank_stalls": "injected rank stalls",
     "ckpt_corruptions": "injected checkpoint corruptions",
     "peer_failures": "gang peers declared dead/stalled",
+    "stragglers": "straggler advisories (slow ranks)",
     "gang_restarts": "gang coordinated restarts",
     "gang_shrinks": "gang shrinks to survivors",
     "reshard_restores": "restores resharded across world sizes",

@@ -37,6 +37,31 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Every custom marker used in tests/ must be registered in
+    pytest.ini — tier-1 headroom depends on ``slow``/``faultinject``
+    gating, and a typo'd marker (``@pytest.mark.solw``) silently pulls
+    a heavy test back into the default run instead of failing loudly.
+    pytest core registers its own built-ins (parametrize, skipif, ...)
+    through the same ini mechanism, so one registry covers both."""
+    registered = {
+        line.split(":", 1)[0].split("(", 1)[0].strip()
+        for line in config.getini("markers")
+    }
+    unknown = {}
+    for item in items:
+        for mark in item.iter_markers():
+            if mark.name not in registered:
+                unknown.setdefault(mark.name, item.nodeid)
+    if unknown:
+        raise pytest.UsageError(
+            "unregistered pytest marker(s) used in tests/: "
+            + "; ".join(f"{name!r} (first use: {nodeid})"
+                        for name, nodeid in sorted(unknown.items()))
+            + " — register them under [pytest] markers in pytest.ini"
+        )
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -1,0 +1,645 @@
+"""Gang-wide observability plane (ISSUE 6): cross-rank metric
+aggregation, the straggler detector (offline over metrics streams and
+live over heartbeat snapshots), heartbeat enrichment, collision-safe
+per-rank telemetry, the ``gang_status``/``trace_merge`` tools, and the
+chaos proof — a 4-worker gang whose stalled rank is flagged by the
+advisory detector *before* the peer-timeout abort tears the gang down.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributed_machine_learning_tpu.runtime.coordinator import (
+    GANG_HEALTH_FILE,
+    GangCoordinator,
+    append_health_event,
+    clear_gang_state,
+)
+from distributed_machine_learning_tpu.runtime.faults import FaultEvents
+from distributed_machine_learning_tpu.runtime.supervisor import (
+    gang_supervise,
+)
+from distributed_machine_learning_tpu.telemetry import (
+    MetricsRegistry,
+    SpanTracer,
+    Telemetry,
+    aggregate_gang_metrics,
+    discover_rank_streams,
+    instance_file,
+    read_jsonl,
+)
+from distributed_machine_learning_tpu.telemetry.aggregator import (
+    HeartbeatSampler,
+    StragglerDetector,
+    publish_rollup,
+    read_beats,
+    read_health_events,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_rows(path, rows):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "a") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def _row(step, iter_s, *, attempt=0, eps=100.0, **extra):
+    return {"step": step, "iter_s": iter_s, "attempt": attempt,
+            "examples_per_s": eps, "barrier_wait_s": iter_s * 0.25,
+            "compute_s": iter_s * 0.75, **extra}
+
+
+# ---------------------------------------------------------------------------
+# Telemetry instance suffix: sink collision safety (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_instance_file_splices_tag():
+    assert instance_file("metrics.jsonl", "rank3") == "metrics.rank3.jsonl"
+    assert instance_file("trace.json", "rank0") == "trace.rank0.json"
+    assert instance_file("metrics.jsonl", None) == "metrics.jsonl"
+    with pytest.raises(ValueError):
+        instance_file("metrics.jsonl", "a/b")
+
+
+def test_shared_dir_instances_never_interleave(tmp_path):
+    """Regression (satellite): two processes pointed at the SAME
+    telemetry dir must land in separate streams — interleaved appends
+    would weld rows into garbage.  With instance tags, each stream
+    parses completely and carries only its own rows; the canonical
+    single-process filenames are untouched."""
+    tels = {r: Telemetry(tmp_path, instance=f"rank{r}", enabled=True)
+            for r in (0, 1)}
+    for step in range(30):
+        for r, tel in tels.items():
+            tel.log_step(step, iter_s=0.01 + r, rank=r)
+    for r, tel in tels.items():
+        tel.tracer.instant("gang_worker_finish", rank=r)
+        tel.close()
+    for r in (0, 1):
+        path = tmp_path / f"metrics.rank{r}.jsonl"
+        rows = read_jsonl(path)  # raises on any mid-file corruption
+        assert len(rows) == 30
+        assert all(row["rank"] == r for row in rows)
+        assert (tmp_path / f"registry.rank{r}.json").exists()
+        assert (tmp_path / f"trace.rank{r}.json").exists()
+    assert not (tmp_path / "metrics.jsonl").exists()
+    # Attempt numbering resumes per-instance, not from a neighbor.
+    again = Telemetry(tmp_path, instance="rank1", enabled=True)
+    assert again.attempt == 1
+    again.close()
+
+
+# ---------------------------------------------------------------------------
+# Aggregator: discovery + cross-rank rollups
+# ---------------------------------------------------------------------------
+
+
+def test_discover_rank_streams_both_layouts(tmp_path):
+    _write_rows(str(tmp_path / "metrics.rank0.jsonl"), [_row(0, 0.01)])
+    _write_rows(str(tmp_path / "rank1" / "metrics.jsonl"),
+                [_row(0, 0.01)])
+    (tmp_path / "rank2").mkdir()  # no metrics: not a stream
+    streams = discover_rank_streams(tmp_path)
+    assert sorted(streams) == [0, 1]
+    assert streams[0]["metrics"].endswith("metrics.rank0.jsonl")
+    assert streams[1]["metrics"].endswith(os.path.join("rank1",
+                                                       "metrics.jsonl"))
+    assert discover_rank_streams(tmp_path / "nope") == {}
+
+
+def test_aggregate_cross_rank_rollups(tmp_path):
+    # Rank 2 runs 3x slower than ranks 0/1 on every step.
+    for r in (0, 1, 2):
+        speed = 0.03 if r == 2 else 0.01
+        _write_rows(str(tmp_path / f"metrics.rank{r}.jsonl"),
+                    [_row(s, speed, eps=1.0 / speed) for s in range(6)])
+    rollup = aggregate_gang_metrics(tmp_path, multiple=2.0,
+                                    consecutive=2)
+    assert rollup.ranks == [0, 1, 2]
+    assert len(rollup.steps) == 6
+    step0 = rollup.steps[0]
+    assert step0["iter_s"]["min"] == pytest.approx(0.01)
+    assert step0["iter_s"]["median"] == pytest.approx(0.01)
+    assert step0["iter_s"]["max"] == pytest.approx(0.03)
+    assert step0["skew"] == pytest.approx(3.0)
+    assert step0["phases"]["compute_s"]["max"] == pytest.approx(0.0225)
+    assert step0["examples_per_s"]["2"] == pytest.approx(1 / 0.03)
+    assert rollup.skew["p95"] == pytest.approx(3.0)
+    # Offline detector: rank 2 is flagged once (one episode), with the
+    # step of the verdict recorded.
+    assert [v["rank"] for v in rollup.stragglers] == [2]
+    assert rollup.stragglers[0]["ratio"] == pytest.approx(3.0)
+    assert rollup.per_rank[2]["iter_s_mean"] == pytest.approx(0.03)
+    assert rollup.per_rank[0]["rows"] == 6
+    assert sorted(rollup.phases) == ["barrier_wait_s", "compute_s"]
+
+
+def test_aggregate_last_attempt_wins_and_tolerates_torn_line(tmp_path):
+    p = str(tmp_path / "metrics.rank0.jsonl")
+    _write_rows(p, [_row(s, 0.01, attempt=0) for s in range(4)])
+    # Attempt 1 replays steps 2..3 with different timings; its rows are
+    # authoritative.  Warm-up rows never enter the rollup.
+    _write_rows(p, [_row(2, 0.05, attempt=1),
+                    _row(3, 0.05, attempt=1),
+                    dict(_row(4, 9.9, attempt=1), warmup=True)])
+    _write_rows(str(tmp_path / "metrics.rank1.jsonl"),
+                [_row(s, 0.01, attempt=a)
+                 for a, s in [(0, 0), (0, 1), (0, 2), (1, 2), (1, 3)]])
+    with open(p, "a") as f:
+        f.write('{"step": 5, "iter_s": 0.0')  # kill mid-write
+    rollup = aggregate_gang_metrics(tmp_path)
+    by_step = {e["step"]: e for e in rollup.steps}
+    assert sorted(by_step) == [0, 1, 2, 3]  # warmup + torn rows dropped
+    assert by_step[2]["iter_s"]["max"] == pytest.approx(0.05)
+    assert by_step[2]["skew"] == pytest.approx(0.05 / 0.03)
+    assert rollup.per_rank[0]["attempts"] == [0, 1]
+
+
+def test_publish_rollup_mirrors_into_registry(tmp_path):
+    for r in (0, 1, 2):
+        _write_rows(str(tmp_path / f"metrics.rank{r}.jsonl"),
+                    [_row(s, 0.09 if r == 1 else 0.01) for s in range(5)])
+    rollup = aggregate_gang_metrics(tmp_path, multiple=3.0,
+                                    consecutive=2)
+    reg = MetricsRegistry()
+    publish_rollup(rollup, reg)
+    snap = reg.snapshot()
+    counters = {(c["name"], c["labels"].get("rank")): c["value"]
+                for c in snap["counters"]}
+    assert counters[("gang_straggler", "1")] == 1
+    gauges = {g["name"]: g["value"] for g in snap["gauges"]}
+    assert gauges["gang_skew_ratio"] == pytest.approx(9.0)
+
+
+# ---------------------------------------------------------------------------
+# StragglerDetector semantics
+# ---------------------------------------------------------------------------
+
+
+def test_straggler_detector_flags_after_consecutive():
+    d = StragglerDetector(multiple=3.0, consecutive=3)
+    sample = {0: 0.01, 1: 0.01, 2: 0.01, 3: 0.2}
+    assert d.update(sample) == []
+    assert d.update(sample) == []
+    verdicts = d.update(sample)
+    assert [v.rank for v in verdicts] == [3]
+    assert verdicts[0].ratio == pytest.approx(20.0)
+    assert verdicts[0].streak == 3
+    assert d.skew_ratio == pytest.approx(20.0)
+    # Already flagged: the same episode never re-fires.
+    assert d.update(sample) == []
+    assert d.flags_total == 1
+
+
+def test_straggler_detector_recovery_rearms():
+    d = StragglerDetector(multiple=3.0, consecutive=2)
+    slow = {0: 0.01, 1: 0.01, 2: 0.1}
+    ok = {0: 0.01, 1: 0.01, 2: 0.01}
+    d.update(slow)
+    assert [v.rank for v in d.update(slow)] == [2]
+    d.update(ok)  # recovery: unflag + streak reset
+    assert 2 not in d.flagged
+    d.update(slow)
+    assert [v.rank for v in d.update(slow)] == [2]  # a NEW episode
+    assert d.flags_total == 2
+
+
+def test_straggler_detector_needs_a_gang():
+    d = StragglerDetector(multiple=2.0, consecutive=1)
+    assert d.update({0: 5.0}) == []          # one rank is not a gang
+    assert d.update({0: 5.0, 1: None}) == []  # None = no timing yet
+    assert d.update({0: 0.0, 1: 0.0}) == []   # zero median: no verdict
+    with pytest.raises(ValueError):
+        StragglerDetector(multiple=1.0)
+    with pytest.raises(ValueError):
+        StragglerDetector(consecutive=0)
+    with pytest.raises(ValueError):
+        StragglerDetector(min_ranks=1)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat enrichment + live sampling
+# ---------------------------------------------------------------------------
+
+HB = 0.05
+TIMEOUT = 30.0  # generous: these tests never want a real abort
+
+
+def test_heartbeat_carries_metric_snapshot(tmp_path):
+    c = GangCoordinator(tmp_path, rank=0, world=2,
+                        heartbeat_interval_s=HB, peer_timeout_s=TIMEOUT,
+                        check_self=False, on_abort=lambda r: None,
+                        metrics_window=4).start()
+    try:
+        for i in range(6):
+            c.observe_step(i + 1, 0.02,
+                           {"barrier_wait_s": 0.005, "compute_s": 0.015})
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            payload = read_beats(tmp_path).get(0)
+            if payload and "metrics" in payload:
+                break
+            time.sleep(0.01)
+        m = payload["metrics"]
+        assert payload["step"] == 6
+        assert m["steps_timed"] == 4  # window, not whole history
+        assert m["step_time_s"] == pytest.approx(0.02)
+        assert m["last_step_time_s"] == pytest.approx(0.02)
+        assert m["phases"] == {"barrier_wait_s": 0.005,
+                               "compute_s": 0.015}
+    finally:
+        c.stop()
+    with pytest.raises(ValueError):
+        GangCoordinator(tmp_path, rank=0, world=1, metrics_window=0)
+
+
+def _beat(rank, step, *, beat_age=0.0, seq=1, step_time=None,
+          suspended=False, done=False):
+    payload = {"rank": rank, "seq": seq, "step": step,
+               "beat_age": beat_age, "suspended": suspended,
+               "done": done, "time": time.time()}
+    if step_time is not None:
+        payload["metrics"] = {"step_time_s": step_time,
+                              "last_step_time_s": step_time,
+                              "steps_timed": 4, "phases": {}}
+    return payload
+
+
+def _write_beats(gang_dir, payloads):
+    os.makedirs(gang_dir, exist_ok=True)
+    for p in payloads:
+        with open(os.path.join(gang_dir, f"beat_rank{p['rank']}.json"),
+                  "w") as f:
+            json.dump(p, f)
+
+
+def test_sampler_inflates_only_the_barrier_holder(tmp_path):
+    """The attribution rule: in-flight time counts only against ranks
+    at the gang's minimum published step (the ones the lock-step
+    barrier waits on) — blocked-but-ahead ranks keep their rolling
+    mean, so the median stays honest and the true straggler stands
+    out."""
+    gang = str(tmp_path)
+    sampler = HeartbeatSampler()
+    _write_beats(gang, [
+        _beat(0, step=8, step_time=0.01),
+        _beat(1, step=7, step_time=0.01),               # min: stalled
+        _beat(2, step=8, step_time=0.01),
+        _beat(3, step=9, step_time=0.01, suspended=True),
+        _beat(4, step=12, step_time=0.01, done=True),
+    ])
+    sampler.sample(gang)           # first sight: seq baselines
+    time.sleep(0.25)               # no beat rewrites: files frozen
+    samples = sampler.sample(gang)
+    assert samples[1].eff_step_time_s >= 0.25  # holder: age counts
+    assert samples[0].eff_step_time_s == pytest.approx(0.01)
+    assert samples[2].eff_step_time_s == pytest.approx(0.01)
+    assert samples[3].eff_step_time_s == pytest.approx(0.01)  # suspended
+    assert samples[4].done and samples[1].step == 7
+    # Fed to the detector, only rank 1 crosses the threshold.
+    d = StragglerDetector(multiple=4.0, consecutive=1)
+    feed = {r: s.eff_step_time_s for r, s in samples.items()
+            if not s.done and not s.suspended}
+    assert [v.rank for v in d.update(feed)] == [1]
+
+
+def test_sampler_no_timing_published_is_no_judgement(tmp_path):
+    sampler = HeartbeatSampler()
+    _write_beats(str(tmp_path), [_beat(0, step=0), _beat(1, step=0)])
+    samples = sampler.sample(str(tmp_path))
+    assert all(s.eff_step_time_s is None for s in samples.values())
+    d = StragglerDetector(multiple=2.0, consecutive=1)
+    assert d.update({r: s.eff_step_time_s
+                     for r, s in samples.items()}) == []
+
+
+# ---------------------------------------------------------------------------
+# gang_supervise: live advisory (stub workers, no jax)
+# ---------------------------------------------------------------------------
+
+
+_STALL_STUB = """\
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from distributed_machine_learning_tpu.runtime.coordinator import (
+    GangCoordinator,
+)
+rank, world = {rank}, {world}
+gang = os.path.join({root!r}, "gang")
+c = GangCoordinator(gang, rank=rank, world=world,
+                    heartbeat_interval_s=0.05, peer_timeout_s=30.0,
+                    check_self=False, on_abort=lambda r: None).start()
+end = time.monotonic() + 2.0
+step = 0
+while time.monotonic() < end:
+    if rank == 1 and step >= 3:
+        time.sleep(0.05)   # stalled: progress frozen at step 3
+        continue
+    step += 1
+    c.observe_step(step, 0.01)
+    time.sleep(0.02)
+c.finish()
+"""
+
+
+def test_gang_supervise_flags_live_straggler(tmp_path):
+    """Three stub ranks heartbeat through a real gang dir; rank 1
+    freezes its progress mid-run.  The supervisor's poll loop must flag
+    it (events.stragglers, a gang_health.jsonl verdict keyed to the
+    ORIGINAL rank) while the gang still finishes cleanly — advisory
+    detection changes no policy."""
+
+    def worker_cmd(rank, attempt, world, orig_rank):
+        code = _STALL_STUB.format(repo=REPO, rank=rank, world=world,
+                                  root=str(tmp_path))
+        return [sys.executable, "-c", code]
+
+    events = FaultEvents()
+    codes = gang_supervise(
+        worker_cmd, 3, tmp_path / "gang", events=events, poll_s=0.05,
+        straggler_multiple=3.0, straggler_consecutive=2,
+    )
+    assert codes == [0, 0, 0]
+    assert events.stragglers >= 1
+    verdicts = [e for e in read_health_events(tmp_path / "gang")
+                if e["kind"] == "straggler"]
+    assert verdicts and all(v["rank"] == 1 for v in verdicts)
+    assert verdicts[0]["ratio"] > 3.0
+    assert events.gang_restarts == 0  # advisory only: no relaunch
+
+
+def test_gang_supervise_records_restart_history(tmp_path):
+    """The health ledger keeps the restart history the status tool
+    renders — and a fresh supervision run starts it clean."""
+    append_health_event(tmp_path / "gang", "straggler", rank=9)
+
+    body = ("import sys\n"
+            "sys.exit(7 if {attempt} == 0 and {rank} == 0 else 0)\n")
+
+    def worker_cmd(rank, attempt, world, orig_rank):
+        return [sys.executable, "-c",
+                body.format(rank=rank, attempt=attempt)]
+
+    events = FaultEvents()
+    codes = gang_supervise(worker_cmd, 2, tmp_path / "gang",
+                           events=events, poll_s=0.05, max_restarts=2)
+    assert codes == [0, 0]
+    health = read_health_events(tmp_path / "gang")
+    assert all(e.get("rank") != 9 for e in health)  # stale run cleared
+    restarts = [e for e in health if e["kind"] == "restart"]
+    assert len(restarts) == 1 and restarts[0]["attempt"] == 1
+    assert "exited 7" in restarts[0]["why"]
+    assert events.stragglers == 0  # instant exits: nothing to judge
+
+
+def test_clear_gang_state_groups_health_with_run_history(tmp_path):
+    append_health_event(tmp_path, "straggler", rank=1)
+    clear_gang_state(tmp_path)  # between attempts: history kept
+    assert (tmp_path / GANG_HEALTH_FILE).exists()
+    clear_gang_state(tmp_path, restore_records=True)  # fresh run
+    assert not (tmp_path / GANG_HEALTH_FILE).exists()
+
+
+# ---------------------------------------------------------------------------
+# tools/gang_status.py + tools/trace_merge.py (stdlib CLIs)
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_gang(tmp_path):
+    gang = str(tmp_path / "gang")
+    tel = os.path.join(gang, "telemetry")
+    _write_beats(gang, [
+        _beat(0, step=12, step_time=0.01, done=True),
+        _beat(1, step=8, beat_age=55.0, step_time=0.04),
+    ])
+    # An OLD verdict (attempt 0, rank 0) must NOT flag the live table —
+    # only the latest attempt's verdicts are current state, matched by
+    # CURRENT rank numbering (cur_rank), not original identity.
+    append_health_event(gang, "straggler", rank=0, cur_rank=0, attempt=0,
+                        step=2, ratio=4.2, value_s=0.042,
+                        median_s=0.01)
+    append_health_event(gang, "restart", attempt=1, world=2,
+                        why="rank 1 exited 21")
+    append_health_event(gang, "shrink", attempt=2, from_world=2,
+                        to_world=1, lost=[3], restore_step=5)
+    append_health_event(gang, "straggler", rank=2, cur_rank=1, attempt=2,
+                        step=8, ratio=5.5, value_s=0.055,
+                        median_s=0.01)
+    with open(os.path.join(gang, "faults_fired.jsonl"), "w") as f:
+        f.write(json.dumps({"index": 0, "kind": "kill_rank", "at": 7,
+                            "rank": 1}) + "\n")
+    for r in (0, 1):
+        _write_rows(os.path.join(tel, f"metrics.rank{r}.jsonl"),
+                    [_row(s, 0.04 if r else 0.01) for s in range(5)])
+    return gang
+
+
+def test_gang_status_tool_renders_and_dumps(tmp_path, capsys):
+    tool = _load_tool("gang_status")
+    gang = _synthetic_gang(tmp_path)
+    assert tool.main([gang]) == 0
+    out = capsys.readouterr().out
+    assert "2 rank(s) heartbeating" in out
+    assert "DONE" in out and "STRAGGLER" in out
+    assert "straggler: rank 2 at step 8" in out  # history: orig ids
+    assert "restart #1" in out and "rank 1 exited 21" in out
+    assert "shrink @attempt 2: 2 -> 1" in out
+    assert "fault fired: kill_rank rank 1" in out
+    assert "skew" in out and "rank 0: 5 step row(s)" in out
+    assert tool.main([gang, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["world"] == 2
+    # Live flag: latest attempt's verdict, keyed by CURRENT rank —
+    # cur rank 1 (orig 2) is flagged, and the stale attempt-0 verdict
+    # against rank 0 is history, not state.
+    assert payload["ranks"][1]["straggler"] is True
+    assert payload["ranks"][0]["straggler"] is False
+    # Two ranks: the median is the midpoint of (0.01, 0.04), so the
+    # skew ratio is 0.04 / 0.025.
+    assert payload["rollup"]["skew"]["max"] == pytest.approx(1.6)
+    assert tool.main([str(tmp_path / "missing")]) == 2
+
+
+def test_trace_merge_fuses_one_track_per_rank(tmp_path, capsys):
+    tel = tmp_path / "tel"
+    tel.mkdir()
+    tr = SpanTracer(tel / "trace.rank0.json", enabled=True)
+    t0 = tr.now()
+    tr.complete("compute", t0, t0 + 0.01, step=0)
+    tr.instant("gang_worker_start", attempt=0, rank=0)
+    tr.close()
+    # Rank 1 died mid-write: unterminated array + torn final event.
+    (tel / "trace.rank1.json").write_text(
+        '[\n{"name": "barrier_wait", "ph": "X", "ts": 5.0, "dur": 2.0,'
+        ' "pid": 0, "tid": 9},\n{"name": "torn_ev'
+    )
+    tool = _load_tool("trace_merge")
+    assert tool.main([str(tel)]) == 0
+    out = capsys.readouterr().out
+    assert "2 rank(s)" in out and "rank1:1" in out
+    with open(tel / "trace.merged.json") as f:
+        merged = json.load(f)  # strictly-valid JSON, always
+    events = merged["traceEvents"]
+    assert {e["pid"] for e in events} == {0, 1}
+    names = {(e["pid"], e["name"]) for e in events}
+    assert (1, "barrier_wait") in names  # re-homed from its local pid 0
+    assert (1, "torn_ev") not in names and (1, "torn_ev'") not in names
+    meta = {e["pid"]: e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert meta == {0: "rank 0", 1: "rank 1"}
+    # An empty dir is an explicit error, not an empty timeline.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert tool.main([str(empty)]) == 2
+
+
+def test_trace_summary_counts_instants(tmp_path):
+    """Satellite fix: trace instants (fault/shrink markers) used to be
+    silently dropped; they now land in the per-phase table."""
+    tr = SpanTracer(tmp_path / "trace.json", enabled=True)
+    t0 = tr.now()
+    tr.complete("data_wait", t0, t0 + 0.01)
+    tr.instant("fault_rank_stalls")
+    tr.instant("gang_shrink", from_world=4, to_world=3)
+    tr.instant("gang_shrink", from_world=3, to_world=2)
+    tr.close()
+    tool = _load_tool("trace_summary")
+    out = tool.summarize(str(tmp_path))
+    assert "gang_shrink" in out and "(2 instant(s))" in out
+    assert "fault_rank_stalls" in out and "(1 instant(s))" in out
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the stalled rank is flagged BEFORE the peer-timeout abort
+# ---------------------------------------------------------------------------
+
+
+def _run_gang(root, *, faults=None, workers=4, steps=12, save_every=5,
+              peer_timeout=4.0, timeout=280):
+    from distributed_machine_learning_tpu.cli.gang import (
+        scrubbed_worker_env,
+    )
+
+    cmd = [
+        sys.executable, "-m", "distributed_machine_learning_tpu.cli.gang",
+        "--workers", str(workers), "--steps", str(steps),
+        "--save-every", str(save_every),
+        "--ckpt-dir", os.path.join(root, "ckpt"),
+        "--gang-dir", os.path.join(root, "gang"),
+        "--peer-timeout", str(peer_timeout),
+    ]
+    if faults:
+        cmd += ["--faults", faults]
+    return subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout,
+        env=scrubbed_worker_env(REPO), cwd=REPO,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.faultinject
+def test_gang_chaos_straggler_flagged_before_abort(tmp_path):
+    """ISSUE 6's acceptance bar.  stall_rank@1:7:30 on a 4-worker gang:
+    rank 1 wedges before step 7 and the stall exceeds the 1.5x
+    peer-timeout budget, so the gang eventually aborts and restarts —
+    but the advisory detector must name rank 1 FIRST, the verdict must
+    land in the default-on telemetry plane (gang_straggler{rank=1},
+    gang_skew_ratio, FaultEvents.stragglers -> resilience_summary,
+    gang_health.jsonl), gang_status must render the story from the gang
+    dir alone, and trace_merge must fuse one Perfetto timeline with a
+    track per rank spanning both attempts."""
+    root = str(tmp_path / "chaos")
+    res = _run_gang(root, faults="stall_rank@1:7:30")
+    assert res.returncode == 0, res.stdout + res.stderr
+
+    # Flagged before the abort tore the gang down: the advisory line
+    # precedes the coordinated-restart line in the supervisor log.
+    flag_at = res.stdout.find("straggler advisory: rank 1")
+    restart_at = res.stdout.find("coordinated restart")
+    assert flag_at != -1, res.stdout
+    assert restart_at != -1, res.stdout
+    assert flag_at < restart_at, res.stdout
+    assert "straggler advisories (slow ranks)" in res.stdout  # summary
+    assert "cross-rank step-time skew" in res.stdout
+
+    gang = os.path.join(root, "gang")
+    tel = os.path.join(gang, "telemetry")
+
+    # The default-on telemetry plane: supervisor registry carries the
+    # verdict counters and the skew gauge.
+    with open(os.path.join(tel, "registry.json")) as f:
+        snap = json.load(f)
+    counters = {(c["name"], c["labels"].get("rank", c["labels"].get(
+        "kind"))): c["value"] for c in snap["counters"]}
+    assert counters[("gang_straggler", "1")] >= 1
+    assert counters[("fault_events", "stragglers")] >= 1
+    assert counters[("gang_restarts", None)] >= 1
+    # The gauge is LIVE (last write wins): after the healthy restart it
+    # reads near 1; the episode's peak ratio is in the health verdicts.
+    gauges = {g["name"]: g["value"] for g in snap["gauges"]}
+    assert gauges["gang_skew_ratio"] > 0.0
+
+    # The health ledger tells the same story, keyed to the rank.
+    health = read_health_events(gang)
+    verdicts = [e for e in health if e["kind"] == "straggler"]
+    assert verdicts and all(v["rank"] == 1 for v in verdicts)
+    assert any(e["kind"] == "restart" for e in health)
+
+    # Every rank streamed collision-safe metrics; the restarted attempt
+    # APPENDED to the same per-rank streams (attempt tags 0 and 1).
+    rollup = aggregate_gang_metrics(tel)
+    assert rollup.ranks == [0, 1, 2, 3]
+    assert rollup.per_rank[0]["attempts"] == [0, 1]
+    assert rollup.per_rank[0]["last_step"] == 11
+
+    # gang_status renders the per-rank table + history from the dirs.
+    status = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "gang_status.py"),
+         gang], capture_output=True, text=True, timeout=60,
+    )
+    assert status.returncode == 0, status.stdout + status.stderr
+    assert "4 rank(s) heartbeating" in status.stdout
+    assert "straggler: rank 1" in status.stdout
+    assert "restart #1" in status.stdout
+    assert "Cross-rank rollup" in status.stdout
+
+    # trace_merge: one Perfetto-loadable timeline, a track per rank,
+    # spanning both attempts.
+    merge = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_merge.py"),
+         tel], capture_output=True, text=True, timeout=60,
+    )
+    assert merge.returncode == 0, merge.stdout + merge.stderr
+    with open(os.path.join(tel, "trace.merged.json")) as f:
+        merged = json.load(f)
+    events = merged["traceEvents"]
+    assert {e["pid"] for e in events
+            if e.get("ph") != "M"} == {0, 1, 2, 3}
+    starts = [e for e in events if e["name"] == "gang_worker_start"]
+    attempts = {e["args"]["attempt"] for e in starts}
+    assert attempts == {0, 1}, starts
+    names = {e["pid"]: e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {r: f"rank {r}" for r in range(4)}

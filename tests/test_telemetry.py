@@ -98,6 +98,116 @@ def test_prometheus_export_format():
     assert "step_seconds_count 2" in text
 
 
+def _parse_prometheus(text):
+    """A minimal exposition-format parser for round-trip assertions:
+    {(name, ((label, value), ...)): float}, plus {family: type}.
+
+    Label values are matched with the escape-aware pattern
+    ``(?:[^"\\\\]|\\\\.)*`` (a quote inside a value is always written
+    escaped, so an unescaped quote really ends the value) and unescaped
+    in a SINGLE pass — sequential str.replace would corrupt values like
+    a literal backslash-n, and splitting on '",' would cut any value
+    containing a quote-then-comma.
+    """
+    import re
+
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+    def unescape(v):
+        return re.sub(r"\\(.)",
+                      lambda m: "\n" if m.group(1) == "n" else m.group(1),
+                      v)
+
+    series, types = {}, {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, fam, kind = line.split(" ")
+            assert fam not in types, f"duplicate TYPE for {fam}"
+            types[fam] = kind
+            continue
+        head, _, value = line.rpartition(" ")
+        labels = ()
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            labels = tuple((k, unescape(v))
+                           for k, v in label_re.findall(rest.rstrip("}")))
+        else:
+            name = head
+        key = (name, labels)
+        assert key not in series, f"duplicate series {key}"
+        series[key] = float(value)
+    return series, types
+
+
+def test_prometheus_labeled_round_trip_with_escaping(tmp_path):
+    """The textfile export must survive hostile label values (quotes,
+    backslashes, newlines — an abort reason or fault spec carried as a
+    label) and parse back to the exact instrument values."""
+    from distributed_machine_learning_tpu.telemetry import (
+        write_prometheus,
+    )
+
+    reg = MetricsRegistry()
+    hostile = 'rank "1"\\fault\nspec'
+    tricky = 'a",b\\n'  # quote-then-comma + literal backslash-n
+    reg.counter("gang_straggler", rank="1").inc(2)
+    reg.counter("fault_events", kind=hostile).inc(5)
+    reg.counter("fault_events", kind=tricky).inc(1)
+    reg.gauge("gang_skew_ratio", why='a "quoted" reason').set(7.5)
+    text = reg.to_prometheus()
+    for line in text.splitlines():
+        assert "\n" not in line  # the raw newline must be escaped away
+    assert r"\n" in text and r"\"" in text
+    series, types = _parse_prometheus(text)
+    assert types["gang_straggler"] == "counter"
+    assert types["gang_skew_ratio"] == "gauge"
+    assert series[("gang_straggler", (("rank", "1"),))] == 2
+    assert series[("fault_events", (("kind", hostile),))] == 5
+    assert series[("fault_events", (("kind", tricky),))] == 1
+    assert series[("gang_skew_ratio",
+                   (("why", 'a "quoted" reason'),))] == 7.5
+    # And the atomic file writer emits the same parseable payload.
+    write_prometheus(tmp_path / "m.prom", reg)
+    assert (tmp_path / "m.prom").read_text() == text
+
+
+def test_prometheus_histogram_bucket_round_trip():
+    """Labeled histograms: bucket bounds strictly ascending with +Inf
+    last, cumulative counts non-decreasing and ending at _count, _sum
+    matching the observations — per label series, under one TYPE."""
+    reg = MetricsRegistry()
+    # Creation order descends on purpose: export must still ascend.
+    for shard in ("a", "b"):
+        h = reg.histogram("step_seconds", buckets=[1.0, 0.1, 0.5],
+                          shard=shard)
+        obs = [0.05, 0.3, 0.3, 0.7, 2.0] if shard == "a" else [0.2]
+        for v in obs:
+            h.observe(v)
+    text = reg.to_prometheus()
+    assert text.count("# TYPE step_seconds histogram") == 1
+    series, _ = _parse_prometheus(text)
+    for shard, total, summed in (("a", 5, 3.35), ("b", 1, 0.2)):
+        sel = {
+            dict(labels)["le"]: v
+            for (name, labels), v in series.items()
+            if name == "step_seconds_bucket"
+            and dict(labels)["shard"] == shard
+        }
+        bounds = [b for b in sel if b != "+Inf"]
+        assert [float(b) for b in bounds] == sorted(float(b)
+                                                    for b in bounds)
+        assert list(sel)[-1] == "+Inf"  # +Inf closes the series
+        cum = [sel[b] for b in sel]
+        assert cum == sorted(cum)  # cumulative counts never decrease
+        assert cum[-1] == total
+        assert series[("step_seconds_count",
+                       (("shard", shard),))] == total
+        assert series[("step_seconds_sum",
+                       (("shard", shard),))] == pytest.approx(summed)
+
+
 def test_registry_snapshot_shape():
     reg = MetricsRegistry()
     reg.counter("c").inc()

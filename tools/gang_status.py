@@ -1,0 +1,236 @@
+#!/usr/bin/env python3
+"""Live / post-mortem gang status — no third-party imports, jax-free.
+
+Renders one gang's health from its coordination directory (the files
+``runtime/coordinator.py`` and ``gang_supervise`` write) plus the
+telemetry plane the workers stream (default ``<gang-dir>/telemetry``):
+
+- the per-rank table: last published step, progress age, rolling step
+  time, skew vs the gang median, and state (ok / SUSPENDED / DONE /
+  STRAGGLER / STALE?);
+- the advisory history from ``gang_health.jsonl``: straggler verdicts,
+  coordinated restarts, shrinks — plus fired faults from
+  ``faults_fired.jsonl`` and the abort latch, if present;
+- the cross-rank rollup from the per-rank metrics streams
+  (``telemetry/aggregator.py``): per-rank throughput, whole-run
+  p95/max step-time skew, offline straggler verdicts.
+
+Live mode (``--watch N``) re-renders every N seconds; everything
+tolerates the artifacts of a crash (torn lines, frozen beat files) —
+diagnosing dead runs is this tool's main job.
+
+Usage:  python tools/gang_status.py <gang-dir> [--telemetry DIR]
+                                    [--watch N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Reader-side package modules only (telemetry/ + utils/timing are
+# stdlib-importable by construction; the jax-heavy runtime package is
+# never touched) — same bootstrap as tools/trace_summary.py.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+from distributed_machine_learning_tpu.telemetry.aggregator import (  # noqa: E402,E501
+    FAULT_LEDGER_FILE,
+    aggregate_gang_metrics,
+    median,
+    read_beats,
+    read_health_events,
+)
+from distributed_machine_learning_tpu.telemetry.sink import (  # noqa: E402
+    read_jsonl,
+)
+
+ABORT_FILE = "abort.json"  # runtime/coordinator.py's abort latch
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        return payload if isinstance(payload, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _ledger_entries(gang_dir: str) -> list[dict]:
+    try:
+        return [e for e in read_jsonl(os.path.join(gang_dir,
+                                                   FAULT_LEDGER_FILE))
+                if isinstance(e, dict)]
+    except OSError:
+        return []
+
+
+def collect(gang_dir: str, telemetry_dir: str) -> dict:
+    """Everything the renderers need, as one JSON-ready dict."""
+    beats = read_beats(gang_dir)
+    now = time.time()
+    health = read_health_events(gang_dir)
+    # The live table's STRAGGLER column must match the beat files'
+    # CURRENT rank numbering (a shrink renumbers survivors, while
+    # verdict `rank` fields carry the original identity) and only the
+    # LATEST attempt's verdicts — a rank that stalled two attempts ago
+    # is history, not current state.  The History section below still
+    # shows every verdict under its original-rank id.
+    latest_attempt = max(
+        (e["attempt"] for e in health
+         if isinstance(e.get("attempt"), int)), default=0,
+    )
+    flagged = {
+        e.get("cur_rank", e.get("rank")) for e in health
+        if e.get("kind") == "straggler"
+        and e.get("attempt", 0) == latest_attempt
+    }
+    rank_rows = []
+    step_times = {}
+    for rank, p in sorted(beats.items()):
+        metrics = p.get("metrics") if isinstance(p.get("metrics"), dict) \
+            else {}
+        stime = metrics.get("step_time_s")
+        if isinstance(stime, (int, float)):
+            step_times[rank] = float(stime)
+        # Post-mortem age: the rank's own published progress age plus
+        # how long ago (wall clock) it wrote the beat — approximate
+        # across hosts, exact on the single-host gangs this renders
+        # live; a frozen file simply reads as ever-older.
+        wall_age = max(now - float(p.get("time", now)), 0.0)
+        rank_rows.append({
+            "rank": rank,
+            "step": int(p.get("step", 0)),
+            "age_s": float(p.get("beat_age", 0.0)) + wall_age,
+            "step_time_s": stime,
+            "phases": metrics.get("phases") or {},
+            "suspended": bool(p.get("suspended")),
+            "done": bool(p.get("done")),
+            "straggler": rank in flagged,
+        })
+    med = median(step_times.values())
+    for row in rank_rows:
+        st = row["step_time_s"]
+        row["skew"] = (st / med) if (st and med > 0) else None
+    out = {
+        "gang_dir": gang_dir,
+        "world": len(rank_rows),
+        "abort": _read_json(os.path.join(gang_dir, ABORT_FILE)),
+        "ranks": rank_rows,
+        "health": health,
+        "faults_fired": _ledger_entries(gang_dir),
+    }
+    if os.path.isdir(telemetry_dir):
+        rollup = aggregate_gang_metrics(telemetry_dir)
+        if rollup.ranks:
+            out["rollup"] = rollup.as_dict()
+    return out
+
+
+def render(status: dict) -> str:
+    lines = [f"== Gang {status['gang_dir']} — "
+             f"{status['world']} rank(s) heartbeating =="]
+    if status["abort"]:
+        a = status["abort"]
+        lines.append(f"  ABORT latched by rank {a.get('by_rank')}: "
+                     f"{a.get('reason')}")
+    if status["ranks"]:
+        lines.append(f"  {'rank':>4}  {'step':>6}  {'age':>8}  "
+                     f"{'step_time':>10}  {'skew':>6}  state")
+        for r in status["ranks"]:
+            st = (f"{r['step_time_s']:.4f}s"
+                  if r["step_time_s"] is not None else "-")
+            skew = f"{r['skew']:.2f}x" if r["skew"] is not None else "-"
+            state = ("DONE" if r["done"]
+                     else "SUSPENDED" if r["suspended"]
+                     else "STRAGGLER" if r["straggler"] else "ok")
+            lines.append(f"  {r['rank']:>4}  {r['step']:>6}  "
+                         f"{r['age_s']:>7.1f}s  {st:>10}  {skew:>6}  "
+                         f"{state}")
+    else:
+        lines.append("  (no heartbeat files)")
+
+    history = [e for e in status["health"]
+               if e.get("kind") in ("restart", "shrink", "straggler")]
+    if history or status["faults_fired"]:
+        lines.append("== History ==")
+    for e in history:
+        kind = e.get("kind")
+        if kind == "restart":
+            lines.append(f"  restart #{e.get('attempt')}: world "
+                         f"{e.get('world')} — {e.get('why', '?')}")
+        elif kind == "shrink":
+            lines.append(f"  shrink @attempt {e.get('attempt')}: "
+                         f"{e.get('from_world')} -> {e.get('to_world')} "
+                         f"(lost rank(s) {e.get('lost')}, restore step "
+                         f"{e.get('restore_step')})")
+        else:
+            lines.append(f"  straggler: rank {e.get('rank')} at step "
+                         f"{e.get('step')} — {e.get('ratio')}x the gang "
+                         f"median (attempt {e.get('attempt')})")
+    for e in status["faults_fired"]:
+        lines.append(f"  fault fired: {e.get('kind')} rank "
+                     f"{e.get('rank')} at {e.get('at')}")
+
+    rollup = status.get("rollup")
+    if rollup:
+        lines.append("== Cross-rank rollup ==")
+        skew = rollup["skew"]
+        lines.append(f"  step-time skew (slowest/median): p95 "
+                     f"{skew['p95']:.2f}x  max {skew['max']:.2f}x over "
+                     f"{len(rollup['steps'])} step(s)")
+        for rank_s, pr in sorted(rollup["per_rank"].items(),
+                                 key=lambda kv: int(kv[0])):
+            eps = (f"{pr['examples_per_s_mean']:.1f} ex/s"
+                   if pr["examples_per_s_mean"] is not None else "-")
+            lines.append(f"  rank {rank_s}: {pr['rows']} step row(s), "
+                         f"mean {pr['iter_s_mean']:.4f}s, {eps}, "
+                         f"attempt(s) "
+                         f"{','.join(map(str, pr['attempts']))}, last "
+                         f"step {pr['last_step']}")
+        for v in rollup["stragglers"]:
+            lines.append(f"  straggler (offline): rank {v['rank']} at "
+                         f"step {v['step']} ({v['ratio']:.1f}x median)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("gang_dir", help="the gang coordination dir "
+                                         "(--gang-dir of the run)")
+    parser.add_argument("--telemetry", default=None,
+                        help="gang telemetry plane (default: "
+                             "<gang-dir>/telemetry)")
+    parser.add_argument("--watch", type=float, default=None,
+                        help="re-render every N seconds (live mode)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable dump instead of the table")
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.gang_dir):
+        print(f"not a directory: {args.gang_dir}", file=sys.stderr)
+        return 2
+    telemetry_dir = args.telemetry or os.path.join(args.gang_dir,
+                                                   "telemetry")
+    try:
+        while True:
+            status = collect(args.gang_dir, telemetry_dir)
+            if args.json:
+                print(json.dumps(status, indent=1))
+            else:
+                print(render(status))
+            if args.watch is None:
+                return 0
+            time.sleep(args.watch)
+            print()
+    except KeyboardInterrupt:
+        return 0
+    except BrokenPipeError:  # `| head` closed the pipe — not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Fuse per-rank Chrome traces into ONE Perfetto timeline — stdlib-only.
+
+Each gang worker streams its own trace (``trace.rank<r>.json`` under
+the shared telemetry dir, or ``rank<r>/trace.json``), and each records
+its events under its own local ``pid`` — every rank believes it is
+process 0, so dragging the files into Perfetto one by one can never
+show the thing cross-rank traces exist for: barrier convoys, skewed
+phases, and which rank's stall the others were waiting on ("Automatic
+Cross-Replica Sharding", arxiv 2004.13336, motivates exactly this
+per-phase overlap proof).
+
+The merge rewrites every event's ``pid`` to the rank that produced it
+(one Perfetto process track per rank, named and sorted), keeps ``tid``
+(worker-side threads stay distinct within a track), and carries the
+events through otherwise untouched — attempt tags
+(``gang_worker_start`` instants, ``restart_attempt``/``gang_attempt``
+spans) stay in ``args``, so one timeline spans every attempt of a
+supervised chaos run.  Ranks are ORIGINAL-numbering identities: a
+renumbered survivor keeps appending to its original stream, so its
+track is continuous across shrinks.  Torn final events (a killed rank)
+and unterminated arrays are tolerated by construction — the readers
+drop exactly the record the crash destroyed.
+
+Usage:  python tools/trace_merge.py <telemetry-dir> [-o OUT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+from distributed_machine_learning_tpu.telemetry.tracer import (  # noqa: E402,E501
+    read_trace,
+)
+
+_TRACE_FILE_RE = re.compile(r"^trace\.rank(\d+)\.json$")
+_RANK_DIR_RE = re.compile(r"^rank(\d+)$")
+
+
+def discover_rank_traces(root: str) -> dict[int, str]:
+    """rank -> trace path, over both layouts (rank-suffixed files win,
+    mirroring ``telemetry/aggregator.py::discover_rank_streams``)."""
+    out: dict[int, str] = {}
+    if not os.path.isdir(root):
+        return out
+    names = sorted(os.listdir(root))
+    for name in names:
+        m = _TRACE_FILE_RE.match(name)
+        if m:
+            out.setdefault(int(m.group(1)), os.path.join(root, name))
+    for name in names:
+        m = _RANK_DIR_RE.match(name)
+        if m:
+            path = os.path.join(root, name, "trace.json")
+            if os.path.isfile(path):
+                out.setdefault(int(m.group(1)), path)
+    return out
+
+
+def merge_traces(root: str) -> tuple[dict, dict[int, int]]:
+    """(merged trace object, rank -> event count).
+
+    The result is the Chrome JSON Object Format (``{"traceEvents":
+    [...]}``) — strictly-valid JSON whatever state the inputs were
+    killed in, with one metadata-named process track per rank.
+    """
+    traces = discover_rank_traces(root)
+    events: list[dict] = []
+    counts: dict[int, int] = {}
+    for rank, path in sorted(traces.items()):
+        rank_events = [e for e in read_trace(path) if isinstance(e, dict)]
+        for e in rank_events:
+            e = dict(e)
+            e["pid"] = rank  # every rank thinks it's pid 0: re-home it
+            events.append(e)
+        counts[rank] = len(rank_events)
+        events.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"rank {rank}"}})
+        events.append({"name": "process_sort_index", "ph": "M",
+                       "pid": rank, "args": {"sort_index": rank}})
+    # Chronological order is not required by the format but makes the
+    # merged file diffable and stream-readable; metadata events carry
+    # no ts and sort first.
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    return {"traceEvents": events}, counts
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("telemetry_dir",
+                        help="gang telemetry dir holding per-rank "
+                             "traces (trace.rank<r>.json or "
+                             "rank<r>/trace.json)")
+    parser.add_argument("-o", "--out", default=None,
+                        help="output path (default: "
+                             "<telemetry-dir>/trace.merged.json)")
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.telemetry_dir):
+        print(f"not a directory: {args.telemetry_dir}", file=sys.stderr)
+        return 2
+    merged, counts = merge_traces(args.telemetry_dir)
+    if not counts:
+        print(f"no per-rank traces under {args.telemetry_dir} "
+              "(expected trace.rank<r>.json or rank<r>/trace.json)",
+              file=sys.stderr)
+        return 2
+    out = args.out or os.path.join(args.telemetry_dir,
+                                   "trace.merged.json")
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, out)
+    spans = [e["ts"] for e in merged["traceEvents"] if "ts" in e]
+    dur_s = (max(spans) - min(spans)) / 1e6 if spans else 0.0
+    per_rank = "  ".join(f"rank{r}:{n}" for r, n in sorted(counts.items()))
+    print(f"merged {sum(counts.values())} event(s) from "
+          f"{len(counts)} rank(s) spanning {dur_s:.1f}s -> {out}")
+    print(f"  {per_rank}")
+    print("  open in ui.perfetto.dev (one process track per rank)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,9 @@ Reads the artifacts a run's ``--telemetry-dir`` produced
 - per-phase time shares from the Chrome trace's complete events
   (data_wait / place_batch / step_dispatch / device_block /
   checkpoint_save / eval / ...), the first diagnosis dimension for
-  stragglers and sync overhead;
+  stragglers and sync overhead — trace *instants* (fault markers,
+  gang_shrink, restarts) are counted in the same table: a fault that
+  fired during a phase is the context that phase's duration needs;
 - the top-5 slowest steps from the metrics JSONL (attempt-tagged), with
   their phase breakdown;
 - attempt/restart structure when the run was supervised.
@@ -60,8 +62,18 @@ def summarize(telemetry_dir: str, top: int = 5) -> str:
 
     # -- per-phase shares from the trace --------------------------------
     if os.path.isfile(trace_path):
-        events = [e for e in read_trace(trace_path)
-                  if isinstance(e, dict) and e.get("ph") == "X"]
+        all_events = [e for e in read_trace(trace_path)
+                      if isinstance(e, dict)]
+        events = [e for e in all_events if e.get("ph") == "X"]
+        # Instants (ph "i") are the zero-duration markers — injected
+        # faults, gang aborts/shrinks, worker starts.  They were
+        # silently dropped before this fix; a phase table that omits
+        # the fault fired mid-phase misreads the run it summarizes.
+        instants: dict[str, int] = {}
+        for e in all_events:
+            if e.get("ph") == "i":
+                name = str(e.get("name", "?"))
+                instants[name] = instants.get(name, 0) + 1
         by_name: dict[str, dict] = {}
         for e in events:
             d = by_name.setdefault(e.get("name", "?"),
@@ -92,7 +104,9 @@ def summarize(telemetry_dir: str, top: int = 5) -> str:
                 f"  {n:<14} ------  "
                 f"({d['dur'] / 1e6:.3f}s over {d['count']} spans)"
             )
-        if not by_name:
+        for n in sorted(instants, key=lambda n: (-instants[n], n)):
+            lines.append(f"  {n:<14} ------  ({instants[n]} instant(s))")
+        if not by_name and not instants:
             lines.append("  (no complete events)")
     else:
         lines.append(f"== No trace at {trace_path} ==")
