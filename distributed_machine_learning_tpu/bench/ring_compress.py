@@ -1,0 +1,152 @@
+"""Compressed-ring weak-scaling bench: wire bytes, step tails, parity.
+
+Measures the round-7 tentpole (``ops/ring.py`` wire schemes +
+``parallel/strategies.py::RingAllReduce`` error feedback) three ways,
+per world size and codec:
+
+- **wire bytes/step** — the static accounting
+  (``ring_wire_bytes``; the HLO audit in ``overlap_audit.py
+  --wire-bytes`` verifies the same number against the compiled
+  program's collective-permute shapes);
+- **step time p50/p95** — the mandatory-tail protocol (PERF.md round-6
+  mandate).  NOTE on CPU hosts the ppermute "wire" is a memcpy, so
+  compression costs compute and saves nothing — the honest reading of
+  a CPU row is *overhead of the codec*, while the byte column is the
+  bandwidth win an ICI-bound pod realizes;
+- **loss parity** — final-loss relative delta vs the exact ring over
+  the same fixed-seed synthetic batch stream (error feedback on).
+
+Weak scaling: per-device batch is FIXED (default 16); the global batch
+grows with the world, the reference's scaling protocol.
+
+Run:  python -m distributed_machine_learning_tpu.bench.ring_compress \
+          [--worlds 2,4,8] [--iters 24] [--model vggtest] [--json out]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_ring_compress(worlds=(2, 4, 8), iters: int = 24,
+                        per_device_batch: int = 16,
+                        model_name: str = "vggtest",
+                        topk_frac: float = 0.125,
+                        bucket_mb: int = 25) -> list[dict]:
+    import jax
+    import numpy as np
+
+    from distributed_machine_learning_tpu.cli.common import (
+        SEED,
+        init_model_and_state,
+    )
+    from distributed_machine_learning_tpu.models.registry import get_model
+    from distributed_machine_learning_tpu.ops.ring import WIRE_SCHEMES
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+    from distributed_machine_learning_tpu.train.sgd import SGDConfig
+    from distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+        shard_batch,
+    )
+    from distributed_machine_learning_tpu.utils.timing import (
+        percentile_stats,
+    )
+
+    model = get_model(model_name, use_bn=False)
+    rows = []
+    for world in worlds:
+        if world > jax.device_count():
+            continue
+        mesh = make_mesh(world)
+        B = per_device_batch * world
+        rng = np.random.default_rng(SEED)
+        batches = [
+            (rng.integers(0, 256, (B, 32, 32, 3), dtype=np.uint8),
+             rng.integers(0, 10, B).astype(np.int32))
+            for _ in range(iters)
+        ]
+        final_exact = None
+        for compress in WIRE_SCHEMES:  # "none" first: the parity anchor
+            kwargs = {"bucket_bytes": bucket_mb * 2**20}
+            if compress != "none":
+                kwargs.update(compress=compress, topk_frac=topk_frac)
+            strategy = get_strategy("ring", **kwargs)
+            state = init_model_and_state(
+                model,
+                config=SGDConfig(learning_rate=0.1, weight_decay=0.0),
+            )
+            n_elems = sum(
+                int(l.size)
+                for l in jax.tree_util.tree_leaves(state.params)
+            )
+            step = make_train_step(model, strategy, mesh=mesh,
+                                   augment=False)
+            times = []
+            loss = None
+            for i, (x, y) in enumerate(batches):
+                xs, ys = shard_batch(mesh, x, y)
+                t0 = time.perf_counter()
+                state, loss = step(state, xs, ys)
+                loss = jax.block_until_ready(loss)
+                if i > 0:  # iteration 0 holds the compile
+                    times.append(time.perf_counter() - t0)
+            final = float(loss)
+            if compress == "none":
+                final_exact = final
+            stats = percentile_stats(times)
+            rows.append({
+                "world": world,
+                "global_batch": B,
+                "compress": compress,
+                "error_feedback": getattr(strategy, "stateful", False),
+                "wire_bytes_per_step": strategy.wire_bytes_per_step(
+                    n_elems, world
+                ),
+                "compression_ratio": strategy.compression_ratio(
+                    n_elems, world
+                ),
+                "iter_p50_s": stats["p50"],
+                "iter_p95_s": stats["p95"],
+                "final_loss": final,
+                "final_loss_rel_delta_vs_exact": (
+                    None if final_exact is None
+                    else abs(final - final_exact) / max(abs(final_exact),
+                                                        1e-30)
+                ),
+            })
+            print(json.dumps(rows[-1]))
+    return rows
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--worlds", default="2,4,8")
+    parser.add_argument("--iters", default=24, type=int)
+    parser.add_argument("--batch-size", default=16, type=int,
+                        help="PER-DEVICE batch (weak scaling)")
+    parser.add_argument("--model", default="vggtest")
+    parser.add_argument("--topk-frac", default=0.125, type=float)
+    parser.add_argument("--bucket-mb", default=25, type=int)
+    parser.add_argument("--json", dest="json_out", default=None)
+    args = parser.parse_args(argv)
+    rows = bench_ring_compress(
+        worlds=tuple(int(w) for w in args.worlds.split(",")),
+        iters=args.iters,
+        per_device_batch=args.batch_size,
+        model_name=args.model,
+        topk_frac=args.topk_frac,
+        bucket_mb=args.bucket_mb,
+    )
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(rows, f, indent=2)
+        print(f"wrote {args.json_out}")
+
+
+if __name__ == "__main__":
+    main()

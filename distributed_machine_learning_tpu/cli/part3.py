@@ -5,6 +5,14 @@ The reference wraps the model in DDP with 25 MB buckets
 enabled (``part3/model.py:24``).  Here: the hand-rolled explicit
 ``lax.ppermute`` ring (the north-star), 25 MB buckets, mean semantics,
 VGG-11 with BatchNorm.
+
+Gradient wire compression (``--ring-compress {none,bf16,int8,topk}``,
+``--ring-topk-frac``): compress each ring hop's payload — int8 with
+per-chunk fp32 scales or magnitude top-k sparsification, both carrying
+an error-feedback residual across steps (EF-SGD), or a cast-only bf16
+wire.  ~4x fewer bytes on the wire for int8/topk at loss-curve parity
+(docs/PERF.md "Compressed ring all-reduce"); ``--wire-dtype bfloat16``
+is the deprecated spelling of ``--ring-compress bf16``.
 """
 
 from __future__ import annotations

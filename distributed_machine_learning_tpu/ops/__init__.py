@@ -4,14 +4,20 @@ from distributed_machine_learning_tpu.ops.collectives import (
     gather_scatter_sum,
 )
 from distributed_machine_learning_tpu.ops.ring import (
+    WireScheme,
+    get_wire_scheme,
     ring_all_reduce,
     ring_all_reduce_flat,
+    ring_wire_bytes,
 )
 
 __all__ = [
     "all_reduce_sum",
     "all_reduce_mean",
     "gather_scatter_sum",
+    "WireScheme",
+    "get_wire_scheme",
     "ring_all_reduce",
     "ring_all_reduce_flat",
+    "ring_wire_bytes",
 ]

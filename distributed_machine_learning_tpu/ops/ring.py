@@ -37,11 +37,35 @@ overlap audit" for the numbers and protocol.
 The ring steps use *static* chunk indices (the loop over steps is unrolled;
 N is a compile-time mesh constant), so every slice is a static-shape
 ``lax.slice`` the TPU backend can lay out without dynamic-update overhead.
+
+**Wire compression** (round 7): every hop's payload can be compressed
+through a :class:`WireScheme` — the quantized/sparsified multi-hop
+all-reduce of the retrieved literature (DynamiQ, arxiv 2602.08923;
+"Efficient Training of Convolutional Neural Nets on Large Distributed
+Systems", arxiv 1711.00705).  Three codecs behind one interface:
+
+- ``bf16`` — plain dtype cast on the wire (2 bytes/elem, no metadata);
+  this is CAST-ONLY lossy compression, not the error-compensated scheme
+  of the literature — residual correction lives a layer up, in
+  ``parallel/strategies.py::RingAllReduce(error_feedback=True)``.
+- ``int8`` — per-chunk symmetric int8 with one fp32 scale per chunk
+  (~4x fewer wire bytes), reusing the serving quantizer
+  (``ops/pallas/quant_matmul.py::quantize_int8``) on the chunk viewed
+  as a single output column.  Each reduce-scatter hop dequantizes,
+  adds in fp32, and requantizes — the dequantize–add–requantize fusion
+  of the compressed multi-hop all-reduce.
+- ``topk`` — magnitude top-k sparsification: (values, indices) on the
+  wire, ``k = topk_frac × chunk``; the receiver scatter-adds.
+
+The all-gather phase relays each completed chunk's *encoded payload*
+bit-exactly around the ring and decodes it on every rank (including the
+owner), so all ranks end the all-reduce with IDENTICAL synced gradients
+and replicated params cannot drift — the same invariant the bf16 path
+establishes by quantizing the owner's copy once.
 """
 
 from __future__ import annotations
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -55,34 +79,194 @@ def _right_shift_perm(n: int) -> list[tuple[int, int]]:
     return [(i, (i + 1) % n) for i in range(n)]
 
 
+# ---------------------------------------------------------------------------
+# Wire schemes — per-chunk codecs for the ring hops.
+# ---------------------------------------------------------------------------
+
+
+class WireScheme:
+    """Codec for one ring hop's payload over a flat fp32 chunk.
+
+    ``encode(v) -> tuple[jax.Array, ...]`` produces the arrays that go
+    over the wire (each leaf is ppermuted independently);
+    ``decode(payload, length) -> jax.Array`` reconstructs a dense fp32
+    chunk of ``length`` elements; ``payload_bytes(length)`` is the
+    static byte accounting the telemetry counters and the HLO wire-byte
+    audit (``bench/overlap_audit.py --wire-bytes``) check against.
+
+    The base class is the exact (identity) scheme.
+    """
+
+    name = "none"
+
+    def encode(self, v: jax.Array) -> tuple[jax.Array, ...]:
+        return (v,)
+
+    def decode(self, payload: tuple[jax.Array, ...], length: int) -> jax.Array:
+        return payload[0]
+
+    def payload_bytes(self, length: int, itemsize: int = 4) -> int:
+        return length * itemsize
+
+
+class CastScheme(WireScheme):
+    """Dtype cast on the wire (``bf16``): halves fp32 bytes, no metadata.
+
+    Cast-only — per-hop rounding error is NOT tracked here; pairing it
+    with the strategy layer's error-feedback residual is possible but
+    historically this ran bare (the deprecated ``wire_dtype`` knob).
+    """
+
+    name = "bf16"
+
+    def __init__(self, dtype=jnp.bfloat16):
+        self.dtype = jnp.dtype(dtype)
+
+    def encode(self, v):
+        return (v.astype(self.dtype),)
+
+    def decode(self, payload, length):
+        return payload[0].astype(jnp.float32)
+
+    def payload_bytes(self, length, itemsize=4):
+        return length * self.dtype.itemsize
+
+
+class Int8Scheme(WireScheme):
+    """Per-chunk symmetric int8 + one fp32 scale (~itemsize/1 ≈ 4x fewer
+    bytes for fp32 gradients).  Reuses the serving weight quantizer
+    (:func:`~distributed_machine_learning_tpu.ops.pallas.quant_matmul.quantize_int8`)
+    on the chunk viewed as a [L, 1] single-column matrix, so "per
+    output channel" degenerates to exactly the per-chunk scale the
+    compressed-ring recipe wants."""
+
+    name = "int8"
+
+    def encode(self, v):
+        from distributed_machine_learning_tpu.ops.pallas.quant_matmul import (
+            quantize_int8,
+        )
+
+        q, scale = quantize_int8(v[:, None])  # [L,1] → one column = one scale
+        return (q.reshape(-1), scale)
+
+    def decode(self, payload, length):
+        q, scale = payload
+        return q.astype(jnp.float32) * scale  # scale is [1]; broadcasts
+
+    def payload_bytes(self, length, itemsize=4):
+        return length + 4  # int8 chunk + one fp32 scale
+
+
+class TopKScheme(WireScheme):
+    """Magnitude top-k sparsification: ``k = max(1, round(frac·L))``
+    (values fp32, indices int32 — 8 bytes per kept element, so the wire
+    ratio vs fp32 is ``2·frac``; the default frac 1/8 is 4x fewer
+    bytes).  Indices from ``lax.top_k`` are unique, so decode is a
+    scatter-``set`` into zeros."""
+
+    name = "topk"
+
+    def __init__(self, frac: float = 0.125):
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(f"topk frac must be in (0, 1], got {frac}")
+        self.frac = float(frac)
+
+    def k_for(self, length: int) -> int:
+        return min(length, max(1, int(round(self.frac * length))))
+
+    def encode(self, v):
+        k = self.k_for(v.shape[0])
+        _, idx = lax.top_k(jnp.abs(v), k)
+        return (jnp.take(v, idx), idx.astype(jnp.int32))
+
+    def decode(self, payload, length):
+        vals, idx = payload
+        return jnp.zeros((length,), jnp.float32).at[idx].set(
+            vals.astype(jnp.float32)
+        )
+
+    def payload_bytes(self, length, itemsize=4):
+        return self.k_for(length) * (itemsize + 4)
+
+
+WIRE_SCHEMES = ("none", "bf16", "int8", "topk")
+
+
+def get_wire_scheme(name: str, topk_frac: float = 0.125) -> WireScheme:
+    """Resolve a ``--ring-compress`` name to a codec instance."""
+    if name == "none":
+        return WireScheme()
+    if name == "bf16":
+        return CastScheme(jnp.bfloat16)
+    if name == "int8":
+        return Int8Scheme()
+    if name == "topk":
+        return TopKScheme(topk_frac)
+    raise ValueError(
+        f"unknown wire scheme {name!r}; choose from {WIRE_SCHEMES}"
+    )
+
+
+def _resolve_scheme(scheme, wire_dtype) -> WireScheme | None:
+    """Back-compat shim: the legacy ``wire_dtype`` kwarg maps onto the
+    cast scheme; an explicit ``scheme`` wins.  None = exact (identity
+    fast path: the uncompressed program is bit-identical to the
+    pre-compression implementation)."""
+    if scheme is not None:
+        return None if scheme.name == "none" else scheme
+    if wire_dtype is not None:
+        return CastScheme(wire_dtype)
+    return None
+
+
 def ring_all_reduce_flat(
     x: jax.Array,
     axis_name: str,
     axis_size: int,
     mean: bool = False,
     wire_dtype=None,
-) -> jax.Array:
+    scheme: WireScheme | None = None,
+    return_residual: bool = False,
+):
     """All-reduce a flat vector via an explicit ppermute ring.
 
     Must be called inside ``shard_map`` (or any context where ``axis_name``
     is bound).  ``axis_size`` is the static ring size (mesh axis length).
 
-    ``wire_dtype`` (e.g. ``jnp.bfloat16``): compress every hop's payload
-    to this dtype on the wire, upcasting before the fp32 accumulation —
-    the gradient-compression trick of the multi-hop compressed all-reduce
-    literature (see PAPERS.md): halves ring bytes for fp32 gradients at
-    the cost of quantizing each partial sum once per hop.  None = exact.
+    ``scheme`` (a :class:`WireScheme`): compress every hop's payload —
+    reduce-scatter hops dequantize–add–requantize, all-gather hops relay
+    the encoded payload bit-exactly so every rank decodes the identical
+    chunk.  ``wire_dtype`` (e.g. ``jnp.bfloat16``) is the legacy
+    cast-only spelling of ``scheme=CastScheme(...)``; None/None = exact.
+
+    ``return_residual``: also return this rank's error-feedback residual
+    — COMPLETE local error accounting, zero extra collectives.  Every
+    lossy encode in the ring is observed by exactly one rank:
+
+    - *send error*: each reduce-scatter hop's sender sees
+      ``partial − decode(encode(partial))`` — the mass that hop drops
+      from the downstream accumulation.  Upstream ranks' errors were
+      already theirs (the received value is the decode), so summing
+      per-send errors over ranks counts every phase-1 drop exactly once;
+    - *owner correction*: the rank that completed a chunk is the only
+      one that sees both the true reduced chunk and its lossy broadcast
+      encode; it re-injects that gap (× N under mean semantics, so the
+      next step's mean moves by exactly the gap) — without this term
+      the all-gather's loss is invisible to EF.
+
+    Summed over ranks, the residuals equal the all-reduce's total
+    compression error — the next step's reduction of ``grad + residual``
+    recovers everything the wire dropped this step (EF-SGD with exact
+    bookkeeping; arxiv 1711.00705's error compensation, DynamiQ's
+    residual accumulation).
     """
     n = axis_size
     if n == 1:
+        if return_residual:
+            return x, jnp.zeros_like(x)
         return x
-
-    def hop(v):
-        if wire_dtype is None:
-            return lax.ppermute(v, axis_name, perm)
-        return lax.ppermute(v.astype(wire_dtype), axis_name, perm).astype(
-            x.dtype
-        )
+    scheme = _resolve_scheme(scheme, wire_dtype)
 
     orig_len = x.shape[0]
     chunk = -(-orig_len // n)  # ceil division
@@ -91,41 +275,96 @@ def ring_all_reduce_flat(
     perm = _right_shift_perm(n)
     rank = lax.axis_index(axis_name)
 
+    def hop(payload):
+        return tuple(lax.ppermute(p, axis_name, perm) for p in payload)
+
     # Phase 1 — reduce-scatter.  The chunk index each rank touches at step s
     # is rank-dependent (r−s mod n), but ppermute needs every rank to execute
     # the same program; we roll the chunk axis by the (traced) rank once so
     # that the per-step indices become static: after rolling by −r, rank r's
     # "send chunk (r−s)" is row (−s mod n) for every rank.
     chunks = jnp.roll(chunks, -rank, axis=0)  # row i ≡ global chunk (i + r) mod n
+    account = scheme is not None and return_residual
+    res_rows = jnp.zeros_like(chunks) if account else None
     for s in range(n - 1):
         send_row = (-s) % n
         recv_row = (-s - 1) % n
-        recvd = hop(chunks[send_row])
+        v = chunks[send_row]
+        if scheme is None:
+            recvd = lax.ppermute(v, axis_name, perm)
+        else:
+            # One hop of dequantize–add–requantize: encode the partial,
+            # permute the payload, decode on arrival; the requantize is
+            # the next hop's encode of the updated partial.
+            enc = scheme.encode(v)
+            recvd = scheme.decode(hop(enc), chunk).astype(x.dtype)
+            if account:
+                # Send error: the mass THIS encode drops from the
+                # downstream accumulation (decode(enc) is what the
+                # receiver actually adds) — observed by the sender,
+                # once per hop across the whole ring.
+                res_rows = res_rows.at[send_row].add(
+                    v - scheme.decode(enc, chunk).astype(x.dtype)
+                )
         chunks = chunks.at[recv_row].add(recvd)
     # Rank r now owns the full sum of global chunk (r+1) mod n == row 1.
     own = chunks[1 % n]
     if mean:
         own = own / n
-    if wire_dtype is not None:
-        # Quantize the completed chunk ONCE before phase 2, including the
-        # owner's own stored copy: receivers see bf16(own), so the owner
-        # must too, or ranks end the all-reduce with slightly different
-        # "synced" gradients and replicated params silently drift apart
-        # (further hops re-quantize the same values — idempotent).
-        own = own.astype(wire_dtype).astype(x.dtype)
 
     # Phase 2 — all-gather the completed chunks around the same ring.
     out = jnp.zeros_like(chunks)
-    out = out.at[1 % n].set(own)
-    cur = own
-    for s in range(n - 1):
-        cur = hop(cur)
-        # After s+1 hops, the chunk arriving at rank r was completed by rank
-        # (r − s − 1), i.e. global chunk (r − s) mod n == local row (−s) mod n.
-        out = out.at[(-s) % n].set(cur)
+    own_dec = own
+    if scheme is None:
+        out = out.at[1 % n].set(own)
+        cur = own
+        for s in range(n - 1):
+            cur = lax.ppermute(cur, axis_name, perm)
+            # After s+1 hops, the chunk arriving at rank r was completed by
+            # rank (r − s − 1), i.e. global chunk (r − s) mod n == local row
+            # (−s) mod n.
+            out = out.at[(-s) % n].set(cur)
+    else:
+        # Encode the completed chunk ONCE, store its DECODE (the owner
+        # must see exactly what receivers will see, or ranks end the
+        # all-reduce with slightly different "synced" gradients and
+        # replicated params silently drift apart), then relay the
+        # encoded payload bit-exactly — every rank decodes identical
+        # bits, so the replication invariant holds for lossy codecs too.
+        payload = scheme.encode(own)
+        own_dec = scheme.decode(payload, chunk).astype(x.dtype)
+        out = out.at[1 % n].set(own_dec)
+        for s in range(n - 1):
+            payload = hop(payload)
+            out = out.at[(-s) % n].set(
+                scheme.decode(payload, chunk).astype(x.dtype)
+            )
     # Undo the roll to restore global chunk order.
     out = jnp.roll(out, rank, axis=0)
-    return out.reshape(-1)[:orig_len]
+    result = out.reshape(-1)[:orig_len]
+    if not return_residual:
+        return result
+    if scheme is None:
+        return result, jnp.zeros_like(x)
+    # Owner correction on the owned row (row 1, the only row this rank
+    # never sent): phase-1 send errors accumulated above are in SUM
+    # units; the broadcast gap is in output units, so × N under mean
+    # semantics makes the next step's mean move by exactly the gap.
+    factor = float(n) if mean else 1.0
+    res_rows = res_rows.at[1 % n].add(factor * (own - own_dec))
+    res = jnp.roll(res_rows, rank, axis=0).reshape(-1)[:orig_len]
+    return result, res
+
+
+def _bucket_bounds(n_elems: int, bucket_bytes: int, itemsize: int):
+    """(start, stop) element ranges of the ring buckets — ONE definition
+    shared by the all-reduce/residual accounting and the static byte
+    accounting, so the two can never chunk differently."""
+    bucket_elems = max(1, int(bucket_bytes) // itemsize)
+    return [
+        (i, min(i + bucket_elems, n_elems))
+        for i in range(0, n_elems, bucket_elems)
+    ]
 
 
 def ring_all_reduce(
@@ -135,27 +374,69 @@ def ring_all_reduce(
     mean: bool = True,
     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
     wire_dtype=None,
+    scheme: WireScheme | None = None,
+    return_residual: bool = False,
 ) -> object:
     """Bucketed ring all-reduce over a gradient pytree.
 
     ``mean=True`` reproduces DDP's averaging (part3 semantics — SURVEY.md
     §2.4); ``mean=False`` gives the SUM semantics of parts 2a/2b.
-    ``wire_dtype``: optional on-the-wire compression (see
-    :func:`ring_all_reduce_flat`).
+    ``scheme``/``wire_dtype``: optional on-the-wire compression;
+    ``return_residual``: also return the per-rank error-feedback
+    residual pytree (see :func:`ring_all_reduce_flat`).
     """
     flat, unravel = ravel_pytree(grads)
     if axis_size == 1 or flat.shape[0] == 0:
+        if return_residual:
+            return grads, jax.tree_util.tree_map(jnp.zeros_like, grads)
         return grads
-    bucket_elems = max(1, int(bucket_bytes) // flat.dtype.itemsize)
-    num_buckets = -(-flat.shape[0] // bucket_elems)
-    reduced = [
+    outs = [
         ring_all_reduce_flat(
-            flat[i * bucket_elems : min((i + 1) * bucket_elems, flat.shape[0])],
+            flat[start:stop],
             axis_name,
             axis_size,
             mean=mean,
             wire_dtype=wire_dtype,
+            scheme=scheme,
+            return_residual=return_residual,
         )
-        for i in range(num_buckets)
+        for start, stop in _bucket_bounds(
+            flat.shape[0], bucket_bytes, flat.dtype.itemsize
+        )
     ]
-    return unravel(reduced[0] if num_buckets == 1 else jnp.concatenate(reduced))
+    if return_residual:
+        reduced = [o for o, _ in outs]
+        residuals = [r for _, r in outs]
+        return (
+            unravel(reduced[0] if len(reduced) == 1
+                    else jnp.concatenate(reduced)),
+            unravel(residuals[0] if len(residuals) == 1
+                    else jnp.concatenate(residuals)),
+        )
+    reduced = outs
+    return unravel(reduced[0] if len(reduced) == 1 else jnp.concatenate(reduced))
+
+
+def ring_wire_bytes(
+    n_elems: int,
+    axis_size: int,
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+    scheme: WireScheme | None = None,
+    itemsize: int = 4,
+) -> int:
+    """Static per-device wire bytes of ONE bucketed ring all-reduce:
+    ``sum over buckets of 2·(N−1) hops × payload_bytes(chunk)``.
+
+    Pure host arithmetic — the number the ``ring_wire_bytes`` telemetry
+    counter accumulates per step, and the number the HLO audit
+    (``bench/overlap_audit.py --wire-bytes``) verifies against the
+    compiled program's actual collective-permute operand shapes.
+    """
+    if axis_size <= 1 or n_elems <= 0:
+        return 0
+    scheme = scheme or WireScheme()
+    total = 0
+    for start, stop in _bucket_bounds(n_elems, bucket_bytes, itemsize):
+        chunk = -(-(stop - start) // axis_size)
+        total += 2 * (axis_size - 1) * scheme.payload_bytes(chunk, itemsize)
+    return total

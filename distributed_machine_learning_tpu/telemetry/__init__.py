@@ -194,6 +194,13 @@ class Telemetry:
         self.flops_per_example: float | None = None
         self.flops_per_token: float | None = None
         self.peak_tflops: float | None = None
+        # Static per-step counter increments the train loop applies on
+        # every completed step (e.g. ``ring_wire_bytes``: the compressed
+        # ring's bytes-on-the-wire are a compile-time constant of the
+        # program, so the CLI computes the increment once and the loop
+        # just accumulates it).  Empty by default: one dict iteration
+        # per step when telemetry is on, nothing when off.
+        self.step_counters: dict[str, float] = {}
         self._closed = False
 
     def _artifact(self, name: str) -> str:

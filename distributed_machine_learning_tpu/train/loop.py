@@ -187,6 +187,12 @@ def train_epoch(
             warmup = timer._iter <= timer.skip_first
             reg = tel.registry
             reg.counter("steps_total").inc()
+            for _cname, _cval in (getattr(tel, "step_counters", None)
+                                  or {}).items():
+                # Static per-step increments the CLI registered (e.g.
+                # ring_wire_bytes — the compressed ring's per-step wire
+                # bytes, a compile-time constant of the program).
+                reg.counter(_cname).inc(_cval)
             if not warmup:
                 reg.histogram("step_seconds").observe(iter_time)
                 reg.histogram("data_wait_seconds").observe(data_wait_s)

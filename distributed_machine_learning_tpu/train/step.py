@@ -42,6 +42,7 @@ def _train_step_impl(
     state: TrainState,
     images_u8,
     labels,
+    sync_state=None,
     *,
     axis_name: str | None,
     axis_size: int,
@@ -117,8 +118,23 @@ def _train_step_impl(
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
         loss = loss / accum_steps
 
+    new_sync_state = None
     if axis_name is not None:
-        grads = strategy(grads, axis_name, axis_size)
+        if sync_state is not None:
+            # Stateful strategy (error-feedback compressed ring): the
+            # state rides OUTSIDE TrainState, sharded P(batch) on a
+            # leading [world, ...] axis so each device carries its OWN
+            # residual — error feedback is rank-local; replicating it
+            # would both waste world× memory and be semantically wrong.
+            local = jax.tree_util.tree_map(lambda r: r[0], sync_state)
+            grads, new_local = strategy.apply(
+                grads, local, axis_name, axis_size
+            )
+            new_sync_state = jax.tree_util.tree_map(
+                lambda r: r[None], new_local
+            )
+        else:
+            grads = strategy(grads, axis_name, axis_size)
         if new_stats and sync_bn:
             # part3's reference leaves BN running stats unsynced per node (a
             # documented quirk — SURVEY.md §7.3); the TPU-idiomatic default
@@ -168,7 +184,14 @@ def _train_step_impl(
             tree_all_finite,
         )
 
-        new_state = guard_update(tree_all_finite(grads), new_state, state)
+        ok = tree_all_finite(grads)
+        new_state = guard_update(ok, new_state, state)
+        if new_sync_state is not None:
+            # A skipped update must also freeze the residual: feeding a
+            # non-finite error back into the next step would poison it.
+            new_sync_state = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, a, b), new_sync_state, sync_state
+            )
     if axis_name is not None:
         if local_loss:
             # Reference print-surface parity mode: each rank prints its
@@ -179,6 +202,8 @@ def _train_step_impl(
             # Default: the global mean loss (SPMD has one print stream,
             # so surface the mean).
             loss = lax.pmean(loss, axis_name)
+    if sync_state is not None:
+        return new_state, loss, new_sync_state
     return new_state, loss
 
 
@@ -228,6 +253,19 @@ def make_train_step(
     callers that embed the step in a larger compiled program, e.g. the
     benchmark's ``lax.scan``-ed epoch (bench.py) where per-step dispatch
     would dominate on a remote/tunneled device.
+
+    Stateful strategies (``strategy.stateful``, e.g. the error-feedback
+    compressed ring — ``RingAllReduce(compress="int8")``): the compiled
+    step threads the strategy's per-device state (the EF residual)
+    through the program — state in, state out, donated, sharded
+    P(batch).  With ``jit=True`` the returned callable keeps the
+    ``step(state, x, y) -> (state, loss)`` signature and manages the
+    residual buffers itself (``step.sync_state()`` /
+    ``step.reset_sync_state()`` / ``step.fresh_sync_state(params)``;
+    ``step.inner`` is the raw 4-ary jitted fn for AOT lowering).  With
+    ``jit=False`` the raw 4-ary fn is returned and the caller threads
+    the state.  Stateless strategies compile the exact program they
+    always did — zero overhead.
 
     ``guard_nonfinite``: compile the non-finite-gradient guard into the
     step — an all-leaves ``isfinite`` reduction over the (synced)
@@ -300,13 +338,75 @@ def make_train_step(
     )
     state_spec = P()  # replicated
     batch_spec = P(axis_name)  # sharded along the data axis
+    loss_spec = P(axis_name) if local_loss else P()
+    if not getattr(strategy, "stateful", False):
+        sharded = _shard_map(
+            impl,
+            mesh=mesh,
+            in_specs=(state_spec, batch_spec, batch_spec),
+            out_specs=(state_spec, loss_spec),
+        )
+        return jax.jit(sharded, donate_argnums=(0,)) if jit else sharded
+
+    # Stateful strategy (error-feedback compressed ring): the compiled
+    # step threads the strategy's per-device state through the program —
+    # state in, state out, DONATED, sharded P(batch) on a leading
+    # [world, ...] axis (each device owns its residual row).  The
+    # stateless path above compiles the exact program it always did:
+    # the uncompressed ring pays zero overhead for this feature.
+    res_spec = P(axis_name)
     sharded = _shard_map(
         impl,
         mesh=mesh,
-        in_specs=(state_spec, batch_spec, batch_spec),
-        out_specs=(state_spec, P(axis_name) if local_loss else P()),
+        in_specs=(state_spec, batch_spec, batch_spec, res_spec),
+        out_specs=(state_spec, loss_spec, res_spec),
     )
-    return jax.jit(sharded, donate_argnums=(0,)) if jit else sharded
+    if not jit:
+        # Un-jitted stateful form: the caller threads the state
+        # explicitly — step(state, x, y, sync_state) →
+        # (state, loss, sync_state) — e.g. a scanned-epoch bench
+        # carrying it alongside TrainState.
+        return sharded
+    inner = jax.jit(sharded, donate_argnums=(0, 3))
+
+    def fresh_sync_state(params):
+        """[world, *leaf] stacked zeros, sharded P(batch) over the mesh
+        — each device's row is its own (initially empty) residual.
+        Shapes come from an abstract eval of the strategy's init (no
+        throwaway full-size zeros tree is ever materialized)."""
+        res0 = jax.eval_shape(strategy.init_state, params)
+        stacked = jax.tree_util.tree_map(
+            lambda r: jnp.zeros((axis_size,) + r.shape, r.dtype), res0
+        )
+        return jax.device_put(
+            stacked, NamedSharding(mesh, P(axis_name))
+        )
+
+    holder = {"res": None}
+
+    def step(state, images_u8, labels):
+        # Caller-facing signature unchanged (state, x, y) → (state,
+        # loss): the wrapper owns the residual buffers, lazily zeroed
+        # from the first state's param shapes and re-donated each call.
+        if holder["res"] is None:
+            holder["res"] = fresh_sync_state(state.params)
+        new_state, loss, holder["res"] = inner(
+            state, images_u8, labels, holder["res"]
+        )
+        return new_state, loss
+
+    def sync_state():
+        """The CURRENT residual pytree — the live buffers the next
+        ``step()`` call donates back into the program, so a kept
+        reference dies with that call (Array deleted).  Copy before
+        holding across steps: ``jax.tree_util.tree_map(jnp.copy, ...)``."""
+        return holder["res"]
+
+    step.inner = inner  # AOT/lowering access: inner.lower(state, x, y, res)
+    step.fresh_sync_state = fresh_sync_state
+    step.sync_state = sync_state
+    step.reset_sync_state = lambda: holder.__setitem__("res", None)
+    return step
 
 
 def broadcast_bn_stats(state: TrainState, world: int) -> TrainState:

@@ -87,3 +87,20 @@ def mesh4():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(69143)
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs, check_vma=False):
+    """Replication-check-off shard_map for tests, across jax's API
+    rename (``runtime/mesh.py::shard_map_no_check`` owns the version
+    shim — new jax spells the flag ``check_vma``, the experimental API
+    ``check_rep``).  Drop-in for the old per-file
+    ``from jax import shard_map`` + ``check_vma=False`` pattern, which
+    breaks on jax versions where the top-level ``shard_map`` lacks the
+    kwarg."""
+    from distributed_machine_learning_tpu.runtime.mesh import (
+        shard_map_no_check,
+    )
+
+    return shard_map_no_check(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+    )

@@ -6,10 +6,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-try:  # jax>=0.6 exposes shard_map at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from conftest import shard_map_compat as shard_map
 
 from distributed_machine_learning_tpu.cli.common import init_model_and_state
 from distributed_machine_learning_tpu.models.vgg import VGGTest

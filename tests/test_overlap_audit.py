@@ -1,10 +1,17 @@
 """Schedule-walker unit tests for the ring overlap audit
 (bench/overlap_audit.py); the TPU AOT compile itself is exercised by
-the audit's __main__ on TPU-capable hosts."""
+the audit's __main__ on TPU-capable hosts.  The wire-byte audit
+(--wire-bytes) additionally gets a REAL compile check here: the CPU
+backend names collective-permute identically, so the int8-vs-exact
+byte ratio is asserted against actual compiled executables in CI."""
 
 import pytest
 
-from distributed_machine_learning_tpu.bench.overlap_audit import audit_schedule
+from distributed_machine_learning_tpu.bench.overlap_audit import (
+    audit_schedule,
+    compile_ring_hlo,
+    wire_bytes_from_hlo,
+)
 
 HLO = """\
 HloModule m
@@ -33,3 +40,63 @@ def test_audit_counts_windows_and_overlap():
 def test_audit_rejects_entryless_text():
     with pytest.raises(ValueError, match="ENTRY"):
         audit_schedule("HloModule empty")
+
+
+WIRE_HLO = """\
+HloModule m
+
+ENTRY main {
+  p0 = f32[64]{0} parameter(0)
+  q = s8[64]{0} convert(p0)
+  cp.1 = s8[64]{0} collective-permute(q), source_target_pairs={{0,1}}
+  s = f32[1]{0} constant({1.0})
+  cp.2 = f32[1]{0} collective-permute(s), source_target_pairs={{0,1}}
+  cps.1 = (f32[2,8]{1,0}, f32[2,8]{1,0}) collective-permute-start(p0), source_target_pairs={{0,1}}
+  cpd.1 = f32[2,8]{1,0} collective-permute-done(cps.1)
+  ROOT r = f32[64]{0} convert(cp.1)
+}
+"""
+
+
+def test_wire_bytes_parser_counts_defs_once():
+    """Sync and async forms both count; a start's tuple result counts
+    the operand buffer only (not the paired result buffer), and -done
+    lines are uses, never double-counted."""
+    got = wire_bytes_from_hlo(WIRE_HLO)
+    assert got["count"] == 3
+    # s8[64]=64B + f32[1]=4B + first tuple element f32[2,8]=64B
+    assert got["total_bytes"] == 64 + 4 + 64
+    assert got["by_dtype"] == {"s8": 64, "f32": 68}
+
+
+def test_wire_bytes_parser_empty_module():
+    got = wire_bytes_from_hlo("HloModule m\nENTRY main { ROOT r = f32[] constant(0) }")
+    assert got == {"total_bytes": 0, "count": 0, "by_dtype": {}}
+
+
+def test_wire_bytes_ci_regression_int8_vs_exact(mesh8):
+    """The fast CI gate (ISSUE 7 satellite): compile a real bucketed
+    ring for the 8-device mesh, exact and int8, and assert the
+    compressed executable moves ≤ 1/3 of the exact one's
+    collective-permute bytes — read from the compiled programs, so a
+    regression that silently decompresses the wire fails here."""
+    from distributed_machine_learning_tpu.ops.ring import ring_wire_bytes
+    from distributed_machine_learning_tpu.ops.ring import get_wire_scheme
+
+    length = 4096
+    exact = wire_bytes_from_hlo(
+        compile_ring_hlo(mesh8, length, bucket_bytes=8192)
+    )
+    int8 = wire_bytes_from_hlo(
+        compile_ring_hlo(mesh8, length, compress="int8", bucket_bytes=8192)
+    )
+    assert exact["count"] > 0 and int8["count"] > 0
+    assert int8["total_bytes"] * 3 <= exact["total_bytes"]
+    # The compiled programs' byte totals match the static accounting the
+    # telemetry counter uses — the two can never drift apart silently.
+    assert exact["total_bytes"] == ring_wire_bytes(
+        length, 8, bucket_bytes=8192
+    )
+    assert int8["total_bytes"] == ring_wire_bytes(
+        length, 8, bucket_bytes=8192, scheme=get_wire_scheme("int8")
+    )

@@ -1,5 +1,7 @@
 """The hand-rolled ppermute ring vs lax.psum/pmean (SURVEY.md §4d):
-property tests on an 8-device CPU mesh."""
+property tests on an 8-device CPU mesh — plus the round-7 compressed
+ring (int8/topk wire schemes, error-feedback residuals, wire-byte
+accounting and the slow acceptance audit)."""
 
 import functools
 
@@ -10,14 +12,13 @@ import pytest
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from conftest import shard_map_compat as shard_map
 
 from distributed_machine_learning_tpu.ops.ring import (
+    get_wire_scheme,
     ring_all_reduce,
     ring_all_reduce_flat,
+    ring_wire_bytes,
 )
 
 
@@ -97,3 +98,384 @@ def test_ring_matches_pmean_collective(mesh4, rng):
 def test_ring_single_device_identity():
     x = jnp.arange(10.0)
     assert np.allclose(ring_all_reduce_flat(x, "batch", 1), x)
+
+
+# ---------------------------------------------------------------------------
+# Compressed ring (round 7): int8 / topk wire schemes.
+# ---------------------------------------------------------------------------
+
+
+def _reduce_compressed(n, data, scheme, mean=True, length=None):
+    """Run the compressed flat ring on an n-device mesh; returns the
+    [n, L] per-rank outputs."""
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh(n)
+    f = shard_map(
+        lambda v: ring_all_reduce_flat(
+            v.reshape(-1), "batch", n, mean=mean, scheme=scheme
+        )[None],
+        mesh=mesh, in_specs=P("batch"), out_specs=P("batch"),
+        check_vma=False,
+    )
+    return np.asarray(jax.jit(f)(jnp.asarray(data)))
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize("length", [64, 1000])
+def test_int8_ring_close_and_rank_identical(world, length, rng):
+    """Per-chunk int8+scale hops: every rank ends with IDENTICAL bits
+    (encoded payloads are relayed verbatim in the gather phase), and
+    the value is within accumulated per-hop quantization error of the
+    exact mean."""
+    data = rng.standard_normal((world, length)).astype(np.float32)
+    out = _reduce_compressed(world, data, get_wire_scheme("int8"))
+    for d in range(1, world):
+        np.testing.assert_array_equal(out[d], out[0])
+    exact = data.sum(axis=0) / world
+    # Each of the ≤2(n−1) lossy encodes rounds by ≤ scale/2 = amax/254;
+    # partial-sum amax is bounded by the column-sum amax.
+    bound = 2 * world * np.abs(data).sum(axis=0).max() / 254 / world
+    assert np.max(np.abs(out[0] - exact)) <= max(bound, 0.05)
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_topk_full_frac_is_exact(world, rng):
+    """topk with frac=1.0 sends every element — the scatter/relay
+    plumbing must then reproduce the exact ring bit-for-bit in value."""
+    data = rng.standard_normal((world, 257)).astype(np.float32)
+    out = _reduce_compressed(
+        world, data, get_wire_scheme("topk", topk_frac=1.0)
+    )
+    exact = data.sum(axis=0) / world
+    for d in range(world):
+        np.testing.assert_allclose(out[d], exact, rtol=1e-5, atol=1e-5)
+
+
+def test_topk_partial_frac_rank_identical_and_bounded(rng):
+    n = 8
+    data = rng.standard_normal((n, 512)).astype(np.float32)
+    out = _reduce_compressed(
+        n, data, get_wire_scheme("topk", topk_frac=0.25)
+    )
+    for d in range(1, n):
+        np.testing.assert_array_equal(out[d], out[0])
+    exact = data.sum(axis=0) / n
+    # Sparsification drops mass but must never invent it.
+    assert np.max(np.abs(out[0] - exact)) <= np.abs(data).sum(0).max() / n
+
+
+@pytest.mark.parametrize("scheme_name", ["int8", "topk"])
+def test_compressed_pytree_ragged_buckets(mesh8, scheme_name, rng):
+    """Tiny bucket_bytes force many buckets with a ragged tail (the
+    last bucket shorter than the rest, chunks padded per rank); the
+    compressed pytree ring must still reduce every leaf and stay
+    rank-identical."""
+    n = 8
+    tree_shapes = {"w": (33, 17), "b": (129,), "k": (3, 3, 4, 8)}
+    data = {
+        k: rng.standard_normal((n, *s)).astype(np.float32)
+        for k, s in tree_shapes.items()
+    }
+    scheme = get_wire_scheme(scheme_name, topk_frac=1.0)
+
+    def per_device(tree):
+        local = jax.tree_util.tree_map(lambda x: x[0], tree)
+        out = ring_all_reduce(
+            local, "batch", n, mean=True, bucket_bytes=1024, scheme=scheme
+        )
+        return jax.tree_util.tree_map(lambda x: x[None], out)
+
+    wrapped = shard_map(
+        per_device, mesh=mesh8, in_specs=P("batch"), out_specs=P("batch"),
+        check_vma=False,
+    )
+    result = jax.jit(wrapped)(jax.tree_util.tree_map(jnp.asarray, data))
+    for k in tree_shapes:
+        expected = data[k].sum(axis=0) / n
+        for d in range(1, n):
+            np.testing.assert_array_equal(
+                np.asarray(result[k][d]), np.asarray(result[k][0])
+            )
+        tol = 0.08 if scheme_name == "int8" else 1e-5
+        np.testing.assert_allclose(
+            np.asarray(result[k][0]), expected, rtol=tol, atol=tol
+        )
+
+
+def test_ring_wire_bytes_accounting():
+    """Static byte accounting: exact=4B/elem; bf16 halves; int8 is
+    chunk+4 per hop (~4x); topk is 8B × k (~4x at frac=1/8) — and the
+    bucketed sum covers the ragged tail bucket."""
+    n, elems = 8, 10_000
+    exact = ring_wire_bytes(elems, n)
+    chunk = -(-elems // n)
+    assert exact == 2 * (n - 1) * chunk * 4
+    assert ring_wire_bytes(elems, n, scheme=get_wire_scheme("bf16")) \
+        == exact // 2
+    int8 = ring_wire_bytes(elems, n, scheme=get_wire_scheme("int8"))
+    assert exact / int8 > 3.9
+    topk = ring_wire_bytes(
+        elems, n, scheme=get_wire_scheme("topk", topk_frac=0.125)
+    )
+    assert exact / topk > 3.9
+    # Ragged buckets: 3 buckets of 1024B (256 elems) + a 192-elem tail.
+    ragged = ring_wire_bytes(960, 4, bucket_bytes=1024)
+    assert ragged == 2 * 3 * ((256 // 4) * 3 + (-(-192 // 4))) * 4
+    # Degenerate cases.
+    assert ring_wire_bytes(0, 8) == 0
+    assert ring_wire_bytes(100, 1) == 0
+
+
+def test_ring_residual_accounts_total_dropped_mass(mesh4, rng):
+    """Complete EF bookkeeping: summed over ranks, the residuals equal
+    the all-reduce's total compression error — N·(exact mean − output)
+    under mean semantics.  Every dropped byte lands in exactly one
+    rank's residual (per-hop send errors + the owner's broadcast gap)."""
+    n, L = 4, 192
+    data = rng.standard_normal((n, L)).astype(np.float32)
+
+    def per_device(v):
+        out, res = ring_all_reduce_flat(
+            v.reshape(-1), "batch", n, mean=True,
+            scheme=get_wire_scheme("topk", topk_frac=0.2),
+            return_residual=True,
+        )
+        return out[None], res[None]
+
+    f = shard_map(per_device, mesh=mesh4, in_specs=P("batch"),
+                  out_specs=(P("batch"), P("batch")))
+    out, res = jax.jit(f)(jnp.asarray(data))
+    out, res = np.asarray(out), np.asarray(res)
+    exact_mean = data.sum(axis=0) / n
+    # Residuals sum to N × the output's deviation from the exact mean.
+    np.testing.assert_allclose(
+        res.sum(axis=0), n * (exact_mean - out[0]), rtol=1e-4, atol=1e-4
+    )
+    # The exact scheme's residual is identically zero.
+    def per_device_exact(v):
+        out, r = ring_all_reduce_flat(
+            v.reshape(-1), "batch", n, mean=True, return_residual=True
+        )
+        return out[None], r[None]
+
+    g = shard_map(per_device_exact, mesh=mesh4, in_specs=P("batch"),
+                  out_specs=(P("batch"), P("batch")))
+    _, res0 = jax.jit(g)(jnp.asarray(data))
+    assert float(jnp.max(jnp.abs(res0))) == 0.0
+
+
+def test_error_feedback_recovers_dropped_mass(mesh4, rng):
+    """The EF acceptance property (satellite): with a PERSISTENT
+    gradient direction (the same per-rank gradient every step — the
+    canonical EF failure mode, where small coordinates are dropped by
+    top-k on every step and never transmitted), the cumulative synced
+    gradient of the topk ring WITH error feedback is closer to the
+    exact ring's than without: the residual grows the dropped
+    coordinates until they win a later step's top-k."""
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+
+    n, L, steps = 4, 256, 8
+    g_fixed = rng.standard_normal((n, L)).astype(np.float32)
+    grads = [g_fixed for _ in range(steps)]
+
+    def run(strategy):
+        stateful = strategy.stateful
+
+        def per_device(gs):
+            # gs: [1, steps, L] — this rank's gradient sequence.
+            g_seq = gs.reshape(steps, L)
+            res = jnp.zeros((L,), jnp.float32)
+            total = jnp.zeros((L,), jnp.float32)
+            for t in range(steps):
+                if stateful:
+                    synced, res = strategy.apply(
+                        g_seq[t], res, "batch", n
+                    )
+                else:
+                    synced = strategy(g_seq[t], "batch", n)
+                total = total + synced
+            return total[None]
+
+        f = shard_map(per_device, mesh=mesh4, in_specs=P("batch"),
+                      out_specs=P("batch"), check_vma=False)
+        stacked = jnp.asarray(np.stack(grads, axis=1))  # [n, steps, L]
+        return np.asarray(jax.jit(f)(stacked))[0]
+
+    exact = run(get_strategy("ring"))
+    with_ef = run(get_strategy("ring", compress="topk", topk_frac=0.1))
+    without = run(get_strategy("ring", compress="topk", topk_frac=0.1,
+                               error_feedback=False))
+    err_ef = np.linalg.norm(with_ef - exact)
+    err_no = np.linalg.norm(without - exact)
+    assert err_ef < err_no, (err_ef, err_no)
+    # And materially so (measured ~0.65 at this fixed seed): without EF
+    # the same mass is re-dropped every step and the error grows with T;
+    # with EF the outstanding error stays bounded at ~one step's drop.
+    assert err_ef < 0.75 * err_no, (err_ef, err_no)
+
+
+def test_stateful_step_threads_residual(mesh8, rng):
+    """make_train_step with an EF strategy keeps the (state, x, y) →
+    (state, loss) caller signature, threads the donated residual
+    internally, and the residual is per-device state that becomes
+    nonzero after a compressed step."""
+    from distributed_machine_learning_tpu.cli.common import (
+        init_model_and_state,
+    )
+    from distributed_machine_learning_tpu.models.registry import get_model
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+    from distributed_machine_learning_tpu.train.sgd import SGDConfig
+    from distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+        shard_batch,
+    )
+
+    model = get_model("vggtest", use_bn=False)
+    strategy = get_strategy("ring", compress="int8")
+    assert strategy.stateful
+    state = init_model_and_state(
+        model, config=SGDConfig(learning_rate=0.1, weight_decay=0.0)
+    )
+    step = make_train_step(model, strategy, mesh=mesh8, augment=False)
+    assert step.sync_state() is None  # lazily initialized
+    for _ in range(2):
+        x = rng.integers(0, 256, (32, 32, 32, 3), dtype=np.uint8)
+        y = rng.integers(0, 10, 32).astype(np.int32)
+        state, loss = step(state, *shard_batch(mesh8, x, y))
+    assert np.isfinite(float(loss))
+    res = step.sync_state()
+    leaves = jax.tree_util.tree_leaves(res)
+    assert leaves and leaves[0].shape[0] == 8  # [world, ...] sharded rows
+    assert any(float(jnp.max(jnp.abs(l))) > 0 for l in leaves)
+    # Params stayed replicated and finite through the stateful program.
+    for p in jax.tree_util.tree_leaves(state.params):
+        assert bool(jnp.all(jnp.isfinite(p)))
+    step.reset_sync_state()
+    assert step.sync_state() is None
+
+
+def test_cli_ring_compress_flags():
+    """Flag surface: --ring-compress choices parse onto the namespace,
+    --ring-topk-frac is validated at parse time (before any runtime
+    spin-up), and error feedback defaults on with an opt-out."""
+    from distributed_machine_learning_tpu.cli.common import (
+        make_flag_parser,
+        parse_flags,
+    )
+
+    parser = make_flag_parser("test")
+    args = parse_flags(parser, ["--ring-compress", "int8"])
+    assert args.ring_compress == "int8"
+    assert args.ring_error_feedback is True
+    args = parse_flags(parser, ["--ring-compress", "topk",
+                                "--ring-topk-frac", "0.25",
+                                "--ring-no-error-feedback"])
+    assert args.ring_topk_frac == 0.25
+    assert args.ring_error_feedback is False
+    with pytest.raises(SystemExit):
+        parse_flags(parser, ["--ring-topk-frac", "0"])
+    with pytest.raises(SystemExit):
+        parse_flags(parser, ["--ring-compress", "fp4"])
+
+
+def test_ring_strategy_compress_validation():
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+
+    with pytest.raises(ValueError, match="compress"):
+        get_strategy("ring", compress="fp4")
+    with pytest.raises(ValueError, match="topk_frac"):
+        get_strategy("ring", compress="topk", topk_frac=0.0)
+    with pytest.warns(DeprecationWarning, match="wire_dtype"):
+        s = get_strategy("ring", wire_dtype="bfloat16")
+    assert s.scheme().name == "bf16"
+    assert not s.stateful  # cast-only stays stateless
+    assert not get_strategy(
+        "ring", compress="int8", error_feedback=False
+    ).stateful
+
+
+@pytest.mark.slow
+def test_int8_ring_acceptance_audit_and_parity(mesh8, rng):
+    """The round-7 acceptance criteria, both halves:
+
+    1. HLO wire-byte audit: the AOT-compiled part3 train step (vggtest,
+       8-device mesh) moves ≥3x fewer collective-permute payload bytes
+       with the int8 ring than the exact ring — read from the compiled
+       executables, not the source.
+    2. Fixed-seed parity: over a 40-iteration synthetic run, the
+       int8+error-feedback ring's final loss is within 1% relative of
+       the uncompressed ring's.
+    """
+    from distributed_machine_learning_tpu.bench.overlap_audit import (
+        wire_bytes_from_hlo,
+    )
+    from distributed_machine_learning_tpu.cli.common import (
+        init_model_and_state,
+    )
+    from distributed_machine_learning_tpu.models.registry import get_model
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+    from distributed_machine_learning_tpu.train.sgd import SGDConfig
+    from distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+        shard_batch,
+    )
+
+    model = get_model("vggtest", use_bn=False)
+
+    def lower_hlo(strategy):
+        step = make_train_step(model, strategy, mesh=mesh8, augment=False)
+        state_shape = jax.eval_shape(
+            lambda: init_model_and_state(
+                model,
+                config=SGDConfig(learning_rate=0.1, weight_decay=0.0),
+            )
+        )
+        x = jax.ShapeDtypeStruct((32, 32, 32, 3), jnp.uint8)
+        y = jax.ShapeDtypeStruct((32,), jnp.int32)
+        if getattr(strategy, "stateful", False):
+            res = jax.eval_shape(
+                lambda: step.fresh_sync_state(state_shape.params)
+            )
+            return step.inner.lower(
+                state_shape, x, y, res
+            ).compile().as_text()
+        return step.lower(state_shape, x, y).compile().as_text()
+
+    exact_bytes = wire_bytes_from_hlo(lower_hlo(get_strategy("ring")))
+    int8_bytes = wire_bytes_from_hlo(
+        lower_hlo(get_strategy("ring", compress="int8"))
+    )
+    assert exact_bytes["count"] > 0 and int8_bytes["count"] > 0
+    ratio = int8_bytes["total_bytes"] / exact_bytes["total_bytes"]
+    assert ratio <= 1 / 3, (int8_bytes, exact_bytes)
+
+    # -- half 2: fixed-seed loss parity over the 40-iter protocol ------
+    batches = [
+        (rng.integers(0, 256, (64, 32, 32, 3), dtype=np.uint8),
+         rng.integers(0, 10, 64).astype(np.int32))
+        for _ in range(40)
+    ]
+
+    def final_loss(strategy):
+        state = init_model_and_state(
+            model, config=SGDConfig(learning_rate=0.1, weight_decay=0.0)
+        )
+        step = make_train_step(model, strategy, mesh=mesh8, augment=False)
+        loss = None
+        for x, y in batches:
+            state, loss = step(state, *shard_batch(mesh8, x, y))
+        return float(loss)
+
+    exact_loss = final_loss(get_strategy("ring"))
+    int8_loss = final_loss(get_strategy("ring", compress="int8"))
+    rel = abs(int8_loss - exact_loss) / abs(exact_loss)
+    assert rel <= 0.01, (int8_loss, exact_loss, rel)

@@ -509,6 +509,36 @@ def test_train_epoch_emits_phase_spans_and_rows(tmp_path):
     assert hists["step_seconds"]["count"] == 2
 
 
+def test_train_epoch_applies_static_step_counters(tmp_path):
+    """``Telemetry.step_counters``: static per-step increments the CLI
+    registers (ring_wire_bytes) accumulate once per completed step and
+    land in the registry snapshot next to the compression-ratio gauge —
+    the surface trace_summary and gang benches read bytes-saved from."""
+    from distributed_machine_learning_tpu.train.loop import train_epoch
+
+    with Telemetry(tmp_path, flush_every=1) as tel:
+        tel.step_counters["ring_wire_bytes"] = 1000
+        tel.registry.gauge("ring_compression_ratio").set(4.0)
+        train_epoch(
+            _fake_step, _S(), _img_batches(3),
+            place_batch=lambda x, y: (x, y), max_iters=10,
+            loss_print_every=10**9, telemetry=tel,
+        )
+    snap = json.loads((tmp_path / "registry.json").read_text())
+    counters = {c["name"]: c["value"] for c in snap["counters"]}
+    assert counters["ring_wire_bytes"] == 3000
+    gauges = {g["name"]: g["value"] for g in snap["gauges"]}
+    assert gauges["ring_compression_ratio"] == 4.0
+    # trace_summary's ring section renders from exactly this snapshot.
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "trace_summary.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "Ring wire compression" in out
+    assert "3,000" in out and "compression ratio        4.00x" in out
+
+
 def test_train_epoch_token_batches_report_tokens_per_s(tmp_path):
     from distributed_machine_learning_tpu.train.loop import train_epoch
 

@@ -165,6 +165,24 @@ def summarize(telemetry_dir: str, top: int = 5) -> str:
                 lines.append(
                     f"  {c['labels'].get('kind', '?'):<18} {c['value']}"
                 )
+        # -- ring wire compression, if the run synced through the ring --
+        wire = [c for c in snap.get("counters", [])
+                if c.get("name") == "ring_wire_bytes"]
+        ratio = [g for g in snap.get("gauges", [])
+                 if g.get("name") == "ring_compression_ratio"]
+        if wire:
+            total = sum(c.get("value", 0) for c in wire)
+            r = ratio[0].get("value") if ratio else None
+            lines.append("== Ring wire compression ==")
+            lines.append(f"  wire bytes (whole run)   {total:,.0f}")
+            if r:
+                lines.append(f"  compression ratio        {r:.2f}x "
+                             f"(exact/compressed)")
+                if r > 1:
+                    saved = total * (r - 1)
+                    lines.append(
+                        f"  bytes saved vs exact     {saved:,.0f}"
+                    )
     return "\n".join(lines)
 
 
