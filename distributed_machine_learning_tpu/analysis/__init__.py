@@ -1,0 +1,32 @@
+"""dmlcheck — static analysis for distributed-correctness invariants.
+
+Every hard bug this repo has shipped or fixed belongs to a recurring,
+mechanically detectable class: the restore-then-donate heap corruption
+(ISSUE 1), cross-host wall-clock comparisons the heartbeat sampler had
+to ban (ISSUE 6), ledgers that must fsync before ``os._exit`` (ISSUE 3),
+and the critical-path all-gather in the zero1 weight update that
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arxiv 2004.13336) exists to eliminate.  This package turns
+that tribal knowledge into a checker:
+
+- **Layer 1** (:mod:`.ast_rules`): stdlib-only AST rules over the
+  package source — importable and runnable WITHOUT jax, fast enough for
+  tier-1 (``tests/test_dmlcheck.py::test_package_is_clean``).
+- **Layer 2** (:mod:`.program_audit`): jaxpr/HLO audit passes that lower
+  real train steps and assert structural properties of the COMPILED
+  program (donation actually taken, no sync all-gather on the weight-
+  update critical path, collective wire bytes equal to the static
+  accounting).  Imports jax lazily, inside the audit functions.
+
+Front door: ``tools/dmlcheck.py`` (``--json`` for machine-readable
+verdicts, consistent with ``ckpt_verify --json``).  Justified
+suppressions live in the checked-in ``dmlcheck_baseline.json``.
+"""
+
+from distributed_machine_learning_tpu.analysis.findings import (  # noqa: F401
+    BaselineError,
+    Finding,
+    apply_baseline,
+    findings_to_json,
+    load_baseline,
+)

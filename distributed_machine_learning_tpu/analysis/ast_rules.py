@@ -1,0 +1,823 @@
+"""Layer 1: stdlib-only AST lint rules over the repo source.
+
+Each rule encodes one invariant this repo learned the hard way; the
+docstring of every checker names the incident it descends from (the
+rule table in docs/ARCHITECTURE.md cross-references them).  The module
+imports NOTHING beyond the stdlib — ``tests/test_dmlcheck.py`` asserts
+Layer 1 runs over the whole package in under 10 s without jax in
+``sys.modules``.
+
+Scope model: every rule declares which repo-relative paths it applies
+to (``runtime/`` + ``telemetry/`` for the clock rules, ``tests/`` for
+the marker rules, everywhere for the hygiene rules).  Fixtures under
+``tests/fixtures/dmlcheck/`` carry a ``# dmlcheck-virtual-path:`` header
+so a deliberate-violation snippet can exercise a scoped rule without
+living at the scoped path — and that directory is excluded from real
+scans for the same reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Callable, Iterable, Iterator
+
+from distributed_machine_learning_tpu.analysis.findings import Finding
+
+PACKAGE_DIR = "distributed_machine_learning_tpu"
+
+# Directories a repo scan walks; fixtures are deliberate violations.
+SCAN_DIRS = (PACKAGE_DIR, "tools", "tests")
+EXCLUDE_PARTS = ("__pycache__", os.path.join("tests", "fixtures"))
+
+VIRTUAL_PATH_RE = re.compile(r"#\s*dmlcheck-virtual-path:\s*(\S+)")
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+class Rule:
+    def __init__(self, rule_id: str, title: str, incident: str,
+                 applies: Callable[[str], bool],
+                 check: Callable[["FileContext"], Iterator[Finding]]):
+        self.id = rule_id
+        self.title = title
+        self.incident = incident
+        self.applies = applies
+        self.check = check
+
+
+RULES: dict[str, Rule] = {}
+
+
+def _rule(rule_id: str, title: str, incident: str,
+          applies: Callable[[str], bool]):
+    def wrap(fn):
+        RULES[rule_id] = Rule(rule_id, title, incident, applies, fn)
+        return fn
+    return wrap
+
+
+class FileContext:
+    """One parsed source file, with the shared lookups rules need."""
+
+    def __init__(self, path: str, src: str):
+        self.path = path.replace(os.sep, "/")
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src)
+        self._parents: dict[ast.AST, ast.AST] | None = None
+
+    def line(self, node: ast.AST) -> str:
+        ln = getattr(node, "lineno", 0)
+        return self.lines[ln - 1].strip() if 0 < ln <= len(self.lines) else ""
+
+    def seg(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.src, node) or self.line(node)
+
+    def parents(self) -> dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        parents = self.parents()
+        while node in parents:
+            node = parents[node]
+            yield node
+
+    def finding(self, rule_id: str, node: ast.AST, message: str,
+                severity: str = "error") -> Finding:
+        return Finding(rule=rule_id, file=self.path,
+                       line=getattr(node, "lineno", 0), message=message,
+                       snippet=self.line(node), severity=severity, layer=1)
+
+
+# ---------------------------------------------------------------------------
+# Shared AST predicates
+# ---------------------------------------------------------------------------
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted best-effort name of a call target ('os.path.getmtime')."""
+    return _dotted(node.func)
+
+
+def _dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        return _dotted(node.func)
+    return ""
+
+
+_WALL_CALLS = {"time.time", "datetime.now", "datetime.utcnow",
+               "datetime.datetime.now", "datetime.datetime.utcnow",
+               "os.path.getmtime"}
+
+
+def _is_wall_clock(node: ast.AST) -> bool:
+    """A wall-clock reading: ``time.time()``, ``datetime.now()`` (and
+    ``.timestamp()`` thereof), ``os.path.getmtime``, or an ``st_mtime``
+    attribute.  ``st_mtime_ns`` used in EQUALITY is fine (change-
+    signature staleness, the ISSUE 6 idiom) — callers only pass nodes
+    that sit in ordering/subtraction positions."""
+    if isinstance(node, ast.Call):
+        name = _call_name(node)
+        if name in _WALL_CALLS:
+            return True
+        # datetime.now().timestamp()
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "timestamp"
+                and isinstance(node.func.value, ast.Call)
+                and _is_wall_clock(node.func.value)):
+            return True
+        return False
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("st_mtime", "st_mtime_ns")
+    return False
+
+
+def _contains_wall_clock(node: ast.AST, tainted: set[str]) -> bool:
+    for sub in ast.walk(node):
+        if _is_wall_clock(sub):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _walk_scope(body: list, *, skip_functions: bool) -> Iterator[ast.AST]:
+    """Walk statements/expressions under ``body``; with
+    ``skip_functions`` nested function subtrees are not entered (their
+    locals are a different scope)."""
+    stack = list(reversed(body))
+    while stack:
+        node = stack.pop()
+        if skip_functions and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+def _ordered_walk(node: ast.AST) -> list[ast.AST]:
+    """Every descendant sorted by source position — ``ast.walk`` is
+    BFS, which breaks anything order-sensitive (taint tracking)."""
+    return sorted(
+        (n for n in ast.walk(node) if hasattr(n, "lineno")),
+        key=lambda n: (n.lineno, n.col_offset),
+    )
+
+
+def _assigned_names(target: ast.AST) -> Iterator[str]:
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _assigned_names(elt)
+
+
+def _in_package(path: str) -> bool:
+    return path.startswith(PACKAGE_DIR + "/")
+
+
+def _pkg_or_tools(path: str) -> bool:
+    return _in_package(path) or path.startswith("tools/")
+
+
+def _everywhere(path: str) -> bool:
+    return True
+
+
+def _tests_only(path: str) -> bool:
+    return path.startswith("tests/")
+
+
+# ---------------------------------------------------------------------------
+# DML001 — wall-clock arithmetic (the ISSUE 6 monotonic-clock ban)
+# ---------------------------------------------------------------------------
+
+@_rule(
+    "DML001", "wall-clock reading used in ordering or subtraction",
+    "ISSUE 6: cross-host mtime/wall-clock staleness misjudged peers by "
+    "routine NFS clock skew; the heartbeat sampler was rebuilt on "
+    "change-signatures + the local monotonic clock.",
+    _pkg_or_tools,
+)
+def check_wall_clock_arithmetic(ctx: FileContext) -> Iterator[Finding]:
+    """``time.time()`` / ``datetime.now()`` / ``getmtime`` / ``st_mtime``
+    in a ``<``/``>`` comparison or a subtraction — durations and
+    staleness must use ``time.monotonic()``/``perf_counter`` (equality
+    on ``st_mtime_ns`` is the sanctioned change-signature idiom and is
+    NOT flagged).  Recording a wall timestamp into a payload is fine;
+    doing arithmetic on one is the bug."""
+    # Each scope (module body, each function body) is taint-tracked
+    # independently; nested functions are their own scope, so `now`
+    # meaning monotonic in one function never poisons another.
+    scopes: list[list] = [ctx.tree.body]
+    scopes += [fn.body for fn in _functions(ctx.tree)]
+    for body in scopes:
+        tainted: set[str] = set()
+        for node in _walk_scope(body, skip_functions=True):
+            if isinstance(node, ast.Assign) and _is_wall_clock(node.value):
+                for t in node.targets:
+                    tainted.update(_assigned_names(t))
+        for node in _walk_scope(body, skip_functions=True):
+            bad = None
+            if isinstance(node, ast.Compare) and any(
+                    isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
+                    for op in node.ops):
+                operands = [node.left, *node.comparators]
+                if any(_contains_wall_clock(o, tainted) for o in operands):
+                    bad = node
+            elif isinstance(node, ast.BinOp) and isinstance(
+                    node.op, ast.Sub):
+                if (_contains_wall_clock(node.left, tainted)
+                        or _contains_wall_clock(node.right, tainted)):
+                    bad = node
+            if bad is not None:
+                yield ctx.finding(
+                    "DML001", bad,
+                    "wall-clock reading used in ordering/subtraction "
+                    "— cross-host wall clocks and file mtimes skew "
+                    "by minutes on shared mounts; use "
+                    "time.monotonic()/perf_counter for durations "
+                    "and change-signatures for staleness",
+                )
+
+
+# ---------------------------------------------------------------------------
+# DML002 — ledger writes must flush+fsync (ISSUE 3 fired-fault ledger)
+# ---------------------------------------------------------------------------
+
+# Token must not be the tail of a longer word ('default' is not
+# 'fault'); a leading '_'/'.'/quote is how the tokens appear in real
+# identifiers (self._ledger_path, gang_health.jsonl, consumed_rank).
+_LEDGER_TOKEN_RE = re.compile(
+    r"(?<![a-z])(ledger|fault|health|consumed)", re.IGNORECASE)
+
+
+def _ledgerish(path_src: str) -> bool:
+    return _LEDGER_TOKEN_RE.search(path_src) is not None
+
+
+@_rule(
+    "DML002", "ledger append without flush+fsync",
+    "ISSUE 3: the fired-fault ledger is read by the relaunched gang — a "
+    "buffered entry lost to the very next os._exit re-fires the fault "
+    "every attempt and no restart budget suffices.",
+    _pkg_or_tools,
+)
+def check_ledger_fsync(ctx: FileContext) -> Iterator[Finding]:
+    """Every ``with open(<ledger-ish path>, "a")`` block must call both
+    ``.flush()`` and ``os.fsync(...)`` before leaving — the writer's
+    very next statement may be ``os._exit`` (coordinated abort, injected
+    kill), which skips buffered IO.  Ledger-ish = the path expression
+    mentions ledger/fault/health/consumed (``faults_fired.jsonl``,
+    ``gang_health.jsonl``, ``consumed_rank<r>.jsonl``, ``*_ledger``)."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            call = item.context_expr
+            if not (isinstance(call, ast.Call)
+                    and _call_name(call) == "open" and len(call.args) >= 2):
+                continue
+            mode = call.args[1]
+            if not (isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and mode.value.startswith("a")):
+                continue
+            path_src = ctx.seg(call.args[0])
+            if not _ledgerish(path_src):
+                continue
+            body_src = "\n".join(ctx.seg(s) for s in node.body)
+            has_flush = ".flush()" in body_src
+            has_fsync = "fsync(" in body_src
+            if not (has_flush and has_fsync):
+                missing = [w for w, ok in (("flush", has_flush),
+                                           ("os.fsync", has_fsync))
+                           if not ok]
+                yield ctx.finding(
+                    "DML002", node,
+                    f"ledger append without {' + '.join(missing)} — the "
+                    "next statement may be os._exit (abort/kill), which "
+                    "drops buffered rows; the relaunch then replays "
+                    "history that was never durable",
+                )
+
+
+# ---------------------------------------------------------------------------
+# DML003 — restored buffers into a donating step (ISSUE 1 segfault)
+# ---------------------------------------------------------------------------
+
+# Raw restore surfaces whose results alias storage (orbax/tensorstore
+# zero-copy).  restore_checkpoint / reshard_restore are NOT here: they
+# re-materialize through fresh_buffers internally (the ISSUE 1 fix) and
+# are the safe front doors.
+_RESTORE_CALLS = ()
+_RESTORE_ATTRS = ("restore",)           # orbax ckptr.restore(...)
+_RESTORE_NAME_RE = re.compile(r"(^|_)raw_restore|restore_raw")
+_CLEANSE_CALLS = ("fresh_buffers", "_fresh_buffers")
+
+
+@_rule(
+    "DML003", "restored/aliased buffers handed to a donating step",
+    "ISSUE 1: zero-copy numpy/tensorstore aliases of restored leaves "
+    "fed to a donate_argnums step segfaulted the seed suite — donation "
+    "frees the buffer under the alias (fixed with checkpoint.py::"
+    "fresh_buffers).",
+    _in_package,
+)
+def check_restore_then_donate(ctx: FileContext) -> Iterator[Finding]:
+    """Intra-function taint: a name bound from a raw restore (orbax
+    ``.restore(...)``, ``reshard_restore``) must pass through
+    ``fresh_buffers`` before being handed to any ``*step*`` call — the
+    compiled steps donate their state argument, and a restore's zero-
+    copy aliases die with the donated buffer.  (``restore_checkpoint``
+    re-materializes internally and is safe to call directly.)"""
+    for fn in _functions(ctx.tree):
+        tainted: set[str] = set()
+        # Source-position order approximates execution order well
+        # enough for a lint (ast.walk is BFS, which does not).
+        for stmt in _ordered_walk(fn):
+            if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call):
+                call = stmt.value
+                name = _call_name(call)
+                attr = (call.func.attr
+                        if isinstance(call.func, ast.Attribute) else "")
+                targets = [n for t in stmt.targets
+                           for n in _assigned_names(t)]
+                if (name.split(".")[-1] in _RESTORE_CALLS
+                        or attr in _RESTORE_ATTRS
+                        or _RESTORE_NAME_RE.search(name.split(".")[-1])):
+                    tainted.update(targets)
+                elif name.split(".")[-1] in _CLEANSE_CALLS:
+                    tainted.difference_update(targets)
+            if isinstance(stmt, ast.Call):
+                callee = _call_name(stmt).split(".")[-1]
+                if "step" in callee and callee not in _CLEANSE_CALLS:
+                    for arg in stmt.args:
+                        if (isinstance(arg, ast.Name)
+                                and arg.id in tainted):
+                            yield ctx.finding(
+                                "DML003", stmt,
+                                f"{arg.id!r} holds a raw restore result "
+                                f"and is passed to {callee!r} — the step "
+                                "donates its state, freeing the restored "
+                                "buffers under their zero-copy aliases; "
+                                "re-materialize via train.checkpoint."
+                                "fresh_buffers first",
+                            )
+
+
+# ---------------------------------------------------------------------------
+# DML004 — host syncs in the hot training loop (ISSUE 2 +2.8% budget)
+# ---------------------------------------------------------------------------
+
+_GUARD_TOKENS = ("tel", "telemetry", "events", "metrics", "until_step",
+                 "watchdog", "stop", "loss_print_every", "warmup",
+                 "profil")
+
+
+@_rule(
+    "DML004", "unguarded host sync in the train-loop hot path",
+    "ISSUE 2 set the telemetry-off budget at ONE pointer test per step; "
+    "every device_get/block_until_ready serializes dispatch and an "
+    "unguarded one taxes every run, consumers or not.",
+    lambda p: p.endswith("train/loop.py"),
+)
+def check_hot_loop_host_sync(ctx: FileContext) -> Iterator[Finding]:
+    """Inside the per-step loops of ``train/loop.py``'s ``train*``
+    functions, ``jax.device_get`` / ``.block_until_ready`` / ``.item()``
+    / ``float(loss-or-state)`` must sit under a consumer guard (``if
+    events is not None:``, ``if tel is not None:``, the print-interval
+    test, ...) so the no-consumer path stays a pointer test.  The one
+    deliberate exception — the reference measurement protocol's
+    ``block_until_ready`` timing bracket — is a baselined suppression,
+    not a pass."""
+    for fn in _functions(ctx.tree):
+        if "train" not in fn.name:
+            continue
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _call_name(node)
+                sync = (
+                    name in ("jax.device_get", "jax.block_until_ready")
+                    or (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("block_until_ready",
+                                               "item"))
+                    or (name == "float" and node.args and any(
+                        isinstance(s, ast.Name)
+                        and ("loss" in s.id or "state" in s.id)
+                        for s in ast.walk(node.args[0])))
+                )
+                if not sync:
+                    continue
+                guarded = False
+                for anc in ctx.ancestors(node):
+                    test = getattr(anc, "test", None)
+                    if isinstance(anc, (ast.If, ast.IfExp)) and \
+                            test is not None:
+                        test_src = ctx.seg(test)
+                        if any(tok in test_src for tok in _GUARD_TOKENS):
+                            guarded = True
+                            break
+                    if anc is loop:
+                        break
+                if not guarded:
+                    yield ctx.finding(
+                        "DML004", node,
+                        f"{name or 'host sync'} in the hot loop outside "
+                        "any consumer guard — serializes dispatch on "
+                        "every step even when nothing reads the value",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# DML005 — bare/swallowing exception handlers (ISSUE 3 verify chain)
+# ---------------------------------------------------------------------------
+
+@_rule(
+    "DML005", "bare except / swallowed verification error",
+    "ISSUE 3: a swallowed CheckpointVerifyError turns a detected-corrupt "
+    "checkpoint into silent garbage params — the fallback chain exists "
+    "so the error has somewhere to go.",
+    _pkg_or_tools,
+)
+def check_swallowed_errors(ctx: FileContext) -> Iterator[Finding]:
+    """Flags ``except:`` (catches SystemExit/KeyboardInterrupt — breaks
+    the gang teardown paths) and ``except CheckpointVerifyError/
+    Exception: pass`` bodies that neither re-raise, log, count, nor
+    inspect the exception — a verification error with no consumer is
+    corruption waved through."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            yield ctx.finding(
+                "DML005", node,
+                "bare 'except:' catches SystemExit/KeyboardInterrupt too "
+                "— it swallows the gang teardown/drain paths; name the "
+                "exceptions you mean",
+            )
+            continue
+        caught = ctx.seg(node.type)
+        if not ("CheckpointVerifyError" in caught
+                or caught.strip() == "Exception"):
+            continue
+        body_is_noop = all(
+            isinstance(s, (ast.Pass, ast.Continue)) for s in node.body)
+        if body_is_noop:
+            yield ctx.finding(
+                "DML005", node,
+                f"'except {caught.strip()}' swallowed with no re-raise, "
+                "log, or counter — a detected failure must reach a "
+                "consumer (fallback chain, FaultEvents, at least a log)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# DML006 — heavy tests must be marked (ISSUE 6 marker guard, extended)
+# ---------------------------------------------------------------------------
+
+_SPAWN_TOKENS = ("cli.gang", "runtime.gang_worker", "gang_worker.py",
+                 "mh_worker")
+_MESH_BUILDERS = ("make_mesh", "Mesh")
+
+
+def _string_constants(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value
+
+
+def _spawns_gang(node: ast.AST) -> bool:
+    return any(any(tok in s for tok in _SPAWN_TOKENS)
+               for s in _string_constants(node))
+
+
+def _big_mesh(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Call)
+                and _call_name(sub).split(".")[-1] in _MESH_BUILDERS
+                and sub.args
+                and isinstance(sub.args[0], ast.Constant)
+                and isinstance(sub.args[0].value, int)
+                and sub.args[0].value > 8):
+            return True
+    for s in _string_constants(node):
+        m = re.search(r"host_platform_device_count=(\d+)", s)
+        if m and int(m.group(1)) > 8:
+            return True
+    return False
+
+
+@_rule(
+    "DML006", "gang/large-mesh test without slow|faultinject marker",
+    "ISSUE 6's conftest guard bans unregistered markers; tier-1 runs "
+    "~500-750s against an 870s timeout, so a multi-process gang test "
+    "slipping into the default run is a suite timeout, not a slowdown.",
+    _tests_only,
+)
+def check_heavy_test_markers(ctx: FileContext) -> Iterator[Finding]:
+    """A test that spawns worker processes (``cli.gang`` /
+    ``gang_worker`` / ``mh_worker`` module paths, directly or via a
+    module-level helper) or builds a >8-device mesh must carry
+    ``@pytest.mark.slow`` or ``@pytest.mark.faultinject`` — resource
+    classes, extending the marker-registration guard in conftest."""
+    spawner_helpers = {
+        fn.name for fn in _functions(ctx.tree)
+        if not fn.name.startswith("test_")
+        and (_spawns_gang(fn) or _big_mesh(fn))
+    }
+    for fn in _functions(ctx.tree):
+        if not fn.name.startswith("test_"):
+            continue
+        marked = any(
+            tok in ctx.seg(d)
+            for d in fn.decorator_list
+            for tok in ("slow", "faultinject")
+        )
+        if marked:
+            continue
+        calls_spawner = any(
+            isinstance(n, ast.Call)
+            and _call_name(n).split(".")[-1] in spawner_helpers
+            for n in ast.walk(fn))
+        if _spawns_gang(fn) or _big_mesh(fn) or calls_spawner:
+            yield ctx.finding(
+                "DML006", fn,
+                f"{fn.name} spawns gang workers / a >8-device mesh but "
+                "carries neither @pytest.mark.slow nor .faultinject — "
+                "tier-1's timeout headroom cannot absorb it",
+            )
+
+
+# ---------------------------------------------------------------------------
+# DML007 — mutable defaults + nondeterministic manifest payloads
+# ---------------------------------------------------------------------------
+
+@_rule(
+    "DML007", "mutable default arg / nondeterministic manifest payload",
+    "ISSUE 5: checkpoint manifests are compared digest-for-digest "
+    "across ranks and world sizes — any nondeterminism in the payload "
+    "(wall timestamps, shared mutable defaults) breaks the bit-"
+    "identical resharding proof.",
+    _pkg_or_tools,
+)
+def check_deterministic_payloads(ctx: FileContext) -> Iterator[Finding]:
+    """(a) Mutable default arguments anywhere (a shared list/dict
+    default leaks state across calls — in ledger/manifest builders that
+    is cross-rank divergence); (b) wall-clock / datetime readings inside
+    ``train/checkpoint.py``'s manifest-building functions, whose output
+    every rank must reproduce byte-for-byte."""
+    for fn in _functions(ctx.tree):
+        for default in [*fn.args.defaults, *fn.args.kw_defaults]:
+            if default is None:
+                continue
+            mutable = isinstance(default, (ast.List, ast.Dict, ast.Set))
+            if (isinstance(default, ast.Call)
+                    and _call_name(default) in ("list", "dict", "set")):
+                mutable = True
+            if mutable:
+                yield ctx.finding(
+                    "DML007", default,
+                    f"mutable default argument in {fn.name}() — shared "
+                    "across calls; use None + in-body construction",
+                )
+    if ctx.path.endswith("train/checkpoint.py") or \
+            "manifest" in os.path.basename(ctx.path):
+        for fn in _functions(ctx.tree):
+            if not ("manifest" in fn.name or "save_checkpoint" in fn.name):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Call, ast.Attribute)) and \
+                        _is_wall_clock(node):
+                    yield ctx.finding(
+                        "DML007", node,
+                        f"wall-clock reading inside {fn.name}() — "
+                        "manifest payloads are digest-compared across "
+                        "ranks and must be deterministic",
+                    )
+                    break
+
+
+# ---------------------------------------------------------------------------
+# DML008 — subprocess without timeout in tests/tools
+# ---------------------------------------------------------------------------
+
+@_rule(
+    "DML008", "subprocess call without timeout",
+    "Tier-1 runs against a hard 870s kill: one hung child (wedged "
+    "rendezvous, dead gang) eats the entire suite budget instead of "
+    "failing one test.",
+    lambda p: p.startswith("tests/") or p.startswith("tools/"),
+)
+def check_subprocess_timeout(ctx: FileContext) -> Iterator[Finding]:
+    """``subprocess.run``/``check_output``/``check_call`` must pass
+    ``timeout=`` — a child that never exits must fail its own test, not
+    outlive the suite.  (``Popen`` is exempt: its bound lives on the
+    later ``communicate(timeout=...)``.)"""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name not in ("subprocess.run", "subprocess.check_output",
+                        "subprocess.check_call"):
+            continue
+        if not any(k.arg == "timeout" for k in node.keywords):
+            yield ctx.finding(
+                "DML008", node,
+                f"{name}(...) without timeout= — a hung child consumes "
+                "the tier-1 suite's whole 870s budget",
+            )
+
+
+# ---------------------------------------------------------------------------
+# DML009 — SystemExit/BaseException swallowed (ISSUE 6 drain path)
+# ---------------------------------------------------------------------------
+
+@_rule(
+    "DML009", "SystemExit/BaseException caught without propagating",
+    "ISSUE 6: gang_worker converts SIGTERM → SystemExit → flush-then-"
+    "die; a handler that eats SystemExit turns a coordinated drain "
+    "into a zombie rank whose telemetry never reaches disk.",
+    _everywhere,
+)
+def check_base_exception_swallow(ctx: FileContext) -> Iterator[Finding]:
+    """A handler catching ``SystemExit`` or ``BaseException`` must
+    either re-``raise`` or visibly hand the exception off (reference
+    the bound name — the loader's producer-thread channel pattern).
+    ``KeyboardInterrupt`` alone is exempt (deliberate ctrl-C handling
+    in the watch tools)."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler) or node.type is None:
+            continue
+        caught = ctx.seg(node.type)
+        if not ("SystemExit" in caught or "BaseException" in caught):
+            continue
+        has_raise = any(isinstance(s, ast.Raise)
+                        for s in ast.walk(node))
+        uses_exc = node.name is not None and any(
+            isinstance(s, ast.Name) and s.id == node.name
+            for b in node.body for s in ast.walk(b))
+        if not (has_raise or uses_exc):
+            yield ctx.finding(
+                "DML009", node,
+                f"'except {caught.strip()}' neither re-raises nor hands "
+                "the exception off — this eats the SIGTERM→SystemExit "
+                "drain path (flush-then-die) and process teardown",
+            )
+
+
+# ---------------------------------------------------------------------------
+# DML010 — append-only artifacts opened in truncate mode
+# ---------------------------------------------------------------------------
+
+@_rule(
+    "DML010", "append-only ledger/stream opened with mode 'w'",
+    "ISSUE 2: a supervisor re-exec resumes attempt numbering from disk "
+    "so restarts APPEND, never truncate — 'w' on a JSONL stream erases "
+    "the pre-crash attempts a post-mortem needs.",
+    _pkg_or_tools,
+)
+def check_ledger_truncate(ctx: FileContext) -> Iterator[Finding]:
+    """``open(<*.jsonl or ledger-ish path>, "w")`` — the JSONL streams
+    (metrics, ledgers, health events, consumption records) are whole-
+    run history; writers must append."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node) == "open" and len(node.args) >= 2):
+            continue
+        mode = node.args[1]
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and mode.value.startswith("w")):
+            continue
+        path_src = ctx.seg(node.args[0])
+        if ".jsonl" in path_src.lower() or _ledgerish(path_src):
+            yield ctx.finding(
+                "DML010", node,
+                "append-only JSONL/ledger opened with mode "
+                f"{mode.value!r} — truncates whole-run history that "
+                "restarts and post-mortems read; open with 'a'",
+            )
+
+
+# ---------------------------------------------------------------------------
+# DML011 — os._exit outside the runtime package
+# ---------------------------------------------------------------------------
+
+@_rule(
+    "DML011", "os._exit outside runtime/",
+    "ISSUE 3: os._exit skips atexit, buffered IO, and telemetry flush "
+    "— only the coordinated-abort/fault paths (which flush explicitly "
+    "first) may hard-exit, and they live in runtime/.",
+    lambda p: _in_package(p) and "/runtime/" not in p,
+)
+def check_hard_exit_scope(ctx: FileContext) -> Iterator[Finding]:
+    """``os._exit`` anywhere in the package outside ``runtime/`` — the
+    sanctioned hard-exit sites (coordinator abort, fault injection,
+    watchdog escalation) all flush their ledgers/telemetry first and
+    are deliberately confined to the runtime package."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _call_name(node) == "os._exit":
+            yield ctx.finding(
+                "DML011", node,
+                "os._exit outside runtime/ — skips buffered IO and "
+                "telemetry flush; route through the runtime abort paths "
+                "(which flush first) or raise SystemExit",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+def iter_source_files(root: str | os.PathLike) -> Iterator[str]:
+    """Repo-relative paths of every .py file a scan covers (package +
+    tools + tests, minus fixtures and caches)."""
+    root = os.fspath(root)
+    for top in SCAN_DIRS:
+        base = os.path.join(root, top)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            rel_dir = os.path.relpath(dirpath, root)
+            if any(part in rel_dir for part in EXCLUDE_PARTS):
+                dirnames[:] = []
+                continue
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.relpath(
+                        os.path.join(dirpath, name), root
+                    ).replace(os.sep, "/")
+
+
+def run_source(src: str, virtual_path: str,
+               rules: Iterable[str] | None = None,
+               honor_virtual_header: bool = True) -> list[Finding]:
+    """Run Layer 1 over one source string as if it lived at
+    ``virtual_path`` — the fixture-snippet entry point.  A
+    ``# dmlcheck-virtual-path:`` header in the source overrides the
+    argument (fixtures use it to opt into scoped rules); repo scans
+    pass ``honor_virtual_header=False`` so findings always carry the
+    REAL path the baseline matches on."""
+    if honor_virtual_header:
+        m = VIRTUAL_PATH_RE.search(src)
+        if m:
+            virtual_path = m.group(1)
+    ctx = FileContext(virtual_path, src)
+    out: list[Finding] = []
+    for rule in RULES.values():
+        if rules is not None and rule.id not in rules:
+            continue
+        if rule.applies(ctx.path):
+            out.extend(rule.check(ctx))
+    return out
+
+
+def run_layer1(root: str | os.PathLike,
+               rules: Iterable[str] | None = None,
+               files: Iterable[str] | None = None) -> list[Finding]:
+    """Run every (or the selected) Layer-1 rule over the repo at
+    ``root``; returns findings sorted by (file, line, rule).  Files
+    that fail to parse yield a DML000 finding instead of crashing the
+    scan (a syntax error in the tree is a finding, not an excuse)."""
+    root = os.fspath(root)
+    findings: list[Finding] = []
+    for rel in (files if files is not None else iter_source_files(root)):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        try:
+            findings.extend(run_source(src, rel, rules=rules,
+                                       honor_virtual_header=False))
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="DML000", file=rel, line=e.lineno or 0,
+                message=f"file does not parse: {e.msg}", layer=1))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings

@@ -1,0 +1,129 @@
+"""Finding + baseline machinery shared by both dmlcheck layers.
+
+Stdlib-only by construction (the Layer-1 fast path must never import
+jax).  A finding is one rule violation at one source location; the
+baseline is the checked-in list of JUSTIFIED suppressions
+(``dmlcheck_baseline.json``) — the escape hatch for sites where the
+flagged idiom is deliberate (e.g. the reference measurement protocol's
+``block_until_ready`` in the train loop).
+
+Baseline matching is line-number-free on purpose: an entry matches on
+``(rule, file, match-substring-of-the-flagged-source-line)``, so edits
+above a suppressed site don't churn the baseline.  Every entry MUST
+carry a non-empty ``justification`` — a suppression nobody can defend
+is a bug report, not a baseline entry — and unused entries are surfaced
+so the baseline can only shrink as findings get fixed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+
+class BaselineError(ValueError):
+    """The baseline file is malformed or carries unjustified entries."""
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str              # "DML004" (layer 1) / "DML102" (layer 2)
+    file: str              # repo-relative posix path (or an audit label)
+    line: int              # 1-based; 0 for whole-program audits
+    message: str           # what is wrong and why it matters
+    snippet: str = ""      # the flagged source line, stripped
+    severity: str = "error"   # "error" | "advisory"
+    layer: int = 1
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def location(self) -> str:
+        return f"{self.file}:{self.line}" if self.line else self.file
+
+
+def load_baseline(path: str | os.PathLike) -> list[dict]:
+    """Load + validate ``dmlcheck_baseline.json``; [] when absent.
+
+    Raises :class:`BaselineError` on malformed entries or a missing /
+    empty ``justification`` — an unjustified suppression must fail the
+    run louder than the finding it hides.
+    """
+    try:
+        with open(os.fspath(path)) as f:
+            payload = json.load(f)
+    except FileNotFoundError:
+        return []
+    except json.JSONDecodeError as e:
+        raise BaselineError(f"baseline {path}: invalid JSON ({e})") from e
+    entries = payload.get("suppressions", payload) if isinstance(
+        payload, dict) else payload
+    if not isinstance(entries, list):
+        raise BaselineError(
+            f"baseline {path}: expected a list (or {{'suppressions': "
+            f"[...]}}), got {type(entries).__name__}")
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict):
+            raise BaselineError(f"baseline {path}: entry {i} is not a dict")
+        for key in ("rule", "file", "match"):
+            if not isinstance(e.get(key), str) or not e[key]:
+                raise BaselineError(
+                    f"baseline {path}: entry {i} needs a non-empty "
+                    f"{key!r} string")
+        just = e.get("justification")
+        if not isinstance(just, str) or len(just.strip()) < 10:
+            raise BaselineError(
+                f"baseline {path}: entry {i} ({e['rule']} {e['file']}) "
+                "has no written justification — every suppression must "
+                "say WHY the flagged idiom is deliberate")
+    return entries
+
+
+def _entry_matches(entry: dict, f: Finding) -> bool:
+    return (entry["rule"] == f.rule
+            and entry["file"] == f.file
+            and entry["match"] in (f.snippet or f.message))
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: list[dict],
+) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """Split ``findings`` against the baseline.
+
+    Returns ``(new, suppressed, unused_entries)``: findings no entry
+    matches, findings an entry matches, and entries that matched
+    nothing (stale — the violation was fixed; drop the entry).
+    """
+    new: list[Finding] = []
+    suppressed: list[Finding] = []
+    used = [False] * len(baseline)
+    for f in findings:
+        hit = False
+        for i, entry in enumerate(baseline):
+            if _entry_matches(entry, f):
+                used[i] = True
+                hit = True
+        (suppressed if hit else new).append(f)
+    unused = [e for e, u in zip(baseline, used) if not u]
+    return new, suppressed, unused
+
+
+def findings_to_json(
+    new: list[Finding], suppressed: list[Finding],
+    unused_baseline: list[dict], *, rules_run: list[str] | None = None,
+) -> dict:
+    """The machine-readable verdict (``tools/dmlcheck.py --json``) —
+    same shape philosophy as ``ckpt_verify --json``: one top-level dict
+    with the per-item records plus the counts a CI gate keys on."""
+    return {
+        "findings": [f.as_dict() for f in new],
+        "suppressed": [f.as_dict() for f in suppressed],
+        "baseline_unused": unused_baseline,
+        "total": len(new) + len(suppressed),
+        "new": len(new),
+        "clean": not new and not unused_baseline,
+        **({"rules_run": rules_run} if rules_run is not None else {}),
+    }

@@ -43,31 +43,50 @@ import json
 import re
 
 
+# Collective kinds the async-window walker tracks (round 8: the
+# analysis/program_audit passes reuse this walker for the zero1
+# weight-update all-gather, so it is no longer permute-only).
+ASYNC_COLLECTIVE_KINDS = (
+    "collective-permute", "all-gather", "all-reduce", "reduce-scatter",
+)
+_KIND_ALT = "|".join(ASYNC_COLLECTIVE_KINDS)
+_ASYNC_START_RE = re.compile(
+    rf"%?(\S+) = .* ({_KIND_ALT})-start\(")
+_ASYNC_DONE_RE = re.compile(
+    rf"(?:{_KIND_ALT})-done\(.*?%?([\w\.\-]+)\)")
+
+
 def audit_schedule(hlo_text: str) -> dict:
     """Walk an optimized, scheduled HLO module; report per-async-window
-    compute.  Returns a JSON-able summary dict."""
+    compute.  Returns a JSON-able summary dict.
+
+    Tracks every async collective kind in :data:`ASYNC_COLLECTIVE_KINDS`
+    (the ``-start``/``-done`` pairs); the legacy permute-only keys keep
+    their meaning (``async_ppermute_pairs`` counts permute windows), and
+    ``async_pairs_by_kind`` breaks all windows down per collective."""
     m = re.search(r"ENTRY [^\{]+\{(.*?)\n\}", hlo_text, re.S)
     if not m:
         raise ValueError("no ENTRY computation found in HLO text")
-    start_re = re.compile(r"%?(\S+) = .* collective-permute-start\(")
-    done_re = re.compile(r"collective-permute-done\(.*?%?([\w\.\-]+)\)")
     compute_re = re.compile(
         r"%?(\S+) = .*?(fusion|convolution|dot|all-reduce(?!-)|"
-        r"reduce-scatter)\("
+        r"reduce-scatter(?!-))\("
     )
     open_pairs: dict[str, list] = {}
+    open_kinds: dict[str, str] = {}
     in_flight, max_in_flight = 0, 0
     windows = []
     for line in m.group(1).splitlines():
-        s = start_re.search(line)
+        s = _ASYNC_START_RE.search(line)
         if s:
             open_pairs[s.group(1)] = []
+            open_kinds[s.group(1)] = s.group(2)
             in_flight += 1
             max_in_flight = max(max_in_flight, in_flight)
             continue
-        d = done_re.search(line)
+        d = _ASYNC_DONE_RE.search(line)
         if d and d.group(1) in open_pairs:
-            windows.append((d.group(1), open_pairs.pop(d.group(1))))
+            windows.append((d.group(1), open_kinds.pop(d.group(1)),
+                            open_pairs.pop(d.group(1))))
             in_flight -= 1
             continue
         c = compute_re.search(line)
@@ -77,15 +96,50 @@ def audit_schedule(hlo_text: str) -> dict:
     # An op inside two concurrently-open windows counts once: the
     # metric is "distinct compute ops that execute under some in-flight
     # DMA", not a per-window tally.
-    unique_ops = {name: kind for _, ops in windows for name, kind in ops}
+    unique_ops = {name: kind for _, _, ops in windows for name, kind in ops}
     kinds = collections.Counter(unique_ops.values())
+    permute = [w for w in windows if w[1] == "collective-permute"]
     return {
-        "async_ppermute_pairs": len(windows),
-        "pairs_with_compute_in_window": sum(1 for _, o in windows if o),
+        "async_ppermute_pairs": len(permute),
+        "pairs_with_compute_in_window": sum(
+            1 for _, _, o in windows if o),
+        "async_pairs_by_kind": dict(
+            collections.Counter(k for _, k, _ in windows)),
+        "pairs_with_compute_by_kind": dict(
+            collections.Counter(k for _, k, o in windows if o)),
         "distinct_compute_ops_in_windows": len(unique_ops),
         "op_kinds_in_windows": dict(kinds),
         "max_concurrent_in_flight": max_in_flight,
     }
+
+
+_SYNC_DEF_RE = re.compile(
+    rf"%?([\w\.\-]+) = \(?\s*([a-z]+\d*\[[\d,]*\])[^=]*?"
+    rf"\b({_KIND_ALT})(?!-start|-done)\(")
+
+
+def sync_collectives_from_hlo(hlo_text: str, kinds=None) -> list[dict]:
+    """Every SYNC collective definition in the module — a collective
+    issued without a ``-start``/``-done`` split sits on the critical
+    path by construction (nothing can be scheduled under it).  Returns
+    ``[{"name", "kind", "shape", "feeds_root"}]``; ``feeds_root`` is
+    True when the op's result is a direct operand of its computation's
+    ROOT — for a train step, the signature of a weight-update gather
+    serialized against the step output (arxiv 2004.13336's target)."""
+    kinds = set(kinds or ASYNC_COLLECTIVE_KINDS)
+    out = []
+    root_operands: set[str] = set()
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("ROOT "):
+            root_operands.update(re.findall(r"%([\w\.\-]+)", stripped))
+        m = _SYNC_DEF_RE.search(line)
+        if m and m.group(3) in kinds:
+            out.append({"name": m.group(1), "kind": m.group(3),
+                        "shape": m.group(2), "feeds_root": False})
+    for rec in out:
+        rec["feeds_root"] = rec["name"] in root_operands
+    return out
 
 
 # HLO primitive-type widths (bytes) — the types a ring payload can carry

@@ -1075,9 +1075,17 @@ def run_part(
                         # would silently drop an epoch of finished work.
                         try:
                             ckpt_writer.wait()
-                        except Exception:
-                            pass  # torn save stays incomplete; restore
-                            # falls back to the previous complete one
+                        except Exception as e:
+                            # Torn save stays incomplete; restore falls
+                            # back to the previous complete one — but
+                            # say so (dmlcheck DML005): a silently
+                            # dropped save reads as lost work.
+                            rank0_print(
+                                "async checkpoint save failed before "
+                                f"restart ({type(e).__name__}: {e}); "
+                                "resuming from the previous complete "
+                                "checkpoint"
+                            )
                     s = restore_latest(_maybe_stack(
                         init_model_and_state(model, config=opt_config)
                     ))

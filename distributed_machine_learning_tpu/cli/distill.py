@@ -34,7 +34,7 @@ deterministic synthetic stream, exactly as ``cli.lm``.
 from __future__ import annotations
 
 import argparse
-from datetime import datetime
+import time
 
 import numpy as np
 
@@ -240,7 +240,9 @@ def main(argv=None) -> None:
         # Reference timing protocol: fetch the loss (real step time on a
         # tunneled chip), exclude iteration 0 (part1/main.py:53-58).
         loss_v = float(loss)
-        now = datetime.now().timestamp()
+        # Monotonic clock for the iteration deltas (dmlcheck DML001):
+        # wall clocks step under NTP slew and make timing rows lie.
+        now = time.perf_counter()
         if t_prev is not None:
             total += now - t_prev
         t_prev = now

@@ -110,14 +110,16 @@ def _write_atomic(path: str, payload: dict) -> None:
 def append_health_event(gang_dir: str | os.PathLike, kind: str,
                         **fields) -> None:
     """Record one advisory event in the gang health ledger — flushed
-    immediately (the next supervisor action may be tearing the gang
-    down, and a verdict only in host memory at that point is lost)."""
+    AND fsynced before returning (dmlcheck DML002): the next supervisor
+    action may be tearing the gang down via ``os._exit``, and a verdict
+    that only reached the page cache at that point is lost with it."""
     payload = {"kind": kind, "time": time.time(), **fields}
     gang_dir = os.fspath(gang_dir)
     os.makedirs(gang_dir, exist_ok=True)
     with open(os.path.join(gang_dir, GANG_HEALTH_FILE), "a") as f:
         f.write(json.dumps(payload) + "\n")
         f.flush()
+        os.fsync(f.fileno())
 
 
 def read_abort(gang_dir: str | os.PathLike) -> dict | None:

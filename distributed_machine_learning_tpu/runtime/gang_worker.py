@@ -223,6 +223,10 @@ def main(argv=None) -> None:
         """One line per completed step: which global example ids THIS
         rank consumed, under which (attempt, world) — the exactly-once
         audit trail the elastic chaos test checks."""
+        # flush+fsync (dmlcheck DML002): the coordinator's monitor
+        # thread may os._exit this process at any poll, and a consumed
+        # row lost from the ledger reads as a missed example in the
+        # exactly-once audit.
         with open(consumed_path, "a") as f:
             f.write(json.dumps({
                 "attempt": args.attempt, "world": args.world,
@@ -231,6 +235,7 @@ def main(argv=None) -> None:
                         for j in local_ids],
             }) + "\n")
             f.flush()
+            os.fsync(f.fileno())
 
     with coord.suspend():
         state = TrainState.create(

@@ -1,0 +1,24 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/fixture.py
+"""DML001 clean case: monotonic durations, change-signature staleness,
+wall timestamps only ever RECORDED into payloads."""
+import os
+import time
+
+last_seen = time.monotonic()
+_peer_sig = {}
+
+
+def progress_age():
+    return time.monotonic() - last_seen
+
+
+def peer_changed(peer, path):
+    st = os.stat(path)
+    sig = (st.st_mtime_ns, st.st_size)   # equality only: sanctioned
+    changed = _peer_sig.get(peer) != sig
+    _peer_sig[peer] = sig
+    return changed
+
+
+def beat_payload(step):
+    return {"step": step, "time": time.time()}  # recorded, no arithmetic

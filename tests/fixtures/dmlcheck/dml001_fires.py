@@ -1,0 +1,18 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/fixture.py
+"""DML001 firing case: wall-clock readings in staleness arithmetic."""
+import os
+import time
+
+last_seen = 0.0
+PEER_TIMEOUT = 30.0
+
+
+def peer_is_dead(path):
+    # Comparing a local wall clock to a cross-host file mtime: NFS
+    # clock skew of a minute reads as instant death.
+    return time.time() - os.path.getmtime(path) > PEER_TIMEOUT
+
+
+def progress_age():
+    now = time.time()
+    return now - last_seen  # tainted-name subtraction

@@ -1,0 +1,18 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/fixture.py
+"""DML002 clean case: ledger appends flushed AND fsynced (the very
+next statement may be os._exit), plus a non-ledger append that the
+rule correctly ignores."""
+import json
+import os
+
+
+def mark_fired(ledger_path, entry):
+    with open(ledger_path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def append_note(path, text):
+    with open(path, "a") as f:             # no ledger token: out of scope
+        f.write(text)

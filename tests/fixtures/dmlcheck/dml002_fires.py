@@ -1,0 +1,14 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/fixture.py
+"""DML002 firing case: ledger appends missing fsync (and flush)."""
+import json
+
+
+def mark_fired(ledger_path, entry):
+    with open(ledger_path, "a") as f:      # 'ledger' token, no fsync
+        f.write(json.dumps(entry) + "\n")
+        f.flush()
+
+
+def record_health(gang_dir, payload):
+    with open(gang_dir + "/gang_health.jsonl", "a") as f:  # neither
+        f.write(json.dumps(payload) + "\n")

@@ -1,0 +1,10 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/train/fixture.py
+"""DML003 clean case: the restore result is re-materialized through
+fresh_buffers before the donating step sees it."""
+from distributed_machine_learning_tpu.train.checkpoint import fresh_buffers
+
+
+def resume(ckptr, path, train_step, x, y):
+    state = ckptr.restore(path)
+    state = fresh_buffers(state)     # XLA-owned buffers, donation-safe
+    return train_step(state, x, y)

@@ -1,0 +1,8 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/train/fixture.py
+"""DML003 firing case: raw orbax restore handed straight to a donating
+step — the ISSUE 1 segfault class."""
+
+
+def resume(ckptr, path, train_step, x, y):
+    state = ckptr.restore(path)      # zero-copy tensorstore aliases
+    return train_step(state, x, y)   # step donates: use-after-free

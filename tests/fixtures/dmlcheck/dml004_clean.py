@@ -1,0 +1,14 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/train/loop.py
+"""DML004 clean case: every host sync sits under a consumer guard, so
+the no-consumer path stays a pointer test."""
+import jax
+
+
+def train_epoch(train_step, state, batches, events=None, metrics=None):
+    for images, labels in batches:
+        state, loss = train_step(state, images, labels)
+        if events is not None:
+            events.steps = int(jax.device_get(state.step))
+        if metrics is not None:
+            metrics.log(loss=float(loss))
+    return state

@@ -1,0 +1,12 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/train/loop.py
+"""DML004 firing case: unguarded host syncs in the per-step loop."""
+import jax
+
+
+def train_epoch(train_step, state, batches):
+    for images, labels in batches:
+        state, loss = train_step(state, images, labels)
+        step_now = int(jax.device_get(state.step))   # every step, no guard
+        loss.block_until_ready()                     # ditto
+        del step_now
+    return state

@@ -1,0 +1,15 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/train/fixture.py
+"""DML005 clean case: the verification error reaches a consumer (log +
+fallback), and handlers name what they catch."""
+import logging
+
+
+def restore_with_fallback(path, restore, CheckpointVerifyError, events):
+    try:
+        return restore(path)
+    except CheckpointVerifyError as e:
+        logging.warning("checkpoint %s failed verification: %s", path, e)
+        events.ckpt_fallbacks += 1
+        return restore(path + ".bak")
+    except OSError:
+        return None

@@ -1,0 +1,13 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/train/fixture.py
+"""DML005 firing case: bare except + swallowed CheckpointVerifyError."""
+
+
+def restore_or_garbage(path, restore, CheckpointVerifyError):
+    try:
+        return restore(path)
+    except CheckpointVerifyError:
+        pass                       # detected corruption, waved through
+    try:
+        return restore(path + ".bak")
+    except:                        # noqa: E722 — deliberate fixture
+        return None

@@ -1,0 +1,26 @@
+# dmlcheck-virtual-path: tests/test_fixture.py
+"""DML006 clean case: the gang chaos test is marked, and an ordinary
+8-device test needs no marker."""
+import subprocess
+import sys
+
+import pytest
+
+
+def _run_gang(root):
+    return subprocess.run(
+        [sys.executable, "-m", "distributed_machine_learning_tpu.cli.gang",
+         "--workers", "4", "--gang-dir", root],
+        capture_output=True, timeout=120,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.faultinject
+def test_gang_survives_chaos(tmp_path):
+    assert _run_gang(str(tmp_path)).returncode == 0
+
+
+def test_small_mesh(make_mesh):
+    mesh = make_mesh(8)
+    assert mesh is not None

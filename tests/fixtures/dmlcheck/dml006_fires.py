@@ -1,0 +1,22 @@
+# dmlcheck-virtual-path: tests/test_fixture.py
+"""DML006 firing case: unmarked tests spawning a gang (directly and via
+a module-level helper) and building an oversized mesh."""
+import subprocess
+import sys
+
+
+def _run_gang(root):
+    return subprocess.run(
+        [sys.executable, "-m", "distributed_machine_learning_tpu.cli.gang",
+         "--workers", "4", "--gang-dir", root],
+        capture_output=True, timeout=120,
+    )
+
+
+def test_gang_finishes(tmp_path):          # unmarked, spawns via helper
+    assert _run_gang(str(tmp_path)).returncode == 0
+
+
+def test_wide_mesh(make_mesh):             # unmarked, >8 devices
+    mesh = make_mesh(16)
+    assert mesh is not None

@@ -1,0 +1,13 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/train/checkpoint.py
+"""DML007 clean case: None-default construction, deterministic manifest
+payload (content digests only — every rank writes identical bytes)."""
+
+
+def gather_leaves(tree, out=None):
+    out = [] if out is None else out
+    out.append(tree)
+    return out
+
+
+def build_manifest(leaves, digests):
+    return {"leaves": leaves, "digests": digests}

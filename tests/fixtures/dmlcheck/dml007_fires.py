@@ -1,0 +1,13 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/train/checkpoint.py
+"""DML007 firing case: mutable default + wall clock in a manifest
+builder (manifests are digest-compared across ranks)."""
+import time
+
+
+def gather_leaves(tree, out=[]):           # shared across calls
+    out.append(tree)
+    return out
+
+
+def build_manifest(leaves):
+    return {"leaves": leaves, "written_at": time.time()}  # nondeterministic

@@ -1,0 +1,19 @@
+# dmlcheck-virtual-path: tests/test_fixture.py
+"""DML008 clean case: every run() is bounded; Popen is exempt (its
+bound lives on communicate(timeout=...))."""
+import subprocess
+import sys
+
+
+def test_tool_runs(tmp_path):
+    res = subprocess.run(
+        [sys.executable, "tools/ckpt_verify.py", str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert res.returncode in (0, 2)
+
+
+def test_worker_pipes(cmd):
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE)
+    out, _ = p.communicate(timeout=180)
+    assert out is not None

@@ -1,0 +1,13 @@
+# dmlcheck-virtual-path: tests/test_fixture.py
+"""DML008 firing case: unbounded subprocess in a test — a hung child
+eats the whole tier-1 870s budget."""
+import subprocess
+import sys
+
+
+def test_tool_runs(tmp_path):
+    res = subprocess.run(
+        [sys.executable, "tools/ckpt_verify.py", str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert res.returncode in (0, 2)

@@ -1,0 +1,20 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/fixture.py
+"""DML009 clean case: flush-then-die re-raise, and the producer-thread
+channel pattern (the exception is handed off, not eaten)."""
+
+
+def worker_loop(step_once, telemetry):
+    try:
+        while True:
+            step_once()
+    except SystemExit:
+        telemetry.flush()        # flush-then-die
+        raise
+
+
+def producer(source, put, failure):
+    try:
+        for batch in source():
+            put(batch)
+    except BaseException as exc:
+        failure.append(exc)      # reaches the consumer thread

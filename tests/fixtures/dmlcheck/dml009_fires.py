@@ -1,0 +1,12 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/fixture.py
+"""DML009 firing case: the SIGTERM→SystemExit drain path is eaten."""
+
+
+def worker_loop(step_once):
+    while True:
+        try:
+            step_once()
+        except SystemExit:
+            break                # drain signal swallowed: zombie rank
+        except BaseException:
+            continue             # including the abort path

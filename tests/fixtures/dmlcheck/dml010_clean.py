@@ -1,0 +1,12 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/telemetry/fixture.py
+"""DML010 clean case: JSONL streams append; truncate-mode is fine for
+non-stream artifacts (a rendered report)."""
+
+
+def start_metrics(path):
+    return open(path + "/metrics.jsonl", "a")
+
+
+def write_report(path, text):
+    with open(path + "/report.txt", "w") as f:
+        f.write(text)

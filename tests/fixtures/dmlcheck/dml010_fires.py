@@ -1,0 +1,14 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/telemetry/fixture.py
+"""DML010 firing case: a JSONL stream truncated on open — erases the
+pre-crash attempts a post-mortem needs."""
+import json
+
+
+def start_metrics(path):
+    return open(path + "/metrics.jsonl", "w")
+
+
+def reset_ledger(ledger_path, entries):
+    with open(ledger_path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")

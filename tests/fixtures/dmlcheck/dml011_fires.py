@@ -1,0 +1,9 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/train/fixture.py
+"""DML011 firing case: a hard exit outside runtime/ — skips atexit,
+buffered IO, and telemetry flush."""
+import os
+
+
+def give_up(msg):
+    print(msg)
+    os._exit(1)

@@ -533,7 +533,7 @@ def test_train_epoch_applies_static_step_counters(tmp_path):
     out = subprocess.run(
         [sys.executable, os.path.join(TOOLS, "trace_summary.py"),
          str(tmp_path)],
-        capture_output=True, text=True, check=True,
+        capture_output=True, text=True, check=True, timeout=60,
     ).stdout
     assert "Ring wire compression" in out
     assert "3,000" in out and "compression ratio        4.00x" in out

@@ -172,7 +172,16 @@ def test_bn_model_distributed_step(mesh8):
     labels = rng.integers(0, 10, (GLOBAL_BATCH,)).astype(np.int32)
     step = make_train_step(model, get_strategy("ring"), mesh=mesh8, augment=False)
     x, y = shard_batch(mesh8, images, labels)
-    old = [np.asarray(s) for s in jax.tree_util.tree_leaves(state.batch_stats)]
+    # COPY the stats snapshot (flake root cause, dmlcheck DML003 class):
+    # np.asarray on a CPU jax array is a ZERO-COPY view of the XLA
+    # buffer, and the step below donates its input state — XLA may then
+    # reuse those very buffers for the updated stats (or anything else),
+    # so an aliased `old` flakily compares new-against-new and the
+    # "stats moved" assertion fails depending on allocator state (it
+    # only reproduced in-suite, under memory pressure).  np.array(...,
+    # copy=True) pins the pre-step values in host-owned memory.
+    old = [np.array(s, copy=True)
+           for s in jax.tree_util.tree_leaves(state.batch_stats)]
     new_state, loss = step(state, x, y)
     assert np.isfinite(float(loss))
     # Running stats moved.

@@ -1,0 +1,187 @@
+#!/usr/bin/env python3
+"""dmlcheck — static analysis for this repo's distributed-correctness
+invariants.
+
+Usage::
+
+    python tools/dmlcheck.py [ROOT] [--json] [--rules DML001,DML004]
+                             [--baseline FILE | --no-baseline]
+                             [--layer2] [--list-rules]
+                             [--write-baseline]
+
+Layer 1 (default, stdlib-only, no jax import, <10 s): the AST rules in
+``distributed_machine_learning_tpu/analysis/ast_rules.py`` over the
+package + tools + tests sources.  ``--layer2`` additionally compiles
+the ring and zero1 train steps on an 8-virtual-device CPU mesh and runs
+the jaxpr/HLO audit passes (donation taken, no critical-path
+all-gather, wire-byte accounting) — slower, imports jax.
+
+Exit codes: 0 clean (every finding baselined, no stale baseline
+entries), 1 non-baselined ERROR findings or stale entries, 2 usage /
+malformed-baseline errors.  Advisory findings are always reported but
+never fail the run.  ``--json`` prints one machine-readable verdict
+dict (same philosophy as ``ckpt_verify --json``).
+
+Baseline workflow: fix the finding if you can; when the flagged idiom
+is deliberate, add an entry to ``dmlcheck_baseline.json`` with a
+written justification (entries without one fail with exit 2), matched
+on (rule, file, substring-of-the-flagged-line).  Stale entries —
+suppressing nothing — fail the run so the baseline only shrinks.
+``--write-baseline`` prints a skeleton for the current NEW findings to
+paste in (justifications left for you to write; an empty one will not
+pass).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Layer 1 must stay importable without jax: only analysis.ast_rules /
+# analysis.findings (stdlib-only by construction) are imported here;
+# program_audit is imported inside --layer2.
+from distributed_machine_learning_tpu.analysis.ast_rules import (  # noqa: E402,E501
+    RULES,
+    run_layer1,
+)
+from distributed_machine_learning_tpu.analysis.findings import (  # noqa: E402,E501
+    BaselineError,
+    apply_baseline,
+    findings_to_json,
+    load_baseline,
+)
+
+BASELINE_NAME = "dmlcheck_baseline.json"
+
+
+def _run_layer2():
+    # The CPU mesh needs the 8-way host-platform split BEFORE jax
+    # initializes a backend (same bootstrap as tests/conftest.py).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from distributed_machine_learning_tpu.analysis.program_audit import (
+        run_layer2,
+    )
+
+    return run_layer2()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("root", nargs="?", default=REPO,
+                        help="repo root to scan (default: this repo)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable verdict on stdout")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all Layer-1 rules)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"suppression file (default: "
+                             f"ROOT/{BASELINE_NAME})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (report everything)")
+    parser.add_argument("--layer2", action="store_true",
+                        help="also compile train steps and run the "
+                             "jaxpr/HLO audit passes (imports jax)")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="print a baseline skeleton for the "
+                             "current NEW findings and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.id}  {r.title}")
+            print(f"        incident: {r.incident}")
+        return 0
+
+    LAYER2_RULES = {"DML101", "DML102", "DML103", "DML104"}
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES) - LAYER2_RULES
+        if unknown:
+            print(f"dmlcheck: unknown rule id(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        if rules & LAYER2_RULES and not args.layer2:
+            # Without the pass actually running, a Layer-2-only filter
+            # would report a false green verdict.
+            print("dmlcheck: rule(s) "
+                  f"{sorted(rules & LAYER2_RULES)} are Layer-2 program "
+                  "audits — add --layer2 to run them", file=sys.stderr)
+            return 2
+
+    root = os.path.abspath(args.root)
+    findings = run_layer1(
+        root, rules=None if rules is None
+        else {r for r in rules if r in RULES})
+    if args.layer2:
+        l2 = _run_layer2()
+        if rules is not None:
+            l2 = [f for f in l2 if f.rule in rules]
+        findings += l2
+
+    baseline = []
+    if not args.no_baseline:
+        try:
+            baseline = load_baseline(
+                args.baseline or os.path.join(root, BASELINE_NAME))
+        except BaselineError as e:
+            print(f"dmlcheck: {e}", file=sys.stderr)
+            return 2
+    if rules is not None:
+        # A --rules subset must not report the OTHER rules' baseline
+        # entries as stale: only entries whose rule actually ran can be
+        # judged used/unused.
+        baseline = [e for e in baseline if e["rule"] in rules]
+    new, suppressed, unused = apply_baseline(findings, baseline)
+    advisories = [f for f in new if f.severity == "advisory"]
+    errors = [f for f in new if f.severity != "advisory"]
+
+    if args.write_baseline:
+        skeleton = [{"rule": f.rule, "file": f.file,
+                     "match": f.snippet or f.message,
+                     "justification": ""} for f in errors]
+        print(json.dumps({"suppressions": skeleton}, indent=2))
+        return 0
+
+    if args.json:
+        payload = findings_to_json(
+            new, suppressed, unused,
+            rules_run=sorted(rules) if rules else sorted(RULES))
+        payload["errors"] = len(errors)
+        payload["advisories"] = len(advisories)
+        payload["clean"] = not errors and not unused
+        print(json.dumps(payload, indent=1))
+    else:
+        for f in errors:
+            print(f"{f.rule} {f.location()}: {f.message}")
+            if f.snippet:
+                print(f"    > {f.snippet}")
+        for f in advisories:
+            print(f"{f.rule} {f.location()} (advisory): {f.message}")
+        for e in unused:
+            print(f"STALE baseline entry (fixed? drop it): "
+                  f"{e['rule']} {e['file']} ~ {e['match']!r}")
+        print(f"dmlcheck: {len(errors)} error(s), "
+              f"{len(advisories)} advisory, "
+              f"{len(suppressed)} baselined, "
+              f"{len(unused)} stale baseline entr(ies)")
+    return 1 if (errors or unused) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -71,7 +71,25 @@ def _ledger_entries(gang_dir: str) -> list[dict]:
 def collect(gang_dir: str, telemetry_dir: str) -> dict:
     """Everything the renderers need, as one JSON-ready dict."""
     beats = read_beats(gang_dir)
-    now = time.time()
+    # Staleness basis (dmlcheck DML001): NEVER this process's wall
+    # clock vs timestamps other hosts wrote — on the shared mounts pods
+    # use, reader-vs-writer clock skew of a minute is routine and would
+    # read as mass death.  Ages are PEER-RELATIVE instead: how much
+    # older each rank's beat is than the freshest beat in the gang,
+    # plus the rank's own self-published progress age — the quantity
+    # the straggler story actually needs, with the reader's clock out
+    # of the comparison entirely.
+    beat_times = [float(p["time"]) for p in beats.values()
+                  if isinstance(p.get("time"), (int, float))]
+    newest_beat = max(beat_times, default=None)
+    # The ONE deliberate reader-clock delta (dmlcheck-baselined): with
+    # every rank dead at once, all beats freeze together and the
+    # peer-relative ages read ~0 forever — only the reader's own clock
+    # can say "nothing has beaten for 20 minutes".  It is a single
+    # gang-LEVEL line, labeled approximate, never folded into the
+    # per-rank comparisons.
+    reader_lag = (max(time.time() - newest_beat, 0.0)
+                  if newest_beat is not None else None)
     health = read_health_events(gang_dir)
     # The live table's STRAGGLER column must match the beat files'
     # CURRENT rank numbering (a shrink renumbers survivors, while
@@ -96,11 +114,13 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
         stime = metrics.get("step_time_s")
         if isinstance(stime, (int, float)):
             step_times[rank] = float(stime)
-        # Post-mortem age: the rank's own published progress age plus
-        # how long ago (wall clock) it wrote the beat — approximate
-        # across hosts, exact on the single-host gangs this renders
-        # live; a frozen file simply reads as ever-older.
-        wall_age = max(now - float(p.get("time", now)), 0.0)
+        # Post-mortem age: self-published progress age plus how much
+        # the rank's beat lags the gang's freshest beat (writer-clock
+        # timestamps compared among themselves; a frozen file reads as
+        # ever-older as its peers keep beating).
+        wall_age = (max(newest_beat - float(p["time"]), 0.0)
+                    if newest_beat is not None
+                    and isinstance(p.get("time"), (int, float)) else 0.0)
         rank_rows.append({
             "rank": rank,
             "step": int(p.get("step", 0)),
@@ -119,6 +139,7 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
         "gang_dir": gang_dir,
         "world": len(rank_rows),
         "abort": _read_json(os.path.join(gang_dir, ABORT_FILE)),
+        "freshest_beat_lag_s": reader_lag,
         "ranks": rank_rows,
         "health": health,
         "faults_fired": _ledger_entries(gang_dir),
@@ -137,6 +158,11 @@ def render(status: dict) -> str:
         a = status["abort"]
         lines.append(f"  ABORT latched by rank {a.get('by_rank')}: "
                      f"{a.get('reason')}")
+    lag = status.get("freshest_beat_lag_s")
+    if lag is not None:
+        lines.append(f"  freshest beat: {lag:.1f}s ago by this "
+                     "reader's clock (approximate across hosts; "
+                     "per-rank ages below are peer-relative)")
     if status["ranks"]:
         lines.append(f"  {'rank':>4}  {'step':>6}  {'age':>8}  "
                      f"{'step_time':>10}  {'skew':>6}  state")
