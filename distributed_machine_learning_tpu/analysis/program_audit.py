@@ -16,8 +16,11 @@ there; this module generalizes them into reusable passes):
   ``-start``/``-done`` split) sit on the critical path by construction;
   for the zero1 weight update this is the all-gather that "Automatic
   Cross-Replica Sharding of Weight Update in Data-Parallel Training"
-  (arxiv 2004.13336) eliminates.  Reported as ADVISORY until the
-  ROADMAP overlap item lands, then the severity flips.
+  (arxiv 2004.13336) eliminates.  The overlap-aware update (ISSUE 9:
+  ``make_zero1_train_step(overlap=True)`` splits the step into an
+  update program and a bucketed-ring consume program) landed, so this
+  is now an ERROR for the zero1 step — the historical advisory phase
+  is over and a re-serialized gather fails the run.
 - :func:`audit_ring_wire_accounting` — the compiled program's
   collective-permute payload bytes must equal the static
   ``ops.ring.ring_wire_bytes`` accounting for every wire scheme (the
@@ -126,7 +129,7 @@ def audit_donation(hlo_text: str, donated_params: Iterable[int],
 
 def audit_critical_path_collectives(
     hlo_text: str, kinds: Sequence[str] = ("all-gather",),
-    label: str = "train_step", severity: str = "advisory",
+    label: str = "train_step", severity: str = "error",
 ) -> list[Finding]:
     """No sync collective of the given kinds on the critical path.
 
@@ -134,9 +137,10 @@ def audit_critical_path_collectives(
     overlap anything — it serializes the step at exactly the point the
     sharded weight update was supposed to be free (2004.13336).  An
     async pair whose window contains no compute is flagged the same
-    way: in-flight but hiding nothing.  Severity defaults to
-    ``advisory`` — the check reports today and is flipped to ``error``
-    when the ROADMAP's overlap-aware weight update lands."""
+    way: in-flight but hiding nothing.  Severity defaults to ``error``
+    since the overlap-aware weight update landed (ISSUE 9); pass
+    ``severity="advisory"`` for programs still carrying documented
+    debt."""
     findings = []
     for rec in sync_collectives_from_hlo(hlo_text, kinds=kinds):
         where = ("feeds the step output directly"
@@ -338,11 +342,24 @@ def audit_ring_step(mesh, global_batch: int = 16) -> list[Finding]:
 
 
 def audit_zero1_step(mesh, global_batch: int = 16) -> list[Finding]:
-    """Compile the zero1 train step; donation audit on the flat state,
-    plus the 2004.13336 critical-path all-gather check — ADVISORY until
-    the ROADMAP overlap item restructures the update (today's update
-    all-gather is known-sync; the pass documents the debt and will gate
-    the fix)."""
+    """Compile the OVERLAP-AWARE zero1 train step (the default build
+    this audit gates since ISSUE 9) — both phases:
+
+    - the **update program** must contain no all-gather at all (the
+      2004.13336 anti-pattern is structurally impossible: the program
+      ends at the updated shard) — checked at ERROR severity, so a
+      change that re-serializes the gather into the step fails CI;
+    - the **consume program** (bucketed ring gather) must be
+      permute-only — an all-gather reappearing there is the same
+      regression wearing the other program's clothes;
+    - donation on the update program: the momentum buffers (the only
+      donated operands — param_flat cannot alias the sharded output,
+      and step/rng are wrapper-carried) must actually alias.
+
+    The legacy sync build (``overlap=False``) still exists for parity
+    testing and the bench baseline; it is not audited here because its
+    critical-path gather is now a *documented baseline*, not the
+    shipped default."""
     import jax
     import jax.numpy as jnp
 
@@ -354,29 +371,114 @@ def audit_zero1_step(mesh, global_batch: int = 16) -> list[Finding]:
     model, init_state, _ = _vggtest_setup()
     z1, unravel, n_elems = shard_zero1_state(init_state(), mesh)
     step = make_zero1_train_step(model, mesh, unravel, n_elems,
-                                 augment=False)
+                                 augment=False, overlap=True)
     zshape = jax.eval_shape(lambda: z1)
     x = jax.ShapeDtypeStruct((global_batch, 32, 32, 3), jnp.float32)
     y = jax.ShapeDtypeStruct((global_batch,), jnp.int32)
-    hlo = step.lower(zshape, x, y).compile().as_text()
-    n_leaves = len(jax.tree_util.tree_leaves(zshape))
-    findings = audit_donation(hlo, range(n_leaves), label="zero1_step")
+    upd_hlo = step.update_for(z1.config).lower(
+        zshape.param_flat, zshape.momentum_shards, zshape.batch_stats,
+        zshape.step, zshape.rng, x, y,
+    ).compile().as_text()
+    gather_hlo = step.gather_inner.lower(
+        zshape.param_flat
+    ).compile().as_text()
+
+    # Donated operands of the update program: momentum (+ BN stats when
+    # present) — flat entry params 1..1+len(mom)+len(stats).
+    n_donated = len(jax.tree_util.tree_leaves(
+        (zshape.momentum_shards, zshape.batch_stats)
+    ))
+    findings = audit_donation(
+        upd_hlo, range(1, 1 + n_donated), label="zero1_update")
     findings += audit_critical_path_collectives(
-        hlo, kinds=("all-gather",), label="zero1_step",
-        severity="advisory")
+        upd_hlo, kinds=("all-gather",), label="zero1_update",
+        severity="error")
+    findings += audit_critical_path_collectives(
+        gather_hlo, kinds=("all-gather",), label="zero1_gather",
+        severity="error")
+    return findings
+
+
+def audit_fsdp_perlayer_step(mesh, batch: int = 8, seq: int = 16
+                             ) -> list[Finding]:
+    """Compile the per-layer (GSPMD) FSDP LM step and verify the
+    overlap-aware structure it claims: one all-gather per parameter AT
+    ITS USE SITE — so there must be SEVERAL gathers (per-leaf, not one
+    monolithic prelude) and NONE of them may feed ROOT (the updated
+    params leave the program in their SHARDED layout; a gather feeding
+    ROOT would mean the update's output was re-gathered onto the
+    critical path — the 2004.13336 anti-pattern in GSPMD clothing)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from distributed_machine_learning_tpu.parallel.fsdp_perlayer import (
+        make_fsdp_pl_lm_train_step,
+        shard_fsdp_pl_state,
+    )
+    from distributed_machine_learning_tpu.train.adamw import AdamWConfig
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+    model = TransformerLM(vocab_size=64, d_model=32, n_layers=2,
+                          n_heads=4, attn_impl="dense")
+    state = shard_fsdp_pl_state(
+        init_lm_state(model, seed=0, config=AdamWConfig()), mesh
+    )
+    step = make_fsdp_pl_lm_train_step(model, mesh)
+    sshape = jax.eval_shape(lambda: state)
+    x = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    y = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    hlo = step.lower(sshape, x, y).compile().as_text()
+
+    findings = []
+    gathers = sync_collectives_from_hlo(hlo, kinds=("all-gather",))
+    rooted = [g for g in gathers if g["feeds_root"]]
+    for g in rooted:
+        findings.append(Finding(
+            rule=RULE_CRITICAL_PATH, file="fsdp_perlayer_step", line=0,
+            message=(
+                f"per-layer FSDP all-gather {g['name']} ({g['shape']}) "
+                "feeds ROOT — the updated params must leave the program "
+                "sharded (gathers belong at the NEXT use site, where "
+                "the scheduler overlaps them with the previous layer's "
+                "compute); a root-feeding gather puts the weight update "
+                "back on the critical path (arxiv 2004.13336)"
+            ),
+            snippet=f"{g['name']} = {g['shape']} all-gather(...)",
+            severity="error", layer=2,
+        ))
+    # Structural sanity: per-layer means SEVERAL gathers (use-site, one
+    # per sharded leaf neighborhood), not one monolithic prelude.
+    if len(gathers) < 2:
+        findings.append(Finding(
+            rule=RULE_CRITICAL_PATH, file="fsdp_perlayer_step", line=0,
+            message=(
+                f"per-layer FSDP step compiled with {len(gathers)} "
+                "all-gather(s) — the per-leaf use-site gathers the "
+                "scheme is named for have collapsed into a monolithic "
+                "(or absent) gather; overlap with the consuming forward "
+                "is no longer possible"
+            ),
+            severity="error", layer=2,
+        ))
     return findings
 
 
 def run_layer2(mesh=None) -> list[Finding]:
     """The full Layer-2 sweep ``tools/dmlcheck.py --layer2`` runs:
-    ring-step donation/collective/jaxpr audits, zero1 critical-path
-    report, and the wire-byte accounting for every wire scheme."""
+    ring-step donation/collective/jaxpr audits, the overlap-aware zero1
+    two-program audit (DML102 at ERROR severity since ISSUE 9), the
+    per-layer-FSDP use-site-gather audit, and the wire-byte accounting
+    for every wire scheme."""
     from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 
     if mesh is None:
         mesh = make_mesh(8)
     findings = audit_ring_step(mesh)
     findings += audit_zero1_step(mesh)
+    findings += audit_fsdp_perlayer_step(mesh)
     wire_findings, _ = audit_ring_wire_accounting(
         mesh, 4096, schemes=("none", "bf16", "int8", "topk"))
     findings += wire_findings

@@ -52,8 +52,14 @@ ASYNC_COLLECTIVE_KINDS = (
 _KIND_ALT = "|".join(ASYNC_COLLECTIVE_KINDS)
 _ASYNC_START_RE = re.compile(
     rf"%?(\S+) = .* ({_KIND_ALT})-start\(")
-_ASYNC_DONE_RE = re.compile(
-    rf"(?:{_KIND_ALT})-done\(.*?%?([\w\.\-]+)\)")
+# A -done op closes the window its operand (the -start op) opened.  The
+# operand list may spell the start's full tuple type inline
+# (``collective-permute-done((f32[1066]{0:T(1024)}, ...) %cps.1)`` — the
+# TPU backend does), so a lazy scan-to-first-paren mis-captures; instead
+# the walker tokenizes everything after ``-done(`` and closes the first
+# token that names an open window.
+_ASYNC_DONE_RE = re.compile(rf"(?:{_KIND_ALT})-done\((.*)")
+_NAME_TOKEN_RE = re.compile(r"%?([\w\.\-]+)")
 
 
 def audit_schedule(hlo_text: str) -> dict:
@@ -84,11 +90,17 @@ def audit_schedule(hlo_text: str) -> dict:
             max_in_flight = max(max_in_flight, in_flight)
             continue
         d = _ASYNC_DONE_RE.search(line)
-        if d and d.group(1) in open_pairs:
-            windows.append((d.group(1), open_kinds.pop(d.group(1)),
-                            open_pairs.pop(d.group(1))))
-            in_flight -= 1
-            continue
+        if d:
+            name = next(
+                (t for t in _NAME_TOKEN_RE.findall(d.group(1))
+                 if t in open_pairs),
+                None,
+            )
+            if name is not None:
+                windows.append((name, open_kinds.pop(name),
+                                open_pairs.pop(name)))
+                in_flight -= 1
+                continue
         c = compute_re.search(line)
         if c:
             for ops in open_pairs.values():
@@ -118,6 +130,11 @@ _SYNC_DEF_RE = re.compile(
     rf"\b({_KIND_ALT})(?!-start|-done)\(")
 
 
+_GTE_RE = re.compile(
+    r"%?([\w\.\-]+) = [^=]*get-tuple-element\([^%]*%([\w\.\-]+)\)"
+)
+
+
 def sync_collectives_from_hlo(hlo_text: str, kinds=None) -> list[dict]:
     """Every SYNC collective definition in the module — a collective
     issued without a ``-start``/``-done`` split sits on the critical
@@ -125,20 +142,30 @@ def sync_collectives_from_hlo(hlo_text: str, kinds=None) -> list[dict]:
     ``[{"name", "kind", "shape", "feeds_root"}]``; ``feeds_root`` is
     True when the op's result is a direct operand of its computation's
     ROOT — for a train step, the signature of a weight-update gather
-    serialized against the step output (arxiv 2004.13336's target)."""
+    serialized against the step output (arxiv 2004.13336's target).
+    Tuple-fused collectives (the TPU backend folds the gather into a
+    variadic all-reduce whose elements reach ROOT via
+    ``get-tuple-element``) are attributed through one GTE hop."""
     kinds = set(kinds or ASYNC_COLLECTIVE_KINDS)
     out = []
     root_operands: set[str] = set()
+    gte_operand: dict[str, str] = {}
     for line in hlo_text.splitlines():
         stripped = line.strip()
         if stripped.startswith("ROOT "):
             root_operands.update(re.findall(r"%([\w\.\-]+)", stripped))
+        g = _GTE_RE.search(line)
+        if g:
+            gte_operand[g.group(1)] = g.group(2)
         m = _SYNC_DEF_RE.search(line)
         if m and m.group(3) in kinds:
             out.append({"name": m.group(1), "kind": m.group(3),
                         "shape": m.group(2), "feeds_root": False})
+    rooted = set(root_operands)
+    rooted.update(op for gte, op in gte_operand.items()
+                  if gte in root_operands)
     for rec in out:
-        rec["feeds_root"] = rec["name"] in root_operands
+        rec["feeds_root"] = rec["name"] in rooted
     return out
 
 
@@ -237,6 +264,135 @@ def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
     return fn.lower(x).compile().as_text()
 
 
+def _tpu_topology_mesh(topology_name: str):
+    """8-chip AOT mesh for a named TPU topology (compile-only client).
+    Sets ``TPU_SKIP_MDS_QUERY`` so libtpu skips the GCE-metadata probe
+    that otherwise stalls the compile-only client for minutes off-GCE."""
+    import os
+
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    import numpy as np
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=topology_name
+    )
+    devs = np.array(topo.devices)
+    return Mesh(devs.reshape(devs.size), ("batch",))
+
+
+def compile_zero1_hlo(mesh, global_batch: int = 256,
+                      overlap: bool = True) -> dict:
+    """Compile the zero1 train step for ``mesh`` (a CPU test mesh or a
+    TPU AOT topology mesh) and return the optimized HLO text(s):
+    ``{"update": ..., "gather": ...}`` for the overlap build,
+    ``{"step": ...}`` for the sync baseline.  State shapes are built
+    host-side (``flatten_padded`` + ``eval_shape``) so no device_put
+    onto AOT devices is needed."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.cli.common import (
+        init_model_and_state,
+    )
+    from distributed_machine_learning_tpu.models.vgg import VGGTest
+    from distributed_machine_learning_tpu.parallel.fsdp import (
+        flatten_padded,
+    )
+    from distributed_machine_learning_tpu.parallel.zero1 import (
+        Zero1State,
+        make_zero1_train_step,
+    )
+
+    axis = mesh.axis_names[0]
+    n = mesh.shape[axis]
+    model = VGGTest()
+    st = init_model_and_state(model)
+    flat, mom_flat, unravel, n_elems = flatten_padded(st, n)
+    z1 = Zero1State(param_flat=flat, momentum_shards=mom_flat,
+                    batch_stats=st.batch_stats, step=st.step, rng=st.rng,
+                    config=st.config)
+    zshape = jax.eval_shape(lambda: z1)
+    x = jax.ShapeDtypeStruct((global_batch, 32, 32, 3), jnp.float32)
+    y = jax.ShapeDtypeStruct((global_batch,), jnp.int32)
+    step = make_zero1_train_step(model, mesh, unravel, n_elems,
+                                 axis_name=axis, augment=False,
+                                 overlap=overlap)
+    if not overlap:
+        return {"step": step.lower(zshape, x, y).compile().as_text()}
+    upd = step.update_for(z1.config).lower(
+        zshape.param_flat, zshape.momentum_shards, zshape.batch_stats,
+        zshape.step, zshape.rng, x, y,
+    ).compile().as_text()
+    gat = step.gather_inner.lower(zshape.param_flat).compile().as_text()
+    return {"update": upd, "gather": gat}
+
+
+def zero1_overlap_audit(mesh, global_batch: int = 256) -> dict:
+    """The ISSUE-9 acceptance audit, read off compiled artifacts:
+
+    - sync baseline: the weight-update all-gather IS on the critical
+      path (sync, feeding ROOT) — the 2004.13336 anti-pattern the
+      overlap build exists to kill (on backends that rewrite the gather
+      into an equivalent collective, that collective is reported);
+    - overlap build, update program: contains NO all-gather (and no
+      root-feeding collective of any kind) — the critical path ends at
+      the updated shard;
+    - overlap build, consume program: the bucketed ppermute ring; on
+      backends with async collectives (the TPU AOT target) the hops
+      must form non-empty async windows — DMAs with the other buckets'
+      assembly scheduled under them, several concurrently in flight.
+    """
+    sync_hlo = compile_zero1_hlo(mesh, global_batch, overlap=False)["step"]
+    ov = compile_zero1_hlo(mesh, global_batch, overlap=True)
+    sync_colls = sync_collectives_from_hlo(sync_hlo)
+    upd_colls = sync_collectives_from_hlo(ov["update"])
+    upd_sched = audit_schedule(ov["update"])
+    gat_sched = audit_schedule(ov["gather"])
+    # The consume program must stay PERMUTE-CHAINED: sync permutes are
+    # fine (the CPU backend emits them), but any non-permute collective
+    # there is the gather re-serializing under a different op name, and
+    # zero permutes at all means it regressed to a monolithic gather.
+    gat_nonpermute = [c for c in sync_collectives_from_hlo(ov["gather"])
+                      if c["kind"] != "collective-permute"]
+    # wire_bytes_from_hlo counts every defining collective-permute,
+    # sync AND -start forms, so it covers both backends' spellings.
+    gat_permutes = wire_bytes_from_hlo(ov["gather"])["count"]
+    pairs = gat_sched["async_pairs_by_kind"].get("collective-permute", 0)
+    windows_nonempty = gat_sched["pairs_with_compute_by_kind"].get(
+        "collective-permute", 0)
+    return {
+        "sync_build": {
+            "critical_path_collectives": sync_colls,
+            "gather_on_critical_path": any(
+                c["feeds_root"] for c in sync_colls),
+        },
+        "overlap_build": {
+            "update_all_gathers": [
+                c for c in upd_colls if c["kind"] == "all-gather"],
+            "update_root_feeding_collectives": [
+                c for c in upd_colls if c["feeds_root"]],
+            "update_schedule": upd_sched,
+            "gather_sync_nonpermute_collectives": gat_nonpermute,
+            "gather_permutes": gat_permutes,
+            "gather_async_permute_pairs": pairs,
+            "gather_windows_with_compute": windows_nonempty,
+            "gather_max_in_flight": gat_sched["max_concurrent_in_flight"],
+        },
+        "passes": (
+            not any(c["kind"] == "all-gather" for c in upd_colls)
+            and not any(c["feeds_root"] for c in upd_colls)
+            and not gat_nonpermute
+            and gat_permutes > 0
+            # Async windows are a property of backends that emit
+            # -start/-done (TPU); on a sync-collective backend (CPU)
+            # the structural checks above carry the gate.
+            and (pairs == 0 or windows_nonempty > 0)
+        ),
+    }
+
+
 def compile_part3_for_topology(topology_name: str = "v5e:2x4",
                                global_batch: int = 256,
                                ring_kwargs: dict | None = None) -> str:
@@ -244,9 +400,6 @@ def compile_part3_for_topology(topology_name: str = "v5e:2x4",
     for a multi-chip TPU topology; return the optimized HLO text."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    from jax.experimental import topologies
-    from jax.sharding import Mesh
 
     from distributed_machine_learning_tpu.cli.common import (
         init_model_and_state,
@@ -257,11 +410,7 @@ def compile_part3_for_topology(topology_name: str = "v5e:2x4",
     )
     from distributed_machine_learning_tpu.train.step import make_train_step
 
-    topo = topologies.get_topology_desc(
-        platform="tpu", topology_name=topology_name
-    )
-    devs = np.array(topo.devices)
-    mesh = Mesh(devs.reshape(devs.size), ("batch",))
+    mesh = _tpu_topology_mesh(topology_name)
     model = VGG11(use_bn=True, compute_dtype=jnp.bfloat16)
     state_shape = jax.eval_shape(lambda: init_model_and_state(model))
     x = jax.ShapeDtypeStruct((global_batch, 32, 32, 3), jnp.float32)
@@ -315,11 +464,44 @@ def main(argv=None) -> None:
                              "overlap schedule; exits non-zero unless "
                              "the int8 build moves <= 1/3 of the exact "
                              "build's bytes")
+    parser.add_argument("--zero1", action="store_true",
+                        help="audit the overlap-aware zero1 weight "
+                             "update (ISSUE 9): sync baseline's gather "
+                             "on the critical path vs the overlap "
+                             "build's shard-terminated update program "
+                             "+ bucketed-ring consume program; exits "
+                             "non-zero unless the overlap build "
+                             "passes")
+    parser.add_argument("--cpu-mesh", action="store_true",
+                        help="with --zero1: audit against the local "
+                             "8-device CPU mesh (structural checks "
+                             "only — XLA:CPU emits sync collectives) "
+                             "instead of the TPU AOT topology")
     args = parser.parse_args(argv)
     if args.wire_bytes:
         summary = wire_bytes_main(args.topology, args.global_batch)
         print(json.dumps(summary))
         if not summary["passes_leq_one_third"]:
+            sys.exit(1)
+        return
+    if args.zero1:
+        if args.cpu_mesh:
+            from distributed_machine_learning_tpu.runtime.mesh import (
+                ensure_host_devices,
+                make_mesh,
+            )
+
+            ensure_host_devices(8)
+            mesh = make_mesh(8)
+        else:
+            mesh = _tpu_topology_mesh(args.topology)
+        summary = zero1_overlap_audit(mesh, args.global_batch)
+        summary["metric"] = (
+            f"zero1_overlap_audit_"
+            f"{'cpu8' if args.cpu_mesh else args.topology.replace(':', '_')}"
+        )
+        print(json.dumps(summary))
+        if not summary["passes"]:
             sys.exit(1)
         return
     summary = audit_schedule(

@@ -174,6 +174,16 @@ def make_parser():
                         "composed axis — parallel/parallel3d.py::"
                         "p3_zero1_moment_spec); update-equivalent to "
                         "plain 3d")
+    p.add_argument("--overlap-update", dest="overlap_update",
+                   action="store_true",
+                   help="overlap-aware sharded weight update (arxiv "
+                        "2004.13336): with --parallel fsdp, take the "
+                        "parameter gather off the critical path (the "
+                        "prefetch protocol of parallel/overlap.py — "
+                        "bit-identical trajectory); with --parallel pp "
+                        "--pp-schedule gpipe, shard the boundary-module "
+                        "update over the pipe axis and ring-gather the "
+                        "slices back")
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"])
     from distributed_machine_learning_tpu.train.optimizers import (
@@ -298,6 +308,18 @@ def build(args):
             f"--parallel 3d only (got --parallel {args.parallel}); the "
             "standalone ZeRO-1 scheme is parallel/zero1.py"
         )
+    if getattr(args, "overlap_update", False):
+        if args.parallel not in ("fsdp", "pp") or (
+            args.parallel == "pp" and args.pp_schedule != "gpipe"
+        ):
+            raise ValueError(
+                "--overlap-update applies to --parallel fsdp (prefetch "
+                "protocol) or --parallel pp --pp-schedule gpipe "
+                "(pipe-sharded boundary update); got --parallel "
+                f"{args.parallel}"
+                + (f" --pp-schedule {args.pp_schedule}"
+                   if args.parallel == "pp" else "")
+            )
     cfg_kwargs = {}
     if args.lr is not None:
         cfg_kwargs["learning_rate"] = args.lr
@@ -413,6 +435,7 @@ def build(args):
         step = make_fsdp_lm_train_step(
             model, mesh, unravel, n_elems,
             fused_ce_chunks=args.fused_ce_chunks,
+            overlap=getattr(args, "overlap_update", False),
         )
         sharding = NamedSharding(mesh, P("batch"))
         place = lambda x, y: (
@@ -656,7 +679,10 @@ def build(args):
             raw_state = init_interleaved_state(model, n, v, seed=SEED,
                                                config=opt_config)
         else:
-            step = make_pp_lm_train_step(model, mesh, args.microbatches)
+            step = make_pp_lm_train_step(
+                model, mesh, args.microbatches,
+                overlap_update=getattr(args, "overlap_update", False),
+            )
             raw_state = init_pipeline_state(model, seed=SEED,
                                             config=opt_config)
         state = shard_pp_state(raw_state, mesh)

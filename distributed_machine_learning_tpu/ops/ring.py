@@ -356,6 +356,74 @@ def ring_all_reduce_flat(
     return result, res
 
 
+def _ring_gather_one(shard: jax.Array, axis_name: str, n: int) -> jax.Array:
+    """One ring all-gather: local chunk → ``[n, L]`` in global rank
+    order, via N−1 ppermute hops.
+
+    Unlike the reduce ring (whose per-step SLICES need static indices,
+    hence its roll-by-rank trick), the gather only WRITES — one
+    dynamic-update-slice per hop at a traced row index is a single
+    static-shape store, so the chunks land directly in global rank
+    order and no roll/unroll repacking pass is ever materialized (a
+    pair of whole-array permutes that measurably dominated the gather
+    on the memcpy-bound CPU host)."""
+    L = shard.shape[0]
+    perm = _right_shift_perm(n)
+    rank = lax.axis_index(axis_name)
+    out = jnp.zeros((n, L), shard.dtype)
+    # Own chunk is global row ``rank``; the chunk arriving after hop
+    # s+1 was sent by rank (r − s − 1), whose chunk is that global row.
+    out = lax.dynamic_update_slice(out, shard[None], (rank, 0))
+    cur = shard
+    for s in range(n - 1):
+        cur = lax.ppermute(cur, axis_name, perm)
+        out = lax.dynamic_update_slice(
+            out, cur[None], ((rank - s - 1) % n, 0)
+        )
+    return out
+
+
+def ring_all_gather_flat(
+    shard: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    n_buckets: int = 1,
+):
+    """All-gather a flat shard via the ring's phase-2 structure.
+
+    Rank r holds global chunk r (``shard``); after N−1 ppermute hops
+    every rank holds the full ``[N·L]`` vector.  Pure data movement —
+    bit-identical to ``lax.all_gather(shard, axis, tiled=True)`` — but
+    spelled as a chunked ppermute chain so each hop's DMA gets its own
+    async window, reused for the overlap-aware sharded weight update
+    (arxiv 2004.13336), where the updated-parameter gather must stop
+    feeding ROOT as one monolithic sync collective.
+
+    ``n_buckets > 1`` splits the shard into that many independent rings
+    whose hops interleave — the same bucket-pipelining that earns the
+    reduce ring its comm/compute overlap (bucket k's DMA in flight
+    while bucket k±1's assembly runs; schedule-verified on the v5e AOT
+    target: 4 buckets → 4 DMAs concurrently in flight with assembly
+    fusions inside the windows).  A single bucket is one serial hop
+    chain: async, but with nothing of its own to hide under the DMAs.
+    """
+    n = axis_size
+    if n == 1:
+        return shard
+    L = shard.shape[0]
+    k = max(1, min(n_buckets, L))
+    if k == 1:
+        return _ring_gather_one(shard, axis_name, n).reshape(-1)
+    bounds = [(i * L // k, (i + 1) * L // k) for i in range(k)]
+    parts = [
+        _ring_gather_one(shard[a:b], axis_name, n)
+        for a, b in bounds
+    ]
+    # Reassemble [n, L] from the per-bucket [n, Lb] blocks, then
+    # flatten: global layout is rank-major, bucket-minor.
+    return jnp.concatenate(parts, axis=1).reshape(-1)
+
+
 def _bucket_bounds(n_elems: int, bucket_bytes: int, itemsize: int):
     """(start, stop) element ranges of the ring buckets — ONE definition
     shared by the all-reduce/residual accounting and the static byte

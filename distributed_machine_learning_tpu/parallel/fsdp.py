@@ -29,12 +29,17 @@ N covers the whole model.
 What the flat layout GIVES UP: the single up-front all-gather is a
 serial ICI prelude the forward must wait out, and the full parameter
 vector stays resident in HBM for the whole step — there is no
-gather/compute overlap and no per-layer liveness.  The per-layer GSPMD
-scheme (``parallel/fsdp_perlayer.py``) trades the flat layout's
-simplicity for exactly those two properties (weights gathered at their
-use site, layer i+1's gather overlapped with layer i's compute by
-XLA's latency-hiding scheduler); prefer it for deep models at scale
-and this one as the simplest correct baseline and for the CNN path.
+gather/compute overlap and no per-layer liveness.  Two ways back:
+``overlap=True`` (round 9, ``parallel/overlap.py``) keeps the flat
+layout but moves the gather off the critical path entirely — the
+updated shards are gathered by a separately-dispatched bucketed ring
+that runs behind the next step's data wait, at the cost of ZeRO-1-like
+parameter residency between steps; the per-layer GSPMD scheme
+(``parallel/fsdp_perlayer.py``) trades the flat layout's simplicity
+for use-site gathers and per-layer liveness (layer i+1's gather
+overlapped with layer i's compute by XLA's latency-hiding scheduler).
+Prefer per-layer for deep models at scale and this one as the simplest
+correct baseline and for the CNN path.
 """
 
 from __future__ import annotations
@@ -186,6 +191,7 @@ def make_fsdp_train_step(
     axis_name: str = BATCH_AXIS,
     augment: bool = True,
     jit: bool = True,
+    overlap: bool = False,
 ):
     """Build the jitted ZeRO-3 train step.
 
@@ -199,17 +205,30 @@ def make_fsdp_train_step(
     program (the bench harness's scan epoch — same convention as
     ``make_train_step``); the donate-argnums buffer reuse only applies
     to the jitted form.
+
+    ``overlap=True`` (requires ``jit``): the prefetch protocol of the
+    overlap-aware sharded update (arxiv 2004.13336; see
+    ``parallel/overlap.py``).  The up-front all-gather leaves the step
+    program: the wrapper gathers the UPDATED shards into a full vector
+    as a separate, immediately-dispatched bucketed-ring program right
+    after each update, so the gather runs behind the host's data wait
+    and the next step's program consumes the pre-gathered vector
+    directly.  Bit-identical trajectory to the sync build (the gather
+    is pure data movement).  The cost is ZeRO-1-like parameter
+    residency: the prefetched full vector stays live between steps —
+    the flat scheme keeps it live across the whole step anyway, so the
+    delta is the inter-step window only.  (``FSDPState`` is unchanged;
+    after a restore or any state rebind the wrapper detects the
+    prefetch miss and re-gathers.)
     """
     n = mesh.shape[axis_name]
 
-    def sharded_for(cfg: SGDConfig):
+    def sharded_for(cfg: SGDConfig, gather: bool = True):
         # cfg is static (FSDPState.config is not a pytree node), so the
         # enclosing jit keys its trace cache on it and this builder runs
         # once per config — no memoization needed here.
-        def impl(param_shards, momentum_shards, batch_stats, step_ctr, rng,
-                 images_u8, labels):
-            # (1) All-gather the full flat parameter vector from the shards.
-            full_flat = lax.all_gather(param_shards, axis_name, tiled=True)
+        def body(full_flat, param_shards, momentum_shards, batch_stats,
+                 step_ctr, rng, images_u8, labels):
             params = unravel(full_flat[:n_elems])
 
             r = step_rng(rng, step_ctr, axis_name)
@@ -234,32 +253,144 @@ def make_fsdp_train_step(
             return new_params, new_mom, new_stats, loss
 
         shard = P(axis_name)
+        if gather:
+            # Sync build: (1) the up-front all-gather INSIDE the program
+            # — a serial ICI prelude the forward must wait out.
+            def impl(param_shards, momentum_shards, batch_stats, step_ctr,
+                     rng, images_u8, labels):
+                full_flat = lax.all_gather(param_shards, axis_name,
+                                           tiled=True)
+                return body(full_flat, param_shards, momentum_shards,
+                            batch_stats, step_ctr, rng, images_u8, labels)
+
+            return _shard_map(
+                impl,
+                mesh=mesh,
+                in_specs=(shard, shard, P(), P(), P(), shard, shard),
+                out_specs=(shard, shard, P(), P()),
+            )
+        # Overlap build: the full vector arrives pre-gathered (the
+        # consume phase of the previous step's prefetch dispatch).
         return _shard_map(
-            impl,
+            body,
             mesh=mesh,
-            in_specs=(shard, shard, P(), P(), P(), shard, shard),
+            in_specs=(P(), shard, shard, P(), P(), P(), shard, shard),
             out_specs=(shard, shard, P(), P()),
         )
 
-    def step(state: FSDPState, images_u8, labels):
-        new_params, new_mom, new_stats, loss = sharded_for(state.config)(
-            state.param_shards,
-            state.momentum_shards,
-            state.batch_stats,
-            state.step,
-            state.rng,
-            images_u8,
-            labels,
+    if not overlap:
+        def step(state: FSDPState, images_u8, labels):
+            new_params, new_mom, new_stats, loss = sharded_for(
+                state.config
+            )(
+                state.param_shards,
+                state.momentum_shards,
+                state.batch_stats,
+                state.step,
+                state.rng,
+                images_u8,
+                labels,
+            )
+            new_state = state.replace(
+                param_shards=new_params,
+                momentum_shards=new_mom,
+                batch_stats=new_stats,
+                step=state.step + 1,
+            )
+            return new_state, loss
+
+        return jax.jit(step, donate_argnums=(0,)) if jit else step
+
+    if not jit:
+        raise ValueError(
+            "overlap=True manages its own two-program dispatch and "
+            "cannot be embedded un-jitted; use overlap=False with "
+            "jit=False for scanned-epoch callers"
         )
-        new_state = state.replace(
+    return _make_fsdp_overlap_step(
+        mesh, axis_name, n,
+        update_sharded_for=lambda cfg: sharded_for(cfg, gather=False),
+        make_state=lambda state, new_params, new_mom, new_stats: state.replace(
             param_shards=new_params,
             momentum_shards=new_mom,
             batch_stats=new_stats,
             step=state.step + 1,
+        ),
+        state_args=lambda state: (
+            state.momentum_shards,
+            state.batch_stats,
+            state.step,
+            state.rng,
+        ),
+        donate=(0, 2, 3),
+    )
+
+
+def _make_fsdp_overlap_step(mesh, axis_name, n, update_sharded_for,
+                            make_state, state_args,
+                            donate=(0, 2, 3)):
+    """Prefetch-protocol wrapper shared by the CNN and LM ZeRO-3 steps:
+    holds the in-flight full-parameter vector between steps, re-gathers
+    on a prefetch miss (first call, restore, external rebind), and
+    keeps the ``param_gather`` telemetry span.
+
+    The update program takes ``(full_flat, param_shards, *state_args,
+    x, y)`` and returns ``(new_shards, new_mom, *rest, loss)``; the
+    wrapper dispatches the next gather right after it."""
+    from distributed_machine_learning_tpu.parallel.overlap import (
+        GatherSpanClock,
+        make_ring_gather,
+    )
+
+    # donate=False: the gather input IS the state's param_shards — the
+    # next update (and any checkpoint) still reads it.
+    gather_inner = make_ring_gather(mesh, axis_name, n, donate=False)
+
+    jitted: dict = {}
+
+    def update_for(cfg):
+        fn = jitted.get(cfg)
+        if fn is None:
+            # Donate the prefetched full vector (arg 0 — consumed by
+            # the forward; freeing it mid-program caps peak HBM at the
+            # sync build's level) plus the momentum/stats buffers,
+            # which alias their updated twins.  NOT donated:
+            # param_shards (arg 1 — the separately-dispatched gather
+            # still reads it), step (re-read by the wrapper's
+            # ``state.step + 1``) and rng (carried unchanged into the
+            # next step).
+            fn = jitted[cfg] = jax.jit(
+                update_sharded_for(cfg), donate_argnums=donate
+            )
+        return fn
+
+    clock = GatherSpanClock()
+    holder: dict = {"shards": None, "full": None}
+
+    def step(state: FSDPState, images_u8, labels):
+        clock.close()
+        if holder["shards"] is not state.param_shards:
+            # Prefetch miss: first step, post-restore, or the caller
+            # rebound the state — gather now (still an async dispatch;
+            # the update program below queues behind it).
+            holder["full"] = gather_inner(state.param_shards)
+        full, holder["full"] = holder["full"], None  # donated below
+        out = update_for(state.config)(
+            full, state.param_shards, *state_args(state), images_u8,
+            labels,
         )
+        new_params, loss = out[0], out[-1]
+        new_state = make_state(state, *out[:-1])
+        holder["shards"] = new_params
+        holder["full"] = gather_inner(new_params)
+        clock.open(holder["full"])
         return new_state, loss
 
-    return jax.jit(step, donate_argnums=(0,)) if jit else step
+    step.overlap = True
+    step.update_for = update_for
+    step.gather_inner = gather_inner
+    step.pop_gather_seconds = clock.pop
+    return step
 
 
 def make_fsdp_lm_train_step(
@@ -269,6 +400,7 @@ def make_fsdp_lm_train_step(
     n_elems: int,
     axis_name: str = BATCH_AXIS,
     fused_ce_chunks: int | None = None,
+    overlap: bool = False,
 ):
     """ZeRO-3 for the transformer LM: params + optimizer state sharded
     1/N over the data axis, batch sharded over the same axis.
@@ -281,6 +413,11 @@ def make_fsdp_lm_train_step(
     memory ZeRO exists to shard.  Dense attention only (ring/ulysses
     need a 2-D mesh; composing FSDP×CP is future work).
 
+    ``overlap=True``: the prefetch protocol (see
+    :func:`make_fsdp_train_step` and ``parallel/overlap.py``) — the
+    up-front gather leaves the program and runs behind the host's data
+    wait as a bucketed-ring dispatch; bit-identical trajectory.
+
     Returns ``step(fsdp_state, tokens, targets) -> (fsdp_state, loss)``.
     """
     if model.attn_impl != "dense":
@@ -290,13 +427,12 @@ def make_fsdp_lm_train_step(
         )
     n = mesh.shape[axis_name]
 
-    def sharded_for(cfg):
-        def impl(param_shards, momentum_shards, step_ctr, rng, tokens,
-                 targets):
+    def sharded_for(cfg, gather: bool = True):
+        def body(full_flat, param_shards, momentum_shards, step_ctr, rng,
+                 tokens, targets):
             del rng  # no augmentation on the LM path
             from distributed_machine_learning_tpu.train.lm_step import lm_loss
 
-            full_flat = lax.all_gather(param_shards, axis_name, tiled=True)
             params = unravel(full_flat[:n_elems])
 
             loss, grads = jax.value_and_grad(
@@ -315,30 +451,58 @@ def make_fsdp_lm_train_step(
             return new_params, new_mom, lax.pmean(loss, axis_name)
 
         shard = P(axis_name)
+        if gather:
+            def impl(param_shards, momentum_shards, step_ctr, rng, tokens,
+                     targets):
+                full_flat = lax.all_gather(param_shards, axis_name,
+                                           tiled=True)
+                return body(full_flat, param_shards, momentum_shards,
+                            step_ctr, rng, tokens, targets)
+
+            return _shard_map(
+                impl,
+                mesh=mesh,
+                in_specs=(shard, shard, P(), P(), shard, shard),
+                out_specs=(shard, shard, P()),
+            )
         return _shard_map(
-            impl,
+            body,
             mesh=mesh,
-            in_specs=(shard, shard, P(), P(), shard, shard),
+            in_specs=(P(), shard, shard, P(), P(), shard, shard),
             out_specs=(shard, shard, P()),
         )
 
-    def step(state: FSDPState, tokens, targets):
-        new_params, new_mom, loss = sharded_for(state.config)(
-            state.param_shards,
-            state.momentum_shards,
-            state.step,
-            state.rng,
-            tokens,
-            targets,
-        )
-        new_state = state.replace(
+    if not overlap:
+        def step(state: FSDPState, tokens, targets):
+            new_params, new_mom, loss = sharded_for(state.config)(
+                state.param_shards,
+                state.momentum_shards,
+                state.step,
+                state.rng,
+                tokens,
+                targets,
+            )
+            new_state = state.replace(
+                param_shards=new_params,
+                momentum_shards=new_mom,
+                step=state.step + 1,
+            )
+            return new_state, loss
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    return _make_fsdp_overlap_step(
+        mesh, axis_name, n,
+        update_sharded_for=lambda cfg: sharded_for(cfg, gather=False),
+        make_state=lambda state, new_params, new_mom: state.replace(
             param_shards=new_params,
             momentum_shards=new_mom,
             step=state.step + 1,
-        )
-        return new_state, loss
-
-    return jax.jit(step, donate_argnums=(0,))
+        ),
+        state_args=lambda state: (state.momentum_shards, state.step,
+                                  state.rng),
+        donate=(0, 2),
+    )
 
 
 def fsdp_memory_footprint(n_params: int, n_dev: int, bytes_per_elem: int = 4):

@@ -70,17 +70,25 @@ def make_cached_sharded_step(impl, mesh: Mesh, spec_for: SpecFor, batch_sharding
     first call's actual state, cached per state tree structure."""
     jitted: dict = {}
 
+    def build(state):
+        shardings = state_shardings(state, mesh, spec_for)
+        return jax.jit(
+            impl,
+            in_shardings=(shardings, batch_sharding, batch_sharding),
+            out_shardings=(shardings, NamedSharding(mesh, P())),
+            donate_argnums=(0,),
+        )
+
     def step(state: TrainState, tokens, targets):
         key = jax.tree_util.tree_structure(state)
         fn = jitted.get(key)
         if fn is None:
-            shardings = state_shardings(state, mesh, spec_for)
-            fn = jitted[key] = jax.jit(
-                impl,
-                in_shardings=(shardings, batch_sharding, batch_sharding),
-                out_shardings=(shardings, NamedSharding(mesh, P())),
-                donate_argnums=(0,),
-            )
+            fn = jitted[key] = build(state)
         return fn(state, tokens, targets)
 
+    # AOT access for the Layer-2 HLO audits and benches: lower without
+    # executing (abstract ShapeDtypeStruct states work — the sharding
+    # derivation only reads shapes).
+    step.lower = lambda state, tokens, targets: build(state).lower(
+        state, tokens, targets)
     return step

@@ -1,0 +1,111 @@
+"""Overlap-aware sharded weight update — shared two-phase machinery.
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (PAPERS.md, arxiv 2004.13336) shards the optimizer update
+across replicas and then has to get the updated-parameter all-gather
+OFF the step's critical path — otherwise the sharding trades memory for
+a serial collective at the exact point the step produces its output
+(the anti-pattern dmlcheck DML102 flags: a sync all-gather feeding the
+ROOT tuple).  The overlap recipe ("Massively Distributed SGD", arxiv
+1811.05233: hide parameter movement under work that does not need the
+fresh parameters) splits every flat-shard scheme's step into:
+
+- an **update phase**: forward/backward, gradient reduce-scatter, and
+  the shard-local optimizer step — a program that ends at the updated
+  SHARD.  The host's ``block_until_ready(loss)`` returns as soon as
+  this program lands; no gather is inside it.
+- a **consume phase**: the gather of the updated shards back to the
+  replicated full vector, dispatched immediately as its OWN program —
+  a bucketed :func:`~distributed_machine_learning_tpu.ops.ring.ring_all_gather_flat`
+  ppermute chain (bucket k's DMA hides bucket k±1's assembly; verified
+  in the v5e AOT schedule).  Dispatch is async, so the gather executes
+  behind the host's ``data_wait``/``place_batch`` for the next batch
+  and its result is consumed by the next step's forward.
+
+Both phases are pure data-movement refactorings of the sync step —
+the overlapped trajectory is BIT-IDENTICAL to the sync one (tested for
+zero1 and fsdp on the 8-device mesh).
+
+This module owns the pieces zero1 and fsdp share, so the two overlap
+protocols cannot drift apart: the jitted ring-gather program builder
+and the ``param_gather`` telemetry bookkeeping (span from gather
+dispatch to observed readiness, closed at the next step's consume;
+``pop_gather_seconds()`` feeds the train loop's ``param_gather_s`` row
+column — the span that should overlap ``data_wait`` on the trace
+timeline while ``device_block`` shrinks).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from distributed_machine_learning_tpu.runtime.mesh import (
+    shard_map_no_check as _shard_map,
+)
+
+# Buckets for the consume-phase ring gather: enough to keep several
+# DMAs in flight with the other buckets' assembly under them (the v5e
+# schedule audit shows 4 concurrent DMAs at 4 buckets), few enough that
+# per-hop payloads stay fat.
+DEFAULT_GATHER_BUCKETS = 4
+
+
+def make_ring_gather(mesh, axis_name: str, axis_size: int,
+                     n_buckets: int = DEFAULT_GATHER_BUCKETS,
+                     donate: bool = True):
+    """The consume-phase program: jitted shard_map'd bucketed ring
+    all-gather, ``[padded] P(axis)`` shards → ``[padded] P()``
+    replicated.  ``donate=True`` lets the shard buffers die into the
+    gather (zero1: nothing else reads them); fsdp keeps them alive
+    (``donate=False`` — the shards ARE the state)."""
+    from distributed_machine_learning_tpu.ops.ring import (
+        ring_all_gather_flat,
+    )
+
+    def _gather(shards):
+        return ring_all_gather_flat(shards, axis_name, axis_size,
+                                    n_buckets=n_buckets)
+
+    fn = _shard_map(_gather, mesh=mesh,
+                    in_specs=P(axis_name), out_specs=P())
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+class GatherSpanClock:
+    """Host-side bookkeeping for the in-flight consume-phase gather.
+
+    ``open(value)`` notes dispatch time; ``close()`` — called at the
+    next step's consume — blocks on the value and records the
+    ``param_gather`` trace span (dispatch → observed ready).  The block
+    only happens when telemetry is installed: the telemetry-off path
+    never adds a host sync (the next update program would wait on its
+    input anyway).  ``pop()`` hands the last closed duration to the
+    train loop exactly once (the ``param_gather_s`` row column)."""
+
+    def __init__(self):
+        self._t0 = None
+        self._value = None
+        self._last_s = None
+
+    def open(self, value):
+        self._t0, self._value = time.perf_counter(), value
+
+    def close(self):
+        from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is None or self._t0 is None:
+            self._t0 = self._value = None
+            return
+        jax.block_until_ready(self._value)
+        t1 = time.perf_counter()
+        tel.tracer.complete("param_gather", self._t0, t1)
+        self._last_s = t1 - self._t0
+        self._t0 = self._value = None
+
+    def pop(self):
+        v, self._last_s = self._last_s, None
+        return v

@@ -57,6 +57,7 @@ __all__ = [
     "make_3d_mesh",
     "p3_param_spec",
     "p3_zero1_moment_spec",
+    "p3_zero1_grad_spec",
     "shard_3d_state",
     "make_3d_lm_train_step",
     "shard_3d_batch",
@@ -146,6 +147,27 @@ def p3_zero1_moment_spec(
     if best is not None:
         axes[best] = data_axis
     return P(*axes)
+
+
+def p3_zero1_grad_spec(
+    path: tuple[str, ...],
+    shape: tuple[int, ...],
+    dp: int,
+    data_axis: str = DATA_AXIS,
+    pipe_axis: str = PIPE_AXIS,
+) -> P:
+    """Gradient PartitionSpec at the zero1_dp backward→update boundary:
+    the MOMENT's dp-sharded layout (``p3_zero1_moment_spec``) with the
+    pipe axis dropped (pipe is manual inside the step's shard_map region
+    — stacked-layer grads are already per-stage slices).  This is the
+    annotation that lets GSPMD propagate the dp-sharded update end to
+    end: the grads arrive at the update already in their consumer's
+    layout, one planned reshard per leaf, instead of the old PARAM-spec
+    barrier's dp-replicated pin (which forced a full-grad
+    materialization and left the dp transition implicit)."""
+    full = tuple(p3_zero1_moment_spec(path, shape, dp, data_axis))
+    axes = [None if a == pipe_axis else a for a in full]
+    return P(*(axes + [None] * (len(shape) - len(axes))))
 
 
 def _state_shardings_3d(
@@ -281,22 +303,44 @@ def make_3d_lm_train_step(
 
     grad_constraint = None
     if zero1_dp:
+        dp = mesh.shape[DATA_AXIS]
+
         def grad_constraint(grads):
-            # Barrier between backward and update: pin the grads to the
-            # PARAM sharding (pipe is manual inside the region — drop
-            # it from the spec), so the dp-sharded moment layout stops
-            # propagating up into the stacked-layer backward scatter
-            # (XLA SPMD-partitioner CHECK otherwise; see
-            # pp_grads_and_update).  GSPMD then reshards each grad down
-            # to its moment's dp shard at the update — a local slice.
-            def spec(path, leaf):
+            # Two sharding-annotated dependencies between backward and
+            # update (replacing the old single PARAM-spec barrier whose
+            # dp-replicated pin was the END of layout propagation — the
+            # update's dp-sharded reshard was left implicit, wherever
+            # GSPMD happened to put it):
+            #
+            # 1. pin the backward's output to the param sharding (pipe
+            #    is manual inside the region — dropped from the spec),
+            #    so the dp-sharded moment layout cannot walk up into
+            #    the stacked-layer backward scatter (the historical XLA
+            #    SPMD-partitioner CHECK; regression-covered at the
+            #    microbatch-rows > 1 shape);
+            # 2. immediately annotate the grads with their MOMENT's
+            #    dp-sharded layout (``p3_zero1_moment_spec``), making
+            #    the shard transition ONE explicit planned reshard per
+            #    leaf through which GSPMD propagates into the update —
+            #    the elementwise update then runs on dp shards end to
+            #    end and the partitioner inserts the dp all-gather
+            #    exactly where updated params return to replicated
+            #    (arxiv 2004.13336's shard-the-update placement).
+            def param_spec(path, leaf):
                 full = tuple(p3_param_spec(_path_keys(path), leaf.ndim))
                 axes = [None if a == PIPE_AXIS else a for a in full]
-                axes += [None] * (leaf.ndim - len(axes))
-                return P(*axes)
+                return P(*(axes + [None] * (leaf.ndim - len(axes))))
 
+            def moment_spec(path, leaf):
+                return p3_zero1_grad_spec(
+                    _path_keys(path), leaf.shape, dp
+                )
+
+            grads = jax.lax.with_sharding_constraint(
+                grads, jax.tree_util.tree_map_with_path(param_spec, grads)
+            )
             return jax.lax.with_sharding_constraint(
-                grads, jax.tree_util.tree_map_with_path(spec, grads)
+                grads, jax.tree_util.tree_map_with_path(moment_spec, grads)
             )
 
     impl = partial(_pp_step_impl, model, pipe_axis=PIPE_AXIS, num_stages=pp,

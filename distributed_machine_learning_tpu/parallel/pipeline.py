@@ -251,8 +251,90 @@ def _reject_lars(config) -> None:
         )
 
 
+_BOUNDARY_MODULES = ("embed", "ln_f", "lm_head")
+
+
+def _boundary_mom(momentum, take):
+    """Apply ``take`` (a subtree selector/merger) across the momentum
+    slot's two possible layouts: params-shaped (SGD) or a dict of
+    params-shaped moment trees (AdamW's ``{"mu","nu"}``)."""
+    if isinstance(momentum, dict) and "blocks" not in momentum:
+        return {k: take(v) for k, v in momentum.items()}
+    return take(momentum)
+
+
+def _sharded_boundary_update(state: TrainState, grads, pipe_axis: str,
+                             num_stages: int):
+    """ZeRO-1-over-pipe for the replicated boundary modules: each stage
+    updates only its 1/P slice of the flattened (embed, ln_f, lm_head)
+    parameter+moment vectors, then ring-gathers the updated slices back
+    to replicated — so the boundary update compute shards P-fold and
+    the gathers' ppermute hops get async windows the scheduler fills
+    with the (much larger) stacked-blocks update math: the pipeline
+    flavor of the overlap-aware sharded weight update (arxiv
+    2004.13336), with the gather hidden under the tail of the step
+    instead of feeding ROOT as one sync collective.
+
+    Bit-identical to the replicated update: the boundary grads arrive
+    psum'd (same reduction as before), elementwise updates are exact on
+    any slice of the flat vector, and the ring gather is pure data
+    movement.  The moments stay REPLICATED in the state (the public
+    TrainState layout is unchanged — this shards the update's compute
+    and schedule, not its storage), so the updated moment slices ride
+    the same ring home as the params.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    from distributed_machine_learning_tpu.ops.ring import (
+        ring_all_gather_flat,
+    )
+
+    update_fn = update_fn_for_config(state.config)
+    take = lambda t: {k: t[k] for k in _BOUNDARY_MODULES}
+
+    flat_p, unravel_p = ravel_pytree(take(state.params))
+    flat_g, _ = ravel_pytree(take(grads))
+    mom_sub = _boundary_mom(state.momentum, take)
+    if isinstance(mom_sub, dict) and "embed" not in mom_sub:
+        # AdamW layout: one flat vector per moment tree.
+        pairs = {k: ravel_pytree(v) for k, v in mom_sub.items()}
+        flat_m = {k: p[0] for k, p in pairs.items()}
+        unravel_m = {k: p[1] for k, p in pairs.items()}
+    else:
+        flat_m, unravel_m = ravel_pytree(mom_sub)
+
+    n_elems = flat_p.shape[0]
+    padded = -(-n_elems // num_stages) * num_stages
+    shard_len = padded // num_stages
+    rank = lax.axis_index(pipe_axis)
+    pad = lambda v: jnp.pad(v, (0, padded - v.shape[0]))
+    slice_of = lambda v: lax.dynamic_slice(
+        pad(v), (rank * shard_len,), (shard_len,)
+    )
+
+    p_slice = slice_of(flat_p)
+    g_slice = slice_of(flat_g)
+    m_slice = jax.tree_util.tree_map(slice_of, flat_m)
+    new_p_slice, new_m_slice = update_fn(
+        p_slice, m_slice, g_slice, state.config, step=state.step
+    )
+
+    gather = lambda s: ring_all_gather_flat(
+        s, pipe_axis, num_stages, n_buckets=2
+    )[:n_elems]
+    new_boundary_p = unravel_p(gather(new_p_slice))
+    if isinstance(flat_m, dict):
+        new_boundary_m = {
+            k: unravel_m[k](gather(new_m_slice[k])) for k in flat_m
+        }
+    else:
+        new_boundary_m = unravel_m(gather(new_m_slice))
+    return new_boundary_p, new_boundary_m
+
+
 def pp_grads_and_update(state: TrainState, loss_fn, pipe_axis,
-                        grad_constraint=None):
+                        grad_constraint=None, overlap_update=False,
+                        num_stages=None):
     """Shared back half of every jax.grad-scheduled pipeline step (GPipe
     and interleaved): differentiate the forward-loss, share the
     last-stage loss, psum the boundary-module grads, update.
@@ -266,23 +348,56 @@ def pp_grads_and_update(state: TrainState, loss_fn, pipe_axis,
     locally.
 
     ``grad_constraint``: optional ``grads -> grads`` hook applied
-    between the backward and the update — the ZeRO-1 × 3-D step pins
-    the grads to the PARAM sharding here (a ``with_sharding_constraint``
-    barrier), so the dp-sharded moments' layout cannot propagate up
-    into the stacked-layer backward scatter (which trips an XLA SPMD
-    partitioner CHECK under the partial-manual shard_map)."""
+    between the backward and the update — the ZeRO-1 × 3-D step
+    annotates the grads with their dp-sharded MOMENT layout here, so
+    GSPMD reshards once at the update instead of propagating the moment
+    sharding up into the stacked-layer backward scatter (see
+    ``parallel3d.py``).
+
+    ``overlap_update``: shard the boundary-module update over the pipe
+    axis and ring-gather the updated slices (see
+    :func:`_sharded_boundary_update`) — bit-identical math, with the
+    boundary gather off the step's sync tail.  Requires ``num_stages``.
+    """
     _reject_lars(state.config)
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
     loss = lax.psum(loss, pipe_axis)
-    for name in ("embed", "ln_f", "lm_head"):
+    for name in _BOUNDARY_MODULES:
         grads[name] = jax.tree_util.tree_map(
             lambda g: lax.psum(g, pipe_axis), grads[name]
         )
     if grad_constraint is not None:
         grads = grad_constraint(grads)
-    new_params, new_momentum = update_fn_for_config(state.config)(
-        state.params, state.momentum, grads, state.config, step=state.step
-    )
+    if overlap_update:
+        if num_stages is None:
+            raise ValueError("overlap_update requires num_stages")
+        take_blocks = lambda t: {"blocks": t["blocks"]}
+        blk_params, blk_mom = update_fn_for_config(state.config)(
+            take_blocks(state.params),
+            _boundary_mom(state.momentum, take_blocks),
+            take_blocks(grads),
+            state.config,
+            step=state.step,
+        )
+        bnd_params, bnd_mom = _sharded_boundary_update(
+            state, grads, pipe_axis, num_stages
+        )
+        new_params = {**bnd_params, **blk_params}
+
+        def merge(blk, bnd):
+            return {**bnd, **blk}
+
+        if isinstance(state.momentum, dict) and "blocks" not in state.momentum:
+            new_momentum = {
+                k: merge(blk_mom[k], bnd_mom[k]) for k in state.momentum
+            }
+        else:
+            new_momentum = merge(blk_mom, bnd_mom)
+    else:
+        new_params, new_momentum = update_fn_for_config(state.config)(
+            state.params, state.momentum, grads, state.config,
+            step=state.step
+        )
     new_state = state.replace(
         params=new_params, momentum=new_momentum, step=state.step + 1
     )
@@ -291,7 +406,7 @@ def pp_grads_and_update(state: TrainState, loss_fn, pipe_axis,
 
 def _pp_step_impl(
     model, state: TrainState, tokens_mb, targets_mb, *, pipe_axis,
-    num_stages, grad_constraint=None,
+    num_stages, grad_constraint=None, overlap_update=False,
 ):
     loss_fn = partial(
         _pipeline_forward_loss,
@@ -302,7 +417,9 @@ def _pp_step_impl(
         num_stages=num_stages,
     )
     return pp_grads_and_update(state, loss_fn, pipe_axis,
-                               grad_constraint=grad_constraint)
+                               grad_constraint=grad_constraint,
+                               overlap_update=overlap_update,
+                               num_stages=num_stages)
 
 
 def _state_specs(
@@ -403,6 +520,7 @@ def make_pp_lm_train_step(
     mesh: Mesh,
     num_microbatches: int,
     pipe_axis: str = PIPE_AXIS,
+    overlap_update: bool = False,
 ):
     """Build the GPipe ``step(state, tokens_mb, targets_mb) ->
     (state, loss)``.
@@ -410,9 +528,17 @@ def make_pp_lm_train_step(
     ``tokens_mb``/``targets_mb``: [num_microbatches, mb, L] (see
     ``microbatch``).  ``state`` from ``init_pipeline_state`` +
     ``shard_pp_state``.  Requires ``n_layers % P == 0``.
+
+    ``overlap_update=True``: shard the boundary-module (embed / ln_f /
+    lm_head) optimizer update over the pipe axis and ring-gather the
+    updated slices back (bit-identical math; the gather's ppermute hops
+    overlap the stacked-blocks update — see
+    :func:`_sharded_boundary_update`).
     """
+    impl = (partial(_pp_step_impl, overlap_update=True)
+            if overlap_update else _pp_step_impl)
     return make_pipeline_step(
-        _pp_step_impl, model, mesh, num_microbatches, pipe_axis
+        impl, model, mesh, num_microbatches, pipe_axis
     )
 
 

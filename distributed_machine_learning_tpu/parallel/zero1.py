@@ -22,6 +22,14 @@ all-gather), the same bytes replicated DP pays — ZeRO-1 costs no extra
 bandwidth and saves (N−1)/N of the momentum memory, the reason it is
 the default first rung of optimizer sharding.  Flat-vector layout and
 padding follow ``parallel/fsdp.py``.
+
+Step (4) has two builds (see :func:`make_zero1_train_step`): the sync
+baseline keeps the gather inside the program (on the critical path,
+feeding ROOT — the arxiv 2004.13336 anti-pattern, dmlcheck DML102),
+and ``overlap=True`` moves it to a separately-dispatched bucketed
+ppermute ring (``parallel/overlap.py``) that runs behind the next
+step's data wait — bit-identical trajectory, gather off the critical
+path.
 """
 
 from __future__ import annotations
@@ -109,15 +117,48 @@ def make_zero1_train_step(
     n_elems: int,
     axis_name: str = BATCH_AXIS,
     augment: bool = True,
+    overlap: bool = False,
 ):
     """Build the jitted ZeRO-1 train step (MEAN gradient semantics).
 
     Returns ``step(zero1_state, images_u8, labels) -> (state, loss)``
     with the batch sharded along the data axis.
+
+    ``overlap=False`` (the sync baseline): one program whose final op
+    is the parameter all-gather — the gather feeds ROOT and nothing can
+    be scheduled under it, exactly the critical-path anti-pattern
+    "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+    Training" (arxiv 2004.13336) eliminates (dmlcheck DML102 flags this
+    build as an error).
+
+    ``overlap=True`` (the 2004.13336 recipe): the step is split into an
+    **update phase** — forward/backward, gradient reduce-scatter, and
+    the shard-local optimizer step, whose program ends at the updated
+    SHARD (no gather anywhere; the host's loss block returns as soon as
+    the update lands) — and a **consume phase**: the gather of the
+    updated shards is dispatched as a separate, immediately-issued
+    program (a chunked :func:`~distributed_machine_learning_tpu.ops.ring.ring_all_gather_flat`
+    ppermute chain, each hop an async window the scheduler fills with
+    the per-chunk assembly), so it executes behind the host's
+    ``data_wait`` for the next batch and is consumed by the next step's
+    forward.  Dispatch is async, so the returned state's ``param_flat``
+    is simply the in-flight gather result — checkpoint/eval callers
+    block on it transparently and see the identical replicated vector.
+    The two builds are BIT-IDENTICAL in trajectory (the gather is pure
+    data movement; the update math is shared) — tested.
+
+    When telemetry is installed the wrapper records a ``param_gather``
+    span from gather dispatch to observed readiness (closed at the next
+    step's consume), and exposes ``step.pop_gather_seconds()`` so the
+    train loop can add a ``param_gather_s`` column — the span that
+    should overlap ``data_wait`` on the trace timeline while
+    ``device_block`` shrinks.  ``step.update_for(cfg)`` /
+    ``step.gather_inner`` expose the two jitted programs for AOT
+    lowering and the HLO overlap audit (``bench/overlap_audit.py``).
     """
     n = mesh.shape[axis_name]
 
-    def sharded_for(cfg: SGDConfig):
+    def sharded_for(cfg: SGDConfig, gather: bool):
         def impl(param_flat, momentum_shard, batch_stats, step_ctr, rng,
                  images_u8, labels):
             shard_len = param_flat.shape[0] // n
@@ -143,20 +184,80 @@ def make_zero1_train_step(
                 p_shard, momentum_shard, grad_shard, cfg, step=step_ctr
             )
 
-            # (4) All-gather the updated slices into the full vector.
-            new_flat = lax.all_gather(new_p_shard, axis_name, tiled=True)
-            return new_flat, new_m_shard, new_stats, loss
+            if gather:
+                # (4, sync build) All-gather the updated slices into the
+                # full vector — ON the critical path, feeding ROOT.
+                new_flat = lax.all_gather(new_p_shard, axis_name, tiled=True)
+                return new_flat, new_m_shard, new_stats, loss
+            # (4, overlap build) stop at the shard; the consume-phase
+            # program gathers it behind the next step's data wait.
+            return new_p_shard, new_m_shard, new_stats, loss
 
         shard = P(axis_name)
         return _shard_map(
             impl,
             mesh=mesh,
             in_specs=(P(), shard, P(), P(), P(), shard, shard),
-            out_specs=(P(), shard, P(), P()),
+            out_specs=((P() if gather else shard), shard, P(), P()),
         )
 
+    if not overlap:
+        def step(state: Zero1State, images_u8, labels):
+            new_flat, new_mom, new_stats, loss = sharded_for(
+                state.config, gather=True
+            )(
+                state.param_flat,
+                state.momentum_shards,
+                state.batch_stats,
+                state.step,
+                state.rng,
+                images_u8,
+                labels,
+            )
+            new_state = state.replace(
+                param_flat=new_flat,
+                momentum_shards=new_mom,
+                batch_stats=new_stats,
+                step=state.step + 1,
+            )
+            return new_state, loss
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    from distributed_machine_learning_tpu.parallel.overlap import (
+        GatherSpanClock,
+        make_ring_gather,
+    )
+
+    # The consume-phase program: the freshly updated shards are donated
+    # into the gather (nothing else reads them); the replicated full
+    # vector is the survivor the next step reads.
+    gather_inner = make_ring_gather(mesh, axis_name, n, donate=True)
+
+    jitted: dict = {}
+
+    def update_for(cfg):
+        # Donate param_flat (arg 0 — it cannot alias the SHARDED
+        # shard-output, but freeing it mid-program caps peak HBM at
+        # the sync build's level, same reasoning as the fsdp prefetch
+        # wrapper's full vector) plus the momentum and BN-stats
+        # buffers (1, 2), which alias their updated twins.  NOT
+        # donated: step (3) is read again by the wrapper's
+        # ``state.step + 1`` and rng (4) is carried unchanged into the
+        # next step — donating either would hand the wrapper a dead
+        # buffer on backends that take donation.
+        fn = jitted.get(cfg)
+        if fn is None:
+            fn = jitted[cfg] = jax.jit(
+                sharded_for(cfg, gather=False), donate_argnums=(0, 1, 2)
+            )
+        return fn
+
+    clock = GatherSpanClock()
+
     def step(state: Zero1State, images_u8, labels):
-        new_flat, new_mom, new_stats, loss = sharded_for(state.config)(
+        clock.close()
+        new_shard, new_mom, new_stats, loss = update_for(state.config)(
             state.param_flat,
             state.momentum_shards,
             state.batch_stats,
@@ -165,6 +266,8 @@ def make_zero1_train_step(
             images_u8,
             labels,
         )
+        new_flat = gather_inner(new_shard)
+        clock.open(new_flat)
         new_state = state.replace(
             param_flat=new_flat,
             momentum_shards=new_mom,
@@ -173,7 +276,11 @@ def make_zero1_train_step(
         )
         return new_state, loss
 
-    return jax.jit(step, donate_argnums=(0,))
+    step.overlap = True
+    step.update_for = update_for
+    step.gather_inner = gather_inner
+    step.pop_gather_seconds = clock.pop
+    return step
 
 
 def zero1_memory_footprint(n_params: int, n_dev: int, bytes_per_elem: int = 4):

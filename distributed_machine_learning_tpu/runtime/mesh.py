@@ -145,6 +145,26 @@ def shard_map_no_check(f, *, mesh, in_specs, out_specs, manual_axes=None):
         )
 
 
+def ensure_host_devices(n: int = 8) -> None:
+    """Put ``--xla_force_host_platform_device_count=n`` into XLA_FLAGS
+    if no device-count flag is present yet.
+
+    MUST run before the CPU client spins up (the first ``jax.devices()``
+    call) — after that the flag is ignored.  The ONE copy of the dance
+    the virtual-mesh entrypoints share (the dmlcheck CLI, the overlap
+    bench/audit ``--cpu-mesh`` paths), so the device count and the
+    ordering invariant cannot drift between them.  tests/conftest.py
+    keeps its own inline copy deliberately: it must mutate the env
+    before importing ANYTHING from this package."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
 def make_mesh(
     num_devices: int | None = None,
     axis_names: tuple[str, ...] = (BATCH_AXIS,),

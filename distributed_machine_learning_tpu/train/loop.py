@@ -208,6 +208,21 @@ def train_epoch(
                 "block_s": t_end - t_block,
                 "examples_per_s": examples_per_s,
             }
+            # Overlap-aware sharded updates (zero1/fsdp overlap=True)
+            # expose the consume-phase gather span: dispatch → observed
+            # ready, closed at the NEXT step's consume, so row k
+            # reports step k−1's gather.  On the trace timeline the
+            # param_gather span overlaps data_wait — the 2004.13336
+            # proof that the weight-update gather left the critical
+            # path (device_block shrinks by what param_gather hides).
+            pop_gather = getattr(train_step, "pop_gather_seconds", None)
+            if pop_gather is not None:
+                gather_s = pop_gather()
+                if gather_s is not None:
+                    row["param_gather_s"] = gather_s
+                    if not warmup:
+                        reg.histogram("param_gather_seconds").observe(
+                            gather_s)
             if n_tokens is not None:
                 tokens_per_s = n_tokens / wall if wall > 0 else 0.0
                 row["tokens_per_s"] = tokens_per_s

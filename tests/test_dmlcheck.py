@@ -351,10 +351,15 @@ def test_audit_jaxpr_flags_host_callback():
 def test_layer2_real_steps_have_no_errors(mesh8):
     """The full --layer2 sweep over the real programs: the ring step's
     donation is fully taken (every state leaf aliased) with no
-    all-gather anywhere; the zero1 weight-update all-gather is reported
-    as the KNOWN advisory debt (2004.13336, flips to error when the
-    ROADMAP overlap item lands)."""
+    all-gather anywhere; the zero1 audit now gates the OVERLAP-AWARE
+    build at ERROR severity (ISSUE 9 landed the 2004.13336 overlap
+    item: the update program contains no all-gather at all and the
+    consume program is a permute-only bucketed ring) and must be
+    entirely clean — the pre-overlap advisory phase is over.  The
+    per-layer FSDP audit (use-site gathers, none feeding ROOT) must be
+    clean too."""
     from distributed_machine_learning_tpu.analysis.program_audit import (
+        audit_fsdp_perlayer_step,
         audit_ring_step,
         audit_zero1_step,
     )
@@ -362,11 +367,45 @@ def test_layer2_real_steps_have_no_errors(mesh8):
     ring = audit_ring_step(mesh8)
     assert ring == [], [f.message for f in ring]
     zero1 = audit_zero1_step(mesh8)
-    assert all(f.severity == "advisory" for f in zero1)
-    assert any(f.rule == "DML102" and "all-gather" in f.message
-               for f in zero1), ("the known zero1 critical-path debt "
-                                 "must be reported until the overlap "
-                                 "item lands")
+    assert zero1 == [], [f.message for f in zero1]
+    pl = audit_fsdp_perlayer_step(mesh8)
+    assert pl == [], [f.message for f in pl]
+
+
+def test_zero1_sync_baseline_still_flagged(mesh8):
+    """The legacy sync zero1 build (overlap=False — kept for parity
+    tests and the bench baseline) must STILL trip DML102 at error
+    severity: the gate's teeth are demonstrated against the known-bad
+    program, so a future change can't silently neuter the pass while
+    the overlap build stays green."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.analysis.program_audit import (
+        _vggtest_setup,
+        audit_critical_path_collectives,
+    )
+    from distributed_machine_learning_tpu.parallel.zero1 import (
+        make_zero1_train_step,
+        shard_zero1_state,
+    )
+
+    model, init_state, _ = _vggtest_setup()
+    z1, unravel, n_elems = shard_zero1_state(init_state(), mesh8)
+    step = make_zero1_train_step(model, mesh8, unravel, n_elems,
+                                 augment=False, overlap=False)
+    zshape = jax.eval_shape(lambda: z1)
+    x = jax.ShapeDtypeStruct((16, 32, 32, 3), jnp.float32)
+    y = jax.ShapeDtypeStruct((16,), jnp.int32)
+    hlo = step.lower(zshape, x, y).compile().as_text()
+    findings = audit_critical_path_collectives(
+        hlo, kinds=("all-gather",), label="zero1_sync")
+    assert findings, "sync zero1 build no longer trips DML102"
+    assert all(f.severity == "error" for f in findings), (
+        "DML102 must default to error severity now that the overlap "
+        "item landed")
+    assert any("feeds the step output directly" in f.message
+               for f in findings)
 
 
 @pytest.mark.slow

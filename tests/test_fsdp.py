@@ -113,6 +113,120 @@ def test_fsdp_state_roundtrip(mesh8):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
+def test_fsdp_overlap_bit_identical_to_sync(batch, mesh8):
+    """ISSUE-9 parity acceptance for ZeRO-3: the prefetch-protocol
+    build (pre-gathered full vector consumed by the update program, the
+    gather dispatched behind the previous step's data wait) must be
+    bitwise equal to the sync build — the gather is pure data movement
+    and the update math is shared."""
+    images, labels = batch
+    mx, my = shard_batch(mesh8, images, labels)
+    model = VGGTest()
+
+    def run(overlap):
+        st, unravel, n_elems = shard_fsdp_state(_fresh_state(model), mesh8)
+        step = make_fsdp_train_step(model, mesh8, unravel, n_elems,
+                                    augment=False, overlap=overlap)
+        losses = []
+        for _ in range(3):
+            st, loss = step(st, mx, my)
+            losses.append(float(loss))
+        return st, losses, unravel, n_elems
+
+    sync, sync_losses, unravel, n_elems = run(False)
+    ov, ov_losses, _, _ = run(True)
+    assert sync_losses == ov_losses
+    np.testing.assert_array_equal(
+        np.asarray(sync.param_shards), np.asarray(ov.param_shards)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sync.momentum_shards), np.asarray(ov.momentum_shards)
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(
+            gather_fsdp_params(sync, unravel, n_elems)),
+        jax.tree_util.tree_leaves(
+            gather_fsdp_params(ov, unravel, n_elems)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fsdp_overlap_prefetch_miss_recovers(batch, mesh8):
+    """The prefetch holder keys on the state's param_shards identity:
+    after a rebind (a checkpoint restore rebuilds the state object),
+    the wrapper must detect the miss, re-gather, and keep the
+    trajectory — not consume a stale full vector."""
+    images, labels = batch
+    mx, my = shard_batch(mesh8, images, labels)
+    model = VGGTest()
+
+    st, unravel, n_elems = shard_fsdp_state(_fresh_state(model), mesh8)
+    step = make_fsdp_train_step(model, mesh8, unravel, n_elems,
+                                augment=False, overlap=True)
+    st, _ = step(st, mx, my)
+    st, _ = step(st, mx, my)
+    # Simulate a restore: same values, NEW array objects.
+    rebound = st.replace(
+        param_shards=jnp.array(st.param_shards, copy=True),
+        momentum_shards=jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True), st.momentum_shards
+        ),
+    )
+    st3, _ = step(rebound, mx, my)
+
+    ref_state, ref_unravel, ref_n = shard_fsdp_state(
+        _fresh_state(model), mesh8)
+    ref_step = make_fsdp_train_step(model, mesh8, ref_unravel, ref_n,
+                                    augment=False, overlap=False)
+    for _ in range(3):
+        ref_state, _ = ref_step(ref_state, mx, my)
+    np.testing.assert_array_equal(
+        np.asarray(st3.param_shards), np.asarray(ref_state.param_shards)
+    )
+
+
+@pytest.mark.slow
+def test_fsdp_lm_overlap_bit_identical_to_sync(mesh8):
+    """The LM flavor of the prefetch protocol (what the CLI's
+    ``--parallel fsdp --overlap-update`` builds) keeps the bitwise
+    guarantee too — AdamW moments included."""
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from distributed_machine_learning_tpu.parallel.fsdp import (
+        make_fsdp_lm_train_step,
+    )
+    from distributed_machine_learning_tpu.train.adamw import AdamWConfig
+    from distributed_machine_learning_tpu.train.lm_step import init_lm_state
+
+    model = TransformerLM(vocab_size=64, d_model=32, n_layers=2,
+                          n_heads=4, attn_impl="dense")
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, 64, (16, 17))
+    from distributed_machine_learning_tpu.train.step import shard_batch
+
+    mx, my = shard_batch(mesh8, toks[:, :-1].astype(np.int32),
+                         toks[:, 1:].astype(np.int32))
+
+    def run(overlap):
+        st, unravel, n_elems = shard_fsdp_state(
+            init_lm_state(model, seed=0, config=AdamWConfig()), mesh8)
+        step = make_fsdp_lm_train_step(model, mesh8, unravel, n_elems,
+                                       overlap=overlap)
+        for _ in range(3):
+            st, loss = step(st, mx, my)
+        return st, float(loss)
+
+    sync, sync_loss = run(False)
+    ov, ov_loss = run(True)
+    assert sync_loss == ov_loss
+    np.testing.assert_array_equal(
+        np.asarray(sync.param_shards), np.asarray(ov.param_shards))
+    for a, b in zip(jax.tree_util.tree_leaves(sync.momentum_shards),
+                    jax.tree_util.tree_leaves(ov.momentum_shards)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_fsdp_memory_footprint():
     fp = fsdp_memory_footprint(9_231_114, 8)
     assert fp["fsdp"] * 7 < fp["replicated"]  # ~8x smaller (padding slack)

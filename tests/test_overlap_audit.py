@@ -74,6 +74,121 @@ def test_wire_bytes_parser_empty_module():
     assert got == {"total_bytes": 0, "count": 0, "by_dtype": {}}
 
 
+TPU_STYLE_ASYNC_HLO = """\
+HloModule m
+
+ENTRY main {
+  p0 = f32[1066]{0} parameter(0)
+  collective-permute-start = (f32[1066]{0:T(1024)}, f32[1066]{0:T(1024)}, u32[]{:S(2)}, u32[]{:S(2)}) collective-permute-start(p0), source_target_pairs={{0,1}}
+  f.1 = f32[8,1066]{1,0} fusion(p0), kind=kLoop, calls=fused_dus
+  collective-permute-done = f32[1066]{0:T(1024)} collective-permute-done((f32[1066]{0:T(1024)}, f32[1066]{0:T(1024)}, u32[]{:S(2)}, u32[]{:S(2)}) %collective-permute-start)
+  ROOT r = f32[1066]{0} add(collective-permute-done, p0)
+}
+"""
+
+
+def test_audit_closes_tuple_typed_done_windows():
+    """The TPU backend spells the -done operand's full tuple type
+    inline (``...-done((f32[...]{0:T(1024)}, ...) %start)``); the
+    walker must still close the window — a lazy scan-to-first-paren
+    used to mis-capture ``1024`` and leave every window open (so
+    max_in_flight counted starts, never overlap)."""
+    s = audit_schedule(TPU_STYLE_ASYNC_HLO)
+    assert s["async_ppermute_pairs"] == 1
+    assert s["pairs_with_compute_in_window"] == 1
+    assert s["max_concurrent_in_flight"] == 1
+
+
+GTE_ROOT_HLO = """\
+HloModule m
+
+ENTRY main {
+  p0 = f32[1066]{0} parameter(0)
+  ar = (f32[8528]{0}, f32[]) all-reduce(p0, p0), replica_groups={{0,1}}, to_apply=add
+  gte0 = f32[8528]{0} get-tuple-element((f32[8528]{0}, f32[]) %ar), index=0
+  ROOT r = (f32[8528]{0}) tuple(%gte0)
+}
+"""
+
+
+def test_sync_collectives_feed_root_through_gte():
+    """Tuple-fused collectives (the TPU backend folds the zero1 gather
+    into a variadic all-reduce) reach ROOT via get-tuple-element; the
+    feeds_root attribution must see through one GTE hop, or the sync
+    baseline's critical-path collective reads as innocent."""
+    from distributed_machine_learning_tpu.bench.overlap_audit import (
+        sync_collectives_from_hlo,
+    )
+
+    recs = sync_collectives_from_hlo(GTE_ROOT_HLO)
+    assert len(recs) == 1
+    assert recs[0]["kind"] == "all-reduce"
+    assert recs[0]["feeds_root"] is True
+
+
+def test_zero1_overlap_audit_ci_regression(mesh8):
+    """The ISSUE-9 acceptance gate, on real compiled executables (CPU
+    mesh — structural checks): the sync baseline's weight-update
+    all-gather IS on the critical path feeding ROOT (the 2004.13336
+    anti-pattern), and the overlap build kills it — the update program
+    contains no all-gather and no root-feeding collective of any kind;
+    the consume program is permute-only.  A future change that
+    re-serializes the gather fails here."""
+    from distributed_machine_learning_tpu.bench.overlap_audit import (
+        zero1_overlap_audit,
+    )
+
+    summary = zero1_overlap_audit(mesh8, global_batch=16)
+    assert summary["sync_build"]["gather_on_critical_path"], (
+        "the sync baseline must still exhibit the anti-pattern the "
+        "overlap build is measured against"
+    )
+    ov = summary["overlap_build"]
+    assert ov["update_all_gathers"] == []
+    assert ov["update_root_feeding_collectives"] == []
+    # The consume program is permute-chained: a regression back to one
+    # monolithic all-gather shows up as zero permutes and/or a
+    # non-permute collective, and must fail the gate.
+    assert ov["gather_sync_nonpermute_collectives"] == []
+    assert ov["gather_permutes"] > 0
+    assert summary["passes"], summary
+
+
+def test_ring_all_gather_bitwise_and_bucketed(mesh8):
+    """The consume-phase primitive: the bucketed ppermute ring gather
+    is bit-identical to ``lax.all_gather(tiled=True)`` for every bucket
+    count (pure data movement — the overlap builds' parity rests on
+    this), and compiles to (N−1)·buckets permutes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_machine_learning_tpu.ops.ring import (
+        ring_all_gather_flat,
+    )
+    from distributed_machine_learning_tpu.runtime.mesh import (
+        shard_map_no_check,
+    )
+
+    x = np.random.default_rng(0).normal(size=(8, 97)).astype(np.float32)
+    ref = jax.jit(shard_map_no_check(
+        lambda s: lax.all_gather(s.reshape(-1), "batch", tiled=True)[None],
+        mesh=mesh8, in_specs=P("batch"), out_specs=P("batch")))(x)
+    for k in (1, 3, 4):
+        fn = jax.jit(shard_map_no_check(
+            lambda s, k=k: ring_all_gather_flat(
+                s.reshape(-1), "batch", 8, n_buckets=k)[None],
+            mesh=mesh8, in_specs=P("batch"), out_specs=P("batch")))
+        np.testing.assert_array_equal(np.asarray(fn(x)), np.asarray(ref))
+        hlo = fn.lower(
+            jax.ShapeDtypeStruct((8, 97), jnp.float32)
+        ).compile().as_text()
+        permutes = wire_bytes_from_hlo(hlo)["count"]
+        assert permutes == 7 * k, (k, permutes)
+
+
 def test_wire_bytes_ci_regression_int8_vs_exact(mesh8):
     """The fast CI gate (ISSUE 7 satellite): compile a real bucketed
     ring for the 8-device mesh, exact and int8, and assert the

@@ -239,11 +239,45 @@ def test_3d_zero1_moment_spec_rules():
     assert "batch" not in tuple(spec2)
 
 
+def test_3d_zero1_grad_spec_carries_dp_and_drops_pipe():
+    """The backward→update annotation (the barrier replacement, ISSUE 9
+    satellite): grads must be annotated with their MOMENT's dp-sharded
+    layout — the data axis present on every shardable weight leaf, so
+    the dp-sharded update propagates end to end — with the pipe axis
+    dropped (manual inside the region).  The old PARAM-spec barrier
+    carried no dp axis at all, which is exactly the layout-propagation
+    block this replaces."""
+    from distributed_machine_learning_tpu.parallel.parallel3d import (
+        p3_zero1_grad_spec,
+    )
+
+    spec = p3_zero1_grad_spec(
+        ("blocks", "attn", "qkv", "kernel"), (2, 32, 3, 4, 8), dp=2
+    )
+    axes = tuple(spec)
+    assert "pipe" not in axes, "pipe is manual inside the region"
+    assert "batch" in axes, (
+        "the dp axis must reach the grads — a dp-free annotation is "
+        "the old barrier behavior"
+    )
+    assert "model" in axes, "TP layout preserved"
+    # Embed stays excluded (the documented gather-scatter CHECK class).
+    embed = tuple(p3_zero1_grad_spec(("embed", "embedding"), (64, 32),
+                                     dp=2))
+    assert "batch" not in embed and "pipe" not in embed
+
+
 def test_3d_zero1_dp_batch8_compiles_and_runs():
     """Regression: at microbatch rows > 1 per dp shard the partitioner
     used to hit an SPMD CHECK (the dp-sharded moment layout propagated
-    into the stacked-layer backward scatter) — the grad barrier in
-    pp_grads_and_update must keep this shape compiling."""
+    into the stacked-layer backward scatter).  The two-stage
+    sharding-annotated dependency in make_3d_lm_train_step (param-spec
+    pin on the backward side + moment-spec annotation the update
+    propagates through — the ISSUE-9 replacement for the old barrier)
+    must keep this shape compiling AND leave the moments dp-sharded,
+    with no barrier-induced dp-replicated grad pin between backward
+    and update (the moment annotation is now the last word on the grad
+    layout)."""
     from distributed_machine_learning_tpu.train.adamw import AdamWConfig
 
     rng = np.random.default_rng(7)
@@ -261,3 +295,17 @@ def test_3d_zero1_dp_batch8_compiles_and_runs():
                                  zero1_dp=True)
     state, loss = step(state, mx, my)
     assert np.isfinite(float(loss))
+    # The memory claim survives the constraint rework: weight moments
+    # really live dp-sharded after a step.
+    def dp_sharded(arr):
+        return any(
+            ax == "batch" or (isinstance(ax, tuple) and "batch" in ax)
+            for ax in tuple(arr.sharding.spec)
+        )
+
+    sharded = [
+        dp_sharded(m)
+        for m in jax.tree_util.tree_leaves(state.momentum)
+        if m.ndim >= 3
+    ]
+    assert sharded and all(sharded)

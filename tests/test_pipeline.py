@@ -85,6 +85,54 @@ def test_pp_step_equals_single_device(batch, stages, microbatches):
         )
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("optimizer", ["sgd", "adamw"])
+def test_pp_overlap_update_parity(batch, optimizer):
+    """ISSUE-9 pipeline composition: overlap_update shards the
+    boundary-module (embed/ln_f/lm_head) optimizer update over the pipe
+    axis and ring-gathers the slices back.  SGD is bitwise identical to
+    the replicated update; AdamW agrees to ~1 ulp (the flat-vector
+    update compiles with different FMA contraction than the per-leaf
+    program — measured |Δ| ≤ 4e-9 on a handful of elements) — a real
+    slicing/gather bug would blow past these bars on most elements."""
+    from distributed_machine_learning_tpu.train.adamw import AdamWConfig
+
+    tokens, targets = batch
+    model = tiny_lm()
+    cfg = AdamWConfig() if optimizer == "adamw" else None
+    mesh = make_mesh(2, axis_names=("pipe",))
+    x, y = microbatch(tokens, targets, 2)
+
+    def run(overlap):
+        state = shard_pp_state(init_pipeline_state(model, config=cfg),
+                               mesh)
+        step = make_pp_lm_train_step(model, mesh, num_microbatches=2,
+                                     overlap_update=overlap)
+        losses = []
+        for _ in range(3):
+            state, loss = step(state, x, y)
+            losses.append(float(loss))
+        return state, losses
+
+    sync, sync_losses = run(False)
+    ov, ov_losses = run(True)
+    assert sync_losses == ov_losses
+    for tree_pair in ((sync.params, ov.params),
+                      (sync.momentum, ov.momentum)):
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(tree_pair[0]),
+            jax.tree_util.tree_leaves_with_path(tree_pair[1]),
+        ):
+            if optimizer == "sgd":
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=jax.tree_util.keystr(pa))
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=0, atol=1e-7,
+                    err_msg=jax.tree_util.keystr(pa))
+
+
 def test_pp_guards(batch):
     model = tiny_lm()
     mesh3 = make_mesh(3, axis_names=("pipe",))

@@ -90,6 +90,104 @@ def test_zero1_momentum_is_sharded(data):
     assert p_shard.data.shape == z1.param_flat.shape  # replicated
 
 
+def test_zero1_overlap_bit_identical_to_sync(data):
+    """The ISSUE-9 parity acceptance: the overlap-aware build (update
+    program + separately-dispatched bucketed-ring gather) must take
+    EXACTLY the sync build's trajectory — the gather is pure data
+    movement and the update math is shared, so every state leaf is
+    bitwise equal after several fixed-seed steps."""
+    x, y = data
+    model = VGGTest()
+    mesh = make_mesh(8)
+    mx, my = shard_batch(mesh, x, y)
+
+    def run(overlap):
+        z1, unravel, n_elems = shard_zero1_state(
+            init_model_and_state(model), mesh
+        )
+        step = make_zero1_train_step(model, mesh, unravel, n_elems,
+                                     augment=False, overlap=overlap)
+        losses = []
+        for _ in range(3):
+            z1, loss = step(z1, mx, my)
+            losses.append(float(loss))
+        return z1, losses, unravel, n_elems
+
+    sync, sync_losses, unravel, n_elems = run(False)
+    ov, ov_losses, _, _ = run(True)
+    assert sync_losses == ov_losses
+    np.testing.assert_array_equal(
+        np.asarray(sync.param_flat), np.asarray(ov.param_flat)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sync.momentum_shards), np.asarray(ov.momentum_shards)
+    )
+    # The overlapped state's param_flat is the (in-flight) gather
+    # output and must still be the replicated full vector checkpoints
+    # and eval expect.
+    from jax.sharding import PartitionSpec as P
+
+    assert tuple(ov.param_flat.sharding.spec) in ((), (None,))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(zero1_params(sync, unravel, n_elems)),
+        jax.tree_util.tree_leaves(zero1_params(ov, unravel, n_elems)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero1_overlap_param_gather_telemetry(data, tmp_path):
+    """With telemetry installed, the overlap step records a
+    ``param_gather`` span per step (dispatch → observed ready, closed
+    at the next consume), the train loop forwards it into the metrics
+    rows as ``param_gather_s``, and ``tools/trace_summary.py`` renders
+    the phase as overlapped."""
+    from distributed_machine_learning_tpu.telemetry import (
+        Telemetry,
+        set_telemetry,
+    )
+    from distributed_machine_learning_tpu.train.loop import train_epoch
+
+    x, y = data
+    model = VGGTest()
+    mesh = make_mesh(8)
+    mx, my = shard_batch(mesh, x, y)
+    z1, unravel, n_elems = shard_zero1_state(
+        init_model_and_state(model), mesh
+    )
+    step = make_zero1_train_step(model, mesh, unravel, n_elems,
+                                 augment=False, overlap=True)
+    tel = Telemetry(tmp_path, flush_every=1)
+    prev = set_telemetry(tel)
+    try:
+        train_epoch(step, z1, [(mx, my)] * 4, max_iters=4, telemetry=tel)
+    finally:
+        set_telemetry(prev)
+        tel.close()
+
+    import json as _json
+
+    trace = (tmp_path / "trace.json").read_text()
+    spans = [_json.loads(line.rstrip(",\n")) for line in
+             trace.splitlines() if '"param_gather"' in line]
+    assert spans, "no param_gather spans in the trace"
+    rows = [_json.loads(line) for line in
+            (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    gather_rows = [r for r in rows if "param_gather_s" in r]
+    # The span closes at the NEXT step's consume: rows 1..3 carry it.
+    assert gather_rows, "no param_gather_s metrics column"
+
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "tools/trace_summary.py", str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "param_gather" in out.stdout
+    assert "overlapped" in out.stdout
+
+
 def test_zero1_memory_footprint():
     fp = zero1_memory_footprint(1000, 8)
     assert fp["replicated"] == 2 * 1000 * 4

@@ -61,12 +61,13 @@ BASELINE_NAME = "dmlcheck_baseline.json"
 
 def _run_layer2():
     # The CPU mesh needs the 8-way host-platform split BEFORE jax
-    # initializes a backend (same bootstrap as tests/conftest.py).
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
+    # initializes a backend (shared helper; Layer 1 must stay jax-free,
+    # so this import lives inside the layer-2 branch only).
+    from distributed_machine_learning_tpu.runtime.mesh import (
+        ensure_host_devices,
+    )
+
+    ensure_host_devices(8)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from distributed_machine_learning_tpu.analysis.program_audit import (
         run_layer2,

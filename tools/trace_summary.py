@@ -53,6 +53,12 @@ REGISTRY_FILE = "registry.json"
 # The per-step driver phases, in pipeline order (other spans —
 # checkpoint_save, eval, restart_attempt — are reported after these).
 STEP_PHASES = ("data_wait", "place_batch", "step_dispatch", "device_block")
+# Spans that run CONCURRENTLY with the pipeline phases (the
+# overlap-aware sharded update's consume-phase gather runs behind
+# data_wait): shown in the phase table for visibility, but excluded
+# from the pipeline total — counting an overlapped span into the
+# denominator would misstate every share.
+OVERLAY_PHASES = ("param_gather",)
 
 
 def summarize(telemetry_dir: str, top: int = 5) -> str:
@@ -94,8 +100,23 @@ def summarize(telemetry_dir: str, top: int = 5) -> str:
                     f"  {p:<14} {share:5.1f}%  "
                     f"({d['dur'] / 1e6:.3f}s over {d['count']} spans)"
                 )
+            for p in OVERLAY_PHASES:
+                d = by_name.get(p)
+                if d is None:
+                    continue
+                # Reported against the same pipeline total so "how much
+                # of a step the gather spans" reads directly, but
+                # flagged: this time runs UNDER the phases above
+                # (overlap-aware update), not in addition to them.
+                share = 100.0 * d["dur"] / phase_total
+                lines.append(
+                    f"  {p:<14} {share:5.1f}%  "
+                    f"({d['dur'] / 1e6:.3f}s over {d['count']} spans, "
+                    "overlapped — runs under data_wait/dispatch)"
+                )
         other = sorted(
-            (n for n in by_name if n not in STEP_PHASES),
+            (n for n in by_name
+             if n not in STEP_PHASES and n not in OVERLAY_PHASES),
             key=lambda n: -by_name[n]["dur"],
         )
         for n in other:
@@ -139,7 +160,7 @@ def summarize(telemetry_dir: str, top: int = 5) -> str:
                 phases = "  ".join(
                     f"{k}={float(r[k]):.6f}"
                     for k in ("data_wait_s", "place_s", "dispatch_s",
-                              "block_s")
+                              "block_s", "param_gather_s")
                     if k in r
                 )
                 lines.append(
