@@ -21,6 +21,22 @@ workers (default 1; 0 disables shrinking), with the per-host batch
 rescaled so the ``--global-batch`` (and the LR schedule) is preserved
 and every example still consumed exactly once per step.
 
+Elastic GROW (ISSUE 10): ``--max-world N`` lets the gang grow back —
+a recovered host (``recover_rank@r:k``, or any out-of-band
+``announce_join``) is readmitted at the next coordinated boundary and
+the world renumbers M→N through the same ``reshard_restore`` path a
+shrink uses.  ``--spares K`` runs K warm-spare workers beside the gang
+(heartbeating and prefetching the newest verified checkpoint, never
+training); spares are promoted at planned boundaries — filling the
+world after a grow admission, or, under
+``--straggler-policy replace``, replacing a persistently slow rank
+(demoted to spare, with ``--replace-after`` consecutive flagged health
+feeds of hysteresis).  ``--scaling-rule`` picks how (global batch, LR)
+respond to a world change (``train/scaling.py``): ``pinned`` keeps
+PR 5's world-invariant batch, ``linear``/``lars`` grow the batch with
+the world and compensate the LR so the loss trajectory stays
+continuous; ``unscaled`` is the deliberately-wrong control.
+
 Observable by default (ISSUE 6): the gang telemetry plane lands under
 ``<gang-dir>/telemetry`` — supervisor counters/spans at canonical
 names, each worker's stream rank-suffixed beside them — with live
@@ -96,6 +112,42 @@ def main(argv=None) -> int:
                          "or per-rank budget spent); 0 disables "
                          "shrinking — an unrecoverable rank then fails "
                          "the job")
+    ap.add_argument("--max-world", dest="max_world", type=int, default=0,
+                    help="largest gang the supervisor may GROW to when "
+                         "a recovered/new host announces a join "
+                         "(recover_rank fault or announce_join); 0 "
+                         "(default) disables growing")
+    ap.add_argument("--spares", type=int, default=0,
+                    help="warm-spare workers run beside the gang: they "
+                         "heartbeat on the join channel and prefetch "
+                         "the newest verified checkpoint but never "
+                         "train; promoted at planned boundaries")
+    ap.add_argument("--straggler-policy", dest="straggler_policy",
+                    default="advise", choices=("advise", "replace"),
+                    help="what a straggler verdict does: 'advise' "
+                         "(default) only flags; 'replace' demotes the "
+                         "slow rank to spare and promotes a warm spare "
+                         "in its place (requires --spares >= 1)")
+    ap.add_argument("--replace-after", dest="replace_after", type=int,
+                    default=2,
+                    help="consecutive flagged health feeds before the "
+                         "replace policy acts (hysteresis: one flag "
+                         "never flips the gang)")
+    ap.add_argument("--scaling-rule", dest="scaling_rule",
+                    default="pinned",
+                    choices=("pinned", "linear", "lars", "unscaled"),
+                    help="how (global batch, LR) respond to a world "
+                         "change (train/scaling.py); anchored at the "
+                         "launch world")
+    ap.add_argument("--base-lr", dest="base_lr", type=float, default=0.5,
+                    help="learning rate at the launch world (the "
+                         "scaling rule's anchor)")
+    ap.add_argument("--feature-dim", dest="feature_dim", type=int,
+                    default=8,
+                    help="toy example dimensionality (the chaos "
+                         "continuity proof uses a wider dim so the "
+                         "per-step loss noise is small against the "
+                         "floor shifts it measures)")
     ap.add_argument("--rank-restart-budget", dest="rank_restart_budget",
                     type=int, default=None,
                     help="failures attributable to one rank before it "
@@ -143,6 +195,21 @@ def main(argv=None) -> int:
                  "median is not a straggler)")
     if args.straggler_consecutive < 1:
         ap.error("--straggler-consecutive must be >= 1")
+    if args.max_world and args.max_world < args.workers:
+        ap.error(f"--max-world must be >= --workers ({args.workers}) "
+                 f"or 0 to disable, got {args.max_world}")
+    if args.spares < 0:
+        ap.error(f"--spares must be >= 0, got {args.spares}")
+    if args.straggler_policy == "replace" and args.spares < 1:
+        ap.error("--straggler-policy replace needs at least one warm "
+                 "spare to promote (--spares >= 1)")
+    if args.spares and not args.max_world \
+            and args.straggler_policy != "replace":
+        ap.error("--spares without a promotion path: spares can only "
+                 "be promoted at a grow (--max-world) or replacement "
+                 "(--straggler-policy replace) boundary")
+    if args.replace_after < 1:
+        ap.error(f"--replace-after must be >= 1, got {args.replace_after}")
 
     from distributed_machine_learning_tpu.runtime.faults import (
         FaultEvents,
@@ -205,6 +272,13 @@ def main(argv=None) -> int:
             "--global-batch", str(args.global_batch),
             "--heartbeat-interval", str(args.heartbeat_interval),
             "--peer-timeout", str(args.peer_timeout),
+            # The scaling rule anchors at the LAUNCH world: relaunches
+            # at other worlds re-derive (batch, lr) from this fixed
+            # base point, not from whatever world they wake up in.
+            "--scaling-rule", args.scaling_rule,
+            "--base-world", str(args.workers),
+            "--base-lr", str(args.base_lr),
+            "--feature-dim", str(args.feature_dim),
         ]
         if args.faults:
             cmd += ["--faults", args.faults]
@@ -216,6 +290,19 @@ def main(argv=None) -> int:
             # stable across shrink renumberings.
             cmd += ["--telemetry-dir", tel_dir]
         return cmd
+
+    def spare_cmd(orig_rank: int, attempt: int) -> list[str]:
+        # A warm spare never trains: it only needs its identity, the
+        # join channel, and the checkpoint root it prefetches from/into.
+        return [
+            sys.executable, "-m",
+            "distributed_machine_learning_tpu.runtime.gang_worker",
+            "--spare", "--rank", str(orig_rank),
+            "--world", str(args.workers),  # unused in spare mode
+            "--orig-rank", str(orig_rank), "--attempt", str(attempt),
+            "--gang-dir", args.gang_dir, "--ckpt-dir", args.ckpt_dir,
+            "--heartbeat-interval", str(args.heartbeat_interval),
+        ]
 
     events = FaultEvents()
     # The scrub may drop the very PYTHONPATH entry this package was
@@ -229,11 +316,18 @@ def main(argv=None) -> int:
     try:
         final_codes = gang_supervise(
             worker_cmd, args.workers, args.gang_dir,
+            # Spares hold original ids just past the launch world and
+            # prefetch into their own rank<orig> dirs, so the dir list
+            # covers workers AND spares.
             ckpt_dirs=[os.path.join(args.ckpt_dir, f"rank{r}")
-                       for r in range(args.workers)],
+                       for r in range(args.workers + args.spares)],
             max_restarts=args.max_restarts,
             rank_restart_budget=args.rank_restart_budget,
             min_world=args.min_world if args.min_world > 0 else None,
+            max_world=args.max_world if args.max_world > 0 else None,
+            spares=args.spares, spare_cmd=spare_cmd,
+            straggler_policy=args.straggler_policy,
+            replace_after=args.replace_after,
             events=events, env=scrubbed_worker_env(pkg_root),
             log_dir=os.path.join(args.gang_dir, "logs"),
             straggler_multiple=args.straggler_multiple,
@@ -250,7 +344,9 @@ def main(argv=None) -> int:
     print(resilience_summary(events), flush=True)
     print(f"gang of {args.workers} finished {args.steps} steps at "
           f"world size {final_world} ({events.gang_restarts} coordinated "
-          f"restart(s), {events.gang_shrinks} shrink(s))", flush=True)
+          f"restart(s), {events.gang_shrinks} shrink(s), "
+          f"{events.gang_grows} grow(s), {events.spare_promotions} "
+          f"spare promotion(s))", flush=True)
     if not args.no_telemetry:
         _print_gang_rollup(tel_dir, args)
     return 0

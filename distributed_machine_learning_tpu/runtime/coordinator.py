@@ -81,13 +81,24 @@ _RESTORE_PREFIX = "restore_rank"
 CONSUMED_PREFIX = "consumed_rank"
 
 # The gang health ledger: one JSON line per advisory event the gang
-# supervisor records (straggler verdicts, restarts, shrinks) — the
-# durable half of the observability plane, read back by
-# ``telemetry/aggregator.py::read_health_events`` and
+# supervisor records (straggler verdicts, restarts, shrinks, grows,
+# promotions/demotions) — the durable half of the observability plane,
+# read back by ``telemetry/aggregator.py::read_health_events`` and
 # ``tools/gang_status.py``.  Whole-run history like the consumption
 # ledgers: survives restarts and shrinks, cleared only at fresh-run
 # init.
 GANG_HEALTH_FILE = "gang_health.jsonl"
+
+# The join/announcement channel (ISSUE 10, elastic GROW): one
+# ``join_rank<r>.json`` per member announcing itself to the supervisor
+# — a recovered host asking to be readmitted, or a warm spare
+# publishing that it is alive and which checkpoint step it has
+# prefetched.  Written atomically by the announcing process, consumed
+# (deleted) by the supervisor when it ADMITS the member at a
+# coordinated restart/grow boundary; pending announcements survive
+# restarts and shrinks (they are exactly what the next boundary reads)
+# and are cleared only at fresh-run init, like the ledgers above.
+JOIN_PREFIX = "join_rank"
 
 
 def _beat_path(gang_dir: str, rank: int) -> str:
@@ -120,6 +131,66 @@ def append_health_event(gang_dir: str | os.PathLike, kind: str,
         f.write(json.dumps(payload) + "\n")
         f.flush()
         os.fsync(f.fileno())
+
+
+def _join_path(gang_dir: str, rank: int) -> str:
+    return os.path.join(gang_dir, f"{JOIN_PREFIX}{rank}.json")
+
+
+def announce_join(gang_dir: str | os.PathLike, rank: int, *,
+                  spare: bool = False, prefetched_step: int | None = None,
+                  **fields) -> None:
+    """Publish (or refresh) a join announcement for ORIGINAL-rank
+    ``rank`` — the member's half of the grow protocol.  A recovered
+    host announces ``spare=False`` (readmit me); a warm spare
+    announces ``spare=True`` with the checkpoint step it has
+    prefetched (``prefetched_step``), refreshed every heartbeat so the
+    supervisor can tell a live spare from a dead announcement.
+    Atomic overwrite: re-announcing is idempotent and the supervisor
+    never reads a torn payload."""
+    if rank < 0:
+        raise ValueError(f"rank must be >= 0, got {rank}")
+    gang_dir = os.fspath(gang_dir)
+    os.makedirs(gang_dir, exist_ok=True)
+    payload = {"rank": int(rank), "spare": bool(spare),
+               "time": time.time(), **fields}
+    if prefetched_step is not None:
+        payload["prefetched_step"] = int(prefetched_step)
+    _write_atomic(_join_path(gang_dir, rank), payload)
+
+
+def read_joins(gang_dir: str | os.PathLike) -> dict[int, dict]:
+    """rank -> announcement payload for every pending join under
+    ``gang_dir`` (torn writes skipped — the next poll sees them
+    whole)."""
+    gang_dir = os.fspath(gang_dir)
+    out: dict[int, dict] = {}
+    try:
+        names = os.listdir(gang_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(JOIN_PREFIX) and name.endswith(".json")):
+            continue
+        rank_s = name[len(JOIN_PREFIX):-len(".json")]
+        if not rank_s.isdigit():
+            continue
+        try:
+            with open(os.path.join(gang_dir, name)) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(payload, dict):
+            out[int(rank_s)] = payload
+    return out
+
+
+def consume_join(gang_dir: str | os.PathLike, rank: int) -> None:
+    """Remove rank ``rank``'s announcement — called by the supervisor
+    at the boundary that ADMITS the member, so the same announcement
+    can never drive two grows."""
+    with contextlib.suppress(OSError):
+        os.remove(_join_path(os.fspath(gang_dir), rank))
 
 
 def read_abort(gang_dir: str | os.PathLike) -> dict | None:
@@ -162,7 +233,11 @@ def clear_gang_state(gang_dir: str | os.PathLike,
     follows ``restore_records``): a gang SHRINK renumbers ranks, so the
     old numbering's restore records must go — but the ledger must stay,
     or every already-fired fault would re-fire on whichever survivor
-    inherited the fired rank's number."""
+    inherited the fired rank's number.  Join announcements follow the
+    same fresh-run-only rule: a pending join must survive the very
+    boundary that will admit it (the supervisor consumes it there),
+    while a stale one from an earlier run must not trigger a phantom
+    grow."""
     from distributed_machine_learning_tpu.runtime.faults import (
         FAULT_LEDGER_FILE,
     )
@@ -179,7 +254,8 @@ def clear_gang_state(gang_dir: str | os.PathLike,
                 or (fault_ledger
                     and (name == FAULT_LEDGER_FILE
                          or name == GANG_HEALTH_FILE
-                         or name.startswith(CONSUMED_PREFIX)))):
+                         or name.startswith(CONSUMED_PREFIX)
+                         or name.startswith(JOIN_PREFIX)))):
             with contextlib.suppress(OSError):
                 os.remove(os.path.join(gang_dir, name))
 

@@ -49,6 +49,19 @@ Rank targeting uses the ORIGINAL (launch-time) numbering: the gang
 worker keys its injector on ``--orig-rank``, so a spec keeps aiming at
 the same host after a shrink renumbers the survivors, and ledger
 entries carry stable ids the supervisor reads without mapping.
+
+- ``recover_rank@R:K`` the GROW counterpart of ``lose_rank``: at batch
+                       K the previously-lost host R "comes back" — a
+                       ledger entry marks R's budget recovered and a
+                       join announcement (``coordinator.announce_join``)
+                       lands in the gang dir, which the elastic
+                       supervisor (``gang_supervise(max_world=...)``)
+                       admits at the next coordinated restart/grow
+                       boundary.  The dead host cannot act for itself,
+                       so the fault is ACTED by whichever live process
+                       holds CURRENT rank 0 (exactly one exists at any
+                       attempt); the ledger latch is gang-wide, so a
+                       renumbered attempt never re-fires it.
 - ``stall_rank@R:K:S`` rank R sleeps S seconds before batch K while
                        the others wait in the collective — the
                        stalled-peer (not dead, just stuck) case.
@@ -109,6 +122,7 @@ _KIND_ALIASES = {
     "kill": "kill_ckpt",
     "kill_rank": "kill_rank",
     "lose_rank": "lose_rank",
+    "recover_rank": "recover_rank",
     "stall_rank": "stall_rank",
     "corrupt_ckpt": "corrupt_ckpt",
 }
@@ -151,12 +165,16 @@ class FaultEvents:
     ckpt_kills: int = 0         # injected death mid-checkpoint-save
     rank_kills: int = 0         # injected hard rank death (kill_rank)
     rank_losses: int = 0        # injected PERMANENT rank loss (lose_rank)
+    rank_recoveries: int = 0    # injected rank recovery (recover_rank)
     rank_stalls: int = 0        # injected rank stall (stall_rank)
     ckpt_corruptions: int = 0   # injected post-save byte flips (corrupt_ckpt)
     peer_failures: int = 0      # gang detector declared a dead/stalled peer
     stragglers: int = 0         # advisory: rank flagged slow vs gang median
     gang_restarts: int = 0      # gang supervisor relaunched all workers
     gang_shrinks: int = 0       # gang continued at a smaller world size
+    gang_grows: int = 0         # gang continued at a LARGER world size
+    spare_promotions: int = 0   # warm spare promoted to a live rank
+    spare_demotions: int = 0    # live rank demoted to warm spare
     reshard_restores: int = 0   # checkpoint restored onto a different world
     ckpt_verify_failures: int = 0  # checkpoint failed manifest verification
     ckpt_fallbacks: int = 0     # restore fell back past an invalid checkpoint
@@ -226,6 +244,12 @@ class FaultInjector:
         self._post_saves = 0
         self._ledger_path: str | None = None
         self.rank = rank
+        # CURRENT-numbering rank (set by elastic gang workers; shrinks
+        # renumber it while ``rank`` stays the original identity).
+        # Only recover_rank consults it: the recovered host cannot act
+        # for itself, so the fault is acted by whichever live process
+        # currently holds rank 0.
+        self.current_rank: int | None = None
 
     def _process_rank(self) -> int:
         if self.rank is not None:
@@ -254,9 +278,16 @@ class FaultInjector:
             except json.JSONDecodeError:
                 continue  # torn final line (a kill mid-append)
             i = entry.get("index")
-            if (isinstance(i, int) and 0 <= i < len(self._faults)
-                    and entry.get("rank") == me
+            if not (isinstance(i, int) and 0 <= i < len(self._faults)
                     and entry.get("kind") == self._faults[i].kind):
+                continue
+            # recover_rank latches GANG-WIDE: the acting process is
+            # "whoever currently holds rank 0", an assignment a grow or
+            # demotion can move between hosts — a per-rank latch would
+            # let the next holder re-fire a recovery that already
+            # happened.
+            if (entry.get("rank") == me
+                    or self._faults[i].kind == "recover_rank"):
                 self._faults[i].fired = True
         return self
 
@@ -270,6 +301,11 @@ class FaultInjector:
             return
         entry = {"index": f.index, "kind": f.kind, "at": f.at,
                  "rank": self._process_rank(), "time": time.time()}
+        if f.rank is not None:
+            # The TARGET of a rank-aimed fault, distinct from the
+            # acting rank — for kill/lose/stall the two coincide, for
+            # recover_rank they cannot (the target is the dead host).
+            entry["target"] = f.rank
         with open(self._ledger_path, "a") as fh:
             fh.write(json.dumps(entry) + "\n")
             fh.flush()
@@ -321,7 +357,8 @@ class FaultInjector:
                     f"{sorted(set(_KIND_ALIASES))}"
                 )
             kind = _KIND_ALIASES[kind]
-            if kind in ("kill_rank", "lose_rank", "stall_rank"):
+            if kind in ("kill_rank", "lose_rank", "recover_rank",
+                        "stall_rank"):
                 # Rank-targeted grammar: kind@RANK:STEP[:ARG].
                 parts = [p.strip() for p in rest.split(":")]
                 want = 3 if kind == "stall_rank" else 2
@@ -387,7 +424,36 @@ class FaultInjector:
             for f in self._faults:
                 if f.fired or f.at != idx:
                     continue
-                if f.kind in ("kill_rank", "lose_rank", "stall_rank"):
+                if f.kind == "recover_rank":
+                    # The target is a DEAD host; the live process that
+                    # currently holds rank 0 acts on its behalf (every
+                    # other rank just latches).  Exactly one current
+                    # rank 0 exists per attempt, and the gang-wide
+                    # ledger latch keeps renumbered relaunches from
+                    # re-firing it.
+                    cur = (self.current_rank if self.current_rank
+                           is not None else self._process_rank())
+                    if cur != 0:
+                        self._mark_fired(f, acted=False)
+                        continue
+                    if events is not None:
+                        events.rank_recoveries += 1
+                    self._mark_fired(f)
+                    if self._ledger_path is not None:
+                        from distributed_machine_learning_tpu.runtime.coordinator import (  # noqa: E501
+                            announce_join,
+                        )
+
+                        announce_join(
+                            os.path.dirname(self._ledger_path), f.rank,
+                            kind="recover", at_step=idx,
+                        )
+                    print(
+                        f"[faults] rank {f.rank} announced recovered "
+                        f"(join published) at batch {idx}",
+                        flush=True,
+                    )
+                elif f.kind in ("kill_rank", "lose_rank", "stall_rank"):
                     # Every rank latches the fault at its index; only the
                     # targeted rank acts — so a gang sharing one spec
                     # fires it exactly once, on the right process.
@@ -555,6 +621,39 @@ def ledger_lost_ranks(path: str | os.PathLike) -> set[int]:
         int(e["rank"]) for e in ledger_entries(path)
         if e.get("kind") == "lose_rank" and isinstance(e.get("rank"), int)
     }
+
+
+def ledger_recovered_ranks(path: str | os.PathLike) -> set[int]:
+    """Ranks whose ``recover_rank`` fault has fired, per the ledger —
+    the budget-recovered marker the elastic supervisor subtracts from
+    :func:`ledger_lost_ranks` (the host came back; holding its
+    ``lose_rank`` entry against it forever would make every loss
+    permanent even after the recovery event).  Rank ids are the
+    ``target`` field (ORIGINAL numbering): the acting process is a
+    different, live rank."""
+    return {
+        int(e["target"]) for e in ledger_entries(path)
+        if e.get("kind") == "recover_rank"
+        and isinstance(e.get("target"), int)
+    }
+
+
+def ledger_unrecovered_lost_ranks(path: str | os.PathLike) -> set[int]:
+    """Ranks currently lost per the ledger, ORDER-AWARE: a
+    ``recover_rank`` clears only the ``lose_rank`` entries appended
+    BEFORE it.  Plain set subtraction
+    (:func:`ledger_lost_ranks` - :func:`ledger_recovered_ranks`) would
+    let one all-time recovery mask every later loss of the same rank —
+    a host that dies again after recovering must count as lost again.
+    The ledger is append-only, so file order is event order."""
+    lost: set[int] = set()
+    for e in ledger_entries(path):
+        kind = e.get("kind")
+        if kind == "lose_rank" and isinstance(e.get("rank"), int):
+            lost.add(int(e["rank"]))
+        elif kind == "recover_rank" and isinstance(e.get("target"), int):
+            lost.discard(int(e["target"]))
+    return lost
 
 
 def corrupt_checkpoint_data(path: str | os.PathLike, match: str | None = None,

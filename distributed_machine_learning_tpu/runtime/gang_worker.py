@@ -21,22 +21,42 @@ the peer-failure detector's coordinated abort frees them.  On real TPU
 pods the blocking collective is the psum itself and the identical
 coordinator sits around it (``cli/common.py``'s ``--gang-dir`` path).
 
-Elastic semantics (ISSUE 5): the worker is WORLD-SIZE-AWARE.  Each
-step's GLOBAL batch is a fixed ``--global-batch`` examples keyed on the
-absolute step index alone, and a rank consumes only its shard of it —
-``data/sharding.py::exact_shard_indices(B, rank, world)`` — logging the
-consumed example ids to ``consumed_rank<orig>.jsonl`` in the gang dir.
-When the supervisor shrinks the gang from N to M survivors, relaunched
-workers re-evaluate their shards at world M: the per-host batch grows
-from B/N to B/M (the global batch — and therefore the effective LR
-schedule — is preserved), and every example is still consumed exactly
-once per step.  The gradient each rank applies is the mean over the
-global batch in canonical order — the value the psum over ANY
-world-size partition of it produces — so params stay bit-identical
-across ranks, across restarts, and across world sizes (the loss-curve
-continuity the chaos test asserts).  Checkpoints are saved with a dp
-``ShardSpec`` recording the world size and restored through
-``reshard_restore``, which tolerates (and counts) a world-size change.
+Elastic semantics (ISSUE 5 + ISSUE 10): the worker is
+WORLD-SIZE-AWARE.  Each step's GLOBAL batch is ``--global-batch``
+examples under the launch world — or, with a grow-aware
+``--scaling-rule`` (``train/scaling.py``), the rule's batch at the
+CURRENT world — keyed on the cumulative EXAMPLE cursor (checkpointed
+alongside the step counter), and a rank consumes only its exact shard
+of it — ``data/sharding.py::exact_shard_indices(B, rank, world)`` —
+logging the consumed example ids to ``consumed_rank<orig>.jsonl`` in
+the gang dir.  When the supervisor reshapes the gang from N to M
+workers (shrink OR grow), relaunched workers re-evaluate their shards
+at world M; under ``pinned`` (the default) the per-host batch rescales
+while the global batch and LR are preserved, under ``linear``/``lars``
+the global batch tracks the world and the LR tracks the batch so the
+loss trajectory stays continuous across the transition (the
+load-bearing half of the 4→3→5 chaos proof; ``unscaled`` is the
+deliberately-wrong control).  Example-id accounting stays exactly-once
+either way: ids are ``example_cursor + shard`` and the cursor rides
+the checkpoint, so any world-size history partitions the stream into
+contiguous, non-overlapping global batches.  The gradient each rank
+applies is the mean over the global batch in canonical order — the
+value the psum over ANY world-size partition of it produces — so
+params stay bit-identical across ranks, across restarts, and across
+world changes.  Each step also logs the toy quadratic loss
+``||w - w*||^2`` (w* = 0), the observable the continuity assertion
+reads.  Checkpoints are saved with a dp ``ShardSpec`` recording the
+world size and restored through ``reshard_restore``, which tolerates
+(and counts) a world-size change.
+
+Warm spares (ISSUE 10): launched with ``--spare``, the worker never
+joins the barrier or consumes data.  It announces itself on the
+coordinator's join channel (``join_rank<orig>.json``, refreshed every
+heartbeat so the supervisor can tell a live spare from a stale file),
+and PREFETCHES the newest verified checkpoint from the live ranks'
+directories into its own ``rank<orig>`` directory — so promotion at a
+restart/grow boundary costs O(restore), not O(provision): the
+promoted worker resumes from its own directory like any survivor.
 
 Observability (ISSUE 6): per-rank telemetry is ON by default — each
 rank streams attempt-tagged step rows, phase spans
@@ -62,15 +82,84 @@ import time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def _global_batch_for_step(step: int, batch: int) -> "object":
-    """The global batch for an absolute step index — deterministic in
-    ``step`` alone, so every rank, every restart attempt, and every
-    world size agrees on it.  Row ``j`` is global example id
-    ``step * batch + j``."""
+def _global_batch_at(example_cursor: int, batch: int, dim: int) -> "object":
+    """The global batch starting at absolute example id
+    ``example_cursor`` — row ``j`` is example ``example_cursor + j``,
+    generated from the example id ALONE, so every rank, every restart
+    attempt, and every world size (and therefore every batch size a
+    scaling rule may pick) agrees on each example's content.  Keying on
+    the example id rather than the step index is what keeps the stream
+    well-defined when a grow/shrink changes the batch size mid-run: the
+    step boundary moves, the examples don't."""
     import numpy as np
 
-    rng = np.random.default_rng(10_000 + step)
-    return rng.standard_normal((batch, 8)).astype(np.float32)
+    rows = np.empty((batch, dim), np.float32)
+    for j in range(batch):
+        rng = np.random.default_rng(10_000 + example_cursor + j)
+        rows[j] = rng.standard_normal(dim)
+    return rows
+
+
+def _spare_main(args, orig_rank: int) -> None:
+    """The warm-spare loop: announce on the join channel, prefetch the
+    newest verified checkpoint into this rank's own directory, repeat —
+    no barrier, no data consumption, no training.  Terminated by the
+    supervisor at the boundary that promotes (or retires) it; SIGTERM
+    is a CLEAN exit (0) — a drained spare is not a failed worker."""
+    import shutil
+    import signal as _signal
+
+    from distributed_machine_learning_tpu.runtime.coordinator import (
+        announce_join,
+    )
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        latest_checkpoint,
+    )
+
+    def _on_term(sig, frame):
+        raise SystemExit(0)
+
+    _signal.signal(_signal.SIGTERM, _on_term)
+    own_dir = os.path.join(args.ckpt_dir, f"rank{orig_rank}")
+    prefetched: int | None = None
+    print(f"spare orig={orig_rank} standing by", flush=True)
+    while True:
+        newest_path, newest_step = None, -1
+        try:
+            names = sorted(os.listdir(args.ckpt_dir))
+        except OSError:
+            names = []
+        for name in names:
+            if not name.startswith("rank") or not name[4:].isdigit():
+                continue
+            if int(name[4:]) == orig_rank:
+                continue
+            # latest_checkpoint runs the full validity chain: a spare
+            # must never prefetch a torn or corrupt save.
+            found = latest_checkpoint(os.path.join(args.ckpt_dir, name))
+            if found is None:
+                continue
+            step = int(os.path.basename(found)[5:])
+            if step > newest_step:
+                newest_path, newest_step = found, step
+        if newest_path is not None and (prefetched is None
+                                        or newest_step > prefetched):
+            dst = os.path.join(own_dir, os.path.basename(newest_path))
+            tmp = dst + f".prefetch{os.getpid()}"
+            try:
+                shutil.rmtree(tmp, ignore_errors=True)
+                shutil.copytree(newest_path, tmp)
+                shutil.rmtree(dst, ignore_errors=True)
+                os.replace(tmp, dst)
+                prefetched = newest_step
+                print(f"spare prefetched step {newest_step}", flush=True)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)
+        # The refreshed announcement IS the spare's heartbeat: the
+        # supervisor promotes only spares whose announcement is fresh.
+        announce_join(args.gang_dir, orig_rank, spare=True,
+                      prefetched_step=prefetched, pid=os.getpid())
+        time.sleep(args.heartbeat_interval)
 
 
 def main(argv=None) -> None:
@@ -91,11 +180,38 @@ def main(argv=None) -> None:
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--save-every", type=int, default=5)
     ap.add_argument("--global-batch", type=int, default=24,
-                    help="examples per GLOBAL step batch; each rank "
-                         "consumes its exact shard (B/world), so a "
-                         "shrink rescales the per-host batch while the "
-                         "global batch — and the LR schedule — is "
-                         "preserved")
+                    help="examples per GLOBAL step batch at the BASE "
+                         "world; each rank consumes its exact shard, "
+                         "so under the default pinned rule a shrink "
+                         "rescales the per-host batch while the global "
+                         "batch — and the LR schedule — is preserved")
+    ap.add_argument("--scaling-rule", default="pinned",
+                    choices=("pinned", "linear", "lars", "unscaled"),
+                    help="how (global batch, LR) respond to a world-"
+                         "size change (train/scaling.py): pinned keeps "
+                         "both at the base point; linear/lars grow the "
+                         "batch with the world and scale the LR with "
+                         "the batch (linearly / by sqrt); unscaled is "
+                         "the deliberately-wrong control that grows "
+                         "the batch and never compensates")
+    ap.add_argument("--base-world", type=int, default=None,
+                    help="the LAUNCH world size anchoring the scaling "
+                         "rule (default: --world; the supervisor "
+                         "passes the launch value so the anchor stays "
+                         "fixed across relaunches)")
+    ap.add_argument("--base-lr", type=float, default=0.5,
+                    help="learning rate at the base world")
+    ap.add_argument("--feature-dim", type=int, default=8,
+                    help="toy example dimensionality (the chaos "
+                         "continuity proof uses a wider dim so the "
+                         "per-step loss noise is small against the "
+                         "floor shifts it measures)")
+    ap.add_argument("--spare", action="store_true",
+                    help="run as a WARM SPARE: announce on the join "
+                         "channel and prefetch the newest verified "
+                         "checkpoint into this rank's directory, but "
+                         "never train or consume data; the supervisor "
+                         "promotes it at a restart/grow boundary")
     ap.add_argument("--faults", default=None)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--heartbeat-interval", type=float, default=0.25)
@@ -114,6 +230,13 @@ def main(argv=None) -> None:
                     help="disable the default-on per-rank telemetry")
     args = ap.parse_args(argv)
     orig_rank = args.rank if args.orig_rank is None else args.orig_rank
+
+    if args.spare:
+        # Spares never join the coordinator barrier or the data stream;
+        # the loop is the checkpoint validity chain plus the join
+        # channel, so a standing spare costs one idle process.
+        _spare_main(args, orig_rank)
+        return
 
     # A drain/preemption SIGTERM becomes a SystemExit raised at the next
     # bytecode: the exception path below flushes telemetry before dying,
@@ -142,10 +265,12 @@ def main(argv=None) -> None:
     from distributed_machine_learning_tpu.train.checkpoint import (
         checkpoint_chain_report,
         checkpoint_cursor,
+        checkpoint_extra,
         latest_checkpoint,
         reshard_restore,
         save_checkpoint,
     )
+    from distributed_machine_learning_tpu.train.scaling import ScalingRule
     from distributed_machine_learning_tpu.train.state import TrainState
     from distributed_machine_learning_tpu.utils.summary import (
         resilience_summary,
@@ -189,6 +314,10 @@ def main(argv=None) -> None:
         rank=orig_rank,
     )
     if injector is not None:
+        # recover_rank is acted by whichever process holds CURRENT rank
+        # 0 (the target host is dead); every other fault keys on the
+        # original identity above.
+        injector.current_rank = args.rank
         from distributed_machine_learning_tpu.runtime.faults import (
             FAULT_LEDGER_FILE,
         )
@@ -206,23 +335,35 @@ def main(argv=None) -> None:
         peer_timeout_s=args.peer_timeout, events=events,
     ).start()
 
-    # This rank's share of every step's global batch under the CURRENT
-    # world size — the shard a shrink rebalances.  exact partition: the
-    # union over ranks is every example exactly once, padding-free.
+    # The scaling rule resolves (global batch, LR) for the CURRENT
+    # world from the launch-time anchor: under the default "pinned"
+    # this is exactly PR 5's world-invariant global batch; the grow
+    # rules re-derive both at every relaunch boundary (train/scaling.py
+    # has the contract).  This rank's shard of each step's batch is the
+    # exact partition a reshape rebalances: union over ranks = every
+    # example exactly once, padding-free.
     from distributed_machine_learning_tpu.runtime.coordinator import (
         CONSUMED_PREFIX,
     )
 
-    local_ids = exact_shard_indices(args.global_batch, args.rank,
-                                    args.world)
+    base_world = args.base_world if args.base_world else args.world
+    rule = ScalingRule(args.scaling_rule, base_lr=args.base_lr,
+                       base_global_batch=args.global_batch,
+                       base_world=base_world)
+    ws = rule.at_world(args.world)
+    global_batch, lr = ws.global_batch, ws.lr
+    local_ids = exact_shard_indices(global_batch, args.rank, args.world)
     consumed_path = os.path.join(
         args.gang_dir, f"{CONSUMED_PREFIX}{orig_rank}.jsonl"
     )
 
-    def record_consumed(step: int) -> None:
+    def record_consumed(step: int, example_cursor: int) -> None:
         """One line per completed step: which global example ids THIS
         rank consumed, under which (attempt, world) — the exactly-once
-        audit trail the elastic chaos test checks."""
+        audit trail the elastic chaos test checks.  Ids are keyed on
+        the cumulative example cursor, so they stay contiguous and
+        non-overlapping even when a scaling rule changes the batch
+        size across world transitions."""
         # flush+fsync (dmlcheck DML002): the coordinator's monitor
         # thread may os._exit this process at any poll, and a consumed
         # row lost from the ledger reads as a missed example in the
@@ -231,29 +372,42 @@ def main(argv=None) -> None:
             f.write(json.dumps({
                 "attempt": args.attempt, "world": args.world,
                 "rank": args.rank, "orig_rank": orig_rank, "step": step,
-                "ids": [int(step) * args.global_batch + int(j)
-                        for j in local_ids],
+                "example_cursor": example_cursor,
+                "global_batch": global_batch,
+                "ids": [example_cursor + int(j) for j in local_ids],
             }) + "\n")
             f.flush()
             os.fsync(f.fileno())
 
     with coord.suspend():
         state = TrainState.create(
-            params={"w": jnp.zeros((8,), jnp.float32)}
+            params={"w": jnp.zeros((args.feature_dim,), jnp.float32)}
         )
         start = 0
+        start_examples = 0
         latest = latest_checkpoint(ckpt_dir, events=events)
         if latest is not None:
             # reshard_restore tolerates a checkpoint saved under a
-            # DIFFERENT world size (the shrink case) — dp params carry
-            # no padding, so this is a verified plain restore plus a
-            # reshard_restores count when the worlds differ.
+            # DIFFERENT world size (the shrink AND grow cases) — dp
+            # params carry no padding, so this is a verified plain
+            # restore plus a reshard_restores count when the worlds
+            # differ.
             state, _spec = reshard_restore(latest, world=args.world,
                                            events=events,
                                            files_verified=True)
             restored_step = int(jax.device_get(state.step))
             cursor = checkpoint_cursor(latest)
             start = cursor if cursor is not None else restored_step
+            # The cumulative example cursor rides the checkpoint: with
+            # a batch-changing scaling rule the example position is NOT
+            # derivable from the step count alone (earlier steps may
+            # have consumed different batch sizes at other worlds).
+            # Pre-extra checkpoints fall back to step x current batch —
+            # exact under the pinned rule, which is all they ever ran.
+            extra = checkpoint_extra(latest)
+            ex = extra.get("example_cursor")
+            start_examples = (int(ex) if isinstance(ex, int)
+                              else start * global_batch)
             # The restore is this rank's proof the checkpoint is whole —
             # record it so the next election can agree on it even if no
             # further save ever lands.
@@ -273,19 +427,28 @@ def main(argv=None) -> None:
 
         @jax.jit
         def step_fn(state, xs):
-            # The mean gradient over the GLOBAL batch in canonical
-            # order — the value a psum over the per-rank shards would
-            # produce under ANY world size, so replicated params stay
-            # bit-identical across ranks, restarts, and shrinks
-            # (asserted by digest below).
-            g = xs.mean(0)
-            w = state.params["w"] - 0.1 * (g + 0.01 * state.params["w"])
-            return state.replace(params={"w": w}, step=state.step + 1)
+            # Mean-estimation SGD on the quadratic loss ||w - mu*||^2
+            # with true optimum mu* = 0: the gradient is (w - mean of
+            # the GLOBAL batch in canonical order) — the value a psum
+            # over the per-rank shards would produce under ANY world
+            # size, so replicated params stay bit-identical across
+            # ranks, restarts, and world changes (asserted by digest
+            # below).  The returned loss is ||w||^2 BEFORE the update —
+            # distance-to-optimum at this step, the world-independent
+            # observable the continuity proof reads (its stationary
+            # floor is set by lr x gradient noise, i.e. lr/batch: the
+            # quantity a scaling rule must keep invariant).
+            w = state.params["w"]
+            loss = jnp.sum(w * w)
+            w = w - lr * (w - xs.mean(0))
+            return (state.replace(params={"w": w}, step=state.step + 1),
+                    loss)
 
         # AOT-compile inside the suspension: the first step's compile
         # must not read as a stall under short chaos-test timeouts.
         compiled = step_fn.lower(
-            state, _global_batch_for_step(start, args.global_batch)
+            state, _global_batch_at(start_examples, global_batch,
+                                    args.feature_dim)
         ).compile()
         # Publish the resumed position BEFORE the first barrier: peers
         # wait for our published step, and a gang resuming at step k
@@ -294,7 +457,8 @@ def main(argv=None) -> None:
         coord.beat(step=start)
 
     print(f"ready rank={args.rank} orig={orig_rank} world={args.world} "
-          f"start={start}", flush=True)
+          f"start={start} examples={start_examples} "
+          f"batch={global_batch} lr={lr:.6g}", flush=True)
     post_save = injector.post_save_hook(events) if injector else None
     batches = range(start, args.steps)
     if injector is not None:
@@ -310,12 +474,18 @@ def main(argv=None) -> None:
             if not coord.wait_for_peers(idx):
                 break  # test mode only; production aborts the process
             t_barrier = time.perf_counter()
-            state = compiled(
-                state, _global_batch_for_step(idx, args.global_batch)
+            # Within one attempt the batch size is constant, so the
+            # example cursor of step idx is affine in idx; across
+            # attempts it re-anchors at the checkpointed cursor.
+            ex_cursor = start_examples + (idx - start) * global_batch
+            state, loss = compiled(
+                state, _global_batch_at(ex_cursor, global_batch,
+                                        args.feature_dim)
             )
             jax.block_until_ready(state.params["w"])
             t_end = time.perf_counter()
-            record_consumed(idx)
+            loss = float(loss)
+            record_consumed(idx, ex_cursor)
             iter_s = t_end - t_start
             phases = {"barrier_wait_s": t_barrier - t_start,
                       "compute_s": t_end - t_barrier}
@@ -334,10 +504,11 @@ def main(argv=None) -> None:
                 eps = len(local_ids) / iter_s if iter_s > 0 else 0.0
                 reg.gauge("examples_per_s").set(eps)
                 telemetry.log_step(idx, iter_s=iter_s, **phases,
-                                   examples_per_s=eps, rank=args.rank,
-                                   orig_rank=orig_rank, world=args.world)
+                                   examples_per_s=eps, loss=loss,
+                                   rank=args.rank, orig_rank=orig_rank,
+                                   world=args.world)
             if args.rank == 0:
-                print(f"step {idx}", flush=True)
+                print(f"step {idx} loss {loss:.6f}", flush=True)
             if (idx + 1) % args.save_every == 0 or idx + 1 == args.steps:
                 # Saves are liveness, not progress: suspend the stall
                 # clock exactly as the watchdog path does.
@@ -346,6 +517,16 @@ def main(argv=None) -> None:
                         ckpt_dir, state, cursor=idx + 1,
                         post_save_hook=post_save,
                         shard_spec=ShardSpec("dp", world=args.world),
+                        extra_payload={
+                            # The elastic-data position: where in the
+                            # example stream step idx+1 begins — the
+                            # anchor a relaunch at ANY world/batch
+                            # resumes consumption from.
+                            "example_cursor":
+                                ex_cursor + global_batch,
+                            "world": args.world,
+                            "scaling_rule": rule.as_dict(),
+                        },
                     )
                 coord.record_valid_step(int(jax.device_get(state.step)))
             if args.step_sleep:

@@ -157,6 +157,60 @@ class GangFailure(RuntimeError):
         self.returncodes = returncodes
 
 
+# ``by_rank`` of an abort latch the SUPERVISOR wrote to stop the gang at
+# a PLANNED boundary (grow-on-join, straggler replacement) — no worker
+# holds a negative rank, so post-mortems can tell a planned stop from a
+# failure, and the attribution pass knows there is no victim to charge.
+SUPERVISOR_BOUNDARY_RANK = -1
+
+
+def _seed_checkpoint(dst_dir, step: int | None, src_dirs) -> bool:
+    """Make ``dst_dir`` hold a valid copy of checkpoint ``step``, copying
+    from the first of ``src_dirs`` whose copy validates — the admission
+    half of a grow: a recovered host may have missed saves while it was
+    gone, and a warm spare's prefetch may trail the elected restore
+    point; either way the joiner must resume from the SAME step as the
+    survivors or the gang diverges at the first barrier.  Valid for the
+    replicated-dp layout the gang harness runs (every rank's checkpoint
+    holds the full state); per-host SHARD layouts reshard offline
+    instead (``tools/ckpt_reshard.py``).  Returns True when ``dst_dir``
+    ends up holding a valid ``step_<step>`` (already had one, or the
+    copy landed); False when no source could provide it."""
+    import shutil
+
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        validate_checkpoint,
+    )
+
+    if step is None:
+        return False
+    dst_dir = os.fspath(dst_dir)
+    dst = os.path.join(dst_dir, f"step_{step}")
+    if os.path.isdir(dst) and validate_checkpoint(dst) == []:
+        return True
+    for src_dir in src_dirs:
+        src = os.path.join(os.fspath(src_dir), f"step_{step}")
+        if not os.path.isdir(src) or validate_checkpoint(src) != []:
+            continue
+        # Copy to a temp name, validate the COPY, then rename into
+        # place: a torn copy must never look like a complete
+        # checkpoint to the joiner's fallback chain.
+        tmp = dst + f".seed{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            shutil.copytree(src, tmp)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            continue
+        if validate_checkpoint(tmp) != []:
+            shutil.rmtree(tmp, ignore_errors=True)
+            continue
+        shutil.rmtree(dst, ignore_errors=True)
+        os.replace(tmp, dst)
+        return True
+    return False
+
+
 def _gang_health_check(gang_dir, sampler, detector, active, events, tel,
                        attempt: int, state: dict) -> None:
     """One advisory health pass over the gang's heartbeat snapshots —
@@ -193,8 +247,20 @@ def _gang_health_check(gang_dir, sampler, detector, active, events, tel,
         return
     state["last_feed"] = now
     feed = {r: s.eff_step_time_s for r, s in samples.items()
-            if not s.done and not s.suspended}
+            if not s.done and not s.suspended and r < len(active)}
     verdicts = detector.update(feed)
+    # Per-ORIGINAL-rank flag streaks (consecutive health feeds the
+    # detector holds the rank flagged) — the hysteresis input of the
+    # backup-worker replacement policy: a verdict alone (one episode)
+    # never flips the gang; the rank must STAY flagged across feeds.
+    streaks = state.setdefault("flag_streak", {})
+    flagged_orig = {active[r] for r in detector.flagged
+                    if 0 <= r < len(active)}
+    for orig in list(streaks):
+        if orig not in flagged_orig:
+            streaks[orig] = 0
+    for orig in flagged_orig:
+        streaks[orig] = streaks.get(orig, 0) + 1
     if tel is not None and detector.skew_ratio:
         tel.registry.gauge("gang_skew_ratio").set(detector.skew_ratio)
     for v in verdicts:
@@ -282,6 +348,10 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                    *, ckpt_dirs=None, max_restarts: int = 3,
                    rank_restart_budget: int | None = None,
                    min_world: int | None = None,
+                   max_world: int | None = None,
+                   spares: int = 0, spare_cmd=None,
+                   straggler_policy: str = "advise",
+                   replace_after: int = 2,
                    events: FaultEvents | None = None,
                    poll_s: float = 0.2, grace_s: float = 10.0,
                    env=None, log_dir=None,
@@ -348,17 +418,66 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
     median for ``straggler_consecutive`` observations is flagged
     (``gang_straggler{rank}`` counter, ``gang_skew_ratio`` gauge,
     ``FaultEvents.stragglers``, a ``gang_health.jsonl`` entry, and a
-    log line) WITHOUT any change to restart policy — the flag names
-    the culprit before the peer-timeout abort has to guess, and is the
-    hook a later backup-worker/elastic-grow policy will consume.
+    log line) WITHOUT any change to restart policy under the default
+    ``straggler_policy="advise"``.
+
+    Elastic GROW (ISSUE 10) — the other direction of the shrink
+    machinery, enabled by ``max_world``:
+
+    5. at EVERY coordinated boundary the supervisor reads the join
+       channel (``coordinator.announce_join`` / ``join_rank<r>.json``):
+       announced non-spare ranks not currently active — a recovered
+       host (the ``recover_rank@r:k`` fault is the deterministic test
+       form), or a newly provisioned one — are ADMITTED up to
+       ``max_world``; a ``recover_rank`` ledger entry also clears the
+       rank's ``lose_rank`` marker and resets its failure budget.
+       While the gang is healthy, a pending join triggers a PLANNED
+       boundary: the supervisor itself latches the abort
+       (``by_rank=-1``) so the workers stop at a checkpoint-consistent
+       point; planned boundaries charge nobody's budget and do not
+       consume ``max_restarts``;
+    6. ``spares`` warm-spare processes (argv from
+       ``spare_cmd(orig_rank, attempt)``; original ids ``world..
+       world+spares-1``) run beside every attempt: they heartbeat on
+       the join channel and prefetch the newest verified checkpoint
+       into their own rank directory, but never train.  Spares are
+       PROMOTED only at planned boundaries — filling the world at a
+       grow admission, or replacing a demoted straggler — never
+       silently at a failure restart, so a shrink's reduced world
+       stays observable;
+    7. admission is checkpoint-seeded: the election runs among the
+       CARRIED-OVER members, and every joiner's directory is made to
+       hold a valid copy of the elected step (``_seed_checkpoint``;
+       newer strays quarantined) before the relaunch, so the grown
+       gang resumes from one consistent restore point.  The world
+       renumbers ``0..M-1`` in original-rank order exactly like a
+       shrink, and ``reshard_restore`` absorbs the M→N change on
+       every rank;
+    8. ``straggler_policy="replace"`` (requires ``spares >= 1``) turns
+       the advisory verdicts into backup-worker semantics
+       (arxiv 1811.05233): a rank the detector holds flagged for
+       ``replace_after`` consecutive health feeds — hysteresis: one
+       flag never flips the gang — is DEMOTED to the spare pool and
+       the best-prefetched live spare is promoted in its place at a
+       planned replacement boundary (world size unchanged,
+       ``spare_promotions``/``spare_demotions`` counters + health
+       ledger entries tell the story).
+
+    Observable surface of a grow: ``gang_grows`` counter +
+    ``gang_world_size`` gauge + one ``gang_grow`` trace instant, and
+    ``grow``/``promote``/``demote`` events in ``gang_health.jsonl`` —
+    exact telemetry parity with the shrink path.
     """
     import subprocess
 
     from distributed_machine_learning_tpu.runtime.coordinator import (
         clear_gang_state,
+        consume_join,
+        declare_abort,
         elect_restore_step,
         enforce_restore_point,
         read_abort,
+        read_joins,
     )
     from distributed_machine_learning_tpu.runtime.coordinator import (
         GANG_ABORT_EXIT,
@@ -368,7 +487,8 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
     )
     from distributed_machine_learning_tpu.runtime.faults import (
         FAULT_LEDGER_FILE,
-        ledger_lost_ranks,
+        ledger_recovered_ranks,
+        ledger_unrecovered_lost_ranks,
     )
     from distributed_machine_learning_tpu.telemetry import get_telemetry
     from distributed_machine_learning_tpu.telemetry.aggregator import (
@@ -388,6 +508,29 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         raise ValueError(
             f"rank_restart_budget must be >= 0, got {rank_restart_budget}"
         )
+    if max_world is not None and max_world < world:
+        raise ValueError(
+            f"max_world must be >= the launch world {world}, got "
+            f"{max_world}"
+        )
+    if spares < 0:
+        raise ValueError(f"spares must be >= 0, got {spares}")
+    if spares > 0 and spare_cmd is None:
+        raise ValueError("spares > 0 requires spare_cmd(orig_rank, "
+                         "attempt) to build the spare worker argv")
+    if straggler_policy not in ("advise", "replace"):
+        raise ValueError(
+            f"straggler_policy must be 'advise' or 'replace', got "
+            f"{straggler_policy!r}"
+        )
+    if straggler_policy == "replace" and spares < 1:
+        raise ValueError(
+            "straggler_policy='replace' needs at least one warm spare "
+            "to promote (spares >= 1); without one the policy could "
+            "only ever demote — use 'advise' instead"
+        )
+    if replace_after < 1:
+        raise ValueError(f"replace_after must be >= 1, got {replace_after}")
     cmd_arity = _worker_cmd_arity(worker_cmd)
     if min_world is not None and cmd_arity < 3:
         raise ValueError(
@@ -395,6 +538,13 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
             "the current world size — use worker_cmd(rank, attempt, "
             "world[, orig_rank]); a legacy two-argument closure would "
             "relaunch workers that still assume the original world"
+        )
+    if (max_world is not None or spares > 0) and cmd_arity < 4:
+        raise ValueError(
+            "growing (max_world/spares) requires the full elastic "
+            "worker_cmd(rank, attempt, world, orig_rank): admissions "
+            "and promotions renumber the gang, and a joiner's identity "
+            "only travels via orig_rank"
         )
     # A fresh supervision run: stale beats/aborts AND restore records
     # from any earlier run in the same gang_dir would poison detection
@@ -413,18 +563,37 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         return [ckpt_dirs[o] for o in origs]
 
     # position = current rank, value = original rank: the identity map
-    # a shrink compacts.  Failure counts and checkpoint directories key
-    # on the ORIGINAL rank, which survives renumbering.
+    # a shrink compacts and a grow re-expands.  Failure counts and
+    # checkpoint directories key on the ORIGINAL rank, which survives
+    # renumbering.  Spares hold the original ids just past the launch
+    # world; a promotion moves the id into `active`, a demotion moves
+    # it back into `spare_pool`.
     active = list(range(world))
-    fail_counts = {r: 0 for r in range(world)}
+    spare_pool = list(range(world, world + spares))
+    if not shared_ckpt and ckpt_dirs is not None:
+        need = world + spares
+        if len(ckpt_dirs) < need:
+            raise ValueError(
+                f"per-rank ckpt_dirs must cover every launch member "
+                f"including spares ({need} dirs), got {len(ckpt_dirs)}"
+            )
+    fail_counts = {r: 0 for r in range(world + spares)}
+    # Joiners whose checkpoint seeding failed at a boundary: their
+    # announcements are KEPT (a recover_rank join is announced exactly
+    # once — consuming it would strand the host forever) but the grow
+    # TRIGGER skips them, so they can't re-declare budget-free planned
+    # boundaries in a loop; any later boundary retries their admission.
+    deferred_joins: set[int] = set()
     ledger_path = os.path.join(os.fspath(gang_dir), FAULT_LEDGER_FILE)
-    restarts = 0
+    restarts = 0  # FAILURE restarts — the max_restarts budget
+    attempt = 0   # every relaunch, planned boundaries included: the
+    #               log/telemetry/consumption attempt tag
     while True:
         cur_world = len(active)
         tel = get_telemetry()
         if tel is not None:
             tel.registry.gauge("gang_world_size").set(cur_world)
-        span = (tel.span("gang_attempt", attempt=restarts,
+        span = (tel.span("gang_attempt", attempt=attempt,
                          world=cur_world)
                 if tel is not None else contextlib.nullcontext())
         # Fresh per attempt: the beat files were just cleared, and a
@@ -434,6 +603,26 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                                      consecutive=straggler_consecutive)
         health_state: dict = {}
         procs, logs = [], []
+        spare_procs: dict[int, subprocess.Popen] = {}
+        planned: dict | None = None
+
+        def ready_spares() -> list[int]:
+            """Spare ids promotable RIGHT NOW: process alive and its
+            join-channel announcement present — best-prefetched first,
+            so a promotion costs the smallest possible seed copy."""
+            joins = read_joins(gang_dir)
+            alive = [o for o in spare_pool
+                     if o in spare_procs
+                     and spare_procs[o].poll() is None
+                     and o in joins and joins[o].get("spare")]
+            def prefetch_key(o):
+                # None-check, not truthiness: a prefetched step_0 is a
+                # real prefetch and must outrank "nothing prefetched".
+                step = joins[o].get("prefetched_step")
+                return (-step if step is not None else 1, o)
+
+            return sorted(alive, key=prefetch_key)
+
         try:
             with span:
                 for rank in range(cur_world):
@@ -442,12 +631,12 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                         out = open(
                             os.path.join(
                                 log_dir,
-                                f"rank{rank}.attempt{restarts}.log",
+                                f"rank{rank}.attempt{attempt}.log",
                             ),
                             "ab",
                         )
                     logs.append(out)
-                    argv = worker_cmd(*(rank, restarts, cur_world,
+                    argv = worker_cmd(*(rank, attempt, cur_world,
                                         active[rank])[:cmd_arity])
                     procs.append(subprocess.Popen(
                         argv,
@@ -456,6 +645,24 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                         else None,
                         env=env,
                     ))
+                for orig in spare_pool:
+                    out = None
+                    if log_dir is not None:
+                        out = open(
+                            os.path.join(
+                                log_dir,
+                                f"spare{orig}.attempt{attempt}.log",
+                            ),
+                            "ab",
+                        )
+                    logs.append(out)
+                    spare_procs[orig] = subprocess.Popen(
+                        spare_cmd(orig, attempt),
+                        stdout=out,
+                        stderr=subprocess.STDOUT if out is not None
+                        else None,
+                        env=env,
+                    )
                 failed = None
                 while failed is None:
                     codes = [p.poll() for p in procs]
@@ -467,136 +674,361 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                     if all(c == 0 for c in codes):
                         return list(codes)  # the gang finished cleanly
                     time.sleep(poll_s)
-                    if health_state.get("broken"):
-                        continue
-                    try:
-                        _gang_health_check(gang_dir, sampler, detector,
-                                           active, events, tel,
-                                           restarts, health_state)
-                    except Exception as exc:
-                        # Advisory means advisory: a broken health pass
-                        # (disk-full health ledger, torn dir) must not
-                        # take down the gang it observes.
-                        health_state["broken"] = True
-                        rank0_print(
-                            "[gang] health advisory disabled for this "
-                            f"attempt: {type(exc).__name__}: {exc}"
+                    if not health_state.get("broken"):
+                        try:
+                            _gang_health_check(gang_dir, sampler,
+                                               detector, active, events,
+                                               tel, attempt, health_state)
+                        except Exception as exc:
+                            # Advisory means advisory: a broken health
+                            # pass (disk-full health ledger, torn dir)
+                            # must not take down the gang it observes.
+                            health_state["broken"] = True
+                            rank0_print(
+                                "[gang] health advisory disabled for "
+                                f"this attempt: "
+                                f"{type(exc).__name__}: {exc}"
+                            )
+                    # -- planned boundaries (elastic grow) -------------
+                    # The supervisor itself latches the abort so the
+                    # gang stops at a coordinated point; the snapshot of
+                    # promotable spares is taken NOW, before the drain
+                    # below kills their processes.
+                    if (planned is None and max_world is not None
+                            and len(active) < max_world):
+                        # Same eligibility as the admission filter
+                        # below — a join the admission step would skip
+                        # (no ckpt dir provisioned for that rank) must
+                        # not declare a boundary, or it re-triggers a
+                        # budget-free restart every attempt forever.
+                        # Seed-failure-deferred joins likewise wait for
+                        # a boundary something else causes.
+                        pending = sorted(
+                            r for r, p in read_joins(gang_dir).items()
+                            if not p.get("spare") and r not in active
+                            and r not in deferred_joins
+                            and (shared_ckpt or r < len(ckpt_dirs or ()))
                         )
+                        if pending and declare_abort(
+                                gang_dir,
+                                f"planned grow boundary: rank(s) "
+                                f"{pending} announced join",
+                                SUPERVISOR_BOUNDARY_RANK):
+                            planned = {"kind": "grow",
+                                       "ready": ready_spares()}
+                            rank0_print(
+                                f"[gang] rank(s) {pending} announced "
+                                "join; stopping the gang at a planned "
+                                "grow boundary"
+                            )
+                    if planned is None and straggler_policy == "replace":
+                        streaks = health_state.get("flag_streak", {})
+                        slow = sorted(
+                            o for o, s in streaks.items()
+                            if s >= replace_after and o in active
+                        )
+                        ready = ready_spares() if slow else []
+                        if slow and ready and declare_abort(
+                                gang_dir,
+                                f"straggler replacement: demoting rank "
+                                f"{slow[0]} (flagged {replace_after}+ "
+                                "consecutive health feeds)",
+                                SUPERVISOR_BOUNDARY_RANK):
+                            planned = {"kind": "replace",
+                                       "demote": slow[0],
+                                       "ready": ready}
+                            rank0_print(
+                                f"[gang] straggler policy: demoting "
+                                f"rank {slow[0]} to spare, promoting "
+                                f"spare {ready[0]} at a planned "
+                                "replacement boundary"
+                            )
         finally:
             final_codes = _drain_gang(procs, grace_s)
+            # Spares are drained every boundary too (SIGTERM is a clean
+            # exit for them); the next attempt relaunches the pool.
+            _drain_gang(list(spare_procs.values()), grace_s)
             for out in logs:
                 if out is not None:
                     out.close()
         abort = read_abort(gang_dir)
-        why = (f"rank {failed[0][0]} exited {failed[0][1]}"
-               + (f"; abort declared by rank {abort.get('by_rank')}: "
-                  f"{abort.get('reason')}" if abort else ""))
-        # -- failure attribution (original-rank identities) -------------
-        # Only self-exits count — ranks the drain terminated, and ranks
-        # that took the coordinated abort exit, are casualties of the
-        # victim, not victims themselves.
-        victims_cur = {r for r, c in failed if c != GANG_ABORT_EXIT}
-        peer = abort.get("peer") if abort else None
-        if isinstance(peer, int) and 0 <= peer < cur_world:
-            victims_cur.add(peer)
-        for r in victims_cur:
-            fail_counts[active[r]] += 1
-        # lose_rank firings mark their rank's budget exhausted outright
-        # (the dead-host event).  The ledger records ORIGINAL-rank ids
-        # (the gang worker keys its injector on --orig-rank), so the
-        # entries stay valid across renumberings — ranks already shrunk
-        # away just filter out of the active set.
-        unrecoverable = ledger_lost_ranks(ledger_path) & set(active)
-        if rank_restart_budget is not None:
-            unrecoverable |= {o for o in active
-                              if fail_counts[o] > rank_restart_budget}
-        if restarts >= max_restarts:
-            rank0_print(
-                f"[gang] giving up after {restarts} restart(s): {why}"
-            )
-            raise GangFailure(
-                f"gang failed after {restarts} restart(s): {why}",
-                final_codes,
-            )
-        restarts += 1
-        if events is not None:
-            events.gang_restarts += 1
-        if tel is not None:
-            tel.registry.counter("gang_restarts").inc()
-            tel.flush()
-        # The health ledger keeps the restart/shrink history the status
-        # tool renders (beat files and the abort latch are about to be
-        # cleared; this line is what survives).
-        append_health_event(gang_dir, "restart", attempt=restarts,
-                            world=cur_world, why=why)
-        if unrecoverable:
-            survivors = [o for o in active if o not in unrecoverable]
-            lost_s = sorted(unrecoverable)
-            if min_world is None or len(survivors) < min_world:
+        # A boundary the supervisor itself declared (grow admission /
+        # straggler replacement): nobody failed, nobody's budget is
+        # charged, and max_restarts is not consumed — the stop is
+        # progress, not a fault.  If a real worker abort won the latch
+        # race, `planned` stays un-honored and the failure path below
+        # runs (pending joins are still admitted at that boundary).
+        planned_stop = (
+            planned is not None and abort is not None
+            and abort.get("by_rank") == SUPERVISOR_BOUNDARY_RANK
+        )
+        unrecoverable: set[int] = set()
+        # recover_rank firings clear their target's EARLIER lose_rank
+        # markers — the host came back; holding the old dead-host entry
+        # against it would make every loss permanent forever.  The
+        # masking is order-aware (ledger_unrecovered_lost_ranks): a
+        # rank that dies again AFTER recovering counts as lost again.
+        recovered = ledger_recovered_ranks(ledger_path)
+        if planned_stop:
+            why = str(abort.get("reason"))
+        else:
+            why = (f"rank {failed[0][0]} exited {failed[0][1]}"
+                   + (f"; abort declared by rank {abort.get('by_rank')}: "
+                      f"{abort.get('reason')}" if abort else ""))
+            # -- failure attribution (original-rank identities) ---------
+            # Only self-exits count — ranks the drain terminated, and
+            # ranks that took the coordinated abort exit, are casualties
+            # of the victim, not victims themselves.
+            victims_cur = {r for r, c in failed if c != GANG_ABORT_EXIT}
+            peer = abort.get("peer") if abort else None
+            if isinstance(peer, int) and 0 <= peer < cur_world:
+                victims_cur.add(peer)
+            for r in victims_cur:
+                fail_counts[active[r]] += 1
+            # lose_rank firings mark their rank's budget exhausted
+            # outright (the dead-host event).  The ledger records
+            # ORIGINAL-rank ids (the gang worker keys its injector on
+            # --orig-rank), so the entries stay valid across
+            # renumberings — ranks already shrunk away just filter out
+            # of the active set.
+            unrecoverable = (ledger_unrecovered_lost_ranks(ledger_path)
+                             & set(active))
+            if rank_restart_budget is not None:
+                unrecoverable |= {o for o in active
+                                  if fail_counts[o] > rank_restart_budget}
+            if restarts >= max_restarts:
+                rank0_print(
+                    f"[gang] giving up after {restarts} restart(s): {why}"
+                )
                 raise GangFailure(
-                    f"rank(s) {lost_s} unrecoverable (budget exhausted "
-                    f"or lose_rank fired) and the gang cannot shrink "
-                    f"to {len(survivors)} worker(s)"
-                    + ("" if min_world is None
-                       else f" (min_world {min_world})"),
+                    f"gang failed after {restarts} restart(s): {why}",
                     final_codes,
                 )
-            # Elect among the SURVIVORS' records (keyed by the failed
-            # attempt's numbering) before renumbering discards them.
-            surv_cur = [active.index(o) for o in survivors]
-            elected = elect_restore_step(
-                gang_dir, cur_world, ckpt_dirs=dirs_for(survivors),
-                ranks=surv_cur,
-            )
-            quarantined = enforce_restore_point(dirs_for(survivors),
-                                                elected)
-            # Renumbering invalidates rank-keyed restore records; the
-            # fired-fault ledger is KEPT — the survivor inheriting a
-            # fired rank number must stay latched.
-            clear_gang_state(gang_dir, restore_records=True,
-                             fault_ledger=False)
+            restarts += 1
             if events is not None:
-                events.gang_shrinks += 1
+                events.gang_restarts += 1
             if tel is not None:
+                tel.registry.counter("gang_restarts").inc()
+                tel.flush()
+        attempt += 1
+        # The health ledger keeps the restart/shrink/grow history the
+        # status tool renders (beat files and the abort latch are about
+        # to be cleared; this line is what survives).
+        append_health_event(
+            gang_dir, "boundary" if planned_stop else "restart",
+            attempt=attempt, world=cur_world, why=why,
+        )
+
+        # -- membership for the next attempt ----------------------------
+        # survivors: carried over (they hold election records under the
+        # failed attempt's numbering).  joiners: admitted announcements
+        # + promoted spares — seeded to the elected restore point below.
+        survivors = [o for o in active if o not in unrecoverable]
+        lost_s = sorted(unrecoverable)
+        if unrecoverable and (min_world is None
+                              or len(survivors) < min_world):
+            raise GangFailure(
+                f"rank(s) {lost_s} unrecoverable (budget exhausted "
+                f"or lose_rank fired) and the gang cannot shrink "
+                f"to {len(survivors)} worker(s)"
+                + ("" if min_world is None
+                   else f" (min_world {min_world})"),
+                final_codes,
+            )
+        demoted: list[int] = []
+        if planned_stop and planned.get("kind") == "replace":
+            victim = planned["demote"]
+            if victim in survivors:
+                survivors = [o for o in survivors if o != victim]
+                demoted = [victim]
+        joined: list[int] = []
+        promoted: list[int] = []
+        if max_world is not None:
+            room = max_world - len(survivors)
+            pending = sorted(
+                r for r, p in read_joins(gang_dir).items()
+                if not p.get("spare") and r not in survivors
+                and (shared_ckpt or r < len(ckpt_dirs or ()))
+            )
+            joined = pending[:max(room, 0)]
+            room -= len(joined)
+        if planned_stop:
+            # Spares promote ONLY at planned boundaries: filling the
+            # world after a grow admission, or replacing the demoted
+            # straggler — never silently backfilling a failure shrink.
+            quota = (len(demoted) if planned.get("kind") == "replace"
+                     else max(max_world - len(survivors) - len(joined),
+                              0) if max_world is not None else 0)
+            promoted = [o for o in planned.get("ready", [])
+                        if o not in survivors][:quota]
+        new_active = sorted(set(survivors) | set(joined) | set(promoted))
+        reshaped = new_active != active
+
+        if not reshaped:
+            # Same membership: clear the dead attempt's beats and abort
+            # latch, but KEEP restore records — the election input.
+            clear_gang_state(gang_dir)
+            if ckpt_dirs is not None:
+                elected = elect_restore_step(gang_dir, cur_world,
+                                             ckpt_dirs=dirs_for(active))
+                quarantined = enforce_restore_point(dirs_for(active),
+                                                    elected)
+                rank0_print(
+                    f"[gang] restore-point election: step "
+                    f"{elected if elected is not None else '<none>'}"
+                    + (f"; quarantined {len(quarantined)} newer "
+                       f"checkpoint(s)" if quarantined else "")
+                )
+            rank0_print(
+                f"[gang] {why}; coordinated restart "
+                f"{restarts}/{max_restarts}"
+            )
+            continue
+
+        # -- reshape: elect among survivors, seed joiners, renumber -----
+        surv_cur = [active.index(o) for o in survivors]
+        elected = elect_restore_step(
+            gang_dir, cur_world, ckpt_dirs=dirs_for(survivors),
+            ranks=surv_cur,
+        )
+        quarantined = enforce_restore_point(dirs_for(survivors), elected)
+        admitted = joined + promoted
+        seeded: list[int] = []
+        if admitted and ckpt_dirs is not None:
+            src_dirs = dirs_for(survivors)
+            src_dirs = [src_dirs] if shared_ckpt else src_dirs
+            for o in admitted:
+                dst = ckpt_dirs if shared_ckpt else ckpt_dirs[o]
+                if _seed_checkpoint(dst, elected, src_dirs):
+                    seeded.append(o)
+                # Either way the joiner's directory must not hold strays
+                # NEWER than the restore point (a pre-loss save, a
+                # spare prefetch that outran the election).
+                enforce_restore_point([dst], elected)
+        if ckpt_dirs is not None and elected is not None:
+            unseeded = sorted(set(admitted) - set(seeded))
+            if unseeded:
+                # Admitting a joiner that does NOT hold the elected
+                # step would let it resume behind the gang and diverge
+                # (re-consumed examples, non-identical params).  Defer
+                # its admission instead — announcement kept, trigger
+                # suppressed, retried at the next boundary; elected
+                # None means no checkpoint exists anywhere and
+                # everyone starts from scratch together, so nothing
+                # to seed.
+                rank0_print(
+                    f"[gang] could not seed restore step {elected} "
+                    f"for joiner(s) {unseeded}; deferring their "
+                    "admission"
+                )
+                deferred_joins |= set(unseeded)
+                joined = [o for o in joined if o in seeded]
+                promoted = [o for o in promoted if o in seeded]
+                if (planned_stop and planned.get("kind") == "replace"
+                        and demoted and not promoted):
+                    # The replacement failed to seed: keep the slow
+                    # rank rather than shrink the world — a demotion
+                    # without a promotion would break the "world size
+                    # unchanged" replacement contract (and could dip
+                    # below min_world, which only guards loss shrinks).
+                    rank0_print(
+                        f"[gang] replacement spare unseeded; keeping "
+                        f"rank {demoted[0]} live"
+                    )
+                    # Its dir sat out the survivor election/enforcement;
+                    # re-align it to the elected step (normally a no-op
+                    # — it saved that step while it was live).
+                    dst = (ckpt_dirs if shared_ckpt
+                           else ckpt_dirs[demoted[0]])
+                    _seed_checkpoint(dst, elected, src_dirs)
+                    enforce_restore_point([dst], elected)
+                    survivors = sorted(set(survivors) | set(demoted))
+                    demoted = []
+                admitted = joined + promoted
+                new_active = sorted(
+                    set(survivors) | set(joined) | set(promoted)
+                )
+        # Only actually-admitted announcements are consumed; a deferred
+        # join's file is the retry ticket.
+        for o in admitted:
+            consume_join(gang_dir, o)
+            fail_counts.setdefault(o, 0)
+            deferred_joins.discard(o)
+        for o in joined:
+            if o in recovered:
+                fail_counts[o] = 0  # the budget recovered with the host
+        spare_pool = sorted(
+            (set(spare_pool) - set(promoted)) | set(demoted)
+        )
+        # Renumbering invalidates rank-keyed restore records; the
+        # fired-fault ledger is KEPT — the member inheriting a fired
+        # rank number must stay latched.
+        clear_gang_state(gang_dir, restore_records=True,
+                         fault_ledger=False)
+        grown = len(new_active) > cur_world
+        shrunk = bool(lost_s)
+        if events is not None:
+            events.gang_shrinks += 1 if shrunk else 0
+            events.gang_grows += 1 if grown else 0
+            events.spare_promotions += len(promoted)
+            events.spare_demotions += len(demoted)
+        if tel is not None:
+            if shrunk:
                 tel.registry.counter("gang_shrinks").inc()
-                tel.registry.gauge("gang_world_size").set(len(survivors))
                 tel.tracer.instant(
                     "gang_shrink", from_world=cur_world,
                     to_world=len(survivors), lost=lost_s,
                 )
-                tel.flush()
+            if grown:
+                tel.registry.counter("gang_grows").inc()
+                tel.tracer.instant(
+                    "gang_grow", from_world=cur_world,
+                    to_world=len(new_active), joined=joined,
+                    promoted=promoted,
+                )
+            if promoted:
+                tel.registry.counter("spare_promotions").inc(
+                    len(promoted)
+                )
+            if demoted:
+                tel.registry.counter("spare_demotions").inc(len(demoted))
+            tel.registry.gauge("gang_world_size").set(len(new_active))
+            tel.flush()
+        if shrunk:
             append_health_event(
-                gang_dir, "shrink", attempt=restarts,
+                gang_dir, "shrink", attempt=attempt,
                 from_world=cur_world, to_world=len(survivors),
                 lost=lost_s, restore_step=elected,
             )
-            rank0_print(
-                f"[gang] {why}; rank(s) {lost_s} unrecoverable — "
-                f"shrinking to {len(survivors)} survivor(s) "
-                f"(restore point "
-                f"{elected if elected is not None else '<none>'}"
-                + (f", quarantined {len(quarantined)} newer "
-                   f"checkpoint(s)" if quarantined else "")
-                + f"); restart {restarts}/{max_restarts}"
+        if grown or promoted or demoted:
+            append_health_event(
+                gang_dir, "grow" if grown else "replace",
+                attempt=attempt, from_world=cur_world,
+                to_world=len(new_active), joined=joined,
+                promoted=promoted, demoted=demoted,
+                restore_step=elected, seeded=seeded,
             )
-            active = survivors
-            continue
-        # Between same-size attempts: clear the dead attempt's beats and
-        # abort latch, but KEEP restore records — the election input.
-        clear_gang_state(gang_dir)
-        if ckpt_dirs is not None:
-            elected = elect_restore_step(gang_dir, cur_world,
-                                         ckpt_dirs=dirs_for(active))
-            quarantined = enforce_restore_point(dirs_for(active), elected)
-            rank0_print(
-                f"[gang] restore-point election: step "
-                f"{elected if elected is not None else '<none>'}"
-                + (f"; quarantined {len(quarantined)} newer "
-                   f"checkpoint(s)" if quarantined else "")
-            )
+        for o in promoted:
+            append_health_event(gang_dir, "promote", attempt=attempt,
+                                rank=o, restore_step=elected)
+        for o in demoted:
+            append_health_event(gang_dir, "demote", attempt=attempt,
+                                rank=o, why="straggler replacement")
         rank0_print(
-            f"[gang] {why}; coordinated restart {restarts}/{max_restarts}"
+            f"[gang] {why}; world {cur_world} -> {len(new_active)}"
+            + (f": rank(s) {lost_s} unrecoverable — shrinking to "
+               f"{len(survivors)} survivor(s)" if lost_s else "")
+            + (f" (joined {joined})" if joined else "")
+            + (f" (promoted spare(s) {promoted})" if promoted else "")
+            + (f" (demoted {demoted})" if demoted else "")
+            + f"; restore point "
+            f"{elected if elected is not None else '<none>'}"
+            + (f", quarantined {len(quarantined)} newer checkpoint(s)"
+               if quarantined else "")
+            + (f"; restart {restarts}/{max_restarts}" if not planned_stop
+               else " (planned boundary)")
         )
+        active = new_active
 
 
 def auto_resume(ckpt_dir, init_state, abstract_state=None, events=None):

@@ -501,7 +501,8 @@ def save_checkpoint(directory: str | os.PathLike, state,
                     layout: str | None = None, cursor: int | None = None,
                     mid_save_hook=None, keep_last_n: int | None = None,
                     post_save_hook=None,
-                    shard_spec: ShardSpec | None = None) -> str:
+                    shard_spec: ShardSpec | None = None,
+                    extra_payload: dict | None = None) -> str:
     """Write `state` under `directory/step_<n>/`; returns the path written.
 
     ``state`` may be a replicated :class:`TrainState` (dp) or one of the
@@ -545,6 +546,12 @@ def save_checkpoint(directory: str | os.PathLike, state,
     world size (``reshard_restore``) with its flat-leaf digests
     verified against the logical arrays.
 
+    ``extra_payload``: optional JSON-serializable dict of caller
+    metadata riding the config payload (under ``__extra__``, so it can
+    never collide with a config field) — e.g. the elastic gang
+    worker's cumulative example cursor, whose meaning only the caller
+    knows.  Read back with :func:`checkpoint_extra`.
+
     Verification: before the config file (the completeness marker)
     lands, a ``manifest.json`` records a sha256 + byte size for every
     file under the state dir and a crc32/sha256/size/dtype/shape for
@@ -585,6 +592,8 @@ def save_checkpoint(directory: str | os.PathLike, state,
                 payload["__cursor__"] = int(cursor)
             if shard_spec is not None:
                 payload["__shard_spec__"] = shard_spec.as_dict()
+            if extra_payload:
+                payload["__extra__"] = dict(extra_payload)
             json.dump(payload, f)
         # The manifest was just computed from these very bytes: the GC
         # below (and every later pass) must not immediately re-hash
@@ -893,6 +902,7 @@ def checkpoint_config(path: str | os.PathLike):
     payload.pop("__layout__", None)  # layout tag is checkpoint_layout's
     payload.pop("__cursor__", None)  # data cursor is checkpoint_cursor's
     payload.pop("__shard_spec__", None)  # spec is checkpoint_shard_spec's
+    payload.pop("__extra__", None)  # caller metadata is checkpoint_extra's
     return config_class_by_name(payload.pop("__class__", "SGDConfig"))(
         **payload
     )
@@ -938,6 +948,21 @@ def checkpoint_cursor(path: str | os.PathLike) -> int | None:
     except (OSError, json.JSONDecodeError):
         return None
     return None if cursor is None else int(cursor)
+
+
+def checkpoint_extra(path: str | os.PathLike) -> dict:
+    """The caller-metadata dict a checkpoint was saved with
+    (``save_checkpoint(extra_payload=...)``); empty for checkpoints
+    without one, and for quarantined/torn dirs (same known-bad-data
+    rule as :func:`checkpoint_cursor`)."""
+    if quarantine_reason(path) is not None:
+        return {}
+    try:
+        with open(os.path.join(os.fspath(path), _CONFIG_FILE)) as f:
+            extra = json.load(f).get("__extra__")
+    except (OSError, json.JSONDecodeError):
+        return {}
+    return extra if isinstance(extra, dict) else {}
 
 
 def checkpoint_layout(path: str | os.PathLike) -> str | None:

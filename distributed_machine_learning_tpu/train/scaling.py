@@ -1,0 +1,164 @@
+"""World-size-aware batch/LR scaling rules — the numeric contract of an
+elastic gang that GROWS.
+
+PR 5's elasticity pinned the global batch: a shrink rescales the
+per-host batch from B/N to B/M so the global batch — and with it the
+LR schedule — is world-size-invariant.  That is the right conservative
+default, but it wastes the grow direction: five hosts each pushing
+B/5 examples leave hardware idle that could be consuming a *larger*
+global batch.  The large-batch literature this repo already cites says
+exactly how to change the batch without breaking the trajectory:
+
+- **linear scaling** ("Massively Distributed SGD", arxiv 1811.05233;
+  Goyal et al.): grow the global batch proportionally to the world and
+  the LR proportionally to the batch — the mean gradient's noise
+  variance shrinks as 1/B, so a B-proportional step keeps the
+  per-example learning signal (and the stationary loss floor) fixed;
+- **sqrt/LARS scaling** ("Extremely Large Minibatch SGD", arxiv
+  1711.04325): at batch sizes where linear scaling diverges, scale the
+  LR with sqrt(B) and normalize each layer's step by its trust ratio
+  (``train/lars.py``) so no layer's update outruns its weights.
+
+A :class:`ScalingRule` is a pure, picklable description of that
+contract: given the launch-time base point (lr, global batch, world),
+:meth:`at_world` answers "at world W, what is the global batch, what is
+the LR, and what does each rank consume?" — deterministically, so every
+rank, the supervisor, and a post-mortem tool agree without
+communicating.  The gang worker re-evaluates it at every relaunch (the
+world size is an argv fact there), and ``exact_shard_indices``
+(``data/sharding.py``) keeps the per-rank shares an exact partition, so
+exactly-once consumption survives the transition.
+
+Kinds:
+
+- ``pinned``   — PR 5 semantics: global batch and LR fixed at the base
+                 point regardless of world.  The default everywhere.
+- ``linear``   — B(W) = round(B0 · W/W0), lr(W) = lr0 · B(W)/B0.
+- ``lars``     — B(W) as linear, lr(W) = lr0 · sqrt(B(W)/B0); pair
+                 with ``optimizer="lars"`` so the trust ratio bounds
+                 per-layer steps (the 1711.04325 recipe).
+- ``unscaled`` — B(W) as linear but the LR pinned at lr0.  This is the
+                 deliberately-WRONG control: the batch changes and
+                 nothing compensates, so the stationary loss floor
+                 moves with 1/W.  It exists so the chaos proof can
+                 demonstrate the rule is load-bearing, not decorative.
+
+Everything here is stdlib+math on host scalars (no jax): the rule is
+consulted at relaunch boundaries, never inside the compiled step —
+inside the step the LR rides the normal ``schedule`` hook
+(:func:`scaled_schedule` wraps any ``step -> lr`` schedule with the
+rule's factor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+SCALING_KINDS = ("pinned", "linear", "lars", "unscaled")
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldScaling:
+    """The resolved numbers at one world size — what a relaunched rank
+    actually uses.  ``lr_factor`` is ``lr / base_lr`` (the multiplier
+    :func:`scaled_schedule` applies to a schedule's output)."""
+
+    world: int
+    global_batch: int
+    lr: float
+    lr_factor: float
+
+    def shard_size(self, rank: int) -> int:
+        """Examples rank ``rank`` consumes per step — the exact-partition
+        share (counts differ by at most one across ranks)."""
+        if not 0 <= rank < self.world:
+            raise ValueError(
+                f"rank {rank} out of range for world {self.world}"
+            )
+        base, extra = divmod(self.global_batch, self.world)
+        return base + (1 if rank < extra else 0)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalingRule:
+    """How (global batch, LR) respond to a world-size change, anchored
+    at the launch-time base point.  Immutable and world-stateless: the
+    same rule object answers for every W, so there is no order
+    dependence between a 4→3 shrink and a 3→5 grow."""
+
+    kind: str = "pinned"
+    base_lr: float = 0.1
+    base_global_batch: int = 24
+    base_world: int = 1
+
+    def __post_init__(self):
+        if self.kind not in SCALING_KINDS:
+            raise ValueError(
+                f"unknown scaling kind {self.kind!r}; known: "
+                f"{list(SCALING_KINDS)}"
+            )
+        if self.base_lr <= 0:
+            raise ValueError(f"base_lr must be > 0, got {self.base_lr}")
+        if self.base_global_batch < 1:
+            raise ValueError(
+                f"base_global_batch must be >= 1, got "
+                f"{self.base_global_batch}"
+            )
+        if self.base_world < 1:
+            raise ValueError(
+                f"base_world must be >= 1, got {self.base_world}"
+            )
+
+    def at_world(self, world: int) -> WorldScaling:
+        """The (global batch, LR) this rule prescribes at world size
+        ``world``.  Batch rounding is shared by every scaling kind
+        (round-half-up to at least 1), and the LR compensates for the
+        ACTUAL batch ratio, rounding included — not the nominal world
+        ratio — so a ragged world never under/over-scales the step."""
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        if self.kind == "pinned":
+            return WorldScaling(world=world,
+                                global_batch=self.base_global_batch,
+                                lr=self.base_lr, lr_factor=1.0)
+        batch = max(
+            1, int(self.base_global_batch * world / self.base_world + 0.5)
+        )
+        ratio = batch / self.base_global_batch
+        if self.kind == "linear":
+            factor = ratio
+        elif self.kind == "lars":
+            factor = math.sqrt(ratio)
+        else:  # unscaled: the documented control — nothing compensates
+            factor = 1.0
+        return WorldScaling(world=world, global_batch=batch,
+                            lr=self.base_lr * factor, lr_factor=factor)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, payload: dict) -> "ScalingRule":
+        return cls(**{k: payload[k] for k in
+                      ("kind", "base_lr", "base_global_batch",
+                       "base_world") if k in payload})
+
+
+def scaled_schedule(rule: ScalingRule, world: int, base_schedule):
+    """Wrap a ``step -> lr`` schedule (``train/schedule.py``) with the
+    rule's world factor — the hook a real training CLI uses: the base
+    schedule keeps its shape (warmup/cosine/staircase) while the whole
+    curve scales with the world's batch.  Identity for ``pinned`` (the
+    wrapper is not even allocated)."""
+    factor = rule.at_world(world).lr_factor
+    if factor == 1.0:
+        return base_schedule
+
+    def schedule(step):
+        return base_schedule(step) * factor
+
+    return schedule

@@ -261,8 +261,13 @@ def make_train_step(
     P(batch).  With ``jit=True`` the returned callable keeps the
     ``step(state, x, y) -> (state, loss)`` signature and manages the
     residual buffers itself (``step.sync_state()`` /
-    ``step.reset_sync_state()`` / ``step.fresh_sync_state(params)``;
-    ``step.inner`` is the raw 4-ary jitted fn for AOT lowering).  With
+    ``step.set_sync_state(res)`` / ``step.reset_sync_state()`` /
+    ``step.fresh_sync_state(params)``; ``step.inner`` is the raw 4-ary
+    jitted fn for AOT lowering).  The wrapper is world-change-safe: a
+    residual stacked for a different world (an elastic shrink/grow
+    carried it across a gang reshape) is rebuilt as zeros at this
+    mesh's world — logged/counted as ``ring_residual_reset`` — never a
+    shape crash inside the compiled program.  With
     ``jit=False`` the raw 4-ary fn is returned and the caller threads
     the state.  Stateless strategies compile the exact program they
     always did — zero overhead.
@@ -384,10 +389,45 @@ def make_train_step(
 
     holder = {"res": None}
 
+    def _residual_world(res) -> int | None:
+        """The world size a stacked residual was built for — its leading
+        axis (every leaf is ``[world, *leaf]``)."""
+        leaves = jax.tree_util.tree_leaves(res)
+        return int(leaves[0].shape[0]) if leaves else None
+
+    def _check_world(res):
+        """Accept ``res`` only if its stacked world matches THIS step's
+        mesh; a mismatch (an elastic shrink/grow carried the residual
+        across a world change) resets to fresh zeros instead of shape-
+        crashing inside the compiled program, and says so: a silent
+        reset would weaken the EF-exactness story, a crash would turn a
+        planned reshape into a failure.  Returns the residual to use."""
+        got = _residual_world(res)
+        if got is None or got == axis_size:
+            return res
+        from distributed_machine_learning_tpu.telemetry import (
+            get_telemetry,
+        )
+
+        tel = get_telemetry()
+        if tel is not None:
+            tel.registry.counter("ring_residual_reset").inc()
+            tel.tracer.instant("ring_residual_reset", from_world=got,
+                               to_world=axis_size)
+        print(
+            f"[ring] ring_residual_reset: error-feedback residual was "
+            f"stacked for world {got}, mesh is world {axis_size} — "
+            "rebuilding at the new world with zeros (one step of EF "
+            "warmup)", flush=True,
+        )
+        return None
+
     def step(state, images_u8, labels):
         # Caller-facing signature unchanged (state, x, y) → (state,
         # loss): the wrapper owns the residual buffers, lazily zeroed
         # from the first state's param shapes and re-donated each call.
+        if holder["res"] is not None:
+            holder["res"] = _check_world(holder["res"])
         if holder["res"] is None:
             holder["res"] = fresh_sync_state(state.params)
         new_state, loss, holder["res"] = inner(
@@ -402,9 +442,23 @@ def make_train_step(
         holding across steps: ``jax.tree_util.tree_map(jnp.copy, ...)``."""
         return holder["res"]
 
+    def set_sync_state(res):
+        """Install a carried residual — the elastic-rebind hook: a
+        caller that preserved the residual across a step rebuild (same
+        params, possibly a DIFFERENT world after a gang reshape) hands
+        it to the new step here.  A world mismatch resets to fresh
+        zeros at the new world (logged as ``ring_residual_reset``)
+        rather than shape-crashing; a matching one is re-placed onto
+        this step's mesh sharding."""
+        res = _check_world(res)
+        if res is not None:
+            res = jax.device_put(res, NamedSharding(mesh, P(axis_name)))
+        holder["res"] = res
+
     step.inner = inner  # AOT/lowering access: inner.lower(state, x, y, res)
     step.fresh_sync_state = fresh_sync_state
     step.sync_state = sync_state
+    step.set_sync_state = set_sync_state
     step.reset_sync_state = lambda: holder.__setitem__("res", None)
     return step
 

@@ -61,12 +61,16 @@ _EVENT_LABELS = {
     "ckpt_kills": "injected mid-checkpoint kills",
     "rank_kills": "injected rank deaths",
     "rank_losses": "injected permanent rank losses",
+    "rank_recoveries": "injected rank recoveries",
     "rank_stalls": "injected rank stalls",
     "ckpt_corruptions": "injected checkpoint corruptions",
     "peer_failures": "gang peers declared dead/stalled",
     "stragglers": "straggler advisories (slow ranks)",
     "gang_restarts": "gang coordinated restarts",
     "gang_shrinks": "gang shrinks to survivors",
+    "gang_grows": "gang grows (joins/promotions admitted)",
+    "spare_promotions": "warm spares promoted to live ranks",
+    "spare_demotions": "live ranks demoted to spares",
     "reshard_restores": "restores resharded across world sizes",
     "ckpt_verify_failures": "checkpoints failing verification",
     "ckpt_fallbacks": "restores fell back past bad checkpoints",

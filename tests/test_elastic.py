@@ -233,6 +233,79 @@ def test_reshard_roundtrip_bit_identical(tmp_path, base_states, mesh8,
     assert leaves1[flat_key]["bytes"] == leaves2[flat_key]["bytes"]
 
 
+@pytest.mark.parametrize("small,big", [(3, 5), (4, 7)])
+@pytest.mark.parametrize("layout", ["dp", "zero1", "fsdp"])
+def test_reshard_grow_direction_ragged_worlds(tmp_path, base_states,
+                                              mesh8, layout, small, big):
+    """ISSUE 10 satellite: the GROW direction with ragged worlds —
+    save@3→restore@5 and save@4→restore@7 (neither divides the element
+    count), asserting logical bit-identity against the original state
+    and that corruption is still caught across the grow."""
+    base = base_states["lm"]
+    ev = FaultEvents()
+    if layout == "dp":
+        p_small = save_checkpoint(tmp_path / "small", base,
+                                  shard_spec=ShardSpec("dp", world=small))
+        grown, spec = reshard_restore(p_small, world=big, events=ev)
+        assert spec == ShardSpec("dp", world=big)
+        assert ev.reshard_restores == 1
+        for a, b in zip(jax.tree_util.tree_leaves(base.params),
+                        jax.tree_util.tree_leaves(grown.params)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        corrupt_checkpoint_data(p_small)
+        with pytest.raises(CheckpointVerifyError):
+            reshard_restore(p_small, world=big)
+        return
+    shard = shard_zero1_state if layout == "zero1" else shard_fsdp_state
+    state8, _, n = shard(base, mesh8)
+    spec8 = ShardSpec(layout, world=8, n_elems=n)
+    p8 = save_checkpoint(tmp_path / "w8", state8, shard_spec=spec8)
+    state_small, spec_small = reshard_restore(p8, world=small, events=ev)
+    p_small = save_checkpoint(tmp_path / "small", state_small,
+                              shard_spec=spec_small)
+    grown, spec_big = reshard_restore(p_small, world=big, events=ev)
+    assert spec_big == spec8.with_world(big)
+    assert ev.reshard_restores == 2
+    vec0, mom0 = _logical_flat(state8, spec8)
+    vec1, mom1 = _logical_flat(grown, spec_big)
+    assert np.array_equal(vec0, vec1)
+    for a, b in zip(jax.tree_util.tree_leaves(mom0),
+                    jax.tree_util.tree_leaves(mom1)):
+        assert np.array_equal(a, b)
+    # The logical digests survive BOTH ragged hops: a byte flip in the
+    # small-world save is caught when restoring at the bigger world.
+    leaves8 = checkpoint_manifest(p8)["leaves"]
+    leaves_s = checkpoint_manifest(p_small)["leaves"]
+    flat_key = "param_shards" if layout == "fsdp" else "param_flat"
+    assert leaves8[flat_key]["sha256"] == leaves_s[flat_key]["sha256"]
+    corrupt_checkpoint_data(p_small)
+    with pytest.raises(CheckpointVerifyError):
+        reshard_restore(p_small, world=big)
+
+
+def test_ckpt_reshard_tool_grow_direction(tmp_path, base_states, mesh8,
+                                          capsys):
+    """The offline tool in the grow direction: a world-3 source rewrites
+    to world 7 (both ragged) and restores bit-identically."""
+    state8, _, n = shard_zero1_state(base_states["lm"], mesh8)
+    w8 = tmp_path / "w8"
+    save_checkpoint(w8, state8,
+                    shard_spec=ShardSpec("zero1", world=8, n_elems=n))
+    state3, spec3 = reshard_restore(w8 / "step_0", world=3)
+    src = tmp_path / "src"
+    save_checkpoint(src, state3, shard_spec=spec3)
+    tool = _load_tool("ckpt_reshard")
+    rc = tool.main([str(src), str(tmp_path / "dst"), "--world", "7"])
+    assert rc == 0, capsys.readouterr().err
+    dst = os.path.join(tmp_path, "dst", "step_0")
+    assert validate_checkpoint(dst) == []
+    assert checkpoint_shard_spec(dst) == ShardSpec("zero1", world=7,
+                                                   n_elems=n)
+    restored, _ = reshard_restore(dst, world=8)
+    assert np.array_equal(np.asarray(restored.param_flat)[:n],
+                          np.asarray(state8.param_flat)[:n])
+
+
 def test_reshard_to_ragged_world_without_mesh(tmp_path, base_states,
                                               mesh8):
     """A world that does not divide the element count (and no mesh to

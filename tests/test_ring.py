@@ -359,6 +359,85 @@ def test_stateful_step_threads_residual(mesh8, rng):
     assert step.sync_state() is None
 
 
+@pytest.mark.parametrize("direction", ["shrink", "grow"])
+def test_residual_world_change_resets_not_crashes(direction, mesh8, mesh4,
+                                                  tmp_path, rng, capsys):
+    """ISSUE 10 satellite: the EF residual is a ``[world, …]`` stacked
+    buffer.  Carrying it across an elastic world change (8→4 shrink or
+    4→8 grow) through ``set_sync_state`` must REBUILD it at the new
+    world — logged and counted as ``ring_residual_reset`` — never shape-
+    crash the compiled step; a same-world install is preserved."""
+    from distributed_machine_learning_tpu.cli.common import (
+        init_model_and_state,
+    )
+    from distributed_machine_learning_tpu.models.registry import get_model
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+    from distributed_machine_learning_tpu.telemetry import (
+        Telemetry,
+        set_telemetry,
+    )
+    from distributed_machine_learning_tpu.train.sgd import SGDConfig
+    from distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+        shard_batch,
+    )
+
+    src_mesh, dst_mesh = ((mesh8, mesh4) if direction == "shrink"
+                          else (mesh4, mesh8))
+    dst_world = dst_mesh.shape["batch"]
+    model = get_model("vggtest", use_bn=False)
+    state = init_model_and_state(
+        model, config=SGDConfig(learning_rate=0.1, weight_decay=0.0)
+    )
+
+    def batch():
+        x = rng.integers(0, 256, (32, 32, 32, 3), dtype=np.uint8)
+        y = rng.integers(0, 10, 32).astype(np.int32)
+        return x, y
+
+    src_step = make_train_step(model, get_strategy("ring", compress="int8"),
+                               mesh=src_mesh, augment=False)
+    state, _ = src_step(state, *shard_batch(src_mesh, *batch()))
+    carried = jax.tree_util.tree_map(jnp.copy, src_step.sync_state())
+
+    tel = Telemetry(tmp_path / "tel")
+    prev = set_telemetry(tel)
+    try:
+        dst_step = make_train_step(
+            model, get_strategy("ring", compress="int8"), mesh=dst_mesh,
+            augment=False,
+        )
+        dst_step.set_sync_state(carried)
+        # The mismatch was detected at install time: reset to lazy-fresh.
+        assert dst_step.sync_state() is None
+        assert tel.registry.counter("ring_residual_reset").value == 1
+        # The elastic flow restores state through reshard_restore, which
+        # places it on the NEW mesh; mirror that placement here.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        state = jax.device_put(
+            state, NamedSharding(dst_mesh, PartitionSpec())
+        )
+        state, loss = dst_step(state, *shard_batch(dst_mesh, *batch()))
+        assert np.isfinite(float(loss))
+        res = dst_step.sync_state()
+        assert jax.tree_util.tree_leaves(res)[0].shape[0] == dst_world
+        # Same-world install round-trips (no reset, values preserved).
+        held = jax.tree_util.tree_map(jnp.copy, res)
+        dst_step.set_sync_state(held)
+        got = dst_step.sync_state()
+        assert tel.registry.counter("ring_residual_reset").value == 1
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(held)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        set_telemetry(prev)
+        tel.close()
+    assert "ring_residual_reset" in capsys.readouterr().out
+
+
 def test_cli_ring_compress_flags():
     """Flag surface: --ring-compress choices parse onto the namespace,
     --ring-topk-frac is validated at parse time (before any runtime

@@ -1,0 +1,173 @@
+"""World-size-aware batch/LR scaling rules (ISSUE 10): fixed
+trajectories for every kind, the exact-partition share accounting an
+elastic grow relies on, the schedule hook, and the statistical property
+the chaos proof leans on — under the linear rule the stationary loss
+floor of noisy SGD is world-size-invariant, while the unscaled control
+moves it by the world ratio.
+
+All host-side (numpy only, no jax, no compile): the rule is consulted
+at relaunch boundaries, never inside a compiled step.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.data.sharding import (
+    exact_shard_indices,
+)
+from distributed_machine_learning_tpu.train.scaling import (
+    SCALING_KINDS,
+    ScalingRule,
+    WorldScaling,
+    scaled_schedule,
+)
+
+
+# ---------------------------------------------------------------------------
+# Fixed trajectories: (world -> batch, lr) golden tables per kind
+# ---------------------------------------------------------------------------
+
+
+def test_pinned_rule_is_world_invariant():
+    rule = ScalingRule("pinned", base_lr=0.1, base_global_batch=24,
+                       base_world=4)
+    for w in (1, 3, 4, 5, 7):
+        ws = rule.at_world(w)
+        assert (ws.global_batch, ws.lr, ws.lr_factor) == (24, 0.1, 1.0)
+
+
+def test_linear_rule_fixed_trajectory():
+    """The 4→3→5 chaos schedule, as golden numbers: batch tracks the
+    world and the LR tracks the ACTUAL batch ratio (ragged rounding
+    included)."""
+    rule = ScalingRule("linear", base_lr=0.2, base_global_batch=24,
+                       base_world=4)
+    got = [(w, rule.at_world(w).global_batch,
+            round(rule.at_world(w).lr, 6)) for w in (4, 3, 5, 1, 7)]
+    assert got == [(4, 24, 0.2), (3, 18, 0.15), (5, 30, 0.25),
+                   (1, 6, 0.05), (7, 42, 0.35)]
+
+
+def test_linear_rule_ragged_base_uses_actual_batch_ratio():
+    """base 10 @ world 4 → world 3 rounds to 8 (not 7.5); the LR factor
+    is 8/10, not 3/4 — the rounding never silently changes the
+    step-to-batch ratio."""
+    rule = ScalingRule("linear", base_lr=1.0, base_global_batch=10,
+                       base_world=4)
+    ws = rule.at_world(3)
+    assert ws.global_batch == 8
+    assert ws.lr == pytest.approx(0.8)
+
+
+def test_lars_rule_sqrt_trajectory():
+    rule = ScalingRule("lars", base_lr=0.4, base_global_batch=16,
+                       base_world=2)
+    ws = rule.at_world(8)  # batch x4 -> lr x2
+    assert ws.global_batch == 64
+    assert ws.lr == pytest.approx(0.8)
+    assert rule.at_world(2).lr == pytest.approx(0.4)
+    assert rule.at_world(1).lr == pytest.approx(0.4 * math.sqrt(0.5))
+
+
+def test_unscaled_control_moves_batch_but_not_lr():
+    rule = ScalingRule("unscaled", base_lr=0.3, base_global_batch=24,
+                       base_world=4)
+    ws = rule.at_world(6)
+    assert ws.global_batch == 36 and ws.lr == pytest.approx(0.3)
+    assert ws.lr_factor == 1.0
+
+
+def test_rule_validation_and_roundtrip():
+    with pytest.raises(ValueError):
+        ScalingRule("quadratic")
+    with pytest.raises(ValueError):
+        ScalingRule("linear", base_lr=0.0)
+    with pytest.raises(ValueError):
+        ScalingRule("linear", base_global_batch=0)
+    with pytest.raises(ValueError):
+        ScalingRule("linear", base_world=0)
+    with pytest.raises(ValueError):
+        ScalingRule("linear").at_world(0)
+    rule = ScalingRule("lars", base_lr=0.2, base_global_batch=32,
+                       base_world=8)
+    assert ScalingRule.from_dict(rule.as_dict()) == rule
+    assert set(SCALING_KINDS) == {"pinned", "linear", "lars", "unscaled"}
+
+
+# ---------------------------------------------------------------------------
+# Per-rank shares: exact partition at every world the rule can produce
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world", [1, 3, 4, 5, 7])
+def test_shard_sizes_partition_the_scaled_batch(world):
+    rule = ScalingRule("linear", base_lr=0.1, base_global_batch=24,
+                       base_world=4)
+    ws = rule.at_world(world)
+    sizes = [ws.shard_size(r) for r in range(world)]
+    assert sum(sizes) == ws.global_batch
+    assert max(sizes) - min(sizes) <= 1
+    # And they are exactly the exact_shard_indices counts — the worker's
+    # id assignment and the rule's accounting can never disagree.
+    assert sizes == [len(exact_shard_indices(ws.global_batch, r, world))
+                     for r in range(world)]
+    with pytest.raises(ValueError):
+        ws.shard_size(world)
+
+
+# ---------------------------------------------------------------------------
+# Schedule hook
+# ---------------------------------------------------------------------------
+
+
+def test_scaled_schedule_multiplies_base_curve():
+    rule = ScalingRule("linear", base_lr=0.1, base_global_batch=24,
+                       base_world=4)
+    base = lambda step: 0.1 * (step + 1)  # noqa: E731
+    sched5 = scaled_schedule(rule, 5, base)
+    assert sched5(0) == pytest.approx(0.1 * 1.25)
+    assert sched5(9) == pytest.approx(1.0 * 1.25)
+    # pinned (factor 1) returns the base schedule object untouched.
+    assert scaled_schedule(ScalingRule("pinned"), 5, base) is base
+
+
+# ---------------------------------------------------------------------------
+# The property the chaos proof leans on: linear keeps the noisy-SGD
+# stationary floor world-invariant; the unscaled control does not.
+# ---------------------------------------------------------------------------
+
+
+def _stationary_floor(rule: ScalingRule, world: int, *, dim=64,
+                      steps=400, tail=200, seed=0) -> float:
+    """Mean ||w||^2 over the tail of mean-estimation SGD: per step draw
+    a global batch of B(world) unit-normal examples, step
+    w -= lr (w - mean) toward the true optimum 0.  The floor is the
+    gradient-noise equilibrium ~ lr/(2-lr) * dim/B — the quantity the
+    slow chaos test measures across the 4→3→5 transitions."""
+    ws = rule.at_world(world)
+    rng = np.random.default_rng(seed)
+    w = np.zeros(dim)
+    floors = []
+    for t in range(steps):
+        mu = rng.standard_normal((ws.global_batch, dim)).mean(0)
+        w = w - ws.lr * (w - mu)
+        if t >= steps - tail:
+            floors.append(float(w @ w))
+    return float(np.mean(floors))
+
+
+def test_linear_rule_keeps_loss_floor_while_control_shifts_it():
+    base = dict(base_lr=0.2, base_global_batch=24, base_world=4)
+    lin3 = _stationary_floor(ScalingRule("linear", **base), 3)
+    lin6 = _stationary_floor(ScalingRule("linear", **base), 6)
+    assert lin6 / lin3 == pytest.approx(1.0, rel=0.25)
+    un3 = _stationary_floor(ScalingRule("unscaled", **base), 3)
+    un6 = _stationary_floor(ScalingRule("unscaled", **base), 6)
+    # Doubling the batch without touching the LR halves the floor: the
+    # control's trajectory is NOT continuous across a world change.
+    assert un6 / un3 < 0.65
+    assert lin6 / lin3 > 1.5 * (un6 / un3)

@@ -7,10 +7,15 @@ telemetry plane the workers stream (default ``<gang-dir>/telemetry``):
 
 - the per-rank table: last published step, progress age, rolling step
   time, skew vs the gang median, and state (ok / SUSPENDED / DONE /
-  STRAGGLER / STALE?);
+  STRAGGLER / STALE?) — plus one row per WARM SPARE (state ``spare``,
+  the checkpoint step it has prefetched) and any pending (non-spare)
+  join announcements from the ``join_rank<r>.json`` channel;
 - the advisory history from ``gang_health.jsonl``: straggler verdicts,
-  coordinated restarts, shrinks — plus fired faults from
-  ``faults_fired.jsonl`` and the abort latch, if present;
+  coordinated restarts, shrinks, grows, spare promotions/demotions, and
+  planned boundaries — plus fired faults from ``faults_fired.jsonl``
+  and the abort latch, if present — and the run's world-size
+  trajectory (e.g. ``4 -> 3 -> 5``), also under ``world_trajectory``
+  in ``--json``;
 - the cross-rank rollup from the per-rank metrics streams
   (``telemetry/aggregator.py``): per-rank throughput, whole-run
   p95/max step-time skew, offline straggler verdicts.
@@ -48,6 +53,10 @@ from distributed_machine_learning_tpu.telemetry.sink import (  # noqa: E402
 )
 
 ABORT_FILE = "abort.json"  # runtime/coordinator.py's abort latch
+# runtime/coordinator.py's join/announcement channel (JOIN_PREFIX
+# there; duplicated so this tool stays importable without the jax-heavy
+# runtime package, like FAULT_LEDGER_FILE above).
+JOIN_PREFIX = "join_rank"
 
 
 def _read_json(path: str) -> dict | None:
@@ -66,6 +75,46 @@ def _ledger_entries(gang_dir: str) -> list[dict]:
                 if isinstance(e, dict)]
     except OSError:
         return []
+
+
+def _read_joins(gang_dir: str) -> dict[int, dict]:
+    """rank -> pending join/spare announcement (torn payloads skipped;
+    mirror of ``runtime/coordinator.py::read_joins`` without the
+    import)."""
+    out: dict[int, dict] = {}
+    try:
+        names = os.listdir(gang_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(JOIN_PREFIX) and name.endswith(".json")):
+            continue
+        rank_s = name[len(JOIN_PREFIX):-len(".json")]
+        if not rank_s.isdigit():
+            continue
+        payload = _read_json(os.path.join(gang_dir, name))
+        if payload is not None:
+            out[int(rank_s)] = payload
+    return out
+
+
+def _world_trajectory(health: list[dict], fallback: int) -> list[int]:
+    """The run's world sizes in order, derived from the health ledger's
+    reshape events (shrink/grow/replace carry from_world/to_world;
+    restart/boundary lines confirm the standing world).  A run with no
+    events at all reports just the observed world."""
+    traj: list[int] = []
+    for e in health:
+        kind = e.get("kind")
+        if kind in ("shrink", "grow", "replace"):
+            fw, tw = e.get("from_world"), e.get("to_world")
+            if not traj and isinstance(fw, int):
+                traj.append(fw)
+            if isinstance(tw, int) and (not traj or traj[-1] != tw):
+                traj.append(tw)
+        elif not traj and isinstance(e.get("world"), int):
+            traj.append(e["world"])
+    return traj or ([fallback] if fallback else [])
 
 
 def collect(gang_dir: str, telemetry_dir: str) -> dict:
@@ -135,12 +184,31 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
     for row in rank_rows:
         st = row["step_time_s"]
         row["skew"] = (st / med) if (st and med > 0) else None
+    # The join channel: warm spares (their own table rows) and pending
+    # non-spare joins (a recovered host waiting for the next boundary).
+    # Ages are writer-clock vs the gang's freshest beat — peer-relative,
+    # same rule as the rank rows; the reader's clock stays out of it.
+    spare_rows, pending_joins = [], []
+    for rank, p in sorted(_read_joins(gang_dir).items()):
+        lag = (max(newest_beat - float(p["time"]), 0.0)
+               if newest_beat is not None
+               and isinstance(p.get("time"), (int, float)) else None)
+        row = {"rank": rank, "announced_lag_s": lag}
+        if p.get("spare"):
+            row["prefetched_step"] = p.get("prefetched_step")
+            spare_rows.append(row)
+        else:
+            row["at_step"] = p.get("at_step")
+            pending_joins.append(row)
     out = {
         "gang_dir": gang_dir,
         "world": len(rank_rows),
+        "world_trajectory": _world_trajectory(health, len(rank_rows)),
         "abort": _read_json(os.path.join(gang_dir, ABORT_FILE)),
         "freshest_beat_lag_s": reader_lag,
         "ranks": rank_rows,
+        "spares": spare_rows,
+        "pending_joins": pending_joins,
         "health": health,
         "faults_fired": _ledger_entries(gang_dir),
     }
@@ -178,28 +246,73 @@ def render(status: dict) -> str:
                          f"{state}")
     else:
         lines.append("  (no heartbeat files)")
+    for r in status.get("spares", ()):
+        pre = (f"prefetched step {r['prefetched_step']}"
+               if r.get("prefetched_step") is not None
+               else "nothing prefetched yet")
+        lag = (f", announced {r['announced_lag_s']:.1f}s behind the "
+               "freshest beat" if r.get("announced_lag_s") is not None
+               else "")
+        lines.append(f"  {r['rank']:>4}  {'-':>6}  {'-':>8}  "
+                     f"{'-':>10}  {'-':>6}  spare ({pre}{lag})")
+    for r in status.get("pending_joins", ()):
+        at = (f" at step {r['at_step']}"
+              if r.get("at_step") is not None else "")
+        lines.append(f"  pending join: rank {r['rank']} announced"
+                     f"{at} — admitted at the next boundary")
+    traj = status.get("world_trajectory") or []
+    if len(traj) > 1:
+        lines.append("  world trajectory: "
+                     + " -> ".join(str(w) for w in traj))
 
     history = [e for e in status["health"]
-               if e.get("kind") in ("restart", "shrink", "straggler")]
+               if e.get("kind") in ("restart", "boundary", "shrink",
+                                    "grow", "replace", "promote",
+                                    "demote", "straggler")]
     if history or status["faults_fired"]:
         lines.append("== History ==")
     for e in history:
         kind = e.get("kind")
-        if kind == "restart":
-            lines.append(f"  restart #{e.get('attempt')}: world "
+        if kind in ("restart", "boundary"):
+            label = ("planned boundary" if kind == "boundary"
+                     else "restart")
+            lines.append(f"  {label} #{e.get('attempt')}: world "
                          f"{e.get('world')} — {e.get('why', '?')}")
         elif kind == "shrink":
             lines.append(f"  shrink @attempt {e.get('attempt')}: "
                          f"{e.get('from_world')} -> {e.get('to_world')} "
                          f"(lost rank(s) {e.get('lost')}, restore step "
                          f"{e.get('restore_step')})")
+        elif kind in ("grow", "replace"):
+            detail = []
+            if e.get("joined"):
+                detail.append(f"joined {e['joined']}")
+            if e.get("promoted"):
+                detail.append(f"promoted spare(s) {e['promoted']}")
+            if e.get("demoted"):
+                detail.append(f"demoted {e['demoted']}")
+            lines.append(f"  {kind} @attempt {e.get('attempt')}: "
+                         f"{e.get('from_world')} -> {e.get('to_world')} "
+                         f"({', '.join(detail) or '?'}; restore step "
+                         f"{e.get('restore_step')})")
+        elif kind == "promote":
+            lines.append(f"  promote @attempt {e.get('attempt')}: spare "
+                         f"{e.get('rank')} -> live (restore step "
+                         f"{e.get('restore_step')})")
+        elif kind == "demote":
+            lines.append(f"  demote @attempt {e.get('attempt')}: rank "
+                         f"{e.get('rank')} -> spare "
+                         f"({e.get('why', '?')})")
         else:
             lines.append(f"  straggler: rank {e.get('rank')} at step "
                          f"{e.get('step')} — {e.get('ratio')}x the gang "
                          f"median (attempt {e.get('attempt')})")
     for e in status["faults_fired"]:
+        tgt = (f" (target rank {e.get('target')})"
+               if e.get("target") is not None
+               and e.get("target") != e.get("rank") else "")
         lines.append(f"  fault fired: {e.get('kind')} rank "
-                     f"{e.get('rank')} at {e.get('at')}")
+                     f"{e.get('rank')} at {e.get('at')}{tgt}")
 
     rollup = status.get("rollup")
     if rollup:
