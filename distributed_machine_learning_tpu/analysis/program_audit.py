@@ -183,23 +183,102 @@ def audit_critical_path_collectives(
 def audit_ring_wire_accounting(
     mesh, length: int, schemes: Sequence[str] = ("none", "int8"),
     bucket_bytes: int = 8192, topk_frac: float = 0.125,
-    label: str = "ring_all_reduce",
+    label: str = "ring_all_reduce", topology: str | None = None,
 ) -> tuple[list[Finding], dict]:
     """Compiled collective-permute bytes == static ``ring_wire_bytes``
     accounting, per wire scheme — the telemetry counter's number and
     the executable's number must be the same number (ISSUE 7's CI
     assertion, generalized to every scheme).  Returns
     ``(findings, {scheme: {"hlo_bytes", "static_bytes", "permutes"}})``.
-    """
+
+    ``topology`` ("INNERxOUTER", round 11): audit the hierarchical
+    build instead, PER AXIS — each permute's compiled
+    ``source_target_pairs`` routing is attributed to the inner or
+    outer axis and must equal the static per-axis accounting
+    (``ring_wire_bytes_by_axis``); the known XLA:CPU bf16-widening
+    signature stays an advisory, carried per axis.  Additionally the
+    exact hierarchical build's OUTER-axis (inter-node) bytes must be
+    ≤ (1/inner + 5%) of the exact FLAT ring's total — the DynamiQ
+    multi-hop reduction, proven on the compiled artifact."""
     from distributed_machine_learning_tpu.ops.ring import (
         get_wire_scheme,
         ring_wire_bytes,
+        ring_wire_bytes_by_axis,
     )
 
     n = mesh.shape[mesh.axis_names[0]]
     findings = []
     table: dict = {}
+    topo = None
+    if topology is not None:
+        from distributed_machine_learning_tpu.ops.topology import (
+            Topology,
+            parse_topology,
+        )
+
+        t_inner, t_outer = parse_topology(topology)
+        flat_exact = ring_wire_bytes(length, n, bucket_bytes=bucket_bytes)
     for scheme_name in schemes:
+        if topology is not None:
+            topo = Topology(t_inner, t_outer, outer_scheme=scheme_name,
+                            topk_frac=topk_frac, hd_max_bytes=0)
+            hlo = compile_ring_hlo(mesh, length, compress=scheme_name,
+                                   topk_frac=topk_frac,
+                                   bucket_bytes=bucket_bytes,
+                                   topology=topology, hd_max_bytes=0)
+            got = wire_bytes_from_hlo(hlo, inner=t_inner)
+            want_axes = ring_wire_bytes_by_axis(
+                length, n, bucket_bytes=bucket_bytes, topology=topo)
+            full_width = ring_wire_bytes_by_axis(
+                length, n, bucket_bytes=bucket_bytes,
+                topology=Topology(t_inner, t_outer, hd_max_bytes=0))
+            table[scheme_name] = {"hlo_bytes": got["total_bytes"],
+                                  "hlo_by_axis": got["by_axis"],
+                                  "static_by_axis": want_axes,
+                                  "permutes": got["count"]}
+            for axis in ("inner", "outer"):
+                got_ax = got["by_axis"][axis]
+                want_ax = want_axes[axis]
+                if got_ax == want_ax:
+                    continue
+                widened = got_ax == full_width[axis]
+                findings.append(Finding(
+                    rule=RULE_WIRE_ACCOUNTING, file=label, line=0,
+                    message=(
+                        f"wire scheme {scheme_name!r} ({topology}): "
+                        f"compiled program moves {got_ax} "
+                        f"collective-permute bytes on the {axis} axis "
+                        f"but the static per-axis accounting says "
+                        f"{want_ax}"
+                        + (" — the backend widened the sub-32-bit "
+                           "payload to full 32-bit words (known "
+                           "XLA:CPU behavior); validate the reduction "
+                           "on the TPU target" if widened else
+                           " — the per-axis ring_wire_bytes telemetry "
+                           "counter is lying about the executable")
+                    ),
+                    snippet=f"{scheme_name}@{axis}: hlo={got_ax} "
+                            f"static={want_ax}",
+                    severity="advisory" if widened else "error", layer=2,
+                ))
+            if scheme_name == "none":
+                bound = (1.0 / t_inner + 0.05) * flat_exact
+                if t_inner > 1 and got["by_axis"]["outer"] > bound:
+                    findings.append(Finding(
+                        rule=RULE_WIRE_ACCOUNTING, file=label, line=0,
+                        message=(
+                            f"hierarchical {topology} exact build moves "
+                            f"{got['by_axis']['outer']} outer-axis "
+                            f"(inter-node) bytes — more than "
+                            f"(1/{t_inner} + 5%) of the flat ring's "
+                            f"{flat_exact}-byte total; the multi-hop "
+                            "inter-node reduction has regressed"
+                        ),
+                        snippet=(f"outer={got['by_axis']['outer']} "
+                                 f"flat_total={flat_exact}"),
+                        severity="error", layer=2,
+                    ))
+            continue
         hlo = compile_ring_hlo(mesh, length, compress=scheme_name,
                                topk_frac=topk_frac,
                                bucket_bytes=bucket_bytes)
@@ -341,6 +420,55 @@ def audit_ring_step(mesh, global_batch: int = 16) -> list[Finding]:
     return findings
 
 
+def audit_hier_ring_step(mesh, global_batch: int = 16,
+                         topology: str | None = None) -> list[Finding]:
+    """Round 11: compile the part3 train step under the TOPOLOGY-aware
+    hierarchical ring (int8 outer codec + error feedback — the
+    stateful build, so donation covers the threaded residual too) and
+    hold it to the flat ring's program invariants:
+
+    - donation taken on every state leaf AND the EF residual pytree
+      (the residual is donated argnum 3 — a copied residual would
+      double the EF memory exactly where it is per-device by design);
+    - permute-only: the hierarchical phases (inner reduce-scatter,
+      outer compressed ring, inner all-gather, halving-doubling) must
+      all lower to collective-permutes — an ``all-gather`` appearing on
+      the critical path means phase 3 re-serialized into the monolithic
+      collective the explicit ring exists to replace;
+    - no host callbacks in the jaxpr.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+    from distributed_machine_learning_tpu.train.step import make_train_step
+
+    n = mesh.shape[mesh.axis_names[0]]
+    if topology is None:
+        topology = f"2x{n // 2}" if n % 2 == 0 else f"1x{n}"
+    model, _, state_shape = _vggtest_setup()
+    strategy = get_strategy("ring", compress="int8", topology=topology)
+    step = make_train_step(model, strategy, mesh=mesh, augment=False)
+    x = jax.ShapeDtypeStruct((global_batch, 32, 32, 3), jnp.float32)
+    y = jax.ShapeDtypeStruct((global_batch,), jnp.int32)
+    res = jax.eval_shape(lambda: step.fresh_sync_state(state_shape.params))
+    hlo = step.inner.lower(state_shape, x, y, res).compile().as_text()
+    n_leaves = len(jax.tree_util.tree_leaves(state_shape))
+    n_res = len(jax.tree_util.tree_leaves(res))
+    # Flat entry params: state leaves, then x, y, then the residual.
+    donated = list(range(n_leaves)) + list(
+        range(n_leaves + 2, n_leaves + 2 + n_res))
+    findings = audit_donation(hlo, donated, label="hier_ring_step")
+    findings += audit_critical_path_collectives(
+        hlo, kinds=("all-gather",), label="hier_ring_step",
+        severity="error")
+    findings += audit_step_host_callbacks(
+        step.inner, state_shape, x, y, res, label="hier_ring_step")
+    return findings
+
+
 def audit_zero1_step(mesh, global_batch: int = 16) -> list[Finding]:
     """Compile the OVERLAP-AWARE zero1 train step (the default build
     this audit gates since ISSUE 9) — both phases:
@@ -468,18 +596,26 @@ def audit_fsdp_perlayer_step(mesh, batch: int = 8, seq: int = 16
 
 def run_layer2(mesh=None) -> list[Finding]:
     """The full Layer-2 sweep ``tools/dmlcheck.py --layer2`` runs:
-    ring-step donation/collective/jaxpr audits, the overlap-aware zero1
+    ring-step donation/collective/jaxpr audits (flat AND the round-11
+    topology-aware hierarchical build), the overlap-aware zero1
     two-program audit (DML102 at ERROR severity since ISSUE 9), the
     per-layer-FSDP use-site-gather audit, and the wire-byte accounting
-    for every wire scheme."""
+    for every wire scheme — whole-ring and per-axis."""
     from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 
     if mesh is None:
         mesh = make_mesh(8)
     findings = audit_ring_step(mesh)
+    findings += audit_hier_ring_step(mesh)
     findings += audit_zero1_step(mesh)
     findings += audit_fsdp_perlayer_step(mesh)
     wire_findings, _ = audit_ring_wire_accounting(
         mesh, 4096, schemes=("none", "bf16", "int8", "topk"))
     findings += wire_findings
+    n = mesh.shape[mesh.axis_names[0]]
+    hier_findings, _ = audit_ring_wire_accounting(
+        mesh, 4096, schemes=("none", "bf16", "int8", "topk"),
+        topology=f"2x{n // 2}" if n % 2 == 0 else f"1x{n}",
+        label="hier_ring_all_reduce")
+    findings += hier_findings
     return findings

@@ -189,6 +189,19 @@ _CP_DEF_RE = re.compile(
     r"(?:-start)?\("
 )
 
+# The permute's routing table: ``source_target_pairs={{0,1},{1,2},...}``
+# — the ground truth for attributing a compiled hop to a topology axis.
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
+_PAIR_RE = re.compile(r"\{(\d+),(\d+)\}")
+
+
+def permute_pairs_from_line(line: str) -> list | None:
+    """The ``source_target_pairs`` of one HLO line, or None."""
+    m = _PAIRS_RE.search(line)
+    if not m:
+        return None
+    return [(int(a), int(b)) for a, b in _PAIR_RE.findall(m.group(1))]
+
 
 def _shape_bytes(shape: str) -> int:
     """``'f32[2,4]'`` → 32.  ``'f32[]'`` (scalar) → 4."""
@@ -201,16 +214,33 @@ def _shape_bytes(shape: str) -> int:
     return n * _DTYPE_BYTES[dtype]
 
 
-def wire_bytes_from_hlo(hlo_text: str) -> dict:
+def wire_bytes_from_hlo(hlo_text: str, inner: int | None = None) -> dict:
     """Sum every collective-permute's operand bytes across the module.
 
     Walks ALL computations (not just ENTRY — a while-body ring on some
     backends hides the permutes one call deep) and counts each
     *defining* occurrence once.  Returns ``{"total_bytes", "count",
-    "by_dtype": {prim: bytes}}``."""
+    "by_dtype": {prim: bytes}}``.
+
+    ``inner`` (round 11): also attribute each permute's bytes to a
+    topology axis from its compiled ``source_target_pairs`` routing
+    (``ops.topology.classify_permute_pairs`` over inner-major blocks of
+    that size — imported at call time so this module stays importable
+    without jax, while compiled and static attribution share ONE
+    classifier), adding ``"by_axis": {"inner": bytes, "outer": bytes}``
+    — the per-axis number DML103 pins against the static
+    ``ring_wire_bytes_by_axis`` accounting.  A permute with no routing
+    table (never seen from the jax lowerings audited here) is charged
+    to the outer axis: over-counting the bottleneck link is the safe
+    direction."""
+    if inner is not None:
+        from distributed_machine_learning_tpu.ops.topology import (
+            classify_permute_pairs,
+        )
     total = 0
     count = 0
     by_dtype: dict[str, int] = {}
+    by_axis = {"inner": 0, "outer": 0}
     for line in hlo_text.splitlines():
         m = _CP_DEF_RE.search(line)
         if not m:
@@ -220,17 +250,33 @@ def wire_bytes_from_hlo(hlo_text: str) -> dict:
         count += 1
         prim = m.group(1).split("[")[0]
         by_dtype[prim] = by_dtype.get(prim, 0) + b
-    return {"total_bytes": total, "count": count, "by_dtype": by_dtype}
+        if inner is not None:
+            pairs = permute_pairs_from_line(line)
+            axis = ("outer" if pairs is None
+                    else classify_permute_pairs(pairs, inner))
+            by_axis[axis] += b
+    out = {"total_bytes": total, "count": count, "by_dtype": by_dtype}
+    if inner is not None:
+        out["by_axis"] = by_axis
+    return out
 
 
 def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
                      topk_frac: float = 0.125,
                      bucket_bytes: int | None = None,
-                     mean: bool = True) -> str:
+                     mean: bool = True,
+                     topology: str | None = None,
+                     hd_max_bytes: int | None = None) -> str:
     """jit-compile a bare bucketed ring all-reduce over ``mesh`` and
     return the optimized HLO text — backend-agnostic (the CPU test mesh
     compiles the same collective-permute program shape the TPU target
-    does), so the wire-byte audit can run in CI without libtpu."""
+    does), so the wire-byte audit can run in CI without libtpu.
+
+    ``topology`` ("INNERxOUTER", round 11): compile the hierarchical
+    plan instead — ``compress`` becomes the OUTER axis's codec (the CLI
+    mapping) and ``hd_max_bytes`` overrides the selector's
+    small-bucket threshold (0 pins every bucket to the ring plans, a
+    large value pins them to halving-doubling)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -247,6 +293,25 @@ def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
     axis = mesh.axis_names[0]
     n = mesh.shape[axis]
     scheme = get_wire_scheme(compress, topk_frac=topk_frac)
+    topo = None
+    if topology is not None:
+        from distributed_machine_learning_tpu.ops.topology import (
+            DEFAULT_HD_MAX_BYTES,
+            Topology,
+            parse_topology,
+        )
+
+        inner, outer = parse_topology(topology)
+        if inner * outer != n:
+            raise ValueError(
+                f"topology {topology!r} does not factor the mesh's "
+                f"{n}-device axis"
+            )
+        topo = Topology(
+            inner, outer, outer_scheme=compress, topk_frac=topk_frac,
+            hd_max_bytes=(DEFAULT_HD_MAX_BYTES if hd_max_bytes is None
+                          else hd_max_bytes),
+        )
 
     def per_device(x):
         out = ring_all_reduce(
@@ -254,6 +319,7 @@ def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
             bucket_bytes=(bucket_bytes if bucket_bytes is not None
                           else DEFAULT_BUCKET_BYTES),
             scheme=None if compress == "none" else scheme,
+            topology=topo,
         )
         return out[None]
 

@@ -257,6 +257,20 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                              "--ring-compress int8/topk (ablation only: "
                              "the dropped compression error is then lost "
                              "instead of re-injected next step)")
+    parser.add_argument("--ring-topology", dest="ring_topology",
+                        default=None, metavar="INNERxOUTER",
+                        help="topology-aware hierarchical ring (part3 "
+                             "ring only; ops/topology.py): factor the "
+                             "data axis as INNERxOUTER (e.g. 2x4 = "
+                             "2-chip nodes × 4 nodes; the product must "
+                             "equal the world size) and all-reduce as "
+                             "reduce-scatter on the fast inner axis, a "
+                             "--ring-compress'd ring on the slow outer "
+                             "axis over 1/INNER of the data (inter-node "
+                             "traffic drops ~INNER-fold), all-gather "
+                             "back down; small buckets take a recursive "
+                             "halving-doubling latency path.  A 1-sized "
+                             "axis degenerates to the flat ring")
     parser.add_argument("--dist-eval", dest="dist_eval", action="store_true",
                         help="shard evaluation batches over the mesh "
                              "(pmean/psum reductions) instead of the "
@@ -350,6 +364,16 @@ def parse_flags(parser: argparse.ArgumentParser, argv=None) -> argparse.Namespac
     frac = getattr(args, "ring_topk_frac", 0.125)
     if not 0.0 < frac <= 1.0:
         parser.error(f"--ring-topk-frac must be in (0, 1], got {frac}")
+    if getattr(args, "ring_topology", None):
+        from distributed_machine_learning_tpu.ops.topology import (
+            parse_topology,
+        )
+
+        try:  # malformed/zero-axis specs die at parse time; the
+            # world-equality half runs once the mesh exists (run_part)
+            parse_topology(args.ring_topology)
+        except ValueError as e:
+            parser.error(f"--ring-topology: {e}")
     if args.grad_accum < 1:
         parser.error(f"--grad-accum must be >= 1, got {args.grad_accum}")
     if args.warmup_steps < 0:
@@ -650,6 +674,7 @@ def run_part(
             )
             if ring_compress == "none":
                 ring_compress = "bf16"
+        ring_topology = getattr(args, "ring_topology", None)
         if strategy_name == "ring":
             if ring_compress != "none":
                 strategy_kwargs["compress"] = ring_compress
@@ -659,11 +684,19 @@ def run_part(
                 strategy_kwargs["error_feedback"] = getattr(
                     args, "ring_error_feedback", True
                 )
+            if ring_topology:
+                strategy_kwargs["topology"] = ring_topology
         elif ring_compress != "none":
             rank0_print(
                 "WARNING: --ring-compress/--wire-dtype only apply to the "
                 f"ring strategy (part3); strategy {strategy_name!r} runs "
                 "uncompressed."
+            )
+        if strategy_name != "ring" and ring_topology:
+            rank0_print(
+                "WARNING: --ring-topology only applies to the ring "
+                f"strategy (part3); strategy {strategy_name!r} runs the "
+                "flat collective."
             )
         # Reference part1 prints a torchsummary table before training
         # (part1/main.py:118; the ~9.2M-param total the report leans on).
@@ -672,6 +705,13 @@ def run_part(
         rank0_print(model_summary(state.params, title=args.model))
 
         strategy = get_strategy(strategy_name, **strategy_kwargs)
+        if hasattr(strategy, "topology_for"):
+            # Fail the factorization mismatch HERE — before any data
+            # loading or compilation — with the flag-level message
+            # (inner×outer must equal the mesh world; topology_for is
+            # also what the train step resolves per call, so a passing
+            # check here is the same check the program will use).
+            strategy.topology_for(world)
         if args.resume and getattr(strategy, "stateful", False):
             # The EF residual is per-device step-wrapper state, not part
             # of TrainState: a resumed run starts it at zero (one step
@@ -694,9 +734,18 @@ def run_part(
             n_elems = sum(
                 int(l.size) for l in jax.tree_util.tree_leaves(state.params)
             )
-            telemetry.step_counters["ring_wire_bytes"] = (
-                strategy.wire_bytes_per_step(n_elems, world)
-            )
+            # Split by mesh axis (round 11): the flat ring counts under
+            # {axis="flat"}; a --ring-topology run counts inner
+            # (intra-node) and outer (inter-node) bytes separately so
+            # tools/trace_summary.py can show the bottleneck-link
+            # reduction, not just the total.
+            telemetry.step_counters["ring_wire_bytes"] = [
+                ({"axis": ax}, b)
+                for ax, b in strategy.wire_bytes_by_axis(
+                    n_elems, world
+                ).items()
+                if b
+            ]
             telemetry.registry.gauge("ring_compression_ratio").set(
                 strategy.compression_ratio(n_elems, world)
             )

@@ -228,11 +228,22 @@ def ring_all_reduce_flat(
     wire_dtype=None,
     scheme: WireScheme | None = None,
     return_residual: bool = False,
+    perm: list[tuple[int, int]] | None = None,
+    ring_rank=None,
 ):
     """All-reduce a flat vector via an explicit ppermute ring.
 
     Must be called inside ``shard_map`` (or any context where ``axis_name``
     is bound).  ``axis_size`` is the static ring size (mesh axis length).
+
+    ``perm``/``ring_rank`` (round 11): run the ring over a LOGICAL
+    sub-axis of the bound mesh axis — ``perm`` is the full permutation
+    table (one entry per physical rank; disjoint sub-rings run
+    concurrently in each ppermute) and ``ring_rank`` this rank's traced
+    position within its sub-ring of size ``axis_size``.  Defaults
+    reproduce the flat whole-axis ring.  This is how the hierarchical
+    all-reduce (``ops/topology.py``) reuses the codec + error-feedback
+    machinery verbatim on the slow outer axis.
 
     ``scheme`` (a :class:`WireScheme`): compress every hop's payload —
     reduce-scatter hops dequantize–add–requantize, all-gather hops relay
@@ -272,8 +283,9 @@ def ring_all_reduce_flat(
     chunk = -(-orig_len // n)  # ceil division
     padded = jnp.pad(x, (0, n * chunk - orig_len))
     chunks = padded.reshape(n, chunk)
-    perm = _right_shift_perm(n)
-    rank = lax.axis_index(axis_name)
+    if perm is None:
+        perm = _right_shift_perm(n)
+    rank = lax.axis_index(axis_name) if ring_rank is None else ring_rank
 
     def hop(payload):
         return tuple(lax.ppermute(p, axis_name, perm) for p in payload)
@@ -444,6 +456,7 @@ def ring_all_reduce(
     wire_dtype=None,
     scheme: WireScheme | None = None,
     return_residual: bool = False,
+    topology=None,
 ) -> object:
     """Bucketed ring all-reduce over a gradient pytree.
 
@@ -452,26 +465,52 @@ def ring_all_reduce(
     ``scheme``/``wire_dtype``: optional on-the-wire compression;
     ``return_residual``: also return the per-rank error-feedback
     residual pytree (see :func:`ring_all_reduce_flat`).
+
+    ``topology`` (round 11): an ``ops.topology.Topology`` descriptor —
+    every bucket is dispatched through ``topology.select(bucket_bytes)``
+    to the flat ring, the hierarchical (inner reduce-scatter →
+    compressed outer ring → inner all-gather) path, or the
+    recursive-halving-doubling latency path.  The descriptor carries the
+    per-axis wire schemes, so ``scheme`` is ignored when it is given.
+    ``topology=None`` compiles the exact historical flat-ring program.
     """
     flat, unravel = ravel_pytree(grads)
     if axis_size == 1 or flat.shape[0] == 0:
         if return_residual:
             return grads, jax.tree_util.tree_map(jnp.zeros_like, grads)
         return grads
-    outs = [
-        ring_all_reduce_flat(
-            flat[start:stop],
-            axis_name,
-            axis_size,
-            mean=mean,
-            wire_dtype=wire_dtype,
-            scheme=scheme,
-            return_residual=return_residual,
+    if topology is not None:
+        from distributed_machine_learning_tpu.ops.topology import (
+            topology_all_reduce_flat,
         )
-        for start, stop in _bucket_bounds(
-            flat.shape[0], bucket_bytes, flat.dtype.itemsize
-        )
-    ]
+
+        outs = [
+            topology_all_reduce_flat(
+                flat[start:stop],
+                axis_name,
+                topology,
+                mean=mean,
+                return_residual=return_residual,
+            )
+            for start, stop in _bucket_bounds(
+                flat.shape[0], bucket_bytes, flat.dtype.itemsize
+            )
+        ]
+    else:
+        outs = [
+            ring_all_reduce_flat(
+                flat[start:stop],
+                axis_name,
+                axis_size,
+                mean=mean,
+                wire_dtype=wire_dtype,
+                scheme=scheme,
+                return_residual=return_residual,
+            )
+            for start, stop in _bucket_bounds(
+                flat.shape[0], bucket_bytes, flat.dtype.itemsize
+            )
+        ]
     if return_residual:
         reduced = [o for o, _ in outs]
         residuals = [r for _, r in outs]
@@ -491,6 +530,7 @@ def ring_wire_bytes(
     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
     scheme: WireScheme | None = None,
     itemsize: int = 4,
+    topology=None,
 ) -> int:
     """Static per-device wire bytes of ONE bucketed ring all-reduce:
     ``sum over buckets of 2·(N−1) hops × payload_bytes(chunk)``.
@@ -499,7 +539,17 @@ def ring_wire_bytes(
     counter accumulates per step, and the number the HLO audit
     (``bench/overlap_audit.py --wire-bytes``) verifies against the
     compiled program's actual collective-permute operand shapes.
+
+    ``topology``: total over both axes of the hierarchical plan (see
+    :func:`ring_wire_bytes_by_axis` for the per-axis split).
     """
+    if topology is not None:
+        return sum(
+            ring_wire_bytes_by_axis(
+                n_elems, axis_size, bucket_bytes=bucket_bytes,
+                scheme=scheme, itemsize=itemsize, topology=topology,
+            ).values()
+        )
     if axis_size <= 1 or n_elems <= 0:
         return 0
     scheme = scheme or WireScheme()
@@ -508,3 +558,39 @@ def ring_wire_bytes(
         chunk = -(-(stop - start) // axis_size)
         total += 2 * (axis_size - 1) * scheme.payload_bytes(chunk, itemsize)
     return total
+
+
+def ring_wire_bytes_by_axis(
+    n_elems: int,
+    axis_size: int,
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+    scheme: WireScheme | None = None,
+    itemsize: int = 4,
+    topology=None,
+) -> dict[str, int]:
+    """Per-AXIS static wire bytes — the split the round-11 telemetry
+    counter labels (``ring_wire_bytes{axis=inner|outer|flat}``) carry
+    and the per-axis HLO audit checks against the compiled program.
+
+    Without a topology the flat ring's bytes all ride one undeclared
+    link class: ``{"flat": total}``.  With one, each bucket's plan
+    (``topology.select``) is accounted hop-by-hop and every hop's bytes
+    are attributed by the SAME pair classifier the HLO walker uses
+    (``ops.topology.classify_permute_pairs``): a hop whose
+    permutation crosses an inner block is inter-node (outer-axis)
+    traffic — which for the flat ring on a 2-D topology means ALL of
+    its bytes, exactly the bottleneck the hierarchical plan divides by
+    ``inner``.
+    """
+    if topology is None:
+        return {"flat": ring_wire_bytes(
+            n_elems, axis_size, bucket_bytes=bucket_bytes, scheme=scheme,
+            itemsize=itemsize,
+        )}
+    from distributed_machine_learning_tpu.ops.topology import (
+        topology_wire_bytes,
+    )
+
+    return topology_wire_bytes(
+        n_elems, topology, bucket_bytes=bucket_bytes, itemsize=itemsize,
+    )

@@ -139,6 +139,18 @@ class RingAllReduce(SyncStrategy):
     strategy STATEFUL: the train step threads a per-device residual
     pytree through the compiled program (see ``make_train_step``).
 
+    ``topology`` ("INNERxOUTER", round 11 — ``--ring-topology``): run
+    the topology-aware hierarchical plan (``ops/topology.py``) instead
+    of the flat ring: reduce-scatter on the fast inner axis, the
+    ``compress`` codec's ring on the slow OUTER axis over 1/inner of
+    the data (inter-node traffic drops to ~1/inner of the flat
+    ring's), all-gather back down — with recursive halving-doubling
+    for latency-bound small buckets, per ``Topology.select``.  The
+    factorization must equal the mesh's data-axis world (validated at
+    ``topology_for``); a 1-sized axis degenerates to exactly the flat
+    ring.  Error feedback becomes per-axis but the residuals still sum
+    to the total dropped mass — the stateful protocol is unchanged.
+
     ``wire_dtype="bfloat16"`` is the deprecated spelling of
     ``compress="bf16"``.
     """
@@ -150,6 +162,7 @@ class RingAllReduce(SyncStrategy):
     compress: str = "none"
     topk_frac: float = 0.125
     error_feedback: bool = True
+    topology: str | None = None
 
     def __post_init__(self):
         if self.compress not in WIRE_SCHEMES:
@@ -161,6 +174,12 @@ class RingAllReduce(SyncStrategy):
             raise ValueError(
                 f"topk_frac must be in (0, 1], got {self.topk_frac}"
             )
+        if self.topology is not None:
+            from distributed_machine_learning_tpu.ops.topology import (
+                parse_topology,
+            )
+
+            parse_topology(self.topology)  # format fails fast, pre-mesh
         if self.wire_dtype is not None:
             warnings.warn(
                 "RingAllReduce(wire_dtype=...) is deprecated: use "
@@ -193,6 +212,45 @@ class RingAllReduce(SyncStrategy):
         s = self.scheme()
         return None if s.name == "none" else s
 
+    def topology_for(self, axis_size: int):
+        """The resolved ``ops.topology.Topology`` for this mesh world
+        (None when the strategy is flat).  Raises ValueError when the
+        declared inner×outer does not factor the world — the
+        world-equality half of ``--ring-topology`` validation, run by
+        the CLI before any training starts."""
+        if self.topology is None:
+            return None
+        from distributed_machine_learning_tpu.ops.topology import (
+            Topology,
+            parse_topology,
+        )
+
+        inner, outer = parse_topology(self.topology)
+        if inner * outer != axis_size:
+            examples = (
+                [f"2x{axis_size // 2}"] if axis_size % 2 == 0
+                and axis_size > 2 else []
+            ) + [f"{axis_size}x1"]
+            raise ValueError(
+                f"--ring-topology {self.topology}: inner×outer = "
+                f"{inner * outer} must equal the mesh's data-axis world "
+                f"{axis_size} (e.g. {axis_size} devices factor as "
+                + " or ".join(examples) + ")"
+            )
+        # --ring-compress is the OUTER (inter-node) codec: compress
+        # where the wire is expensive; the intra-node axis stays exact.
+        # EXCEPT outer==1 (one node): the inner axis is then the whole
+        # wire, and the degenerate flat ring must still carry the
+        # user's codec — parking it on the dead outer axis would
+        # silently decompress an Nx1 run.
+        scheme_axis = ("inner_scheme" if outer == 1 and inner > 1
+                       else "outer_scheme")
+        return Topology(
+            inner, outer,
+            topk_frac=self.topk_frac,
+            **{scheme_axis: self.scheme().name},
+        )
+
     def __call__(self, grads, axis_name: str, axis_size: int):
         return ring_all_reduce(
             grads,
@@ -201,6 +259,7 @@ class RingAllReduce(SyncStrategy):
             mean=self.mean,
             bucket_bytes=self.bucket_bytes,
             scheme=self._wire_scheme_or_none(),
+            topology=self.topology_for(axis_size),
         )
 
     def init_state(self, grads):
@@ -230,6 +289,7 @@ class RingAllReduce(SyncStrategy):
             bucket_bytes=self.bucket_bytes,
             scheme=self._wire_scheme_or_none(),
             return_residual=True,
+            topology=self.topology_for(axis_size),
         )
         return synced, new_state
 
@@ -240,11 +300,27 @@ class RingAllReduce(SyncStrategy):
         ``ring_wire_bytes`` telemetry counter's increment)."""
         return ring_wire_bytes(
             n_elems, axis_size, bucket_bytes=self.bucket_bytes,
-            scheme=self.scheme(),
+            scheme=self.scheme(), topology=self.topology_for(axis_size),
+        )
+
+    def wire_bytes_by_axis(self, n_elems: int, axis_size: int) -> dict:
+        """Per-AXIS wire bytes of one step — ``{"flat": total}`` for
+        the flat ring, ``{"inner": ..., "outer": ...}`` under a
+        topology: the increments behind the
+        ``ring_wire_bytes{axis=...}`` telemetry labels."""
+        from distributed_machine_learning_tpu.ops.ring import (
+            ring_wire_bytes_by_axis,
+        )
+
+        return ring_wire_bytes_by_axis(
+            n_elems, axis_size, bucket_bytes=self.bucket_bytes,
+            scheme=self.scheme(), topology=self.topology_for(axis_size),
         )
 
     def compression_ratio(self, n_elems: int, axis_size: int) -> float:
-        """Exact-wire bytes / this scheme's wire bytes (1.0 = exact)."""
+        """Exact FLAT-ring bytes / this build's wire bytes (1.0 =
+        exact flat; under a topology the denominator is the whole
+        hierarchical plan's per-device bytes)."""
         exact = ring_wire_bytes(
             n_elems, axis_size, bucket_bytes=self.bucket_bytes
         )

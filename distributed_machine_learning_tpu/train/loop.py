@@ -191,8 +191,15 @@ def train_epoch(
                                   or {}).items():
                 # Static per-step increments the CLI registered (e.g.
                 # ring_wire_bytes — the compressed ring's per-step wire
-                # bytes, a compile-time constant of the program).
-                reg.counter(_cname).inc(_cval)
+                # bytes, a compile-time constant of the program).  A
+                # list value is labeled sub-counters:
+                # [({"axis": "outer"}, bytes), ...] increments one
+                # counter per label set under the shared name.
+                if isinstance(_cval, (list, tuple)):
+                    for _clabels, _v in _cval:
+                        reg.counter(_cname, **_clabels).inc(_v)
+                else:
+                    reg.counter(_cname).inc(_cval)
             if not warmup:
                 reg.histogram("step_seconds").observe(iter_time)
                 reg.histogram("data_wait_seconds").observe(data_wait_s)

@@ -370,6 +370,15 @@ def test_layer2_real_steps_have_no_errors(mesh8):
     assert zero1 == [], [f.message for f in zero1]
     pl = audit_fsdp_perlayer_step(mesh8)
     assert pl == [], [f.message for f in pl]
+    # Round 11: the topology-aware hierarchical build holds the same
+    # invariants — donation taken on state AND the EF residual,
+    # permute-only, no host callbacks.
+    from distributed_machine_learning_tpu.analysis.program_audit import (
+        audit_hier_ring_step,
+    )
+
+    hier = audit_hier_ring_step(mesh8)
+    assert hier == [], [f.message for f in hier]
 
 
 def test_zero1_sync_baseline_still_flagged(mesh8):
@@ -426,3 +435,18 @@ def test_layer2_wire_accounting_all_schemes(mesh8):
         assert table[scheme]["hlo_bytes"] == table[scheme]["static_bytes"]
     # int8 actually compresses in the artifact that runs.
     assert table["int8"]["hlo_bytes"] * 3 <= table["none"]["hlo_bytes"]
+    # Round 11: the PER-AXIS accounting over the hierarchical build —
+    # compiled inner/outer bytes equal the static split for every
+    # scheme the backend carries, the bf16 widening stays a per-axis
+    # advisory, and the exact build's inter-node bytes clear the
+    # (1/inner + 5%) DynamiQ bound (asserted inside the audit).
+    hfindings, htable = audit_ring_wire_accounting(
+        mesh8, 4096, schemes=("none", "bf16", "int8", "topk"),
+        bucket_bytes=8192, topology="2x4")
+    assert not [f for f in hfindings if f.severity == "error"], (
+        [f.message for f in hfindings])
+    for scheme in ("none", "int8", "topk"):
+        assert htable[scheme]["hlo_by_axis"] \
+            == htable[scheme]["static_by_axis"]
+    assert htable["int8"]["hlo_by_axis"]["outer"] * 3 \
+        <= htable["none"]["hlo_by_axis"]["outer"]

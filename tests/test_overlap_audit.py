@@ -189,6 +189,71 @@ def test_ring_all_gather_bitwise_and_bucketed(mesh8):
         assert permutes == 7 * k, (k, permutes)
 
 
+def test_hier_wire_bytes_per_axis_ci_regression(mesh8):
+    """The round-11 acceptance gate, read off COMPILED executables:
+
+    1. per-axis attribution: every permute's ``source_target_pairs``
+       routing classifies to the inner/outer axis, and the compiled
+       per-axis bytes equal the static ``ring_wire_bytes_by_axis``
+       accounting for none/int8/topk — the labeled telemetry counters
+       and the executable can never drift apart silently;
+    2. the inter-node reduction: the exact hierarchical build's
+       OUTER-axis bytes are ≤ (1/inner + 5%) of the exact FLAT ring's
+       total, for both 2x4 and 4x2 factorizations of the 8-mesh.
+    """
+    from distributed_machine_learning_tpu.ops.ring import (
+        ring_wire_bytes,
+        ring_wire_bytes_by_axis,
+    )
+    from distributed_machine_learning_tpu.ops.topology import Topology
+
+    length, bb = 4096, 8192
+    flat_total = wire_bytes_from_hlo(
+        compile_ring_hlo(mesh8, length, bucket_bytes=bb)
+    )["total_bytes"]
+    assert flat_total == ring_wire_bytes(length, 8, bucket_bytes=bb)
+    for inner, outer in ((2, 4), (4, 2)):
+        spec = f"{inner}x{outer}"
+        for compress in ("none", "int8", "topk"):
+            got = wire_bytes_from_hlo(
+                compile_ring_hlo(mesh8, length, compress=compress,
+                                 bucket_bytes=bb, topology=spec,
+                                 hd_max_bytes=0),
+                inner=inner,
+            )
+            topo = Topology(inner, outer, outer_scheme=compress,
+                            hd_max_bytes=0)
+            want = ring_wire_bytes_by_axis(
+                length, 8, bucket_bytes=bb, topology=topo)
+            assert got["by_axis"] == want, (spec, compress, got, want)
+            if compress == "none":
+                bound = (1.0 / inner + 0.05) * flat_total
+                assert got["by_axis"]["outer"] <= bound, (
+                    spec, got["by_axis"], flat_total)
+
+
+def test_hd_wire_bytes_attribution(mesh8):
+    """The halving-doubling path's compiled permutes attribute by
+    exchange distance: distance-1 exchanges stay intra-node on a
+    2-wide inner axis, distances 2 and 4 cross — and the compiled
+    per-axis bytes equal the static accounting."""
+    from distributed_machine_learning_tpu.ops.ring import (
+        ring_wire_bytes_by_axis,
+    )
+    from distributed_machine_learning_tpu.ops.topology import Topology
+
+    hlo = compile_ring_hlo(mesh8, 256, bucket_bytes=8192, topology="2x4",
+                           hd_max_bytes=1 << 30)
+    got = wire_bytes_from_hlo(hlo, inner=2)
+    topo = Topology(2, 4, hd_max_bytes=1 << 30)
+    want = ring_wire_bytes_by_axis(256, 8, bucket_bytes=8192,
+                                   topology=topo)
+    assert got["by_axis"] == want
+    assert got["by_axis"]["inner"] > 0 and got["by_axis"]["outer"] > 0
+    # 2·log2(8) = 6 exchange steps, each one ppermute.
+    assert got["count"] == 6
+
+
 def test_wire_bytes_ci_regression_int8_vs_exact(mesh8):
     """The fast CI gate (ISSUE 7 satellite): compile a real bucketed
     ring for the 8-device mesh, exact and int8, and assert the

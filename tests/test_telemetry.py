@@ -539,6 +539,36 @@ def test_train_epoch_applies_static_step_counters(tmp_path):
     assert "3,000" in out and "compression ratio        4.00x" in out
 
 
+def test_train_epoch_applies_labeled_step_counters(tmp_path):
+    """Round-11 satellite: a ``step_counters`` entry may be a list of
+    ``(labels, value)`` sub-counters — the per-AXIS ring_wire_bytes
+    split a ``--ring-topology`` run registers — and trace_summary
+    renders the inner/outer breakdown under the ring section."""
+    from distributed_machine_learning_tpu.train.loop import train_epoch
+
+    with Telemetry(tmp_path, flush_every=1) as tel:
+        tel.step_counters["ring_wire_bytes"] = [
+            ({"axis": "inner"}, 800), ({"axis": "outer"}, 200),
+        ]
+        train_epoch(
+            _fake_step, _S(), _img_batches(3),
+            place_batch=lambda x, y: (x, y), max_iters=10,
+            loss_print_every=10**9, telemetry=tel,
+        )
+    snap = json.loads((tmp_path / "registry.json").read_text())
+    wire = {c["labels"]["axis"]: c["value"] for c in snap["counters"]
+            if c["name"] == "ring_wire_bytes"}
+    assert wire == {"inner": 2400, "outer": 600}
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "trace_summary.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, check=True, timeout=60,
+    ).stdout
+    assert "Ring wire compression" in out
+    assert "axis=inner" in out and "axis=outer" in out
+    assert "(80%)" in out and "(20%)" in out
+
+
 def test_train_epoch_token_batches_report_tokens_per_s(tmp_path):
     from distributed_machine_learning_tpu.train.loop import train_epoch
 

@@ -1,0 +1,411 @@
+"""Topology-aware hierarchical collectives (round 11): the 2-D ring
+(``ops/topology.py``) — equivalence vs the flat ring and psum across
+factored worlds, rank-identity under lossy codecs, the 2-D residual
+invariant, the halving-doubling latency path, the auto-selector, and
+the ``--ring-topology`` flag/validation surface."""
+
+import contextlib
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from conftest import shard_map_compat as shard_map
+
+from distributed_machine_learning_tpu.ops.ring import (
+    get_wire_scheme,
+    ring_all_reduce_flat,
+    ring_wire_bytes,
+    ring_wire_bytes_by_axis,
+)
+from distributed_machine_learning_tpu.ops.topology import (
+    HD_LOSSY_MAX_BYTES,
+    Topology,
+    halving_doubling_all_reduce_flat,
+    hierarchical_all_reduce_flat,
+    parse_topology,
+    topology_all_reduce_flat,
+    topology_wire_bytes,
+)
+
+
+def _run(n, fn, data, nout=1):
+    """shard_map a per-device fn over stacked [n, ...] inputs."""
+    from distributed_machine_learning_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh(n)
+    out_specs = P("batch") if nout == 1 else (P("batch"),) * nout
+    f = shard_map(fn, mesh=mesh, in_specs=P("batch"), out_specs=out_specs,
+                  check_vma=False)
+    return jax.jit(f)(jnp.asarray(data))
+
+
+# ---------------------------------------------------------------------------
+# Descriptor surface: parsing, validation, selection.
+# ---------------------------------------------------------------------------
+
+
+def test_parse_topology_spec():
+    assert parse_topology("2x4") == (2, 4)
+    assert parse_topology("2×4") == (2, 4)
+    assert parse_topology(" 8X1 ") == (8, 1)
+    for bad in ("", "2x", "x4", "0x4", "2x0", "axb", "2x4x2", None):
+        with pytest.raises(ValueError):
+            parse_topology(bad)
+
+
+def test_topology_descriptor_validation():
+    t = Topology(2, 4, outer_scheme="int8")
+    assert t.world == 8
+    assert t.axis_scheme("outer").name == "int8"
+    assert t.axis_scheme("inner").name == "none"
+    with pytest.raises(ValueError, match="axes"):
+        Topology(0, 4)
+    with pytest.raises(ValueError, match="scheme"):
+        Topology(2, 4, outer_scheme="fp4")
+
+
+def test_selector_policy():
+    t = Topology(2, 4)  # exact both axes, world 8 (pow2)
+    assert t.select(1024) == "hd"          # small bucket → latency path
+    assert t.select(t.hd_max_bytes) == "hd"
+    assert t.select(t.hd_max_bytes + 1) == "hier"
+    assert t.select(25 * 2**20) == "hier"
+    # A requested codec is only discarded for TRULY tiny buckets.
+    tc = Topology(2, 4, outer_scheme="int8")
+    assert tc.select(HD_LOSSY_MAX_BYTES) == "hd"
+    assert tc.select(HD_LOSSY_MAX_BYTES + 1) == "hier"
+    # Degenerate axes: flat ring, never a crash.
+    assert Topology(1, 8).select(25 * 2**20) == "flat"
+    assert Topology(8, 1).select(25 * 2**20) == "flat"
+    # Non-power-of-two world: no hd path (6 = 2x3).
+    assert Topology(2, 3).select(64) == "hier"
+    assert Topology(1, 1).select(64) == "flat"
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical all-reduce: equivalence + rank identity.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world,inner,outer",
+                         [(4, 2, 2), (8, 2, 4), (8, 4, 2)])
+def test_hier_matches_pmean_and_rank_identical(world, inner, outer, rng):
+    """Exact hierarchical == lax.pmean across every factored world, and
+    every rank ends with identical bits (the chunks are relayed
+    verbatim down the inner axis)."""
+    topo = Topology(inner, outer)
+    data = rng.standard_normal((world, 1000)).astype(np.float32)
+
+    def per_device(x):
+        x = x.reshape(-1)
+        ours = hierarchical_all_reduce_flat(x, "batch", topo, mean=True)
+        theirs = lax.pmean(x, "batch")
+        return ours[None], (ours - theirs)[None]
+
+    out, diff = _run(world, per_device, data, nout=2)
+    np.testing.assert_allclose(np.asarray(diff), 0.0, atol=1e-5)
+    out = np.asarray(out)
+    for d in range(1, world):
+        np.testing.assert_array_equal(out[d], out[0])
+
+
+def test_hier_bitwise_equals_flat_for_exact_scheme(rng):
+    """ISSUE acceptance: hierarchical ≡ flat BIT-FOR-BIT for the exact
+    scheme.  Summation association differs between the plans, so the
+    property is asserted on integer-valued gradients, where every
+    partial sum is exactly representable and association cannot change
+    the bits — the regime where 'bitwise' is a meaningful contract."""
+    n = 8
+    topo = Topology(2, 4)
+    data = rng.integers(-8, 8, (n, 300)).astype(np.float32)
+    hier = _run(n, lambda x: hierarchical_all_reduce_flat(
+        x.reshape(-1), "batch", topo, mean=True)[None], data)
+    flat = _run(n, lambda x: ring_all_reduce_flat(
+        x.reshape(-1), "batch", n, mean=True)[None], data)
+    np.testing.assert_array_equal(np.asarray(hier), np.asarray(flat))
+
+
+@pytest.mark.parametrize("world,inner,outer,scheme",
+                         [(8, 2, 4, "int8"), (8, 4, 2, "topk"),
+                          (4, 2, 2, "int8")])
+def test_hier_lossy_outer_rank_identical_and_bounded(world, inner, outer,
+                                                     scheme, rng):
+    """Lossy outer codec: all ranks END WITH IDENTICAL BITS (encoded
+    payloads relayed verbatim through both gather phases) and the value
+    stays within accumulated quantization error of the exact mean —
+    replicated params cannot drift under the hierarchical plan."""
+    topo = Topology(inner, outer, outer_scheme=scheme, topk_frac=1.0)
+    data = rng.standard_normal((world, 513)).astype(np.float32)
+    out = np.asarray(_run(world, lambda x: hierarchical_all_reduce_flat(
+        x.reshape(-1), "batch", topo, mean=True)[None], data))
+    for d in range(1, world):
+        np.testing.assert_array_equal(out[d], out[0])
+    exact = data.sum(axis=0) / world
+    tol = 0.05 if scheme == "int8" else 1e-4  # topk@frac=1 sends all
+    assert np.max(np.abs(out[0] - exact)) <= tol
+
+
+@pytest.mark.parametrize("schemes", [
+    {"outer_scheme": "int8"},
+    {"inner_scheme": "int8", "outer_scheme": "int8"},
+    {"inner_scheme": "topk", "outer_scheme": "int8"},
+])
+def test_hier_residual_accounts_total_dropped_mass(schemes, rng):
+    """The 2-D residual invariant (ISSUE satellite): with codecs on the
+    outer axis, both axes, or mixed, the per-axis residuals summed over
+    ALL ranks equal N × (exact mean − output) — every dropped byte
+    lands in exactly one rank's residual: inner reduce-scatter send
+    errors, the outer sub-ring's own EF bookkeeping, and the
+    inner-gather broadcast gap × inner at each node's owner."""
+    n, L = 4, 192
+    topo = Topology(2, 2, topk_frac=0.2, **schemes)
+    data = rng.standard_normal((n, L)).astype(np.float32)
+
+    def per_device(v):
+        out, res = hierarchical_all_reduce_flat(
+            v.reshape(-1), "batch", topo, mean=True, return_residual=True
+        )
+        return out[None], res[None]
+
+    out, res = _run(n, per_device, data, nout=2)
+    out, res = np.asarray(out), np.asarray(res)
+    exact_mean = data.sum(axis=0) / n
+    np.testing.assert_allclose(
+        res.sum(axis=0), n * (exact_mean - out[0]), rtol=1e-4, atol=1e-4
+    )
+
+
+# ---------------------------------------------------------------------------
+# Halving-doubling latency path.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world", [4, 8])
+def test_halving_doubling_matches_pmean_and_rank_identical(world, rng):
+    data = rng.standard_normal((world, 999)).astype(np.float32)
+
+    def per_device(x):
+        x = x.reshape(-1)
+        ours = halving_doubling_all_reduce_flat(x, "batch", world,
+                                                mean=True)
+        return ours[None], (ours - lax.pmean(x, "batch"))[None]
+
+    out, diff = _run(world, per_device, data, nout=2)
+    np.testing.assert_allclose(np.asarray(diff), 0.0, atol=1e-5)
+    out = np.asarray(out)
+    # Each chunk's sum is computed once at its owner and broadcast
+    # verbatim: bitwise rank identity.
+    for d in range(1, world):
+        np.testing.assert_array_equal(out[d], out[0])
+
+
+def test_halving_doubling_rejects_non_power_of_two():
+    with pytest.raises(ValueError, match="power-of-two"):
+        halving_doubling_all_reduce_flat(
+            jnp.zeros((12,)), "batch", 6, mean=True
+        )
+
+
+# ---------------------------------------------------------------------------
+# Degenerate topologies (the bugfix satellite): 1-sized axis == flat.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("spec,scheme_axis", [((1, 8), "outer"),
+                                              ((8, 1), "inner")])
+def test_degenerate_axis_is_flat_ring(spec, scheme_axis, rng):
+    """``--ring-topology 1x8`` / ``8x1`` must degenerate to exactly the
+    round-7 flat compressed ring — bit-for-bit, with the live axis's
+    codec — not crash."""
+    inner, outer = spec
+    topo = Topology(inner, outer, hd_max_bytes=0,
+                    **{f"{scheme_axis}_scheme": "int8"})
+    n = 8
+    data = rng.standard_normal((n, 100)).astype(np.float32)
+    a = _run(n, lambda x: topology_all_reduce_flat(
+        x.reshape(-1), "batch", topo, mean=True)[None], data)
+    b = _run(n, lambda x: ring_all_reduce_flat(
+        x.reshape(-1), "batch", n, mean=True,
+        scheme=get_wire_scheme("int8"))[None], data)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# Static per-axis wire accounting (host arithmetic, no compiles).
+# ---------------------------------------------------------------------------
+
+
+def test_topology_wire_bytes_static():
+    """Hand-checked per-axis accounting: the hierarchical plan's inner
+    axis carries 2·(inner−1) hops of L/inner; the outer axis
+    2·(outer−1) hops of L/(inner·outer) through the outer codec; a
+    flat plan's bytes land on the outer axis (any hop crosses nodes);
+    halving-doubling splits by exchange distance."""
+    L, bb = 4096, 8192  # two 2048-elem buckets (8192 B of fp32 each)
+    topo = Topology(2, 4, hd_max_bytes=0)
+    got = topology_wire_bytes(L, topo, bucket_bytes=bb)
+    assert got == {"inner": 2 * (2 * 1 * 1024 * 4),
+                   "outer": 2 * (2 * 3 * 256 * 4)}
+    # int8 outer: chunk + 4 scale bytes per hop, per bucket.
+    topo8 = Topology(2, 4, outer_scheme="int8", hd_max_bytes=0)
+    assert topology_wire_bytes(L, topo8, bucket_bytes=bb)["outer"] \
+        == 2 * (2 * 3 * (256 + 4))
+    # The flat plan under a 2-D topology: ALL bytes are inter-node
+    # exposure (the block-edge ranks push every hop across nodes).
+    flat = Topology(1, 8, hd_max_bytes=0)
+    assert topology_wire_bytes(L, flat, bucket_bytes=bb) == {
+        "inner": 0, "outer": ring_wire_bytes(L, 8, bucket_bytes=bb)}
+    one_node = Topology(8, 1, hd_max_bytes=0)
+    assert topology_wire_bytes(L, one_node, bucket_bytes=bb) == {
+        "inner": ring_wire_bytes(L, 8, bucket_bytes=bb), "outer": 0}
+    # hd (2x4, chunk=64 elems): distance-1 exchanges stay inside the
+    # 2-wide blocks (inner); distances 2 and 4 cross (outer).
+    hd = Topology(2, 4, hd_max_bytes=1 << 30)
+    got = topology_wire_bytes(512, hd, bucket_bytes=bb)
+    assert got == {"inner": 2 * 4 * 64 * 4,
+                   "outer": 2 * (2 + 1) * 64 * 4}
+    # ring_wire_bytes(topology=...) is the sum of the axes; the by-axis
+    # helper without a topology keeps the flat label.
+    assert ring_wire_bytes(L, 8, bucket_bytes=bb, topology=topo) \
+        == sum(topology_wire_bytes(L, topo, bucket_bytes=bb).values())
+    assert ring_wire_bytes_by_axis(L, 8, bucket_bytes=bb) == {
+        "flat": ring_wire_bytes(L, 8, bucket_bytes=bb)}
+
+
+# ---------------------------------------------------------------------------
+# Strategy + CLI surface.
+# ---------------------------------------------------------------------------
+
+
+def test_ring_strategy_topology_validation():
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+
+    with pytest.raises(ValueError, match="INNERxOUTER"):
+        get_strategy("ring", topology="garbage")
+    s = get_strategy("ring", compress="int8", topology="2x4")
+    assert s.stateful  # EF protocol unchanged under a topology
+    with pytest.raises(ValueError, match="must equal the mesh"):
+        s.topology_for(6)
+    topo = s.topology_for(8)
+    assert (topo.inner, topo.outer) == (2, 4)
+    assert topo.outer_scheme == "int8"  # --ring-compress maps to OUTER
+    assert topo.inner_scheme == "none"
+    # Per-axis accounting surface the telemetry counters consume.
+    by_axis = s.wire_bytes_by_axis(100_000, 8)
+    assert set(by_axis) == {"inner", "outer"}
+    assert s.wire_bytes_per_step(100_000, 8) == sum(by_axis.values())
+    flat = get_strategy("ring")
+    assert set(flat.wire_bytes_by_axis(100_000, 8)) == {"flat"}
+
+
+def test_cli_ring_topology_flag():
+    """Bugfix satellite: invalid factorizations die at PARSE time with
+    a flag-level message; the world-equality half fails before any
+    training once the mesh is known (topology_for)."""
+    from distributed_machine_learning_tpu.cli.common import (
+        make_flag_parser,
+        parse_flags,
+    )
+
+    parser = make_flag_parser("test")
+    args = parse_flags(parser, ["--ring-topology", "2x4"])
+    assert args.ring_topology == "2x4"
+    assert parse_flags(parser, []).ring_topology is None
+    for bad in ("2x", "0x4", "x", "2x4x2"):
+        with pytest.raises(SystemExit), \
+                contextlib.redirect_stderr(io.StringIO()):
+            parse_flags(parser, ["--ring-topology", bad])
+
+
+def test_train_step_hier_int8_ef_threads_residual(mesh8, rng):
+    """The full vertical: make_train_step with the topology-aware
+    int8+EF ring keeps the (state, x, y) signature, threads the donated
+    per-device residual, and the residual is NONZERO (the lossy outer
+    ring ran — the selector did not silently reroute the whole gradient
+    down the exact latency path)."""
+    from distributed_machine_learning_tpu.cli.common import (
+        init_model_and_state,
+    )
+    from distributed_machine_learning_tpu.models.registry import get_model
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+    from distributed_machine_learning_tpu.train.sgd import SGDConfig
+    from distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+        shard_batch,
+    )
+
+    model = get_model("vggtest", use_bn=False)
+    strategy = get_strategy("ring", compress="int8", topology="2x4")
+    state = init_model_and_state(
+        model, config=SGDConfig(learning_rate=0.1, weight_decay=0.0)
+    )
+    step = make_train_step(model, strategy, mesh=mesh8, augment=False)
+    for _ in range(2):
+        x = rng.integers(0, 256, (32, 32, 32, 3), dtype=np.uint8)
+        y = rng.integers(0, 10, 32).astype(np.int32)
+        state, loss = step(state, *shard_batch(mesh8, x, y))
+    assert np.isfinite(float(loss))
+    res = step.sync_state()
+    leaves = jax.tree_util.tree_leaves(res)
+    assert leaves and leaves[0].shape[0] == 8
+    assert any(float(jnp.max(jnp.abs(l))) > 0 for l in leaves)
+    for p in jax.tree_util.tree_leaves(state.params):
+        assert bool(jnp.all(jnp.isfinite(p)))
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: 40-iter fixed-seed parity (slow).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_hier_int8_ef_acceptance_parity(mesh8, rng):
+    """Round-11 acceptance: over the 40-iteration fixed-seed protocol,
+    the hierarchical int8+EF ring's final loss is within 1% relative of
+    the exact FLAT ring's — compression moved to the multi-hop plan
+    without moving the trajectory."""
+    from distributed_machine_learning_tpu.cli.common import (
+        init_model_and_state,
+    )
+    from distributed_machine_learning_tpu.models.registry import get_model
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+    from distributed_machine_learning_tpu.train.sgd import SGDConfig
+    from distributed_machine_learning_tpu.train.step import (
+        make_train_step,
+        shard_batch,
+    )
+
+    model = get_model("vggtest", use_bn=False)
+    batches = [
+        (rng.integers(0, 256, (64, 32, 32, 3), dtype=np.uint8),
+         rng.integers(0, 10, 64).astype(np.int32))
+        for _ in range(40)
+    ]
+
+    def final_loss(strategy):
+        state = init_model_and_state(
+            model, config=SGDConfig(learning_rate=0.1, weight_decay=0.0)
+        )
+        step = make_train_step(model, strategy, mesh=mesh8, augment=False)
+        loss = None
+        for x, y in batches:
+            state, loss = step(state, *shard_batch(mesh8, x, y))
+        return float(loss)
+
+    exact = final_loss(get_strategy("ring"))
+    hier = final_loss(
+        get_strategy("ring", compress="int8", topology="2x4")
+    )
+    rel = abs(hier - exact) / abs(exact)
+    assert rel <= 0.01, (hier, exact, rel)

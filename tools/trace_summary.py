@@ -196,6 +196,21 @@ def summarize(telemetry_dir: str, top: int = 5) -> str:
             r = ratio[0].get("value") if ratio else None
             lines.append("== Ring wire compression ==")
             lines.append(f"  wire bytes (whole run)   {total:,.0f}")
+            # Per-axis split (round 11): a --ring-topology run labels
+            # the counter {axis=inner|outer}; the outer (inter-node)
+            # share is the link the hierarchy exists to relieve.  Flat
+            # runs carry {axis=flat} and skip the breakdown.
+            by_axis = {}
+            for c in wire:
+                ax = (c.get("labels") or {}).get("axis", "flat")
+                by_axis[ax] = by_axis.get(ax, 0) + c.get("value", 0)
+            if set(by_axis) - {"flat"} and total:
+                for ax in ("inner", "outer", "flat"):
+                    if ax in by_axis:
+                        lines.append(
+                            f"    axis={ax:<6} {by_axis[ax]:>14,.0f}  "
+                            f"({100 * by_axis[ax] / total:.0f}%)"
+                        )
             if r:
                 lines.append(f"  compression ratio        {r:.2f}x "
                              f"(exact/compressed)")
