@@ -751,6 +751,77 @@ def check_hard_exit_scope(ctx: FileContext) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# DML012 — socket/HTTP IO without an explicit timeout (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+_TIMEOUT_TOKENS = ("settimeout(", "setdefaulttimeout(")
+
+
+def _runtime_scope(path: str) -> bool:
+    return f"{PACKAGE_DIR}/runtime/" in path or path.startswith("tools/")
+
+
+@_rule(
+    "DML012", "socket/HTTP call without an explicit timeout",
+    "ISSUE 12: the TCP gang transport is the control plane a BLOCKED "
+    "rank escapes through — a monitor thread hung in an unbounded "
+    "connect/recv can neither detect peers nor join an abort, turning "
+    "one lost packet into a wedged gang.",
+    _runtime_scope,
+)
+def check_socket_timeouts(ctx: FileContext) -> Iterator[Finding]:
+    """Under ``runtime/`` and ``tools/``: (a)
+    ``socket.create_connection`` needs its timeout argument (second
+    positional or ``timeout=``); (b) ``urlopen`` and
+    ``http.client.HTTP(S)Connection`` need ``timeout=``; (c) a
+    function that constructs a raw ``socket.socket`` must call
+    ``settimeout`` (or ``socket.setdefaulttimeout``) somewhere in its
+    body — every blocking socket op in the gang control plane must be
+    bounded."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        tail = name.split(".")[-1]
+        has_timeout_kw = any(k.arg == "timeout" for k in node.keywords)
+        if tail == "create_connection" and not (
+                len(node.args) >= 2 or has_timeout_kw):
+            yield ctx.finding(
+                "DML012", node,
+                "socket.create_connection without a timeout — an "
+                "unreachable gang server must fail the op (retry/"
+                "backoff path), not hang the monitor thread",
+            )
+        elif tail == "urlopen" and not has_timeout_kw:
+            yield ctx.finding(
+                "DML012", node,
+                "urlopen without timeout= — unbounded HTTP IO in the "
+                "runtime/tools layer",
+            )
+        elif tail in ("HTTPConnection", "HTTPSConnection") \
+                and not has_timeout_kw:
+            yield ctx.finding(
+                "DML012", node,
+                f"{tail} without timeout= — unbounded HTTP IO in the "
+                "runtime/tools layer",
+            )
+    for fn in _functions(ctx.tree):
+        body_src = "\n".join(ctx.seg(s) for s in fn.body)
+        if any(tok in body_src for tok in _TIMEOUT_TOKENS):
+            continue
+        for node in _walk_scope(fn.body, skip_functions=True):
+            if (isinstance(node, ast.Call)
+                    and _call_name(node) == "socket.socket"):
+                yield ctx.finding(
+                    "DML012", node,
+                    f"{fn.name}() constructs a raw socket but never "
+                    "calls settimeout — every blocking socket op in "
+                    "the gang control plane must be bounded",
+                )
+                break
+
+
+# ---------------------------------------------------------------------------
 # Runner
 # ---------------------------------------------------------------------------
 

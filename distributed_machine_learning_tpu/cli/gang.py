@@ -180,6 +180,26 @@ def main(argv=None) -> int:
                     dest="straggler_consecutive", type=int, default=3,
                     help="consecutive over-threshold observations "
                          "before a straggler verdict")
+    ap.add_argument("--gang-transport", dest="gang_transport",
+                    default="file", choices=("file", "inproc", "tcp"),
+                    help="control-plane backend (runtime/transport.py): "
+                         "'file' = shared-directory channels in "
+                         "--gang-dir (default, on-disk format "
+                         "unchanged); 'inproc' = THREAD workers over "
+                         "in-memory channels — no subprocess spawn, so "
+                         "64-128-rank chaos campaigns run in seconds "
+                         "(durable ledgers still mirror into "
+                         "--gang-dir for gang_status; workers share "
+                         "ONE checkpoint dir, rank 0 saves); 'tcp' = "
+                         "this launcher hosts the gang server and "
+                         "workers connect with per-op timeouts, "
+                         "retry+backoff, and idempotent delivery")
+    ap.add_argument("--tx-chaos", dest="tx_chaos", default=None,
+                    help="transport-level fault injection forwarded to "
+                         "tcp workers (runtime/gang_worker.py): "
+                         "'partition@RANK:AFTER_OPS' severs that "
+                         "original rank's channel on attempt 0 — the "
+                         "connection-loss-is-peer-death chaos proof")
     args = ap.parse_args(argv)
     if args.workers < 1:
         ap.error(f"--workers must be >= 1, got {args.workers}")
@@ -210,6 +230,11 @@ def main(argv=None) -> int:
                  "(--straggler-policy replace) boundary")
     if args.replace_after < 1:
         ap.error(f"--replace-after must be >= 1, got {args.replace_after}")
+    if args.tx_chaos and args.gang_transport != "tcp":
+        ap.error("--tx-chaos injects at the transport send boundary, "
+                 "which only the lossy tcp backend has — it would "
+                 "silently never fire under "
+                 f"--gang-transport {args.gang_transport}")
 
     from distributed_machine_learning_tpu.runtime.faults import (
         FaultEvents,
@@ -313,14 +338,78 @@ def main(argv=None) -> int:
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
         _pkg.__file__
     )))
+
+    # -- control-plane backend (ISSUE 12) -------------------------------
+    server = None
+    transport = None
+    ckpt_dirs = [os.path.join(args.ckpt_dir, f"rank{r}")
+                 for r in range(args.workers + args.spares)]
+    if args.gang_transport == "tcp":
+        # The launcher hosts the gang server (on a pod: rank 0 / the
+        # controller); workers get its address on their argv.  The
+        # supervisor talks to its OWN server hub directly — it must
+        # never compete with the workers for its socket.  Durable
+        # ledgers mirror into --gang-dir for post-mortem tooling.
+        from distributed_machine_learning_tpu.runtime.transport import (
+            TcpGangServer,
+        )
+
+        server = TcpGangServer(mirror_dir=args.gang_dir).start()
+        transport = server.local_transport(events=events)
+        base_worker_cmd = worker_cmd
+
+        def worker_cmd(rank, attempt, world, orig_rank):  # noqa: F811
+            cmd = base_worker_cmd(rank, attempt, world, orig_rank) + [
+                "--gang-transport", "tcp", "--gang-addr", server.address,
+            ]
+            if args.tx_chaos:
+                cmd += ["--tx-chaos", args.tx_chaos]
+            return cmd
+
+        base_spare_cmd = spare_cmd
+
+        def spare_cmd(orig_rank, attempt):  # noqa: F811
+            return base_spare_cmd(orig_rank, attempt) + [
+                "--gang-transport", "tcp", "--gang-addr", server.address,
+            ]
+    elif args.gang_transport == "inproc":
+        # Thread ranks over in-memory channels: the 64-128-rank
+        # campaign mode.  One SHARED checkpoint directory (replicated
+        # dp state; rank 0 saves, the commit broadcasts over the hub),
+        # durable ledgers mirrored into --gang-dir so gang_status and
+        # the consumption audit read the run like any file gang.
+        from distributed_machine_learning_tpu.runtime.inproc_worker import (
+            InprocGangConfig,
+            inproc_worker_cmds,
+        )
+        from distributed_machine_learning_tpu.runtime.transport import (
+            InProcHub,
+            InProcTransport,
+        )
+
+        hub = InProcHub(mirror_dir=args.gang_dir)
+        transport = InProcTransport(hub, events=events)
+        cfg = InprocGangConfig(
+            ckpt_dir=args.ckpt_dir, steps=args.steps,
+            save_every=args.save_every, global_batch=args.global_batch,
+            scaling_rule=args.scaling_rule, base_world=args.workers,
+            base_lr=args.base_lr, feature_dim=args.feature_dim,
+            heartbeat_interval=min(args.heartbeat_interval, 0.1),
+            peer_timeout=min(args.peer_timeout, 5.0),
+            faults=args.faults,
+        )
+        worker_cmd, spare_cmd = inproc_worker_cmds(cfg, hub)
+        ckpt_dirs = args.ckpt_dir  # shared: one dir for the whole gang
+        os.makedirs(args.ckpt_dir, exist_ok=True)
+
     try:
         final_codes = gang_supervise(
             worker_cmd, args.workers, args.gang_dir,
-            # Spares hold original ids just past the launch world and
-            # prefetch into their own rank<orig> dirs, so the dir list
-            # covers workers AND spares.
-            ckpt_dirs=[os.path.join(args.ckpt_dir, f"rank{r}")
-                       for r in range(args.workers + args.spares)],
+            # Per-rank layout: spares hold original ids just past the
+            # launch world and prefetch into their own rank<orig> dirs,
+            # so the dir list covers workers AND spares.  The in-proc
+            # campaign mode passes ONE shared directory instead.
+            ckpt_dirs=ckpt_dirs,
             max_restarts=args.max_restarts,
             rank_restart_budget=args.rank_restart_budget,
             min_world=args.min_world if args.min_world > 0 else None,
@@ -332,12 +421,15 @@ def main(argv=None) -> int:
             log_dir=os.path.join(args.gang_dir, "logs"),
             straggler_multiple=args.straggler_multiple,
             straggler_consecutive=args.straggler_consecutive,
+            transport=transport,
         )
     except GangFailure as e:
         print(f"gang failed: {e}", file=sys.stderr, flush=True)
         print(resilience_summary(events), flush=True)
         return 1
     finally:
+        if server is not None:
+            server.stop()
         if telemetry is not None:
             telemetry.close()
     final_world = len(final_codes)

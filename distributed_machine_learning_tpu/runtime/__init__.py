@@ -1,16 +1,38 @@
-from distributed_machine_learning_tpu.runtime.mesh import make_mesh, BATCH_AXIS
-from distributed_machine_learning_tpu.runtime.distributed import (
-    initialize_from_flags,
-    DistributedContext,
-)
-from distributed_machine_learning_tpu.runtime.coordinator import (
-    GANG_ABORT_EXIT,
-    GangCoordinator,
-    elect_restore_step,
-)
+"""Runtime package exports — lazy on purpose (ISSUE 12).
 
-__all__ = [
-    "make_mesh", "BATCH_AXIS", "initialize_from_flags",
-    "DistributedContext", "GangCoordinator", "GANG_ABORT_EXIT",
-    "elect_restore_step",
-]
+``runtime.transport`` and ``runtime.coordinator`` are stdlib-only by
+contract (the ``tools/`` layer imports them against a dead run's
+directory on hosts without jax); an eager ``from .mesh import ...``
+here would drag jax into every such import.  PEP 562 module
+``__getattr__`` keeps the public ``from ...runtime import make_mesh``
+surface identical while deferring the jax-heavy submodules until a
+name is actually touched.
+"""
+
+import importlib
+
+_EXPORTS = {
+    "make_mesh": ".mesh",
+    "BATCH_AXIS": ".mesh",
+    "initialize_from_flags": ".distributed",
+    "DistributedContext": ".distributed",
+    "GangCoordinator": ".coordinator",
+    "GANG_ABORT_EXIT": ".coordinator",
+    "elect_restore_step": ".coordinator",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    return getattr(importlib.import_module(module, __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -281,7 +281,8 @@ def _as_dirs(ckpt_dirs) -> list[str]:
 
 
 def elect_restore_step(gang_dir: str | os.PathLike, world: int,
-                       ckpt_dirs=None, ranks=None) -> int | None:
+                       ckpt_dirs=None, ranks=None,
+                       transport=None) -> int | None:
     """The highest checkpoint step EVERY rank has verified (the
     intersection of all restore-point records), or None when no common
     step exists — the gang then starts from scratch / whatever the
@@ -298,11 +299,17 @@ def elect_restore_step(gang_dir: str | os.PathLike, world: int,
     SURVIVORS only — a permanently lost rank can never verify anything
     again, and demanding its vote would strand the gang at step None
     forever.
+
+    ``transport``: a ``runtime/transport.py::GangTransport`` to read
+    the records through (the pluggable control plane); None keeps the
+    historical direct-file read of ``gang_dir``.
     """
-    gang_dir = os.fspath(gang_dir)
+    gang_dir = os.fspath(gang_dir) if gang_dir is not None else None
     common: set[int] | None = None
     for rank in (range(world) if ranks is None else ranks):
-        steps = read_restore_record(gang_dir, rank)
+        steps = (transport.read_restore_record(rank)
+                 if transport is not None
+                 else read_restore_record(gang_dir, rank))
         if steps is None:
             return None  # a rank with no record can't agree on anything
         common = steps if common is None else (common & steps)
@@ -390,14 +397,23 @@ class GangCoordinator:
     own progress stalls past the timeout (the stalled rank usually
     notices first: its monitor thread keeps running while the main
     thread sleeps/hangs).
+
+    ``transport`` (ISSUE 12): a ``runtime/transport.py::GangTransport``
+    carrying every channel above; None builds the historical file
+    backend over ``gang_dir`` (byte-identical layout).  With a lossy
+    transport (TCP), a persistent ``TransportError`` streak longer
+    than ``peer_timeout_s`` is treated as THIS rank being partitioned
+    off the gang — peer death seen from the inside — and aborts the
+    process just like a dead peer would.
     """
 
-    def __init__(self, gang_dir: str | os.PathLike, rank: int, world: int,
+    def __init__(self, gang_dir: str | os.PathLike | None, rank: int,
+                 world: int,
                  *, heartbeat_interval_s: float = 1.0,
                  peer_timeout_s: float = 30.0,
                  exit_code: int = GANG_ABORT_EXIT,
                  events=None, check_self: bool = True, on_abort=None,
-                 metrics_window: int = 8):
+                 metrics_window: int = 8, transport=None):
         if world < 1:
             raise ValueError(f"world must be >= 1, got {world}")
         if not 0 <= rank < world:
@@ -413,8 +429,19 @@ class GangCoordinator:
                 f"heartbeat intervals ({heartbeat_interval_s} each): a "
                 "single delayed write would otherwise read as a death"
             )
-        self.gang_dir = os.fspath(gang_dir)
-        os.makedirs(self.gang_dir, exist_ok=True)
+        if gang_dir is None and transport is None:
+            raise ValueError("a coordinator needs gang_dir or transport")
+        self.gang_dir = os.fspath(gang_dir) if gang_dir is not None \
+            else None
+        if transport is None:
+            from distributed_machine_learning_tpu.runtime.transport import (
+                FileTransport,
+            )
+
+            transport = FileTransport(self.gang_dir, events=events)
+        elif self.gang_dir is not None:
+            os.makedirs(self.gang_dir, exist_ok=True)
+        self.transport = transport
         self.rank = rank
         self.world = world
         self.heartbeat_interval_s = heartbeat_interval_s
@@ -446,10 +473,15 @@ class GangCoordinator:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._write_lock = threading.Lock()
-        # peer -> ((mtime_ns, size), monotonic time this monitor first
-        # saw that signature) — the skew-free staleness basis.
-        self._peer_seen: dict[int, tuple[tuple[int, int], float]] = {}
+        # peer -> (beat signature, monotonic time this monitor first
+        # saw that signature) — the skew-free staleness basis.  The
+        # signature is transport-opaque (file: (mtime_ns, size); hub: a
+        # version counter).
+        self._peer_seen: dict[int, tuple[object, float]] = {}
         self._started_at = time.monotonic()
+        # Monotonic instant the transport started failing (None =
+        # healthy): the partition-is-peer-death escalation clock.
+        self._tx_down_since: float | None = None
 
     # -- liveness/progress surface --------------------------------------
     def beat(self, step: int | None = None) -> None:
@@ -495,13 +527,18 @@ class GangCoordinator:
     def peer_state(self, peer: int) -> dict | None:
         """The peer's latest heartbeat payload, or None (never wrote /
         torn write)."""
-        try:
-            with open(_beat_path(self.gang_dir, peer)) as f:
-                return json.load(f)
-        except (OSError, json.JSONDecodeError):
-            return None
+        from distributed_machine_learning_tpu.runtime.transport import (
+            TransportError,
+        )
 
-    def wait_for_peers(self, step: int, poll_s: float = 0.05) -> bool:
+        try:
+            entry = self.transport.read_beat(peer)
+        except TransportError:
+            return None
+        return entry[1] if entry is not None else None
+
+    def wait_for_peers(self, step: int, poll_s: float | None = None,
+                       stop=None) -> bool:
         """Block until every peer's published step reaches ``step`` (or
         the peer finished its run) — a lock-step barrier over the beat
         directory.
@@ -515,15 +552,34 @@ class GangCoordinator:
         time spent starved at the barrier is exactly what the detector
         must judge.  Returns False only in test mode (``on_abort`` set)
         once an abort was observed; production never returns False
-        (the abort exits the process)."""
+        (the abort exits the process).
+
+        ``poll_s`` defaults to the transport's barrier cadence; the
+        read is BATCHED (one ``read_beats`` per poll for the whole
+        gang, not one per peer — at world 128 over TCP the difference
+        is the rank-0 host's life).  ``stop``: optional zero-arg
+        predicate; True releases the barrier with False (the in-proc
+        drain path — a thread cannot be SIGTERMed out of a wait)."""
+        from distributed_machine_learning_tpu.runtime.transport import (
+            TransportError,
+        )
+
+        if poll_s is None:
+            poll_s = self.transport.barrier_poll_s()
         while True:
             if self.aborted is not None:
                 return False
+            if stop is not None and stop():
+                return False
+            try:
+                beats = self.transport.read_beat_payloads()
+            except TransportError:
+                beats = {}  # the monitor escalates a persistent outage
             ready = True
             for peer in range(self.world):
                 if peer == self.rank:
                     continue
-                payload = self.peer_state(peer)
+                payload = beats.get(peer)
                 if payload is None or (
                         not payload.get("done")
                         and int(payload.get("step", -1)) < step):
@@ -554,20 +610,18 @@ class GangCoordinator:
         election would then lose its only common point the moment any
         rank saved once after a restart."""
         self._valid_steps.add(int(step))
-        prior = read_restore_record(self.gang_dir, self.rank)
+        prior = self.transport.read_restore_record(self.rank)
         if prior:
             self._valid_steps |= prior
-        _write_atomic(
-            _restore_path(self.gang_dir, self.rank),
-            {"rank": self.rank, "steps": sorted(self._valid_steps),
-             "time": time.time()},
-        )
+        self.transport.write_restore_record(
+            self.rank, sorted(self._valid_steps))
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "GangCoordinator":
         if self._thread is not None:
             raise RuntimeError("coordinator already started")
-        os.makedirs(self.gang_dir, exist_ok=True)
+        if self.gang_dir is not None:
+            os.makedirs(self.gang_dir, exist_ok=True)
         self._started_at = time.monotonic()
         self._last_beat = time.monotonic()
         self._write_beat()
@@ -615,7 +669,30 @@ class GangCoordinator:
                 "steps_timed": len(times),
                 "phases": self._phases,
             }
-        _write_atomic(_beat_path(self.gang_dir, self.rank), payload)
+        from distributed_machine_learning_tpu.runtime.transport import (
+            TransportError,
+        )
+
+        try:
+            self.transport.publish_beat(self.rank, payload)
+        except TransportError:
+            # A failed publish is transport-outage evidence, not a
+            # crash: the monitor loop escalates once the outage
+            # outlives peer_timeout_s.
+            self._note_transport(ok=False)
+        # A SUCCESSFUL publish deliberately does NOT reset the outage
+        # clock: on a half-open link (tiny beat writes succeed, the
+        # ~world-sized batched reads keep timing out) a rank that can
+        # publish but cannot observe the gang is still blind — it can
+        # neither join an abort nor judge peers, and must escalate on
+        # the READ path's schedule.  Only _run's successful read cycle
+        # resets.
+
+    def _note_transport(self, ok: bool) -> None:
+        if ok:
+            self._tx_down_since = None
+        elif self._tx_down_since is None:
+            self._tx_down_since = time.monotonic()
 
     def _telemetry(self):
         from distributed_machine_learning_tpu.telemetry import get_telemetry
@@ -624,7 +701,18 @@ class GangCoordinator:
 
     def _abort(self, reason: str, peer: int | None = None) -> None:
         """Declare (or join) the gang abort and kill this process."""
-        won = declare_abort(self.gang_dir, reason, self.rank, peer=peer)
+        from distributed_machine_learning_tpu.runtime.transport import (
+            TransportError,
+        )
+
+        try:
+            won = self.transport.declare_abort(reason, self.rank,
+                                               peer=peer)
+        except TransportError:
+            # Partitioned off the gang: the latch is unreachable, but
+            # this rank must still die loudly — the peers' detectors
+            # will read its silence as the death it is.
+            won = False
         self.aborted = reason
         if won and self.events is not None and peer is not None:
             self.events.peer_failures += 1
@@ -644,41 +732,36 @@ class GangCoordinator:
             return
         os._exit(self.exit_code)
 
-    def _check_peer(self, peer: int, now: float, tel) -> str | None:
+    def _check_peer(self, peer: int, entry, now: float, tel
+                    ) -> str | None:
         """None if the peer looks healthy, else the failure reason.
+        ``entry`` is the peer's ``(signature, payload)`` from this
+        poll's batched ``read_beats`` (None: never published).
 
         Staleness is judged by LOCALLY-OBSERVED change (when did THIS
-        monitor last see the peer's beat file advance, on this host's
-        monotonic clock), never by comparing wall clocks to filesystem
-        mtimes: on the shared mounts pods actually use, cross-host
-        clock/mtime skew of a minute is routine and would otherwise
-        read as instant death (or mask a real one)."""
-        path = _beat_path(self.gang_dir, peer)
-        try:
-            st = os.stat(path)
-            sig = (st.st_mtime_ns, st.st_size)
-        except OSError:
+        monitor last see the peer's beat signature advance, on this
+        host's monotonic clock), never by comparing wall clocks to
+        filesystem mtimes: on the shared mounts pods actually use,
+        cross-host clock/mtime skew of a minute is routine and would
+        otherwise read as instant death (or mask a real one)."""
+        if entry is None:
             # Never beat at all: allow a full timeout from gang start
             # (the peer may still be exec'ing / rendezvousing).
             if now - self._started_at > self.peer_timeout_s:
                 return (f"rank {peer} never wrote a heartbeat within "
                         f"{self.peer_timeout_s}s of gang start")
             return None
+        sig, payload = entry
         seen = self._peer_seen.get(peer)
         if seen is None or seen[0] != sig:
             self._peer_seen[peer] = (sig, now)
             file_age = 0.0
         else:
             file_age = now - seen[1]
-        try:
-            with open(path) as f:
-                payload = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            payload = None  # torn read mid-replace: alive by change-sig
         if payload is not None and payload.get("done"):
             return None  # finished cleanly: healthy forever (file frozen)
         if file_age > self.peer_timeout_s:
-            return (f"rank {peer} heartbeat file last changed "
+            return (f"rank {peer} heartbeat last changed "
                     f"{file_age:.1f}s ago (timeout {self.peer_timeout_s}s)"
                     ": process dead")
         if payload is None or payload.get("suspended"):
@@ -701,17 +784,45 @@ class GangCoordinator:
         return None
 
     def _run(self) -> None:
-        poll_s = min(self.heartbeat_interval_s, self.peer_timeout_s / 4)
+        from distributed_machine_learning_tpu.runtime.transport import (
+            TransportError,
+        )
+
+        # Poll cadence is a TRANSPORT property (ISSUE 12): file keeps
+        # the historical min(heartbeat, timeout/4); in-proc polls
+        # tightly (reads are dict lookups); TCP scales the interval
+        # with the world so 128 monitors cannot self-DoS rank 0.
+        poll_s = self.transport.monitor_poll_s(
+            self.heartbeat_interval_s, self.peer_timeout_s, self.world)
         while not self._stop.wait(poll_s):
             self._write_beat()
-            abort = read_abort(self.gang_dir)
+            now = time.monotonic()
+            try:
+                abort = self.transport.read_abort()
+                beats = self.transport.read_beats() if abort is None \
+                    else {}
+            except TransportError:
+                # Connection loss IS peer-death evidence — for THIS
+                # rank: a member that cannot reach the gang for a full
+                # peer timeout is partitioned off it, and its peers are
+                # already reading its silence as a death.
+                self._note_transport(ok=False)
+                if now - self._tx_down_since > self.peer_timeout_s:
+                    self._abort(
+                        f"rank {self.rank} lost the gang transport for "
+                        f"{now - self._tx_down_since:.1f}s (timeout "
+                        f"{self.peer_timeout_s}s): partitioned off the "
+                        "gang", peer=self.rank,
+                    )
+                    return
+                continue
+            self._note_transport(ok=True)
             if abort is not None:
                 self._abort(
                     f"joining gang abort declared by rank "
                     f"{abort.get('by_rank')}: {abort.get('reason')}"
                 )
                 return
-            now = time.monotonic()
             tel = self._telemetry()
             if (self.check_self and not self._suspended
                     and now - self._last_beat > 1.5 * self.peer_timeout_s):
@@ -725,7 +836,8 @@ class GangCoordinator:
             for peer in range(self.world):
                 if peer == self.rank:
                     continue
-                reason = self._check_peer(peer, now, tel)
+                reason = self._check_peer(peer, beats.get(peer), now,
+                                          tel)
                 if reason is not None:
                     self._abort(reason, peer=peer)
                     return

@@ -88,6 +88,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 
 import numpy as np
@@ -178,6 +179,8 @@ class FaultEvents:
     reshard_restores: int = 0   # checkpoint restored onto a different world
     ckpt_verify_failures: int = 0  # checkpoint failed manifest verification
     ckpt_fallbacks: int = 0     # restore fell back past an invalid checkpoint
+    transport_retries: int = 0  # gang-transport ops re-attempted (backoff)
+    transport_timeouts: int = 0  # gang-transport ops that timed out/dropped
 
     def __setattr__(self, name: str, value) -> None:
         # Mirror every increment into the telemetry registry AS IT
@@ -243,7 +246,14 @@ class FaultInjector:
         self._saves = 0
         self._post_saves = 0
         self._ledger_path: str | None = None
+        self._ledger_transport = None
         self.rank = rank
+        # Seams for in-proc gangs (runtime/inproc_worker.py): a thread
+        # rank cannot os._exit (that kills every OTHER rank too) and
+        # must sleep interruptibly so a drain can collect it.  The
+        # subprocess defaults are the historical behavior.
+        self.exit_fn = os._exit
+        self.sleep_fn = time.sleep
         # CURRENT-numbering rank (set by elastic gang workers; shrinks
         # renumber it while ``rank`` stays the original identity).
         # Only recover_rank consults it: the recovered host cannot act
@@ -258,25 +268,26 @@ class FaultInjector:
 
         return jax.process_index()
 
-    def attach_ledger(self, path: str | os.PathLike) -> "FaultInjector":
+    def attach_ledger(self, path_or_transport) -> "FaultInjector":
         """Make the fired-once latch survive process relaunches: every
         firing appends a line here, and attaching replays the lines —
         faults THIS RANK already fired stay fired in the fresh process.
         (Only the acting rank is latched from the ledger: other ranks
         never act on those entries anyway, and per-rank fault state —
-        e.g. each rank's own save ordinals — must not cross ranks.)"""
-        self._ledger_path = os.fspath(path)
-        try:
-            with open(self._ledger_path) as f:
-                lines = f.read().splitlines()
-        except OSError:
-            return self
+        e.g. each rank's own save ordinals — must not cross ranks.)
+
+        Accepts a ledger file path (the historical file backend) or a
+        ``runtime/transport.py::GangTransport`` — the pluggable control
+        plane carries the ledger as a channel (``append_fault_entry`` /
+        ``read_fault_entries``), with identical replay semantics."""
+        if hasattr(path_or_transport, "append_fault_entry"):
+            self._ledger_transport = path_or_transport
+            entries = path_or_transport.read_fault_entries()
+        else:
+            self._ledger_path = os.fspath(path_or_transport)
+            entries = ledger_entries(self._ledger_path)
         me = self._process_rank()
-        for line in lines:
-            try:
-                entry = json.loads(line)
-            except json.JSONDecodeError:
-                continue  # torn final line (a kill mid-append)
+        for entry in entries:
             i = entry.get("index")
             if not (isinstance(i, int) and 0 <= i < len(self._faults)
                     and entry.get("kind") == self._faults[i].kind):
@@ -291,13 +302,17 @@ class FaultInjector:
                 self._faults[i].fired = True
         return self
 
+    def _has_ledger(self) -> bool:
+        return (self._ledger_path is not None
+                or self._ledger_transport is not None)
+
     def _mark_fired(self, f: _Fault, acted: bool = True) -> None:
         """Latch ``f``; when this process actually ACTED on it (not just
         observed a non-target rank's index pass by), persist the firing
         to the ledger — fsynced before returning, because the very next
         statement may be ``os._exit``."""
         f.fired = True
-        if not acted or self._ledger_path is None:
+        if not acted or not self._has_ledger():
             return
         entry = {"index": f.index, "kind": f.kind, "at": f.at,
                  "rank": self._process_rank(), "time": time.time()}
@@ -306,10 +321,14 @@ class FaultInjector:
             # acting rank — for kill/lose/stall the two coincide, for
             # recover_rank they cannot (the target is the dead host).
             entry["target"] = f.rank
-        with open(self._ledger_path, "a") as fh:
-            fh.write(json.dumps(entry) + "\n")
-            fh.flush()
-            os.fsync(fh.fileno())
+        if self._ledger_transport is not None:
+            self._ledger_transport.append_fault_entry(entry)
+            return
+        from distributed_machine_learning_tpu.runtime.transport import (
+            append_jsonl_fsync,
+        )
+
+        append_jsonl_fsync(self._ledger_path, entry)
 
     # -- construction ---------------------------------------------------
     @classmethod
@@ -439,7 +458,14 @@ class FaultInjector:
                     if events is not None:
                         events.rank_recoveries += 1
                     self._mark_fired(f)
-                    if self._ledger_path is not None:
+                    if self._ledger_transport is not None:
+                        self._ledger_transport.announce_join(
+                            f.rank,
+                            {"rank": int(f.rank), "spare": False,
+                             "kind": "recover", "at_step": idx,
+                             "time": time.time()},
+                        )
+                    elif self._ledger_path is not None:
                         from distributed_machine_learning_tpu.runtime.coordinator import (  # noqa: E501
                             announce_join,
                         )
@@ -475,12 +501,12 @@ class FaultInjector:
                         self._mark_fired(f)
                         print(
                             f"[faults] rank {f.rank} exiting hard "
-                            f"(os._exit({code}), "
+                            f"(exit {code}, "
                             f"{'permanent loss' if f.kind == 'lose_rank' else 'crash'}"
                             f") before batch {idx}",
                             flush=True,
                         )
-                        os._exit(code)
+                        self.exit_fn(code)
                     stall_s = float(f.arg)
                     if events is not None:
                         events.rank_stalls += 1
@@ -490,14 +516,14 @@ class FaultInjector:
                         f"before batch {idx}",
                         flush=True,
                     )
-                    time.sleep(stall_s)
+                    self.sleep_fn(stall_s)
                 elif f.kind == "stall":
                     self._mark_fired(f)
                     stall_s = float(f.arg) if f.arg else _default_stall(None)
                     rank0_print(
                         f"[faults] stalling {stall_s}s before batch {idx}"
                     )
-                    time.sleep(stall_s)
+                    self.sleep_fn(stall_s)
                 elif f.kind == "raise":
                     self._mark_fired(f)
                     raise InjectedFault(f"injected loader fault at batch {idx}")
@@ -526,7 +552,7 @@ class FaultInjector:
                         f"[faults] killing process mid-checkpoint "
                         f"(save #{self._saves})"
                     )
-                    os._exit(17)
+                    self.exit_fn(17)
                 raise InjectedKill(
                     f"injected death mid-checkpoint (save #{self._saves}; "
                     "state dir written, config file not)"
@@ -609,6 +635,43 @@ def ledger_entries(path: str | os.PathLike) -> list[dict]:
     return out
 
 
+def lost_ranks_from_entries(entries: list[dict]) -> set[int]:
+    """Ranks whose ``lose_rank`` fault has fired, from parsed ledger
+    entries (any transport backend)."""
+    return {
+        int(e["rank"]) for e in entries
+        if e.get("kind") == "lose_rank" and isinstance(e.get("rank"), int)
+    }
+
+
+def recovered_ranks_from_entries(entries: list[dict]) -> set[int]:
+    """Ranks whose ``recover_rank`` fault has fired, from parsed ledger
+    entries — rank ids are the ``target`` field (ORIGINAL numbering):
+    the acting process is a different, live rank."""
+    return {
+        int(e["target"]) for e in entries
+        if e.get("kind") == "recover_rank"
+        and isinstance(e.get("target"), int)
+    }
+
+
+def unrecovered_lost_from_entries(entries: list[dict]) -> set[int]:
+    """Ranks currently lost, ORDER-AWARE: a ``recover_rank`` clears
+    only the ``lose_rank`` entries appended BEFORE it.  Plain set
+    subtraction would let one all-time recovery mask every later loss
+    of the same rank — a host that dies again after recovering must
+    count as lost again.  The ledger is append-only, so entry order is
+    event order."""
+    lost: set[int] = set()
+    for e in entries:
+        kind = e.get("kind")
+        if kind == "lose_rank" and isinstance(e.get("rank"), int):
+            lost.add(int(e["rank"]))
+        elif kind == "recover_rank" and isinstance(e.get("target"), int):
+            lost.discard(int(e["target"]))
+    return lost
+
+
 def ledger_lost_ranks(path: str | os.PathLike) -> set[int]:
     """Ranks whose ``lose_rank`` fault has fired, per the ledger — the
     marker the gang supervisor reads to declare a rank's restart budget
@@ -617,10 +680,7 @@ def ledger_lost_ranks(path: str | os.PathLike) -> set[int]:
     (stable across shrink renumberings — the gang worker keys its
     injector on ``--orig-rank``), so callers only intersect with the
     ranks still active."""
-    return {
-        int(e["rank"]) for e in ledger_entries(path)
-        if e.get("kind") == "lose_rank" and isinstance(e.get("rank"), int)
-    }
+    return lost_ranks_from_entries(ledger_entries(path))
 
 
 def ledger_recovered_ranks(path: str | os.PathLike) -> set[int]:
@@ -628,32 +688,92 @@ def ledger_recovered_ranks(path: str | os.PathLike) -> set[int]:
     the budget-recovered marker the elastic supervisor subtracts from
     :func:`ledger_lost_ranks` (the host came back; holding its
     ``lose_rank`` entry against it forever would make every loss
-    permanent even after the recovery event).  Rank ids are the
-    ``target`` field (ORIGINAL numbering): the acting process is a
-    different, live rank."""
-    return {
-        int(e["target"]) for e in ledger_entries(path)
-        if e.get("kind") == "recover_rank"
-        and isinstance(e.get("target"), int)
-    }
+    permanent even after the recovery event)."""
+    return recovered_ranks_from_entries(ledger_entries(path))
 
 
 def ledger_unrecovered_lost_ranks(path: str | os.PathLike) -> set[int]:
-    """Ranks currently lost per the ledger, ORDER-AWARE: a
-    ``recover_rank`` clears only the ``lose_rank`` entries appended
-    BEFORE it.  Plain set subtraction
-    (:func:`ledger_lost_ranks` - :func:`ledger_recovered_ranks`) would
-    let one all-time recovery mask every later loss of the same rank —
-    a host that dies again after recovering must count as lost again.
-    The ledger is append-only, so file order is event order."""
-    lost: set[int] = set()
-    for e in ledger_entries(path):
-        kind = e.get("kind")
-        if kind == "lose_rank" and isinstance(e.get("rank"), int):
-            lost.add(int(e["rank"]))
-        elif kind == "recover_rank" and isinstance(e.get("target"), int):
-            lost.discard(int(e["target"]))
-    return lost
+    """File-backed form of :func:`unrecovered_lost_from_entries`."""
+    return unrecovered_lost_from_entries(ledger_entries(path))
+
+
+# ---------------------------------------------------------------------------
+# Transport-level fault injection (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosAction:
+    """What the chaos plan does to ONE transport send attempt."""
+
+    drop: bool = False        # the medium ate the request (→ retry path)
+    duplicate: bool = False   # delivered twice (same op_id → dedup path)
+    delay_s: float = 0.0      # delivered late
+    partitioned: bool = False  # channel severed: the op cannot leave
+
+
+_NO_ACTION = ChaosAction()
+
+
+class TransportChaos:
+    """Deterministic fault plan for a LOSSY gang transport — the tests'
+    proof that the TCP retry/backoff/idempotency layer works, rather
+    than an assertion that it would.
+
+    ``drop``/``duplicate``/``delay``: iterables of ``(op, nth)`` pairs —
+    fire on the nth call (1-based, counted per op kind) of that
+    operation; ``op`` may be ``"*"`` to match any operation (counted
+    globally).  ``delay_s`` applies to every delayed delivery.
+    ``partition_after``: sever the channel entirely after N total
+    operations (every later send raises, as if this member's link was
+    cut) — the partitioned rank stops beating, its peers declare it
+    dead within ``peer_timeout_s``, and the rank itself self-aborts
+    once the outage outlives the same timeout.
+
+    Thread-safe: one plan is shared by a member's worker and monitor
+    threads."""
+
+    def __init__(self, *, drop=(), duplicate=(), delay=(),
+                 partition_after: int | None = None,
+                 delay_s: float = 0.05):
+        self._drop = {(op, int(n)) for op, n in drop}
+        self._dup = {(op, int(n)) for op, n in duplicate}
+        self._delay = {(op, int(n)) for op, n in delay}
+        self.partition_after = partition_after
+        self.delay_s = float(delay_s)
+        self._counts: dict[str, int] = {}
+        self._total = 0
+        self._lock = threading.Lock()
+        self.fired: list[tuple[str, str, int]] = []  # (action, op, nth)
+
+    def _matches(self, plan: set, op: str, nth: int, any_nth: int) -> bool:
+        return (op, nth) in plan or ("*", any_nth) in plan
+
+    def plan(self, op: str) -> ChaosAction:
+        """Called by the transport client once per SEND ATTEMPT (so a
+        dropped op's retry is a fresh attempt that the plan may or may
+        not hit again)."""
+        with self._lock:
+            self._total += 1
+            self._counts[op] = self._counts.get(op, 0) + 1
+            nth, any_nth = self._counts[op], self._total
+            if (self.partition_after is not None
+                    and self._total > self.partition_after):
+                self.fired.append(("partition", op, any_nth))
+                return ChaosAction(partitioned=True)
+            drop = self._matches(self._drop, op, nth, any_nth)
+            dup = self._matches(self._dup, op, nth, any_nth)
+            delay = self._matches(self._delay, op, nth, any_nth)
+            if drop:
+                self.fired.append(("drop", op, nth))
+            if dup:
+                self.fired.append(("duplicate", op, nth))
+            if delay:
+                self.fired.append(("delay", op, nth))
+        if not (drop or dup or delay):
+            return _NO_ACTION
+        return ChaosAction(drop=drop, duplicate=dup,
+                           delay_s=self.delay_s if delay else 0.0)
 
 
 def corrupt_checkpoint_data(path: str | os.PathLike, match: str | None = None,

@@ -74,7 +74,6 @@ from __future__ import annotations
 
 import argparse
 import hashlib
-import json
 import os
 import signal
 import time
@@ -100,7 +99,47 @@ def _global_batch_at(example_cursor: int, batch: int, dim: int) -> "object":
     return rows
 
 
-def _spare_main(args, orig_rank: int) -> None:
+def _parse_tx_chaos(spec: str | None, orig_rank: int, attempt: int):
+    """A ``TransportChaos`` plan when this (orig rank, attempt) is the
+    target, else None.  Grammar: ``partition@RANK:AFTER_OPS`` — sever
+    the channel after N transport ops, attempt 0 only (the relaunch
+    heals the link, so the proof can also show the gang FINISHES)."""
+    if not spec:
+        return None
+    kind, _, rest = spec.partition("@")
+    if kind.strip() != "partition":
+        raise ValueError(
+            f"unknown --tx-chaos kind {kind!r} (known: partition)")
+    rank_s, _, after_s = rest.partition(":")
+    if not (rank_s.strip().isdigit() and after_s.strip().isdigit()):
+        raise ValueError(
+            f"bad --tx-chaos spec {spec!r}: expected partition@rank:ops")
+    if int(rank_s) != orig_rank or attempt != 0:
+        return None
+    from distributed_machine_learning_tpu.runtime.faults import (
+        TransportChaos,
+    )
+
+    return TransportChaos(partition_after=int(after_s))
+
+
+def _make_transport(args, orig_rank: int, attempt: int = 0, events=None):
+    """The control-plane backend from the CLI flags (ISSUE 12): file
+    keeps the byte-compatible shared-directory layout; tcp talks to
+    the gang server with the retry/timeout/idempotency layer."""
+    from distributed_machine_learning_tpu.runtime.transport import (
+        make_transport,
+    )
+
+    if args.gang_transport == "tcp":
+        return make_transport(
+            "tcp", address=args.gang_addr, events=events,
+            chaos=_parse_tx_chaos(args.tx_chaos, orig_rank, attempt),
+        )
+    return make_transport("file", gang_dir=args.gang_dir, events=events)
+
+
+def _spare_main(args, orig_rank: int, transport) -> None:
     """The warm-spare loop: announce on the join channel, prefetch the
     newest verified checkpoint into this rank's own directory, repeat —
     no barrier, no data consumption, no training.  Terminated by the
@@ -109,9 +148,6 @@ def _spare_main(args, orig_rank: int) -> None:
     import shutil
     import signal as _signal
 
-    from distributed_machine_learning_tpu.runtime.coordinator import (
-        announce_join,
-    )
     from distributed_machine_learning_tpu.train.checkpoint import (
         latest_checkpoint,
     )
@@ -157,8 +193,10 @@ def _spare_main(args, orig_rank: int) -> None:
                 shutil.rmtree(tmp, ignore_errors=True)
         # The refreshed announcement IS the spare's heartbeat: the
         # supervisor promotes only spares whose announcement is fresh.
-        announce_join(args.gang_dir, orig_rank, spare=True,
-                      prefetched_step=prefetched, pid=os.getpid())
+        transport.announce_join(orig_rank, {
+            "rank": int(orig_rank), "spare": True, "time": time.time(),
+            "prefetched_step": prefetched, "pid": os.getpid(),
+        })
         time.sleep(args.heartbeat_interval)
 
 
@@ -212,6 +250,29 @@ def main(argv=None) -> None:
                          "checkpoint into this rank's directory, but "
                          "never train or consume data; the supervisor "
                          "promotes it at a restart/grow boundary")
+    ap.add_argument("--gang-transport", dest="gang_transport",
+                    default="file", choices=("file", "tcp"),
+                    help="control-plane backend (runtime/transport.py): "
+                         "'file' = shared-directory channels in "
+                         "--gang-dir (the historical default, on-disk "
+                         "format unchanged); 'tcp' = a gang server at "
+                         "--gang-addr, with per-op timeouts, retry + "
+                         "backoff, and idempotent delivery.  ('inproc' "
+                         "exists only inside one process — "
+                         "cli/gang.py --gang-transport inproc runs "
+                         "thread workers instead of spawning this "
+                         "module.)")
+    ap.add_argument("--gang-addr", dest="gang_addr", default=None,
+                    help="host:port of the gang transport server "
+                         "(required for --gang-transport tcp)")
+    ap.add_argument("--tx-chaos", dest="tx_chaos", default=None,
+                    help="transport-level fault injection (tcp only): "
+                         "'partition@RANK:AFTER_OPS' severs the "
+                         "targeted ORIGINAL rank's channel after N "
+                         "transport ops on ATTEMPT 0 only (the relaunch "
+                         "heals the link, like a repaired switch port) "
+                         "— the chaos proof that connection loss is "
+                         "treated as peer death")
     ap.add_argument("--faults", default=None)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--heartbeat-interval", type=float, default=0.25)
@@ -230,12 +291,15 @@ def main(argv=None) -> None:
                     help="disable the default-on per-rank telemetry")
     args = ap.parse_args(argv)
     orig_rank = args.rank if args.orig_rank is None else args.orig_rank
+    if args.gang_transport == "tcp" and not args.gang_addr:
+        ap.error("--gang-transport tcp requires --gang-addr host:port")
 
     if args.spare:
         # Spares never join the coordinator barrier or the data stream;
         # the loop is the checkpoint validity chain plus the join
         # channel, so a standing spare costs one idle process.
-        _spare_main(args, orig_rank)
+        _spare_main(args, orig_rank,
+                    _make_transport(args, orig_rank, args.attempt))
         return
 
     # A drain/preemption SIGTERM becomes a SystemExit raised at the next
@@ -305,6 +369,8 @@ def main(argv=None) -> None:
 
     ckpt_dir = os.path.join(args.ckpt_dir, f"rank{orig_rank}")
     events = FaultEvents()
+    transport = _make_transport(args, orig_rank, args.attempt,
+                                events=events)
     # Fault targeting is keyed on the ORIGINAL rank identity: a spec
     # written against the launch-time numbering must keep aiming at the
     # same host after a shrink renumbers the survivors — and the ledger
@@ -318,21 +384,18 @@ def main(argv=None) -> None:
         # 0 (the target host is dead); every other fault keys on the
         # original identity above.
         injector.current_rank = args.rank
-        from distributed_machine_learning_tpu.runtime.faults import (
-            FAULT_LEDGER_FILE,
-        )
-
         os.makedirs(args.gang_dir, exist_ok=True)
         # The exactly-once latch must survive the relaunch this very
         # fault will cause — without the ledger every attempt re-fires
-        # the same kill and the gang can never finish.
-        injector.attach_ledger(
-            os.path.join(args.gang_dir, FAULT_LEDGER_FILE)
-        )
+        # the same kill and the gang can never finish.  The ledger is a
+        # transport channel (file backend: the same faults_fired.jsonl
+        # as always).
+        injector.attach_ledger(transport)
     coord = GangCoordinator(
         args.gang_dir, rank=args.rank, world=args.world,
         heartbeat_interval_s=args.heartbeat_interval,
         peer_timeout_s=args.peer_timeout, events=events,
+        transport=transport,
     ).start()
 
     # The scaling rule resolves (global batch, LR) for the CURRENT
@@ -342,10 +405,6 @@ def main(argv=None) -> None:
     # has the contract).  This rank's shard of each step's batch is the
     # exact partition a reshape rebalances: union over ranks = every
     # example exactly once, padding-free.
-    from distributed_machine_learning_tpu.runtime.coordinator import (
-        CONSUMED_PREFIX,
-    )
-
     base_world = args.base_world if args.base_world else args.world
     rule = ScalingRule(args.scaling_rule, base_lr=args.base_lr,
                        base_global_batch=args.global_batch,
@@ -353,9 +412,6 @@ def main(argv=None) -> None:
     ws = rule.at_world(args.world)
     global_batch, lr = ws.global_batch, ws.lr
     local_ids = exact_shard_indices(global_batch, args.rank, args.world)
-    consumed_path = os.path.join(
-        args.gang_dir, f"{CONSUMED_PREFIX}{orig_rank}.jsonl"
-    )
 
     def record_consumed(step: int, example_cursor: int) -> None:
         """One line per completed step: which global example ids THIS
@@ -363,21 +419,17 @@ def main(argv=None) -> None:
         audit trail the elastic chaos test checks.  Ids are keyed on
         the cumulative example cursor, so they stay contiguous and
         non-overlapping even when a scaling rule changes the batch
-        size across world transitions."""
-        # flush+fsync (dmlcheck DML002): the coordinator's monitor
-        # thread may os._exit this process at any poll, and a consumed
-        # row lost from the ledger reads as a missed example in the
-        # exactly-once audit.
-        with open(consumed_path, "a") as f:
-            f.write(json.dumps({
-                "attempt": args.attempt, "world": args.world,
-                "rank": args.rank, "orig_rank": orig_rank, "step": step,
-                "example_cursor": example_cursor,
-                "global_batch": global_batch,
-                "ids": [example_cursor + int(j) for j in local_ids],
-            }) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        size across world transitions.  The transport's consumed
+        channel keeps the durability discipline (file backend:
+        flush+fsync per row, dmlcheck DML002 — the monitor thread may
+        os._exit this process at any poll)."""
+        transport.append_consumed(orig_rank, {
+            "attempt": args.attempt, "world": args.world,
+            "rank": args.rank, "orig_rank": orig_rank, "step": step,
+            "example_cursor": example_cursor,
+            "global_batch": global_batch,
+            "ids": [example_cursor + int(j) for j in local_ids],
+        })
 
     with coord.suspend():
         state = TrainState.create(

@@ -1,0 +1,390 @@
+"""In-proc gang members — thread ranks for 64-128-rank chaos campaigns.
+
+``runtime/gang_worker.py`` proves the resilience stack end to end with
+one OS process per rank, which caps tested worlds at ~5 on the 1-core
+CI host.  This module is the same worker contract — lock-step barrier
+over the coordinator, scaling-rule-resolved global batches, exact
+per-rank shards with an exactly-once consumption ledger, verified
+checkpoints with the cumulative example cursor, fault injection keyed
+on the ORIGINAL rank — rebuilt as a function a daemon THREAD can run
+against an :class:`~.transport.InProcHub`: no subprocess spawn, no
+shared filesystem, no per-rank jit compile.  ``gang_supervise`` runs
+these callables through the same restart/shrink/grow/replace policy it
+applies to processes (``supervisor._ThreadWorker`` adapts the Popen
+surface), which is what lets tier-1 storm a 64-128-rank gang with
+concurrent ``lose_rank``/``stall_rank``/``recover_rank`` firings and
+world trajectories like 64→48→96 in seconds
+(``tests/test_chaos_campaign.py``).
+
+Differences from the subprocess worker, all forced by thread rank
+semantics and all documented where they bite:
+
+- **exits are exceptions**: a thread cannot ``os._exit`` without
+  killing every other rank, so the injector's ``exit_fn`` raises
+  :class:`WorkerExit` (carrying the same exit codes) and stall sleeps
+  are interruptible (``sleep_fn`` observes the drain event — a thread
+  cannot be SIGKILLed out of a ``time.sleep``);
+- **shared checkpoint directory, rank-0 save**: the gang trains
+  replicated dp state that is bit-identical across ranks, so current
+  rank 0 saves ONE verified checkpoint per boundary into the shared
+  directory and broadcasts the commit over the hub box; every rank
+  then records the step for the election.  Restores are likewise
+  rank-0-restore-then-broadcast (on a real pod this is the host-side
+  broadcast after rank 0 reads shared storage) — the checkpoint itself
+  is a real ``save_checkpoint``/``reshard_restore`` artifact the
+  campaign tests re-restore at other worlds;
+- **numpy math**: the toy quadratic step is a handful of vector ops —
+  64 per-thread jit compiles would cost more than the whole campaign.
+  The gradient is still the mean over the GLOBAL batch in canonical
+  order, so params stay bit-identical across ranks, restarts, and
+  world changes, and the loss floor obeys the scaling rules
+  (``train/scaling.py``) exactly as in the subprocess worker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import numpy as np
+
+from distributed_machine_learning_tpu.runtime.coordinator import (
+    GANG_ABORT_EXIT,
+    GangCoordinator,
+)
+from distributed_machine_learning_tpu.runtime.faults import (
+    FaultEvents,
+    FaultInjector,
+)
+from distributed_machine_learning_tpu.runtime.transport import (
+    InProcHub,
+    InProcTransport,
+    TransportError,
+)
+
+
+class WorkerExit(Exception):
+    """An in-proc rank leaving with an exit code — the thread analogue
+    of ``os._exit`` (``supervisor._ThreadWorker`` turns it back into
+    the Popen-style returncode the gang policy reads)."""
+
+    def __init__(self, code: int):
+        super().__init__(f"worker exit {code}")
+        self.code = int(code)
+
+
+@dataclasses.dataclass
+class InprocGangConfig:
+    """One campaign's worker parameters — the ``--flags`` of
+    ``gang_worker`` as a value the thread closures share."""
+
+    ckpt_dir: str                  # SHARED checkpoint directory
+    steps: int = 12
+    save_every: int = 5
+    global_batch: int = 64
+    scaling_rule: str = "pinned"
+    base_world: int | None = None  # anchor world (default: launch world)
+    base_lr: float = 0.5
+    feature_dim: int = 8
+    heartbeat_interval: float = 0.05
+    peer_timeout: float = 2.0
+    faults: str | None = None
+    seed: int = 0
+    step_sleep: float = 0.0
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 arrays — a
+    high-quality stateless hash, so every (example id, coordinate)
+    cell is an independent draw (no cross-id structure a batch mean
+    could cancel against)."""
+    x = (x + np.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def example_batch(start: int, count: int, dim: int) -> np.ndarray:
+    """The global batch whose row ``j`` is example ``start + j``,
+    generated from the example id ALONE (world/batch-partition
+    independent, like ``gang_worker._global_batch_at``) but fully
+    vectorized: 128 ranks each regenerate the global batch every step,
+    so per-row RNG construction would dominate the campaign.
+
+    Cells are iid-like uniform draws scaled to zero mean and UNIT
+    variance — the batch mean's variance must scale exactly 1/B, or
+    the stationary loss floor stops obeying the scaling rules
+    (``train/scaling.py``) the trajectory campaigns assert against."""
+    ids = np.arange(start, start + count, dtype=np.uint64)[:, None]
+    k = np.arange(dim, dtype=np.uint64)[None, :]
+    cells = _splitmix64(ids * np.uint64(dim) + k
+                        + np.uint64(0x5DEECE66D))
+    u = cells.astype(np.float64) * (1.0 / 2.0 ** 64)  # uniform [0, 1)
+    return (np.sqrt(12.0) * (u - 0.5)).astype(np.float32)
+
+
+def _interruptible(stop_event, coord):
+    def sleep(seconds: float) -> None:
+        deadline = time.monotonic() + float(seconds)
+        while time.monotonic() < deadline:
+            if stop_event.is_set() or coord.aborted is not None:
+                return  # the gang is coming down; the stall is moot
+            time.sleep(min(0.05, max(deadline - time.monotonic(), 0.0)))
+
+    return sleep
+
+
+def _await_box(hub: InProcHub, key, stop_event, coord,
+               timeout_s: float) -> object:
+    """Wait for rank 0's broadcast under ``key`` — drain/abort-aware,
+    bounded (rank 0 may be the rank a fault just killed; the abort
+    machinery owns that case and this wait must not outlive it)."""
+    deadline = time.monotonic() + timeout_s
+    missing = object()
+    while time.monotonic() < deadline:
+        value = hub.box_get(key, missing)
+        if value is not missing:
+            return value
+        if stop_event.is_set():
+            raise WorkerExit(143)
+        if coord.aborted is not None:
+            raise WorkerExit(GANG_ABORT_EXIT)
+        time.sleep(0.002)
+    return None
+
+
+def run_inproc_worker(cfg: InprocGangConfig, hub: InProcHub, rank: int,
+                      attempt: int, world: int, orig_rank: int,
+                      stop_event) -> int:
+    """One thread rank of an in-proc gang, to completion — the
+    ``gang_worker.main`` loop against the hub transport.  Returns 0 on
+    a clean finish; raises :class:`WorkerExit` for every abort/fault
+    exit path."""
+    from distributed_machine_learning_tpu.runtime.mesh import ShardSpec
+    from distributed_machine_learning_tpu.data.sharding import (
+        exact_shard_indices,
+    )
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        checkpoint_cursor,
+        checkpoint_extra,
+        latest_checkpoint,
+        reshard_restore,
+        save_checkpoint,
+    )
+    from distributed_machine_learning_tpu.train.scaling import ScalingRule
+
+    tx = InProcTransport(hub, bind_epoch=True)
+    events = FaultEvents()
+    injector = FaultInjector.from_flags(
+        cfg.faults, seed=cfg.seed, horizon=max(cfg.steps, 2),
+        rank=orig_rank,
+    )
+    coord = GangCoordinator(
+        None, rank=rank, world=world, transport=tx,
+        heartbeat_interval_s=cfg.heartbeat_interval,
+        peer_timeout_s=cfg.peer_timeout, events=events,
+        on_abort=lambda reason: None,  # thread mode: flag, never exit
+    )
+    if injector is not None:
+        injector.current_rank = rank
+        injector.exit_fn = _raise_worker_exit
+        injector.sleep_fn = _interruptible(stop_event, coord)
+        injector.attach_ledger(tx)
+    coord.start()
+
+    base_world = cfg.base_world if cfg.base_world else world
+    rule = ScalingRule(cfg.scaling_rule, base_lr=cfg.base_lr,
+                       base_global_batch=cfg.global_batch,
+                       base_world=base_world)
+    ws = rule.at_world(world)
+    global_batch, lr = ws.global_batch, ws.lr
+    local_ids = exact_shard_indices(global_batch, rank, world)
+
+    try:
+        # -- resume: rank 0 restores the shared checkpoint, the hub box
+        # broadcasts the result (the host-side broadcast of a pod).
+        with coord.suspend():
+            key = ("restore", attempt)
+            if rank == 0:
+                latest = latest_checkpoint(cfg.ckpt_dir, events=events)
+                if latest is None:
+                    bcast = {"step": 0}
+                else:
+                    state, _spec = reshard_restore(
+                        latest, world=world, events=events,
+                        files_verified=True)
+                    step0 = int(np.asarray(state.step))
+                    cursor = checkpoint_cursor(latest)
+                    ex = checkpoint_extra(latest).get("example_cursor")
+                    start = cursor if cursor is not None else step0
+                    bcast = {
+                        "step": start,
+                        "restored_step": step0,
+                        "example_cursor": (int(ex) if isinstance(ex, int)
+                                           else start * global_batch),
+                        "w": np.array(np.asarray(state.params["w"]),
+                                      copy=True),
+                    }
+                hub.box_put(key, bcast)
+            else:
+                bcast = _await_box(hub, key, stop_event, coord,
+                                   timeout_s=4 * cfg.peer_timeout)
+                if bcast is None:
+                    raise WorkerExit(GANG_ABORT_EXIT)
+            start = int(bcast["step"])
+            start_examples = int(bcast.get("example_cursor",
+                                           start * global_batch))
+            w = (np.array(bcast["w"], copy=True) if "w" in bcast
+                 else np.zeros((cfg.feature_dim,), np.float32))
+            if "restored_step" in bcast:
+                # The broadcast is this rank's proof the checkpoint is
+                # whole — record it so the next election can agree on
+                # it even if no further save lands.
+                coord.record_valid_step(int(bcast["restored_step"]))
+            coord.beat(step=start)
+
+        batches = range(start, cfg.steps)
+        if injector is not None:
+            batches = injector.wrap_batches(batches, events, start=start)
+
+        for idx in batches:
+            t_start = time.perf_counter()
+            if not coord.wait_for_peers(idx, stop=stop_event.is_set):
+                raise WorkerExit(GANG_ABORT_EXIT
+                                 if coord.aborted is not None else 143)
+            t_barrier = time.perf_counter()
+            ex_cursor = start_examples + (idx - start) * global_batch
+            xs = example_batch(ex_cursor, global_batch, cfg.feature_dim)
+            loss = float(w @ w)  # ||w - w*||^2 BEFORE the update, w*=0
+            w = w - lr * (w - xs.mean(0))
+            t_end = time.perf_counter()
+            tx.append_consumed(orig_rank, {
+                "attempt": attempt, "world": world, "rank": rank,
+                "orig_rank": orig_rank, "step": idx,
+                "example_cursor": ex_cursor,
+                "global_batch": global_batch,
+                "ids": [ex_cursor + int(j) for j in local_ids],
+                "loss": loss,
+            })
+            coord.observe_step(idx + 1, t_end - t_start, {
+                "barrier_wait_s": t_barrier - t_start,
+                "compute_s": t_end - t_barrier,
+            })
+            if (idx + 1) % cfg.save_every == 0 or idx + 1 == cfg.steps:
+                save_step = idx + 1
+                with coord.suspend():
+                    key = ("saved", attempt, save_step)
+                    if rank == 0:
+                        state = _train_state(w, save_step)
+                        save_checkpoint(
+                            cfg.ckpt_dir, state, cursor=save_step,
+                            shard_spec=ShardSpec("dp", world=world),
+                            extra_payload={
+                                "example_cursor":
+                                    ex_cursor + global_batch,
+                                "world": world,
+                                "scaling_rule": rule.as_dict(),
+                            },
+                        )
+                        hub.box_put(key, True)
+                        coord.record_valid_step(save_step)
+                    elif _await_box(hub, key, stop_event, coord,
+                                    timeout_s=4 * cfg.peer_timeout):
+                        # Only a signaled commit is recorded: a vote
+                        # for a save that never landed would be
+                        # filtered by the election's on-disk validity
+                        # check anyway, but there is no reason to cast
+                        # it.
+                        coord.record_valid_step(save_step)
+            if cfg.step_sleep:
+                injector_sleep = _interruptible(stop_event, coord)
+                injector_sleep(cfg.step_sleep)
+        coord.finish()
+        return 0
+    except TransportError as exc:
+        # Stale epoch (this member was drained and the state cleared)
+        # or a severed channel: die like the partitioned process the
+        # supervisor already knows how to handle.
+        raise WorkerExit(GANG_ABORT_EXIT) from exc
+    finally:
+        coord.stop()
+
+
+def _raise_worker_exit(code: int) -> None:
+    raise WorkerExit(code)
+
+
+def _train_state(w: np.ndarray, step: int):
+    """A real TrainState around the toy weight vector — what makes the
+    campaign's checkpoints first-class ``save_checkpoint`` artifacts
+    (manifested, verified, reshard-restorable at any world)."""
+    from distributed_machine_learning_tpu.train.state import TrainState
+
+    state = TrainState.create(
+        params={"w": np.array(w, np.float32, copy=True)}
+    )
+    return state.replace(step=np.asarray(step, np.int32))
+
+
+def run_inproc_spare(cfg: InprocGangConfig, hub: InProcHub,
+                     orig_rank: int, attempt: int, stop_event) -> int:
+    """The warm-spare loop, thread form: announce on the join channel
+    (refresh = liveness) with the newest VERIFIED shared-directory
+    checkpoint step as the prefetch cursor.  In the shared-directory
+    layout the prefetch copy itself is a no-op — the data is already
+    local — so a spare's promotion cost is exactly one restore, the
+    same O(restore) contract as the subprocess spare."""
+    from distributed_machine_learning_tpu.train.checkpoint import (
+        latest_checkpoint,
+    )
+
+    tx = InProcTransport(hub, bind_epoch=True)
+    prefetched: int | None = None
+    seen_names: list[str] | None = None
+    while not stop_event.is_set():
+        try:
+            names = sorted(
+                n for n in os.listdir(cfg.ckpt_dir)
+                if n.startswith("step_"))
+        except OSError:
+            names = []
+        if names != seen_names:
+            seen_names = names
+            found = latest_checkpoint(cfg.ckpt_dir)
+            if found is not None:
+                prefetched = int(os.path.basename(found)[5:])
+        try:
+            tx.announce_join(orig_rank, {
+                "rank": int(orig_rank), "spare": True,
+                "prefetched_step": prefetched, "time": time.time(),
+            })
+        except TransportError:
+            return 0  # drained attempt's epoch: retire quietly
+        stop_event.wait(cfg.heartbeat_interval)
+    return 0
+
+
+def inproc_worker_cmds(cfg: InprocGangConfig, hub: InProcHub):
+    """(worker_cmd, spare_cmd) factories for ``gang_supervise``: each
+    returns a CALLABLE (not an argv list), which the supervisor runs
+    as an in-proc daemon thread (``_ThreadWorker``)."""
+
+    def worker_cmd(rank: int, attempt: int, world: int,
+                   orig_rank: int):
+        def run(stop_event):
+            return run_inproc_worker(cfg, hub, rank, attempt, world,
+                                     orig_rank, stop_event)
+
+        run.__name__ = f"inproc-r{rank}-o{orig_rank}-a{attempt}"
+        return run
+
+    def spare_cmd(orig_rank: int, attempt: int):
+        def run(stop_event):
+            return run_inproc_spare(cfg, hub, orig_rank, attempt,
+                                    stop_event)
+
+        run.__name__ = f"inproc-spare{orig_rank}-a{attempt}"
+        return run
+
+    return worker_cmd, spare_cmd

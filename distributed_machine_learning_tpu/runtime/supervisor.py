@@ -211,7 +211,7 @@ def _seed_checkpoint(dst_dir, step: int | None, src_dirs) -> bool:
     return False
 
 
-def _gang_health_check(gang_dir, sampler, detector, active, events, tel,
+def _gang_health_check(tx, sampler, detector, active, events, tel,
                        attempt: int, state: dict) -> None:
     """One advisory health pass over the gang's heartbeat snapshots —
     the straggler half of the observability plane (ISSUE 6).
@@ -230,16 +230,15 @@ def _gang_health_check(gang_dir, sampler, detector, active, events, tel,
     machinery owns life-and-death; this names the slow rank *before*
     that machinery has to).  Rank ids in verdicts/counters use the
     ORIGINAL numbering (``active[cur_rank]``), the identity that
-    survives shrinks.
+    survives shrinks.  Beats arrive through the gang transport's
+    batched snapshot (``tx.read_beat_payloads()`` — one read per poll
+    regardless of world size), never by globbing beat files.
     """
-    from distributed_machine_learning_tpu.runtime.coordinator import (
-        append_health_event,
-    )
     from distributed_machine_learning_tpu.telemetry.aggregator import (
         median,
     )
 
-    samples = sampler.sample(gang_dir)
+    samples = sampler.sample(None, beats=tx.read_beat_payloads())
     stimes = [s.step_time_s for s in samples.values()
               if s.step_time_s is not None]
     now = time.monotonic()
@@ -273,8 +272,8 @@ def _gang_health_check(gang_dir, sampler, detector, active, events, tel,
                                ratio=round(v.ratio, 2))
             tel.flush()
         step = samples[v.rank].step if v.rank in samples else None
-        append_health_event(
-            gang_dir, "straggler", rank=orig, cur_rank=v.rank,
+        tx.append_health_event(
+            "straggler", rank=orig, cur_rank=v.rank,
             attempt=attempt, step=step, ratio=round(v.ratio, 3),
             value_s=v.value_s, median_s=v.median_s,
         )
@@ -321,6 +320,88 @@ def _drain_gang(procs, grace_s: float,
     return [p.poll() for p in procs]
 
 
+class _ThreadWorker:
+    """A Popen-shaped handle on an IN-PROC gang member (ISSUE 12): a
+    daemon thread running a callable that takes a stop event and
+    returns an exit code.
+
+    The supervisor's process machinery (poll/terminate/kill/wait) maps
+    onto thread semantics: ``terminate``/``kill`` set the stop event —
+    cooperative, because a thread cannot be SIGKILLed; the in-proc
+    worker checks it at every barrier poll and in every injected-stall
+    sleep, and a truly wedged thread is abandoned as a daemon (the
+    hub's epoch guard keeps its late writes out of the next attempt's
+    state).  Exit-code conventions match the subprocess harness:
+    return value, or ``runtime/inproc_worker.py::WorkerExit``'s code
+    (the coordinated-abort / injected-fault paths), or 1 on an
+    unexpected exception."""
+
+    def __init__(self, fn, name: str = "gang-inproc-worker"):
+        import threading
+
+        self.stop_event = threading.Event()
+        self._code: int | None = None
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, args=(fn,),
+                                        name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self, fn) -> None:
+        from distributed_machine_learning_tpu.runtime.inproc_worker import (
+            WorkerExit,
+        )
+
+        try:
+            code = fn(self.stop_event)
+            code = 0 if code is None else int(code)
+        except WorkerExit as exc:
+            code = exc.code
+        except BaseException as exc:  # surfaced as the exit code
+            import traceback
+
+            traceback.print_exc()
+            rank0_print(
+                f"[gang] in-proc worker {self._thread.name} died: "
+                f"{type(exc).__name__}: {exc}"
+            )
+            code = 1
+        with self._lock:
+            self._code = code
+
+    def poll(self) -> int | None:
+        with self._lock:
+            return self._code
+
+    def terminate(self) -> None:
+        self.stop_event.set()
+
+    kill = terminate
+
+    def wait(self, timeout: float | None = None) -> int | None:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(
+                f"in-proc worker {self._thread.name} still running")
+        return self.poll()
+
+
+def _spawn_worker(spec, out, env):
+    """One gang member from a ``worker_cmd`` result: an argv list
+    spawns a subprocess (the historical path); a callable runs as an
+    in-proc :class:`_ThreadWorker` (``spec(stop_event) -> exit code``,
+    no log redirection — in-proc members share the supervisor's
+    stdio)."""
+    import subprocess
+
+    if callable(spec):
+        return _ThreadWorker(spec, name=getattr(spec, "__name__",
+                                                "gang-inproc-worker"))
+    return subprocess.Popen(
+        spec, stdout=out,
+        stderr=subprocess.STDOUT if out is not None else None, env=env,
+    )
+
+
 def _worker_cmd_arity(worker_cmd) -> int:
     """How many of ``(rank, attempt, world, orig_rank)`` the caller's
     ``worker_cmd`` accepts (2-4; ``*args`` takes all four).  Keeps the
@@ -353,10 +434,11 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                    straggler_policy: str = "advise",
                    replace_after: int = 2,
                    events: FaultEvents | None = None,
-                   poll_s: float = 0.2, grace_s: float = 10.0,
+                   poll_s: float | None = None, grace_s: float = 10.0,
                    env=None, log_dir=None,
                    straggler_multiple: float = 4.0,
-                   straggler_consecutive: int = 3) -> list[int]:
+                   straggler_consecutive: int = 3,
+                   transport=None) -> list[int]:
     """Run a gang of ``world`` worker processes to completion, restarting
     ALL of them together on any failure — the multi-host analogue of
     :func:`run_attempts` — and, when allowed, SHRINKING past ranks that
@@ -467,34 +549,54 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
     ``gang_world_size`` gauge + one ``gang_grow`` trace instant, and
     ``grow``/``promote``/``demote`` events in ``gang_health.jsonl`` —
     exact telemetry parity with the shrink path.
-    """
-    import subprocess
 
-    from distributed_machine_learning_tpu.runtime.coordinator import (
-        clear_gang_state,
-        consume_join,
-        declare_abort,
-        elect_restore_step,
-        enforce_restore_point,
-        read_abort,
-        read_joins,
-    )
+    Pluggable control plane (ISSUE 12): every channel above travels
+    through a ``runtime/transport.py::GangTransport`` — ``transport``
+    defaults to the historical file backend over ``gang_dir``.  A
+    ``worker_cmd``/``spare_cmd`` may return a CALLABLE instead of an
+    argv list: the member then runs as an in-proc daemon thread
+    (:class:`_ThreadWorker`; ``runtime/inproc_worker.py`` builds such
+    callables), which is what makes 64-128-rank chaos campaigns run in
+    tier-1 time.  ``poll_s=None`` defers the supervision cadence to
+    the transport (the cadence-is-a-transport-property bugfix); the
+    run ends by appending a ``transport`` health-ledger record (ops /
+    retries / timeouts) that ``tools/gang_status.py`` renders.
+    """
     from distributed_machine_learning_tpu.runtime.coordinator import (
         GANG_ABORT_EXIT,
-    )
-    from distributed_machine_learning_tpu.runtime.coordinator import (
-        append_health_event,
+        elect_restore_step,
+        enforce_restore_point,
     )
     from distributed_machine_learning_tpu.runtime.faults import (
-        FAULT_LEDGER_FILE,
-        ledger_recovered_ranks,
-        ledger_unrecovered_lost_ranks,
+        recovered_ranks_from_entries,
+        unrecovered_lost_from_entries,
+    )
+    from distributed_machine_learning_tpu.runtime.transport import (
+        FileTransport,
     )
     from distributed_machine_learning_tpu.telemetry import get_telemetry
     from distributed_machine_learning_tpu.telemetry.aggregator import (
         HeartbeatSampler,
         StragglerDetector,
     )
+
+    tx = transport if transport is not None \
+        else FileTransport(gang_dir, events=events)
+    if getattr(tx, "events", None) is None:
+        tx.events = events
+    if poll_s is None:
+        poll_s = tx.supervisor_poll_s(world)
+
+    def _record_transport_stats() -> None:
+        # The durable transport-health record (ops/retries/timeouts by
+        # backend) the status tool renders post-mortem — written on
+        # every terminal path, best-effort (a stats line must never
+        # mask the run's real outcome).
+        try:
+            tx.append_health_event("transport", **tx.stats())
+        except Exception as exc:
+            rank0_print(f"[gang] transport stats not recorded: "
+                        f"{type(exc).__name__}: {exc}")
 
     if world < 1:
         raise ValueError(f"world must be >= 1, got {world}")
@@ -547,9 +649,9 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
             "only travels via orig_rank"
         )
     # A fresh supervision run: stale beats/aborts AND restore records
-    # from any earlier run in the same gang_dir would poison detection
-    # and the election.
-    clear_gang_state(gang_dir, restore_records=True)
+    # from any earlier run in the same gang state would poison
+    # detection and the election.
+    tx.clear_gang_state(restore_records=True)
     if log_dir is not None:
         os.makedirs(log_dir, exist_ok=True)
     shared_ckpt = ckpt_dirs is None or isinstance(ckpt_dirs,
@@ -584,7 +686,6 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
     # TRIGGER skips them, so they can't re-declare budget-free planned
     # boundaries in a loop; any later boundary retries their admission.
     deferred_joins: set[int] = set()
-    ledger_path = os.path.join(os.fspath(gang_dir), FAULT_LEDGER_FILE)
     restarts = 0  # FAILURE restarts — the max_restarts budget
     attempt = 0   # every relaunch, planned boundaries included: the
     #               log/telemetry/consumption attempt tag
@@ -603,14 +704,14 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                                      consecutive=straggler_consecutive)
         health_state: dict = {}
         procs, logs = [], []
-        spare_procs: dict[int, subprocess.Popen] = {}
+        spare_procs: dict[int, object] = {}  # Popen or _ThreadWorker
         planned: dict | None = None
 
         def ready_spares() -> list[int]:
             """Spare ids promotable RIGHT NOW: process alive and its
             join-channel announcement present — best-prefetched first,
             so a promotion costs the smallest possible seed copy."""
-            joins = read_joins(gang_dir)
+            joins = tx.read_joins()
             alive = [o for o in spare_pool
                      if o in spare_procs
                      and spare_procs[o].poll() is None
@@ -626,8 +727,10 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         try:
             with span:
                 for rank in range(cur_world):
+                    spec = worker_cmd(*(rank, attempt, cur_world,
+                                        active[rank])[:cmd_arity])
                     out = None
-                    if log_dir is not None:
+                    if log_dir is not None and not callable(spec):
                         out = open(
                             os.path.join(
                                 log_dir,
@@ -636,18 +739,11 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                             "ab",
                         )
                     logs.append(out)
-                    argv = worker_cmd(*(rank, attempt, cur_world,
-                                        active[rank])[:cmd_arity])
-                    procs.append(subprocess.Popen(
-                        argv,
-                        stdout=out,
-                        stderr=subprocess.STDOUT if out is not None
-                        else None,
-                        env=env,
-                    ))
+                    procs.append(_spawn_worker(spec, out, env))
                 for orig in spare_pool:
+                    spec = spare_cmd(orig, attempt)
                     out = None
-                    if log_dir is not None:
+                    if log_dir is not None and not callable(spec):
                         out = open(
                             os.path.join(
                                 log_dir,
@@ -656,13 +752,7 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                             "ab",
                         )
                     logs.append(out)
-                    spare_procs[orig] = subprocess.Popen(
-                        spare_cmd(orig, attempt),
-                        stdout=out,
-                        stderr=subprocess.STDOUT if out is not None
-                        else None,
-                        env=env,
-                    )
+                    spare_procs[orig] = _spawn_worker(spec, out, env)
                 failed = None
                 while failed is None:
                     codes = [p.poll() for p in procs]
@@ -672,11 +762,12 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                         failed = bad
                         break
                     if all(c == 0 for c in codes):
+                        _record_transport_stats()
                         return list(codes)  # the gang finished cleanly
                     time.sleep(poll_s)
                     if not health_state.get("broken"):
                         try:
-                            _gang_health_check(gang_dir, sampler,
+                            _gang_health_check(tx, sampler,
                                                detector, active, events,
                                                tel, attempt, health_state)
                         except Exception as exc:
@@ -704,13 +795,12 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                         # Seed-failure-deferred joins likewise wait for
                         # a boundary something else causes.
                         pending = sorted(
-                            r for r, p in read_joins(gang_dir).items()
+                            r for r, p in tx.read_joins().items()
                             if not p.get("spare") and r not in active
                             and r not in deferred_joins
                             and (shared_ckpt or r < len(ckpt_dirs or ()))
                         )
-                        if pending and declare_abort(
-                                gang_dir,
+                        if pending and tx.declare_abort(
                                 f"planned grow boundary: rank(s) "
                                 f"{pending} announced join",
                                 SUPERVISOR_BOUNDARY_RANK):
@@ -728,8 +818,7 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                             if s >= replace_after and o in active
                         )
                         ready = ready_spares() if slow else []
-                        if slow and ready and declare_abort(
-                                gang_dir,
+                        if slow and ready and tx.declare_abort(
                                 f"straggler replacement: demoting rank "
                                 f"{slow[0]} (flagged {replace_after}+ "
                                 "consecutive health feeds)",
@@ -751,7 +840,7 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
             for out in logs:
                 if out is not None:
                     out.close()
-        abort = read_abort(gang_dir)
+        abort = tx.read_abort()
         # A boundary the supervisor itself declared (grow admission /
         # straggler replacement): nobody failed, nobody's budget is
         # charged, and max_restarts is not consumed — the stop is
@@ -766,9 +855,10 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         # recover_rank firings clear their target's EARLIER lose_rank
         # markers — the host came back; holding the old dead-host entry
         # against it would make every loss permanent forever.  The
-        # masking is order-aware (ledger_unrecovered_lost_ranks): a
+        # masking is order-aware (unrecovered_lost_from_entries): a
         # rank that dies again AFTER recovering counts as lost again.
-        recovered = ledger_recovered_ranks(ledger_path)
+        ledger = tx.read_fault_entries()
+        recovered = recovered_ranks_from_entries(ledger)
         if planned_stop:
             why = str(abort.get("reason"))
         else:
@@ -791,7 +881,7 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
             # --orig-rank), so the entries stay valid across
             # renumberings — ranks already shrunk away just filter out
             # of the active set.
-            unrecoverable = (ledger_unrecovered_lost_ranks(ledger_path)
+            unrecoverable = (unrecovered_lost_from_entries(ledger)
                              & set(active))
             if rank_restart_budget is not None:
                 unrecoverable |= {o for o in active
@@ -800,6 +890,7 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
                 rank0_print(
                     f"[gang] giving up after {restarts} restart(s): {why}"
                 )
+                _record_transport_stats()
                 raise GangFailure(
                     f"gang failed after {restarts} restart(s): {why}",
                     final_codes,
@@ -814,8 +905,8 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         # The health ledger keeps the restart/shrink/grow history the
         # status tool renders (beat files and the abort latch are about
         # to be cleared; this line is what survives).
-        append_health_event(
-            gang_dir, "boundary" if planned_stop else "restart",
+        tx.append_health_event(
+            "boundary" if planned_stop else "restart",
             attempt=attempt, world=cur_world, why=why,
         )
 
@@ -827,6 +918,7 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         lost_s = sorted(unrecoverable)
         if unrecoverable and (min_world is None
                               or len(survivors) < min_world):
+            _record_transport_stats()
             raise GangFailure(
                 f"rank(s) {lost_s} unrecoverable (budget exhausted "
                 f"or lose_rank fired) and the gang cannot shrink "
@@ -846,7 +938,7 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         if max_world is not None:
             room = max_world - len(survivors)
             pending = sorted(
-                r for r, p in read_joins(gang_dir).items()
+                r for r, p in tx.read_joins().items()
                 if not p.get("spare") and r not in survivors
                 and (shared_ckpt or r < len(ckpt_dirs or ()))
             )
@@ -867,10 +959,11 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         if not reshaped:
             # Same membership: clear the dead attempt's beats and abort
             # latch, but KEEP restore records — the election input.
-            clear_gang_state(gang_dir)
+            tx.clear_gang_state()
             if ckpt_dirs is not None:
                 elected = elect_restore_step(gang_dir, cur_world,
-                                             ckpt_dirs=dirs_for(active))
+                                             ckpt_dirs=dirs_for(active),
+                                             transport=tx)
                 quarantined = enforce_restore_point(dirs_for(active),
                                                     elected)
                 rank0_print(
@@ -889,7 +982,7 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         surv_cur = [active.index(o) for o in survivors]
         elected = elect_restore_step(
             gang_dir, cur_world, ckpt_dirs=dirs_for(survivors),
-            ranks=surv_cur,
+            ranks=surv_cur, transport=tx,
         )
         quarantined = enforce_restore_point(dirs_for(survivors), elected)
         admitted = joined + promoted
@@ -951,7 +1044,7 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         # Only actually-admitted announcements are consumed; a deferred
         # join's file is the retry ticket.
         for o in admitted:
-            consume_join(gang_dir, o)
+            tx.consume_join(o)
             fail_counts.setdefault(o, 0)
             deferred_joins.discard(o)
         for o in joined:
@@ -963,8 +1056,7 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
         # Renumbering invalidates rank-keyed restore records; the
         # fired-fault ledger is KEPT — the member inheriting a fired
         # rank number must stay latched.
-        clear_gang_state(gang_dir, restore_records=True,
-                         fault_ledger=False)
+        tx.clear_gang_state(restore_records=True, fault_ledger=False)
         grown = len(new_active) > cur_world
         shrunk = bool(lost_s)
         if events is not None:
@@ -995,25 +1087,25 @@ def gang_supervise(worker_cmd, world: int, gang_dir,
             tel.registry.gauge("gang_world_size").set(len(new_active))
             tel.flush()
         if shrunk:
-            append_health_event(
-                gang_dir, "shrink", attempt=attempt,
+            tx.append_health_event(
+                "shrink", attempt=attempt,
                 from_world=cur_world, to_world=len(survivors),
                 lost=lost_s, restore_step=elected,
             )
         if grown or promoted or demoted:
-            append_health_event(
-                gang_dir, "grow" if grown else "replace",
+            tx.append_health_event(
+                "grow" if grown else "replace",
                 attempt=attempt, from_world=cur_world,
                 to_world=len(new_active), joined=joined,
                 promoted=promoted, demoted=demoted,
                 restore_step=elected, seeded=seeded,
             )
         for o in promoted:
-            append_health_event(gang_dir, "promote", attempt=attempt,
-                                rank=o, restore_step=elected)
+            tx.append_health_event("promote", attempt=attempt,
+                                   rank=o, restore_step=elected)
         for o in demoted:
-            append_health_event(gang_dir, "demote", attempt=attempt,
-                                rank=o, why="straggler replacement")
+            tx.append_health_event("demote", attempt=attempt,
+                                   rank=o, why="straggler replacement")
         rank0_print(
             f"[gang] {why}; world {cur_world} -> {len(new_active)}"
             + (f": rank(s) {lost_s} unrecoverable — shrinking to "

@@ -1,0 +1,1201 @@
+"""Pluggable gang transport — the control-plane seam (ISSUE 12).
+
+Every coordination channel the gang stack uses — heartbeats, the
+first-writer-wins abort latch, join announcements, restore-point
+records, the health/fault/consumption ledgers, and the between-attempt
+state clear — historically lived as files in a shared ``gang_dir``
+(``runtime/coordinator.py``).  Correct, but it hard-capped gang tests
+at worlds ≤ 5 (one OS process per rank), assumed a shared filesystem,
+and left the resilience stack unproven at the worlds the papers it
+reproduces run at (arxiv 1811.05233's hundreds of replicas).
+
+:class:`GangTransport` extracts that channel set as an interface with
+three backends:
+
+- :class:`FileTransport` — today's behavior, delegated verbatim to the
+  ``runtime/coordinator.py`` file functions: the on-disk format is
+  byte-compatible with every earlier PR, the fsync discipline on the
+  ledgers is preserved (dmlcheck DML002), and a coordinator built
+  without an explicit transport gets exactly this.
+- :class:`InProcTransport` over an :class:`InProcHub` — threads +
+  in-memory channels: no shared filesystem, no subprocess spawn.  This
+  is what makes 64-128-rank supervised gangs run in seconds and
+  unlocks the chaos *campaigns* (``runtime/inproc_worker.py``,
+  ``tests/test_chaos_campaign.py``).  Durable ledgers (health, faults,
+  consumption) can MIRROR to a ``mirror_dir`` so post-mortem tools
+  (``tools/gang_status.py``) read a dead campaign exactly like a file
+  gang.
+- :class:`TcpTransport` against a :class:`TcpGangServer` — the first
+  transport with a LOSSY medium, so it carries the robustness layer
+  the others never needed: a per-operation timeout on every socket
+  call, bounded retry with exponential backoff + jitter, idempotent
+  message semantics (every mutating request carries an ``op_id`` the
+  server deduplicates, so a duplicated or retried delivery can never
+  double-fire an abort, double-append a ledger line, or re-admit a
+  consumed join), and persistent connection loss surfaced as
+  :class:`TransportError` — which ``GangCoordinator`` feeds into the
+  existing peer-death detector (a rank that cannot reach the gang for
+  ``peer_timeout_s`` treats ITSELF as partitioned off and exits).
+
+Poll cadence is a TRANSPORT property (the ISSUE 12 bugfix): the old
+``min(heartbeat_interval_s, peer_timeout_s / 4)`` monitor cadence and
+the supervisor's fixed 0.2 s poll were tuned for file-stat costs.  The
+in-proc backend polls tightly (reads are dict lookups — tight polls
+are what make the campaigns fast), while the TCP backend scales its
+cadence with the world size so 128 monitors polling N-1 peers each
+cannot self-DoS the rank-0 host (reads are also BATCHED:
+``read_beats`` is one round trip for the whole gang, never N).
+
+Telemetry: every operation counts into ``gang_transport_ops{op,
+backend}``; retries and timeouts count into ``gang_transport_retries``
+/ ``gang_transport_timeouts`` and mirror into
+``FaultEvents.transport_retries``/``transport_timeouts`` (the
+``resilience_summary`` rows).  ``stats()`` returns the same totals for
+the supervisor's end-of-run health-ledger record, which
+``tools/gang_status.py`` renders as the transport-health line.
+
+This module is deliberately stdlib-only (no jax, no numpy) so the
+``tools/`` layer can import it against a dead run's directory — the
+same contract as ``telemetry/aggregator.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import socket
+import socketserver
+import threading
+import time
+import uuid
+from collections import OrderedDict
+
+from distributed_machine_learning_tpu.runtime import coordinator as _coord
+
+TRANSPORT_BACKENDS = ("file", "inproc", "tcp")
+
+# One line of the TCP wire protocol (request or response) may not
+# exceed this — a 128-rank beat snapshot with metrics is ~100 KiB.
+_MAX_LINE = 8 * 1024 * 1024
+
+# Ops that mutate server/hub state: each request carries an op_id the
+# server deduplicates, so retries and duplicated deliveries are exactly
+# -once.  Reads are naturally idempotent and retry without ids.
+_MUTATING_OPS = frozenset({
+    "publish_beat", "declare_abort", "announce_join", "consume_join",
+    "write_restore", "append_health", "append_fault", "append_consumed",
+    "clear",
+})
+
+
+class TransportError(RuntimeError):
+    """A gang-transport operation failed for good (retries exhausted,
+    or the channel is severed).  The coordinator treats a persistent
+    TransportError streak as evidence this rank is partitioned off the
+    gang — peer death, seen from the inside."""
+
+
+def append_jsonl_fsync(path: str | os.PathLike, entry: dict) -> None:
+    """Append one JSON line to a ledger file, flushed AND fsynced
+    before returning (dmlcheck DML002): ledger consumers include
+    relaunched processes whose writer may ``os._exit`` on its very
+    next statement."""
+    with open(os.fspath(path), "a") as f:
+        f.write(json.dumps(entry) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _read_jsonl_dicts(path: str) -> list[dict]:
+    """Tolerant JSONL reader: absent file → empty, torn final line (a
+    kill mid-append) skipped — the shared reading rule of every gang
+    ledger."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        try:
+            entry = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(entry, dict):
+            out.append(entry)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Interface
+# ---------------------------------------------------------------------------
+
+
+class GangTransport:
+    """The channel set ``GangCoordinator``/``gang_supervise`` coordinate
+    through.  Subclasses implement the ``_do_*`` operations; this base
+    owns operation accounting (the telemetry satellite) and the poll
+    cadence defaults (the file backend's historical numbers).
+
+    Beat reads return ``(signature, payload)`` pairs: ``signature`` is
+    an opaque token that changes whenever the rank re-publishes (file:
+    ``(st_mtime_ns, st_size)``; hub: a version counter) — the
+    change-signature staleness basis the peer detector judges on, never
+    a cross-host clock.  ``payload`` may be None for a torn/unreadable
+    beat whose signature still advanced (alive, content unreadable this
+    instant).
+    """
+
+    backend = "?"
+
+    def __init__(self, events=None):
+        self.events = events
+        self.op_counts: dict[str, int] = {}
+        self.retries = 0
+        self.timeouts = 0
+        self._stats_lock = threading.Lock()
+        self._tel_counters: dict[str, object] = {}
+
+    # -- accounting ------------------------------------------------------
+    def _telemetry(self):
+        from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+        return get_telemetry()
+
+    def _count(self, op: str) -> None:
+        with self._stats_lock:
+            self.op_counts[op] = self.op_counts.get(op, 0) + 1
+            counter = self._tel_counters.get(op)
+        if counter is None:
+            tel = self._telemetry()
+            if tel is None:
+                return
+            counter = tel.registry.counter(
+                "gang_transport_ops", op=op, backend=self.backend
+            )
+            with self._stats_lock:
+                self._tel_counters[op] = counter
+        counter.inc()
+
+    def _count_retry(self) -> None:
+        with self._stats_lock:
+            self.retries += 1
+        if self.events is not None:
+            self.events.transport_retries += 1
+        tel = self._telemetry()
+        if tel is not None:
+            tel.registry.counter("gang_transport_retries",
+                                 backend=self.backend).inc()
+
+    def _count_timeout(self) -> None:
+        with self._stats_lock:
+            self.timeouts += 1
+        if self.events is not None:
+            self.events.transport_timeouts += 1
+        tel = self._telemetry()
+        if tel is not None:
+            tel.registry.counter("gang_transport_timeouts",
+                                 backend=self.backend).inc()
+
+    def stats(self) -> dict:
+        """Op/retry/timeout totals — the transport-health record the
+        supervisor appends to the health ledger at the end of a run."""
+        with self._stats_lock:
+            ops = dict(sorted(self.op_counts.items()))
+            return {
+                "backend": self.backend,
+                "ops": ops,
+                "ops_total": sum(ops.values()),
+                "retries": self.retries,
+                "timeouts": self.timeouts,
+            }
+
+    # -- poll cadence (transport property — the ISSUE 12 bugfix) ---------
+    def monitor_poll_s(self, heartbeat_interval_s: float,
+                       peer_timeout_s: float, world: int) -> float:
+        """How often one rank's monitor thread should poll the gang."""
+        return min(heartbeat_interval_s, peer_timeout_s / 4)
+
+    def supervisor_poll_s(self, world: int) -> float:
+        """How often the gang supervisor should poll worker liveness,
+        joins, and the health snapshot."""
+        return 0.2
+
+    def barrier_poll_s(self) -> float:
+        """How often ``wait_for_peers`` re-reads the gang's steps."""
+        return 0.05
+
+    # -- channel operations (subclass hooks wrapped with accounting) -----
+    def publish_beat(self, rank: int, payload: dict) -> None:
+        self._count("publish_beat")
+        self._do_publish_beat(rank, payload)
+
+    def read_beat(self, rank: int):
+        """(signature, payload|None) or None when the rank never
+        published."""
+        self._count("read_beat")
+        return self._do_read_beat(rank)
+
+    def read_beats(self) -> dict[int, tuple]:
+        """rank -> (signature, payload|None) for every published beat —
+        ONE operation regardless of world size (the batched read the
+        TCP cadence depends on)."""
+        self._count("read_beats")
+        return self._do_read_beats()
+
+    def read_beat_payloads(self) -> dict[int, dict]:
+        """rank -> payload for every beat readable right now."""
+        return {r: p for r, (_, p) in self.read_beats().items()
+                if isinstance(p, dict)}
+
+    def declare_abort(self, reason: str, by_rank: int,
+                      peer: int | None = None) -> bool:
+        self._count("declare_abort")
+        return self._do_declare_abort(reason, by_rank, peer)
+
+    def read_abort(self) -> dict | None:
+        self._count("read_abort")
+        return self._do_read_abort()
+
+    def announce_join(self, rank: int, payload: dict) -> None:
+        """Publish (or refresh — idempotent overwrite) a join
+        announcement.  ``payload`` must carry at least ``rank`` and
+        ``spare``; callers add ``prefetched_step``/``kind``/... ."""
+        self._count("announce_join")
+        self._do_announce_join(int(rank), payload)
+
+    def read_joins(self) -> dict[int, dict]:
+        self._count("read_joins")
+        return self._do_read_joins()
+
+    def consume_join(self, rank: int) -> None:
+        self._count("consume_join")
+        self._do_consume_join(int(rank))
+
+    def write_restore_record(self, rank: int, steps) -> None:
+        self._count("write_restore")
+        self._do_write_restore(int(rank), sorted(int(s) for s in steps))
+
+    def read_restore_record(self, rank: int) -> set[int] | None:
+        self._count("read_restore")
+        return self._do_read_restore(int(rank))
+
+    def append_health_event(self, kind: str, **fields) -> None:
+        self._count("append_health")
+        self._do_append_health({"kind": kind, "time": time.time(),
+                                **fields})
+
+    def read_health_events(self) -> list[dict]:
+        self._count("read_health")
+        return self._do_read_health()
+
+    def append_fault_entry(self, entry: dict) -> None:
+        self._count("append_fault")
+        self._do_append_fault(dict(entry))
+
+    def read_fault_entries(self) -> list[dict]:
+        self._count("read_faults")
+        return self._do_read_faults()
+
+    def append_consumed(self, orig_rank: int, payload: dict) -> None:
+        self._count("append_consumed")
+        self._do_append_consumed(int(orig_rank), dict(payload))
+
+    def read_consumed(self, orig_rank: int | None = None) -> list[dict]:
+        """Consumption rows for one original rank, or (None) for every
+        rank — the exactly-once audit input."""
+        self._count("read_consumed")
+        return self._do_read_consumed(
+            None if orig_rank is None else int(orig_rank))
+
+    def clear_gang_state(self, restore_records: bool = False,
+                         fault_ledger: bool | None = None) -> None:
+        """Same contract as ``coordinator.clear_gang_state``: beats and
+        the abort latch always; restore records on request; the
+        fault/health/consumed ledgers and pending joins only at
+        fresh-run init (``fault_ledger`` defaults to
+        ``restore_records``)."""
+        self._count("clear")
+        self._do_clear(restore_records,
+                       restore_records if fault_ledger is None
+                       else fault_ledger)
+
+    def snapshot(self) -> dict:
+        """Everything a status tool needs in one read: beats, the abort
+        latch, pending joins, health events, fired faults — the API
+        ``tools/gang_status.py`` reads instead of globbing
+        ``beat_rank*.json``."""
+        return {
+            "backend": self.backend,
+            "beats": self.read_beat_payloads(),
+            "abort": self.read_abort(),
+            "joins": self.read_joins(),
+            "health": self.read_health_events(),
+            "faults_fired": self.read_fault_entries(),
+        }
+
+    def close(self) -> None:
+        """Release any live resources (sockets).  Idempotent."""
+
+
+# ---------------------------------------------------------------------------
+# File backend — the PR 3/5/10 behavior, extracted verbatim
+# ---------------------------------------------------------------------------
+
+
+class FileTransport(GangTransport):
+    """The shared-directory backend: every operation delegates to the
+    ``runtime/coordinator.py`` file functions (or reproduces their
+    exact format for the ledgers), so the on-disk layout is
+    byte-compatible with every pre-transport release and mixed
+    deployments (old reader, new writer) keep working."""
+
+    backend = "file"
+
+    def __init__(self, gang_dir: str | os.PathLike, events=None):
+        super().__init__(events=events)
+        self.gang_dir = os.fspath(gang_dir)
+        # The directory is created on the first WRITE, never at
+        # construction: read-only consumers (tools/gang_status.py
+        # pointed at a post-mortem mount, or a typo'd path) must not
+        # mutate the filesystem.
+        self._dir_ready = False
+
+    def _ensure_dir(self) -> None:
+        if not self._dir_ready:
+            os.makedirs(self.gang_dir, exist_ok=True)
+            self._dir_ready = True
+
+    # beats
+    def _do_publish_beat(self, rank: int, payload: dict) -> None:
+        self._ensure_dir()
+        _coord._write_atomic(_coord._beat_path(self.gang_dir, rank),
+                             payload)
+
+    def _beat_entry(self, path: str):
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        sig = (st.st_mtime_ns, st.st_size)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            payload = None  # torn read mid-replace: alive by signature
+        return (sig, payload if isinstance(payload, dict) else None)
+
+    def _do_read_beat(self, rank: int):
+        return self._beat_entry(_coord._beat_path(self.gang_dir, rank))
+
+    def _do_read_beats(self) -> dict[int, tuple]:
+        out: dict[int, tuple] = {}
+        prefix = _coord._BEAT_PREFIX
+        try:
+            names = os.listdir(self.gang_dir)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith(prefix) and name.endswith(".json")):
+                continue
+            rank_s = name[len(prefix):-len(".json")]
+            if not rank_s.isdigit():
+                continue
+            entry = self._beat_entry(os.path.join(self.gang_dir, name))
+            if entry is not None:
+                out[int(rank_s)] = entry
+        return out
+
+    # abort latch
+    def _do_declare_abort(self, reason, by_rank, peer) -> bool:
+        self._ensure_dir()
+        return _coord.declare_abort(self.gang_dir, reason, by_rank,
+                                    peer=peer)
+
+    def _do_read_abort(self):
+        return _coord.read_abort(self.gang_dir)
+
+    # joins
+    def _do_announce_join(self, rank: int, payload: dict) -> None:
+        self._ensure_dir()
+        _coord._write_atomic(_coord._join_path(self.gang_dir, rank),
+                             payload)
+
+    def _do_read_joins(self):
+        return _coord.read_joins(self.gang_dir)
+
+    def _do_consume_join(self, rank: int) -> None:
+        _coord.consume_join(self.gang_dir, rank)
+
+    # restore records
+    def _do_write_restore(self, rank: int, steps: list[int]) -> None:
+        self._ensure_dir()
+        _coord._write_atomic(
+            _coord._restore_path(self.gang_dir, rank),
+            {"rank": rank, "steps": steps, "time": time.time()},
+        )
+
+    def _do_read_restore(self, rank: int):
+        return _coord.read_restore_record(self.gang_dir, rank)
+
+    # ledgers (append paths carry the DML002 flush+fsync discipline)
+    def _do_append_health(self, payload: dict) -> None:
+        self._ensure_dir()
+        append_jsonl_fsync(
+            os.path.join(self.gang_dir, _coord.GANG_HEALTH_FILE), payload)
+
+    def _do_read_health(self) -> list[dict]:
+        return _read_jsonl_dicts(
+            os.path.join(self.gang_dir, _coord.GANG_HEALTH_FILE))
+
+    def fault_ledger_path(self) -> str:
+        # Import-free name: runtime/faults.py pulls in numpy, which the
+        # stdlib-only tools layer must never load.
+        return os.path.join(self.gang_dir, "faults_fired.jsonl")
+
+    def _do_append_fault(self, entry: dict) -> None:
+        self._ensure_dir()
+        append_jsonl_fsync(self.fault_ledger_path(), entry)
+
+    def _do_read_faults(self) -> list[dict]:
+        return _read_jsonl_dicts(self.fault_ledger_path())
+
+    def _consumed_path(self, orig_rank: int) -> str:
+        return os.path.join(
+            self.gang_dir, f"{_coord.CONSUMED_PREFIX}{orig_rank}.jsonl")
+
+    def _do_append_consumed(self, orig_rank: int, payload: dict) -> None:
+        self._ensure_dir()
+        append_jsonl_fsync(self._consumed_path(orig_rank), payload)
+
+    def _do_read_consumed(self, orig_rank: int | None) -> list[dict]:
+        if orig_rank is not None:
+            return _read_jsonl_dicts(self._consumed_path(orig_rank))
+        out: list[dict] = []
+        try:
+            names = sorted(os.listdir(self.gang_dir))
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith(_coord.CONSUMED_PREFIX) \
+                    and name.endswith(".jsonl"):
+                out.extend(_read_jsonl_dicts(
+                    os.path.join(self.gang_dir, name)))
+        return out
+
+    def _do_clear(self, restore_records: bool, fault_ledger: bool) -> None:
+        _coord.clear_gang_state(self.gang_dir,
+                                restore_records=restore_records,
+                                fault_ledger=fault_ledger)
+
+
+# ---------------------------------------------------------------------------
+# In-proc backend — threads + in-memory channels
+# ---------------------------------------------------------------------------
+
+
+class InProcHub:
+    """The shared in-memory gang state N thread-ranks coordinate
+    through — one hub per gang, one :class:`InProcTransport` handle per
+    member.  All mutation is under one lock (operations are dict
+    updates; contention is negligible against even the in-proc poll
+    cadence).
+
+    ``mirror_dir``: when given, the DURABLE ledgers (health, faults,
+    consumption) are also appended to files in that directory in the
+    exact file-backend format — volatile channels (beats, abort latch,
+    joins) stay memory-only.  This is what lets ``tools/gang_status.py``
+    and the exactly-once audits read a finished 64-128-rank campaign
+    exactly like a file-backed gang.
+
+    ``epoch`` advances on every :meth:`clear`: member transports bind
+    the epoch they were created under, so a zombie worker thread from a
+    drained attempt (threads cannot be SIGKILLed) gets
+    :class:`TransportError` on its next write instead of polluting the
+    relaunched attempt's state.
+    """
+
+    def __init__(self, mirror_dir: str | os.PathLike | None = None):
+        self.lock = threading.RLock()
+        self.mirror_dir = (os.fspath(mirror_dir)
+                           if mirror_dir is not None else None)
+        if self.mirror_dir is not None:
+            os.makedirs(self.mirror_dir, exist_ok=True)
+        self.epoch = 0
+        self.beats: dict[int, tuple[int, dict]] = {}
+        self.abort: dict | None = None
+        self.joins: dict[int, dict] = {}
+        self.restore: dict[int, list[int]] = {}
+        self.health: list[dict] = []
+        self.faults: list[dict] = []
+        self.consumed: dict[int, list[dict]] = {}
+        self.box: dict = {}
+        self._version = 0
+
+    # -- the broadcast box (in-proc worker extension) --------------------
+    # A tiny rank-0-broadcast channel the in-proc worker harness uses to
+    # share the restored state and save commits (on a real pod this is a
+    # host-side broadcast collective; in-proc it is a dict).
+    def box_put(self, key, value) -> None:
+        with self.lock:
+            self.box[key] = value
+
+    def box_get(self, key, default=None):
+        with self.lock:
+            return self.box.get(key, default)
+
+    def clear(self, restore_records: bool, fault_ledger: bool) -> None:
+        with self.lock:
+            self.epoch += 1
+            self.beats.clear()
+            self.abort = None
+            self.box.clear()
+            if restore_records:
+                self.restore.clear()
+            if fault_ledger:
+                self.health.clear()
+                self.faults.clear()
+                self.consumed.clear()
+                self.joins.clear()
+        if self.mirror_dir is not None:
+            _coord.clear_gang_state(self.mirror_dir,
+                                    restore_records=restore_records,
+                                    fault_ledger=fault_ledger)
+
+
+class InProcTransport(GangTransport):
+    """One gang member's handle on an :class:`InProcHub`.
+
+    ``bind_epoch=True`` (the worker-thread default via
+    :func:`make_transport`) pins the handle to the hub epoch at
+    creation: after the supervisor clears between attempts, writes from
+    a leftover thread of the drained attempt raise
+    :class:`TransportError` — the in-proc analogue of a killed process
+    staying dead.  The supervisor's own handle binds no epoch (it is
+    the one doing the clearing)."""
+
+    backend = "inproc"
+
+    def __init__(self, hub: InProcHub, events=None,
+                 bind_epoch: bool = False):
+        super().__init__(events=events)
+        self.hub = hub
+        self._epoch = hub.epoch if bind_epoch else None
+
+    def _check_epoch(self) -> None:
+        if self._epoch is not None and self._epoch != self.hub.epoch:
+            raise TransportError(
+                f"stale transport handle (epoch {self._epoch}, hub at "
+                f"{self.hub.epoch}): this member was drained and the "
+                "gang state cleared"
+            )
+
+    def _do_publish_beat(self, rank: int, payload: dict) -> None:
+        self._check_epoch()
+        hub = self.hub
+        with hub.lock:
+            hub._version += 1
+            hub.beats[rank] = (hub._version, dict(payload))
+
+    def _do_read_beat(self, rank: int):
+        self._check_epoch()
+        with self.hub.lock:
+            entry = self.hub.beats.get(rank)
+            # Payloads are replaced wholesale on publish and treated
+            # read-only by every consumer, so reads hand out the stored
+            # reference: N ranks re-reading N beats every barrier poll
+            # must stay O(world) dict lookups, not O(world) deep
+            # copies.
+            return (entry[0], entry[1]) if entry else None
+
+    def _do_read_beats(self) -> dict[int, tuple]:
+        self._check_epoch()
+        with self.hub.lock:
+            return dict(self.hub.beats)
+
+    def _do_declare_abort(self, reason, by_rank, peer) -> bool:
+        self._check_epoch()
+        payload = {"reason": reason, "by_rank": by_rank,
+                   "time": time.time()}
+        if peer is not None:
+            payload["peer"] = peer
+        with self.hub.lock:
+            if self.hub.abort is not None:
+                return False
+            self.hub.abort = payload
+            return True
+
+    def _do_read_abort(self):
+        self._check_epoch()
+        with self.hub.lock:
+            return dict(self.hub.abort) if self.hub.abort else None
+
+    def _do_announce_join(self, rank: int, payload: dict) -> None:
+        self._check_epoch()
+        with self.hub.lock:
+            self.hub.joins[rank] = dict(payload)
+
+    def _do_read_joins(self):
+        self._check_epoch()
+        with self.hub.lock:
+            return {r: dict(p) for r, p in self.hub.joins.items()}
+
+    def _do_consume_join(self, rank: int) -> None:
+        self._check_epoch()
+        with self.hub.lock:
+            self.hub.joins.pop(rank, None)
+
+    def _do_write_restore(self, rank: int, steps: list[int]) -> None:
+        self._check_epoch()
+        with self.hub.lock:
+            self.hub.restore[rank] = list(steps)
+
+    def _do_read_restore(self, rank: int):
+        self._check_epoch()
+        with self.hub.lock:
+            steps = self.hub.restore.get(rank)
+            return set(steps) if steps is not None else None
+
+    def _do_append_health(self, payload: dict) -> None:
+        self._check_epoch()
+        # Mirror writes happen INSIDE the hub lock: the on-disk ledger
+        # order must match the authoritative in-memory order (the
+        # fault ledger's loss/recovery masking is explicitly
+        # order-aware), and hub.lock is an RLock so the ledger paths
+        # stay one critical section.
+        with self.hub.lock:
+            self.hub.health.append(dict(payload))
+            if self.hub.mirror_dir is not None:
+                append_jsonl_fsync(
+                    os.path.join(self.hub.mirror_dir,
+                                 _coord.GANG_HEALTH_FILE), payload)
+
+    def _do_read_health(self) -> list[dict]:
+        self._check_epoch()
+        with self.hub.lock:
+            return [dict(e) for e in self.hub.health]
+
+    def _do_append_fault(self, entry: dict) -> None:
+        self._check_epoch()
+        with self.hub.lock:
+            self.hub.faults.append(dict(entry))
+            if self.hub.mirror_dir is not None:
+                append_jsonl_fsync(
+                    os.path.join(self.hub.mirror_dir,
+                                 "faults_fired.jsonl"), entry)
+
+    def _do_read_faults(self) -> list[dict]:
+        self._check_epoch()
+        with self.hub.lock:
+            return [dict(e) for e in self.hub.faults]
+
+    def _do_append_consumed(self, orig_rank: int, payload: dict) -> None:
+        self._check_epoch()
+        with self.hub.lock:
+            self.hub.consumed.setdefault(orig_rank, []).append(
+                dict(payload))
+            if self.hub.mirror_dir is not None:
+                append_jsonl_fsync(
+                    os.path.join(
+                        self.hub.mirror_dir,
+                        f"{_coord.CONSUMED_PREFIX}{orig_rank}.jsonl"),
+                    payload)
+
+    def _do_read_consumed(self, orig_rank: int | None) -> list[dict]:
+        self._check_epoch()
+        with self.hub.lock:
+            if orig_rank is not None:
+                return [dict(e)
+                        for e in self.hub.consumed.get(orig_rank, ())]
+            return [dict(e) for r in sorted(self.hub.consumed)
+                    for e in self.hub.consumed[r]]
+
+    def _do_clear(self, restore_records: bool, fault_ledger: bool) -> None:
+        self.hub.clear(restore_records, fault_ledger)
+
+    # cadence: reads are dict lookups — poll tightly so barriers and
+    # boundary detection turn around in milliseconds, which is the
+    # whole point of the backend (64-128-rank campaigns in seconds).
+    def monitor_poll_s(self, heartbeat_interval_s, peer_timeout_s,
+                       world) -> float:
+        return max(min(heartbeat_interval_s, peer_timeout_s / 4, 0.05),
+                   0.005)
+
+    def supervisor_poll_s(self, world: int) -> float:
+        return 0.02
+
+    def barrier_poll_s(self) -> float:
+        return 0.002
+
+
+# ---------------------------------------------------------------------------
+# TCP backend — the lossy medium, with the robustness layer
+# ---------------------------------------------------------------------------
+
+
+class _InFlight:
+    """Reservation slot for a mutating op being applied: duplicates
+    arriving while the original is in flight wait on it instead of
+    re-applying."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._claimed = False
+        self._lock = threading.Lock()
+        self.result = None
+        self.error: BaseException | None = None
+
+    def claim(self) -> bool:
+        with self._lock:
+            was = self._claimed
+            self._claimed = True
+            return not was
+
+    def finish(self, result) -> None:
+        self.result = result
+        self._done.set()
+
+    def fail(self, exc: BaseException) -> None:
+        self.error = exc
+        self._done.set()
+
+    def wait(self, timeout_s: float):
+        if not self._done.wait(timeout_s):
+            raise TimeoutError("duplicate op still in flight")
+        if self.error is not None:
+            raise RuntimeError(
+                f"original delivery failed: {self.error}")
+        return self.result
+
+
+class _TcpHandler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        # Per-connection timeout: a wedged client must not pin a
+        # handler thread forever (dmlcheck DML012).
+        self.request.settimeout(self.server.io_timeout_s)
+        try:
+            line = self.rfile.readline(_MAX_LINE)
+        except OSError:
+            return
+        if not line:
+            return
+        try:
+            req = json.loads(line)
+            result = self.server.dispatch(req)
+            resp = {"ok": True, "result": result}
+        except Exception as exc:  # surfaced to the client as an error
+            resp = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+        with contextlib.suppress(OSError):
+            self.wfile.write((json.dumps(resp) + "\n").encode("utf-8"))
+
+
+class _TcpServerCore(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class TcpGangServer:
+    """The rank-0-side gang state server: a threaded stdlib TCP server
+    speaking newline-delimited JSON, holding its state in an
+    :class:`InProcHub` (optionally ledger-mirrored to ``mirror_dir``).
+
+    On a real pod this runs on rank 0 / the controller host; in the
+    local launcher (``cli/gang.py --gang-transport tcp``) the
+    supervisor process hosts it and hands workers the address.
+
+    Idempotency: every mutating request carries an ``op_id``; the
+    server remembers the last :data:`_DEDUP_CAP` ids with their
+    results, so a client retry after a lost RESPONSE (the request
+    actually landed) — or a network-duplicated delivery — returns the
+    original result instead of double-firing.  The abort latch, join
+    overwrite, and consume are idempotent by construction; the dedup
+    store is what extends exactly-once to the ledger appends and makes
+    ``declare_abort``'s first-writer verdict stable under retry.
+    """
+
+    _DEDUP_CAP = 65536
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 mirror_dir=None, io_timeout_s: float = 10.0):
+        self.hub = InProcHub(mirror_dir=mirror_dir)
+        self._state = InProcTransport(self.hub)
+        self._seen: OrderedDict[str, object] = OrderedDict()
+        self._seen_lock = threading.Lock()
+        self._server = _TcpServerCore((host, port), _TcpHandler)
+        self._server.dispatch = self.dispatch
+        self._server.io_timeout_s = io_timeout_s
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "TcpGangServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="gang-tcp-server", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._server.server_close()
+
+    def local_transport(self, events=None) -> InProcTransport:
+        """A direct (no-socket) handle on the server's hub for the
+        process hosting it — the supervisor must never compete with
+        128 workers for its own socket.  Labeled ``tcp`` (it is the
+        server side of the tcp control plane); its stats count the
+        supervisor's ops, while each worker's retry/timeout counts land
+        in that worker's own telemetry registry."""
+        handle = InProcTransport(self.hub, events=events)
+        handle.backend = "tcp"
+        return handle
+
+    # -- request dispatch ------------------------------------------------
+    def dispatch(self, req: dict):
+        op = req.get("op")
+        op_id = req.get("op_id")
+        if op_id is None:
+            return self._apply(op, req)
+        # The op_id is RESERVED under the lock before the apply runs: a
+        # duplicate racing a still-in-flight original (client timeout
+        # shorter than a slow mirror fsync) must wait for the original's
+        # result, never re-apply — check-then-apply outside the lock
+        # would double-append and break exactly-once.
+        with self._seen_lock:
+            if op_id in self._seen:  # membership: a result may be None
+                entry = self._seen[op_id]
+            else:
+                entry = _InFlight()
+                self._seen[op_id] = entry
+        if isinstance(entry, _InFlight):
+            if entry.claim():  # this thread owns the apply
+                try:
+                    result = self._apply(op, req)
+                except BaseException as exc:
+                    # A failed apply must not poison the id: drop the
+                    # reservation so the client's retry re-applies.
+                    with self._seen_lock:
+                        self._seen.pop(op_id, None)
+                    entry.fail(exc)
+                    raise
+                entry.finish(result)
+                with self._seen_lock:
+                    self._seen[op_id] = result
+                    while len(self._seen) > self._DEDUP_CAP:
+                        self._seen.popitem(last=False)
+                return result
+            return entry.wait(self._server.io_timeout_s)
+        return entry  # already-completed result, cached
+
+    def _apply(self, op: str, req: dict):
+        s = self._state
+        if op == "ping":
+            return "pong"
+        if op == "publish_beat":
+            rank, payload = int(req["rank"]), req["payload"]
+            # A duplicated/reordered beat delivery must not make a dead
+            # rank look freshly alive: the version (the reader-side
+            # change signature) only advances when the CONTENT changes.
+            with self.hub.lock:
+                cur = self.hub.beats.get(rank)
+                if cur is not None and cur[1] == payload:
+                    return None
+            s._do_publish_beat(rank, payload)
+            return None
+        if op == "read_beats":
+            return {str(r): [v, p]
+                    for r, (v, p) in s._do_read_beats().items()}
+        if op == "read_beat":
+            entry = s._do_read_beat(int(req["rank"]))
+            return None if entry is None else [entry[0], entry[1]]
+        if op == "declare_abort":
+            return s._do_declare_abort(req["reason"], req["by_rank"],
+                                       req.get("peer"))
+        if op == "read_abort":
+            return s._do_read_abort()
+        if op == "announce_join":
+            s._do_announce_join(int(req["rank"]), req["payload"])
+            return None
+        if op == "read_joins":
+            return {str(r): p for r, p in s._do_read_joins().items()}
+        if op == "consume_join":
+            s._do_consume_join(int(req["rank"]))
+            return None
+        if op == "write_restore":
+            s._do_write_restore(int(req["rank"]), req["steps"])
+            return None
+        if op == "read_restore":
+            steps = s._do_read_restore(int(req["rank"]))
+            return None if steps is None else sorted(steps)
+        if op == "append_health":
+            s._do_append_health(req["payload"])
+            return None
+        if op == "read_health":
+            return s._do_read_health()
+        if op == "append_fault":
+            s._do_append_fault(req["payload"])
+            return None
+        if op == "read_faults":
+            return s._do_read_faults()
+        if op == "append_consumed":
+            s._do_append_consumed(int(req["rank"]), req["payload"])
+            return None
+        if op == "read_consumed":
+            rank = req.get("rank")
+            return s._do_read_consumed(
+                None if rank is None else int(rank))
+        if op == "clear":
+            self.hub.clear(bool(req["restore_records"]),
+                           bool(req["fault_ledger"]))
+            return None
+        raise ValueError(f"unknown transport op {op!r}")
+
+
+class TcpTransport(GangTransport):
+    """A gang member's client on a :class:`TcpGangServer` — the lossy
+    medium, so every call carries the robustness layer:
+
+    - **per-op timeout**: every socket op (connect, send, read) is
+      bounded by ``timeout_s`` — no call can hang a monitor thread;
+    - **bounded retry, backoff + jitter**: up to ``max_tries`` attempts
+      with exponential backoff (``backoff_s * 2**k``) times a random
+      0.5-1.5 jitter factor, so 128 clients recovering from one server
+      hiccup do not re-arrive in lockstep;
+    - **idempotent delivery**: mutating requests carry an ``op_id``
+      (unique per logical operation, REUSED across its retries) the
+      server deduplicates — a retry after a lost response or a
+      fault-injected duplicate can never double-append or re-admit;
+    - **connection loss as peer-death evidence**: retries exhausted →
+      :class:`TransportError`, which ``GangCoordinator`` escalates to a
+      self-abort once the outage outlives ``peer_timeout_s`` (a rank
+      partitioned off the gang IS a dead peer, seen from inside).
+
+    ``chaos``: an optional ``runtime/faults.py::TransportChaos`` plan
+    injecting drop/delay/duplicate/partition at the send boundary —
+    how the retry/idempotency claims are tested rather than asserted.
+    """
+
+    backend = "tcp"
+
+    def __init__(self, address: str, events=None, *,
+                 timeout_s: float = 2.0, max_tries: int = 4,
+                 backoff_s: float = 0.05, chaos=None,
+                 client_id: str | None = None):
+        super().__init__(events=events)
+        host, _, port_s = address.rpartition(":")
+        if not host or not port_s.isdigit():
+            raise ValueError(
+                f"bad gang transport address {address!r} "
+                "(expected host:port)")
+        self.address = (host, int(port_s))
+        self.timeout_s = float(timeout_s)
+        self.max_tries = int(max_tries)
+        self.backoff_s = float(backoff_s)
+        self.chaos = chaos
+        # Unique per INSTANCE, not per process: several clients in one
+        # process (worker + monitor + tools) must never collide in the
+        # server's op_id dedup space.
+        self._id = client_id or (
+            f"{socket.gethostname()}.{os.getpid()}."
+            f"{uuid.uuid4().hex[:12]}")
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        # Jitter only — never used for anything that must reproduce.
+        self._rng = random.Random()
+
+    # -- wire ------------------------------------------------------------
+    def _next_op_id(self) -> str:
+        with self._seq_lock:
+            self._seq += 1
+            return f"{self._id}.{self._seq}"
+
+    def _roundtrip(self, req: dict):
+        data = (json.dumps(req) + "\n").encode("utf-8")
+        with socket.create_connection(self.address,
+                                      timeout=self.timeout_s) as sock:
+            sock.settimeout(self.timeout_s)
+            sock.sendall(data)
+            f = sock.makefile("rb")
+            line = f.readline(_MAX_LINE)
+        if not line:
+            raise TransportError("gang server closed the connection")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise TransportError(
+                f"gang server rejected {req.get('op')}: "
+                f"{resp.get('error')}")
+        return resp.get("result")
+
+    def _call(self, op: str, **fields):
+        req = {"op": op, **fields}
+        if op in _MUTATING_OPS:
+            # ONE op_id per logical operation, reused by every retry:
+            # the server-side dedup is what turns at-least-once
+            # delivery into exactly-once application.
+            req["op_id"] = self._next_op_id()
+        last: Exception | None = None
+        for attempt in range(self.max_tries):
+            if attempt:
+                self._count_retry()
+                sleep_s = (self.backoff_s * (2 ** (attempt - 1))
+                           * (0.5 + self._rng.random()))
+                time.sleep(sleep_s)
+            act = self.chaos.plan(op) if self.chaos is not None else None
+            if act is not None:
+                if act.partitioned:
+                    raise TransportError(
+                        f"{op}: channel severed (injected partition)")
+                if act.delay_s:
+                    time.sleep(act.delay_s)
+                if act.drop:
+                    # The medium ate the request: to the client this is
+                    # indistinguishable from a timeout.
+                    self._count_timeout()
+                    last = TransportError(
+                        f"{op}: request dropped (injected)")
+                    continue
+            try:
+                if act is not None and act.duplicate:
+                    # The medium delivered it twice: same op_id, so the
+                    # server must apply it once.
+                    self._roundtrip(dict(req))
+                return self._roundtrip(req)
+            except socket.timeout as exc:
+                self._count_timeout()
+                last = exc
+            except (TransportError, OSError, ValueError) as exc:
+                # TransportError here covers a response lost to a clean
+                # connection close and transient server-side errors
+                # (e.g. a duplicate that outwaited its in-flight
+                # original) — all retry-safe BECAUSE the op_id rides
+                # every retry: the dedup layer turns the re-send into a
+                # result fetch, never a re-apply.  A deterministic
+                # rejection just burns the bounded retry budget before
+                # surfacing.
+                last = exc
+        raise TransportError(
+            f"{op} failed after {self.max_tries} tries against "
+            f"{self.address[0]}:{self.address[1]}: {last}")
+
+    # -- operations ------------------------------------------------------
+    def _do_publish_beat(self, rank, payload):
+        self._call("publish_beat", rank=rank, payload=payload)
+
+    def _do_read_beat(self, rank):
+        entry = self._call("read_beat", rank=rank)
+        return None if entry is None else (entry[0], entry[1])
+
+    def _do_read_beats(self):
+        raw = self._call("read_beats")
+        return {int(r): (v_p[0], v_p[1]) for r, v_p in raw.items()}
+
+    def _do_declare_abort(self, reason, by_rank, peer):
+        return bool(self._call("declare_abort", reason=reason,
+                               by_rank=by_rank, peer=peer))
+
+    def _do_read_abort(self):
+        return self._call("read_abort")
+
+    def _do_announce_join(self, rank, payload):
+        self._call("announce_join", rank=rank, payload=payload)
+
+    def _do_read_joins(self):
+        return {int(r): p
+                for r, p in self._call("read_joins").items()}
+
+    def _do_consume_join(self, rank):
+        self._call("consume_join", rank=rank)
+
+    def _do_write_restore(self, rank, steps):
+        self._call("write_restore", rank=rank, steps=steps)
+
+    def _do_read_restore(self, rank):
+        steps = self._call("read_restore", rank=rank)
+        return None if steps is None else {int(s) for s in steps}
+
+    def _do_append_health(self, payload):
+        self._call("append_health", payload=payload)
+
+    def _do_read_health(self):
+        return self._call("read_health")
+
+    def _do_append_fault(self, entry):
+        self._call("append_fault", payload=entry)
+
+    def _do_read_faults(self):
+        return self._call("read_faults")
+
+    def _do_append_consumed(self, orig_rank, payload):
+        self._call("append_consumed", rank=orig_rank, payload=payload)
+
+    def _do_read_consumed(self, orig_rank):
+        return self._call("read_consumed", rank=orig_rank)
+
+    def _do_clear(self, restore_records, fault_ledger):
+        self._call("clear", restore_records=restore_records,
+                   fault_ledger=fault_ledger)
+
+    # cadence: each monitor poll is ONE batched read_beats round trip,
+    # and the interval grows with the world so the whole gang's request
+    # rate on the rank-0 host stays bounded (~world/poll ≈ 500/s at any
+    # size) instead of quadratic — the self-DoS fix of ISSUE 12.
+    _PER_RANK_BUDGET_S = 0.002
+
+    def monitor_poll_s(self, heartbeat_interval_s, peer_timeout_s,
+                       world) -> float:
+        base = min(heartbeat_interval_s, peer_timeout_s / 4)
+        return min(max(base, self._PER_RANK_BUDGET_S * world),
+                   peer_timeout_s / 4)
+
+    def supervisor_poll_s(self, world: int) -> float:
+        return max(0.2, self._PER_RANK_BUDGET_S * world)
+
+    def barrier_poll_s(self) -> float:
+        return max(0.05, self._PER_RANK_BUDGET_S * 8)
+
+
+# ---------------------------------------------------------------------------
+# Factory
+# ---------------------------------------------------------------------------
+
+
+def make_transport(backend: str, *, gang_dir=None, address=None,
+                   hub: InProcHub | None = None, events=None,
+                   bind_epoch: bool = True, chaos=None,
+                   timeout_s: float = 2.0) -> GangTransport:
+    """Build a transport from launcher-level selection flags:
+
+    - ``file``: requires ``gang_dir`` (the historical default);
+    - ``inproc``: requires ``hub`` (one per gang, shared by every
+      member thread; ``bind_epoch`` pins worker handles to the current
+      attempt — see :class:`InProcTransport`);
+    - ``tcp``: requires ``address`` (``host:port`` of the gang server).
+    """
+    if backend == "file":
+        if gang_dir is None:
+            raise ValueError("file transport requires gang_dir")
+        return FileTransport(gang_dir, events=events)
+    if backend == "inproc":
+        if hub is None:
+            raise ValueError("inproc transport requires a shared hub")
+        return InProcTransport(hub, events=events, bind_epoch=bind_epoch)
+    if backend == "tcp":
+        if address is None:
+            raise ValueError("tcp transport requires address host:port")
+        return TcpTransport(address, events=events, chaos=chaos,
+                            timeout_s=timeout_s)
+    raise ValueError(
+        f"unknown gang transport {backend!r}; choose from "
+        f"{TRANSPORT_BACKENDS}")

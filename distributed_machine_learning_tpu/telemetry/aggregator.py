@@ -497,9 +497,16 @@ class HeartbeatSampler:
         # rank -> (last seen seq, monotonic time that seq first seen)
         self._seen: dict[int, tuple[int, float]] = {}
 
-    def sample(self, gang_dir: str | os.PathLike,
-               now: float | None = None) -> dict[int, RankSample]:
-        beats = read_beats(gang_dir)
+    def sample(self, gang_dir: str | os.PathLike | None,
+               now: float | None = None,
+               beats: dict[int, dict] | None = None
+               ) -> dict[int, RankSample]:
+        """``beats`` (ISSUE 12): pre-read payloads from a
+        ``GangTransport`` snapshot — the supervisor samples through its
+        transport's batched read instead of globbing beat files; the
+        offline tools keep passing a directory."""
+        if beats is None:
+            beats = read_beats(gang_dir)
         now = time.monotonic() if now is None else now
         live_steps = [int(p.get("step", 0)) for p in beats.values()
                       if not p.get("done")]

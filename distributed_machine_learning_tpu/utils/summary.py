@@ -74,6 +74,8 @@ _EVENT_LABELS = {
     "reshard_restores": "restores resharded across world sizes",
     "ckpt_verify_failures": "checkpoints failing verification",
     "ckpt_fallbacks": "restores fell back past bad checkpoints",
+    "transport_retries": "gang-transport ops retried (backoff)",
+    "transport_timeouts": "gang-transport ops timed out/dropped",
 }
 
 

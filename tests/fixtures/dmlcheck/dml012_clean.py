@@ -1,0 +1,23 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/x.py
+"""DML012 clean cases: every socket/HTTP op carries an explicit bound
+— the transport robustness-layer discipline."""
+import socket
+import urllib.request
+
+
+def fetch_state(address):
+    with socket.create_connection(address, timeout=2.0) as sock:
+        sock.settimeout(2.0)
+        sock.sendall(b"{}\n")
+        return sock.recv(4096)
+
+
+def fetch_page(url):
+    return urllib.request.urlopen(url, timeout=5.0).read()
+
+
+def raw_channel(host, port):
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.settimeout(2.0)
+    sock.connect((host, port))
+    return sock

@@ -1,0 +1,28 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/x.py
+"""DML012 firing cases: unbounded socket/HTTP IO in the runtime layer
+— a monitor thread hung in a timeout-less connect can neither detect
+peers nor join an abort."""
+import socket
+import urllib.request
+
+
+def fetch_state(address):
+    with socket.create_connection(address) as sock:
+        sock.sendall(b"{}\n")
+        return sock.recv(4096)
+
+
+def fetch_page(url):
+    return urllib.request.urlopen(url).read()
+
+
+def fetch_api(host):
+    from http.client import HTTPConnection
+
+    return HTTPConnection(host)  # bare-import form, still unbounded
+
+
+def raw_channel(host, port):
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.connect((host, port))
+    return sock

@@ -1,0 +1,560 @@
+"""GangTransport conformance + the TCP robustness layer (ISSUE 12).
+
+One parametrized contract suite runs the SAME assertions against all
+three backends (file / in-proc / tcp), so a fourth backend is a
+checklist, not an archaeology dig: beat freshness (signature advances
+on publish), abort first-writer-wins under concurrent latching, join
+announce/consume idempotency, restore-record round-trips, ledger
+append-only semantics and their survival across
+``clear_gang_state(fault_ledger=False)``, the snapshot API, and the
+poll-cadence contract (cadence is a transport property — the ISSUE 12
+bugfix).
+
+The TCP half then proves the lossy-medium claims against injected
+faults (``runtime/faults.py::TransportChaos``) instead of asserting
+them: a dropped request is retried (with the retry/timeout counters
+landing in the registry), a duplicated delivery is applied exactly
+once (op_id dedup), a REPLAYED join announcement cannot re-admit a
+consumed join, and a partitioned member both self-detects (its
+coordinator treats the outage as its own death) and is detected by its
+peers within ``peer_timeout_s``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from distributed_machine_learning_tpu.runtime.coordinator import (
+    GangCoordinator,
+)
+from distributed_machine_learning_tpu.runtime.faults import (
+    FaultEvents,
+    TransportChaos,
+)
+from distributed_machine_learning_tpu.runtime.transport import (
+    FileTransport,
+    InProcHub,
+    InProcTransport,
+    TcpGangServer,
+    TcpTransport,
+    TransportError,
+    make_transport,
+)
+
+BACKENDS = ("file", "inproc", "tcp")
+
+
+@pytest.fixture(params=BACKENDS)
+def backend(request, tmp_path):
+    """(name, make_handle): ``make_handle()`` returns a FRESH transport
+    handle on the SAME underlying gang state — the multi-member view
+    the contract is about."""
+    name = request.param
+    if name == "file":
+        yield name, lambda: FileTransport(tmp_path / "gang")
+        return
+    if name == "inproc":
+        hub = InProcHub()
+        yield name, lambda: InProcTransport(hub)
+        return
+    server = TcpGangServer().start()
+    try:
+        yield name, lambda: TcpTransport(server.address, backoff_s=0.01)
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Conformance: the same contract against every backend
+# ---------------------------------------------------------------------------
+
+
+def test_beat_publish_read_and_signature_freshness(backend):
+    _, make = backend
+    tx, peer = make(), make()
+    assert peer.read_beat(0) is None
+    assert peer.read_beats() == {}
+    tx.publish_beat(0, {"rank": 0, "seq": 1, "step": 3, "done": False})
+    sig1, payload = peer.read_beat(0)
+    assert payload["step"] == 3
+    # A re-publish with NEW content must advance the signature — the
+    # change-signature staleness basis the peer detector judges on.
+    time.sleep(0.02)  # file mtime granularity
+    tx.publish_beat(0, {"rank": 0, "seq": 2, "step": 4, "done": False})
+    sig2, payload2 = peer.read_beat(0)
+    assert sig2 != sig1 and payload2["step"] == 4
+    beats = peer.read_beats()
+    assert set(beats) == {0} and beats[0][1]["step"] == 4
+    assert peer.read_beat_payloads()[0]["seq"] == 2
+
+
+def test_abort_latch_first_writer_wins_under_concurrency(backend):
+    _, make = backend
+    reader = make()
+    assert reader.read_abort() is None
+    wins: list[tuple[int, bool]] = []
+    lock = threading.Lock()
+
+    def latch(i):
+        won = make().declare_abort(f"declared by {i}", i, peer=i)
+        with lock:
+            wins.append((i, won))
+
+    threads = [threading.Thread(target=latch, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    winners = [i for i, won in wins if won]
+    assert len(winners) == 1, wins
+    abort = reader.read_abort()
+    assert abort["by_rank"] == winners[0]
+    # The losers' reasons never overwrite the winner's.
+    assert abort["reason"] == f"declared by {winners[0]}"
+    assert reader.declare_abort("late", 99) is False
+
+
+def test_join_announce_consume_idempotency(backend):
+    _, make = backend
+    tx, peer = make(), make()
+    tx.announce_join(2, {"rank": 2, "spare": False, "time": time.time(),
+                         "kind": "recover", "at_step": 5})
+    tx.announce_join(4, {"rank": 4, "spare": True, "time": time.time(),
+                         "prefetched_step": 10})
+    joins = peer.read_joins()
+    assert set(joins) == {2, 4}
+    assert joins[2]["at_step"] == 5 and joins[4]["prefetched_step"] == 10
+    # Re-announcing is an idempotent overwrite (the spare heartbeat).
+    tx.announce_join(4, {"rank": 4, "spare": True, "time": time.time(),
+                         "prefetched_step": 12})
+    assert peer.read_joins()[4]["prefetched_step"] == 12
+    tx.consume_join(2)
+    assert set(peer.read_joins()) == {4}
+    tx.consume_join(2)  # consuming twice is a no-op
+    assert set(peer.read_joins()) == {4}
+
+
+def test_restore_records_roundtrip(backend):
+    _, make = backend
+    tx, peer = make(), make()
+    assert peer.read_restore_record(0) is None
+    tx.write_restore_record(0, {5, 3})
+    assert peer.read_restore_record(0) == {3, 5}
+    tx.write_restore_record(0, {3, 5, 10})
+    assert peer.read_restore_record(0) == {3, 5, 10}
+    assert peer.read_restore_record(1) is None
+
+
+def test_ledgers_append_only_and_clear_semantics(backend):
+    _, make = backend
+    tx, peer = make(), make()
+    tx.append_health_event("restart", attempt=1, world=4)
+    tx.append_health_event("shrink", attempt=2, from_world=4, to_world=3)
+    tx.append_fault_entry({"index": 0, "kind": "lose_rank", "rank": 1,
+                           "at": 7})
+    tx.append_consumed(0, {"step": 0, "ids": [0, 1]})
+    tx.append_consumed(2, {"step": 0, "ids": [2, 3]})
+    tx.publish_beat(0, {"rank": 0, "seq": 1, "step": 1})
+    tx.declare_abort("boom", 0)
+    tx.write_restore_record(0, {4})
+    tx.announce_join(3, {"rank": 3, "spare": False, "time": time.time()})
+
+    kinds = [e["kind"] for e in peer.read_health_events()]
+    assert kinds == ["restart", "shrink"]  # append order preserved
+    assert [e["kind"] for e in peer.read_fault_entries()] == ["lose_rank"]
+    assert [r["ids"] for r in peer.read_consumed(0)] == [[0, 1]]
+    assert len(peer.read_consumed()) == 2  # all-ranks read
+
+    # Between-attempt clear: beats + abort die, everything durable
+    # survives — the ledger is what keeps fired faults latched and the
+    # pending join is what the next boundary admits.
+    tx.clear_gang_state(fault_ledger=False)
+    assert peer.read_beats() == {} and peer.read_abort() is None
+    assert peer.read_restore_record(0) == {4}
+    assert [e["kind"] for e in peer.read_health_events()] == kinds
+    assert len(peer.read_fault_entries()) == 1
+    assert len(peer.read_consumed()) == 2
+    assert 3 in peer.read_joins()
+
+    # Renumbering clear: restore records go, ledgers stay.
+    tx.clear_gang_state(restore_records=True, fault_ledger=False)
+    assert peer.read_restore_record(0) is None
+    assert len(peer.read_fault_entries()) == 1
+
+    # Fresh-run clear: everything durable goes too.
+    tx.clear_gang_state(restore_records=True)
+    assert peer.read_health_events() == []
+    assert peer.read_fault_entries() == []
+    assert peer.read_consumed() == []
+    assert peer.read_joins() == {}
+
+
+def test_snapshot_api(backend):
+    name, make = backend
+    tx = make()
+    tx.publish_beat(1, {"rank": 1, "seq": 1, "step": 2})
+    tx.announce_join(5, {"rank": 5, "spare": True, "time": time.time()})
+    tx.append_health_event("restart", attempt=1, world=2)
+    tx.append_fault_entry({"index": 0, "kind": "kill_rank", "rank": 0,
+                           "at": 3})
+    snap = make().snapshot()
+    assert snap["backend"] == name
+    assert snap["beats"][1]["step"] == 2
+    assert snap["abort"] is None
+    assert set(snap["joins"]) == {5}
+    assert [e["kind"] for e in snap["health"]] == ["restart"]
+    assert [e["kind"] for e in snap["faults_fired"]] == ["kill_rank"]
+
+
+def test_poll_cadence_is_a_transport_property(backend):
+    """The ISSUE 12 bugfix contract: the file backend keeps the
+    historical file-stat cadence; in-proc polls at least as tightly
+    (dict reads); tcp never polls faster than its per-world request
+    budget allows, and never slower than a quarter peer timeout — at
+    world 128 the whole gang's read rate on the rank-0 host stays
+    bounded instead of quadratic."""
+    name, make = backend
+    tx = make()
+    file_like = min(0.25, 30.0 / 4)
+    poll_small = tx.monitor_poll_s(0.25, 30.0, 2)
+    poll_big = tx.monitor_poll_s(0.25, 30.0, 128)
+    for poll in (poll_small, poll_big):
+        assert 0 < poll <= 30.0 / 4
+    if name == "file":
+        assert poll_small == poll_big == file_like
+    elif name == "inproc":
+        assert poll_small <= file_like and poll_big <= file_like
+    else:
+        assert poll_big > poll_small  # cadence backs off with world
+        assert poll_big >= 128 * TcpTransport._PER_RANK_BUDGET_S
+    assert tx.supervisor_poll_s(2) > 0
+    assert tx.barrier_poll_s() > 0
+    if name == "tcp":
+        assert tx.supervisor_poll_s(128) >= tx.supervisor_poll_s(2)
+
+
+def test_op_accounting(backend):
+    _, make = backend
+    tx = make()
+    tx.publish_beat(0, {"rank": 0, "seq": 1, "step": 0})
+    tx.read_beats()
+    tx.read_beats()
+    stats = tx.stats()
+    assert stats["ops"]["publish_beat"] == 1
+    assert stats["ops"]["read_beats"] == 2
+    assert stats["ops_total"] >= 3
+    assert stats["retries"] == 0 and stats["timeouts"] == 0
+
+
+def test_coordinator_detects_dead_peer_over_backend(backend):
+    """The peer-death detector works unchanged over every transport:
+    rank 1 publishes once and goes silent; rank 0's monitor declares it
+    dead within the timeout and the abort latch names it."""
+    _, make = backend
+    aborts: list[str] = []
+    c1 = GangCoordinator(None, rank=1, world=2, transport=make(),
+                         heartbeat_interval_s=0.05, peer_timeout_s=0.6,
+                         check_self=False, on_abort=lambda r: None)
+    c1.start()
+    c1.stop()  # one beat published, then silence — a dead process
+    c0 = GangCoordinator(None, rank=0, world=2, transport=make(),
+                         heartbeat_interval_s=0.05, peer_timeout_s=0.6,
+                         check_self=False, on_abort=aborts.append)
+    c0.start()
+    try:
+        deadline = time.monotonic() + 6.0
+        while not aborts and time.monotonic() < deadline:
+            c0.beat()
+            time.sleep(0.05)
+        assert aborts and "rank 1" in aborts[0]
+        assert "rank 1" in str(make().read_abort()["reason"])
+    finally:
+        c0.stop()
+
+
+# ---------------------------------------------------------------------------
+# TCP robustness layer: the lossy-medium claims, tested not asserted
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def tcp_server():
+    server = TcpGangServer().start()
+    try:
+        yield server
+    finally:
+        server.stop()
+
+
+def test_tcp_drop_is_retried_and_counted(tcp_server):
+    events = FaultEvents()
+    chaos = TransportChaos(drop=[("append_health", 1)])
+    tx = TcpTransport(tcp_server.address, events=events, chaos=chaos,
+                      backoff_s=0.01)
+    tx.append_health_event("x", n=1)
+    # The drop looked like a timeout to the client; the retry landed
+    # the op exactly once.
+    reader = TcpTransport(tcp_server.address)
+    assert len(reader.read_health_events()) == 1
+    stats = tx.stats()
+    assert stats["retries"] >= 1 and stats["timeouts"] >= 1
+    assert events.transport_retries >= 1
+    assert events.transport_timeouts >= 1
+    assert ("drop", "append_health", 1) in chaos.fired
+
+
+def test_tcp_retry_timeout_counters_reach_the_registry(tcp_server,
+                                                       tmp_path):
+    from distributed_machine_learning_tpu.telemetry import (
+        Telemetry,
+        set_telemetry,
+    )
+
+    tel = Telemetry(str(tmp_path / "tel"))
+    set_telemetry(tel)
+    try:
+        chaos = TransportChaos(drop=[("publish_beat", 1)])
+        tx = TcpTransport(tcp_server.address, chaos=chaos,
+                          backoff_s=0.01)
+        tx.publish_beat(0, {"rank": 0, "seq": 1, "step": 0})
+        snap = tel.registry.snapshot()
+        counters = {(c["name"], tuple(sorted((c.get("labels") or {})
+                                             .items()))): c["value"]
+                    for c in snap["counters"]}
+        assert counters[("gang_transport_retries",
+                         (("backend", "tcp"),))] >= 1
+        assert counters[("gang_transport_timeouts",
+                         (("backend", "tcp"),))] >= 1
+        assert any(name == "gang_transport_ops"
+                   and dict(labels).get("op") == "publish_beat"
+                   for name, labels in counters)
+    finally:
+        set_telemetry(None)
+        tel.close()
+
+
+def test_tcp_duplicate_delivery_applies_exactly_once(tcp_server):
+    """A network-duplicated delivery (same op_id, delivered twice)
+    must not double-append a ledger line or double-fire an abort."""
+    chaos = TransportChaos(duplicate=[("append_fault", 1),
+                                      ("declare_abort", 1)])
+    tx = TcpTransport(tcp_server.address, chaos=chaos, backoff_s=0.01)
+    tx.append_fault_entry({"index": 0, "kind": "lose_rank", "rank": 1,
+                           "at": 3})
+    reader = TcpTransport(tcp_server.address)
+    assert len(reader.read_fault_entries()) == 1
+    # The duplicated declare still reports ONE first-writer win, and a
+    # different member's later declare correctly loses.
+    assert tx.declare_abort("first", 1) is True
+    assert reader.read_abort()["by_rank"] == 1
+    assert reader.declare_abort("late", 2) is False
+
+
+def test_tcp_replayed_join_cannot_readmit_after_consume(tcp_server):
+    """Reordered/duplicated delivery of an OLD announce arriving after
+    the supervisor consumed the join must not resurrect it — the
+    server's op_id dedup extends exactly-once across the reorder."""
+    tx = TcpTransport(tcp_server.address)
+    tx.announce_join(3, {"rank": 3, "spare": False,
+                         "time": time.time()})
+    replay = {"op": "announce_join", "rank": 3, "op_id": "replay-123",
+              "payload": {"rank": 3, "spare": False, "time": 1.0}}
+    tx._roundtrip(dict(replay))  # first delivery
+    tx.consume_join(3)
+    assert tx.read_joins() == {}
+    tx._roundtrip(dict(replay))  # late duplicate of the SAME message
+    assert tx.read_joins() == {}
+
+
+def test_tcp_duplicate_racing_inflight_original_applies_once(tcp_server):
+    """The nasty dedup window: a duplicate arrives while the ORIGINAL
+    is still being applied (client timeout shorter than a slow apply).
+    The op_id is reserved before the apply runs, so the racer waits for
+    the original's result instead of re-applying."""
+    real_apply = tcp_server._apply
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_apply(op, req):
+        if op == "append_fault":
+            started.set()
+            release.wait(5.0)
+        return real_apply(op, req)
+
+    tcp_server._apply = slow_apply
+    req = {"op": "append_fault", "op_id": "race-1",
+           "payload": {"index": 0, "kind": "kill_rank", "rank": 0,
+                       "at": 1}}
+    results = []
+    t1 = threading.Thread(
+        target=lambda: results.append(tcp_server.dispatch(dict(req))))
+    t1.start()
+    assert started.wait(5.0)
+    t2 = threading.Thread(
+        target=lambda: results.append(tcp_server.dispatch(dict(req))))
+    t2.start()
+    time.sleep(0.1)  # let the duplicate reach the reservation
+    release.set()
+    t1.join(5.0)
+    t2.join(5.0)
+    tcp_server._apply = real_apply
+    assert len(results) == 2
+    assert len(TcpTransport(tcp_server.address)
+               .read_fault_entries()) == 1
+
+
+def test_tcp_delay_is_survived(tcp_server):
+    """A delayed delivery (well under the op timeout) is just latency:
+    the op lands once, no retry, no timeout."""
+    chaos = TransportChaos(delay=[("append_health", 1)], delay_s=0.2)
+    tx = TcpTransport(tcp_server.address, chaos=chaos, timeout_s=2.0)
+    t0 = time.monotonic()
+    tx.append_health_event("late", n=1)
+    assert time.monotonic() - t0 >= 0.2
+    assert len(TcpTransport(tcp_server.address)
+               .read_health_events()) == 1
+    stats = tx.stats()
+    assert stats["retries"] == 0 and stats["timeouts"] == 0
+    assert ("delay", "append_health", 1) in chaos.fired
+
+
+def test_tcp_partition_raises_transport_error(tcp_server):
+    chaos = TransportChaos(partition_after=2)
+    tx = TcpTransport(tcp_server.address, chaos=chaos, backoff_s=0.01)
+    tx.read_abort()
+    tx.read_abort()
+    with pytest.raises(TransportError):
+        tx.read_abort()
+
+
+def test_tcp_partitioned_rank_detected_as_dead_by_both_sides(tcp_server):
+    """The connection-loss-is-peer-death contract, detector level: rank
+    1's channel is severed; its peers declare it dead within
+    ``peer_timeout_s`` (its beats stop advancing) and rank 1 itself
+    escalates the outage to a self-abort naming the partition."""
+    aborts0: list[str] = []
+    aborts1: list[str] = []
+    t0 = TcpTransport(tcp_server.address, backoff_s=0.01)
+    chaos = TransportChaos(partition_after=20)
+    t1 = TcpTransport(tcp_server.address, chaos=chaos, backoff_s=0.01,
+                      max_tries=2)
+    c0 = GangCoordinator(None, rank=0, world=2, transport=t0,
+                         heartbeat_interval_s=0.05, peer_timeout_s=0.8,
+                         check_self=False, on_abort=aborts0.append)
+    c1 = GangCoordinator(None, rank=1, world=2, transport=t1,
+                         heartbeat_interval_s=0.05, peer_timeout_s=0.8,
+                         check_self=False, on_abort=aborts1.append)
+    c0.start()
+    c1.start()
+    try:
+        deadline = time.monotonic() + 8.0
+        while (not aborts0 or not aborts1) \
+                and time.monotonic() < deadline:
+            c0.beat()
+            c1.beat()
+            time.sleep(0.05)
+        assert aborts0 and "rank 1" in aborts0[0]
+        assert aborts1 and "partitioned" in aborts1[0]
+        abort = t0.read_abort()
+        assert abort is not None and abort["by_rank"] == 0
+    finally:
+        c0.stop()
+        c1.stop()
+
+
+def test_make_transport_factory_validation(tmp_path):
+    with pytest.raises(ValueError):
+        make_transport("file")
+    with pytest.raises(ValueError):
+        make_transport("inproc")
+    with pytest.raises(ValueError):
+        make_transport("tcp")
+    with pytest.raises(ValueError):
+        make_transport("carrier-pigeon", gang_dir=tmp_path)
+    with pytest.raises(ValueError):
+        TcpTransport("no-port-here")
+    hub = InProcHub()
+    assert make_transport("inproc", hub=hub).backend == "inproc"
+    assert make_transport("file", gang_dir=tmp_path).backend == "file"
+
+
+def test_inproc_epoch_guard_fences_drained_members():
+    """A zombie thread from a drained attempt (threads cannot be
+    SIGKILLed) must not write into the next attempt's state: its
+    epoch-bound handle raises once the supervisor clears."""
+    hub = InProcHub()
+    worker = InProcTransport(hub, bind_epoch=True)
+    supervisor = InProcTransport(hub)  # the clearing side: unbound
+    worker.publish_beat(0, {"rank": 0, "seq": 1, "step": 0})
+    supervisor.clear_gang_state()
+    with pytest.raises(TransportError):
+        worker.publish_beat(0, {"rank": 0, "seq": 2, "step": 1})
+    with pytest.raises(TransportError):
+        worker.read_beats()
+    # The next attempt's fresh handle works.
+    fresh = InProcTransport(hub, bind_epoch=True)
+    fresh.publish_beat(0, {"rank": 0, "seq": 1, "step": 0})
+    assert supervisor.read_beat_payloads()[0]["seq"] == 1
+
+
+def test_file_reads_never_create_the_directory(tmp_path):
+    """A read-only consumer (gang_status on a typo'd or post-mortem
+    path) must not mutate the filesystem: reads on a missing gang dir
+    return empty, and the directory appears only on the first write."""
+    gang = tmp_path / "never-written"
+    tx = FileTransport(gang)
+    assert tx.read_beats() == {}
+    assert tx.read_abort() is None
+    assert tx.read_health_events() == []
+    assert tx.snapshot()["joins"] == {}
+    assert not gang.exists()
+    tx.publish_beat(0, {"rank": 0, "seq": 1, "step": 0})
+    assert gang.exists()
+
+
+def test_file_backend_layout_is_byte_compatible(tmp_path):
+    """The transport writes the EXACT file layout the pre-transport
+    readers (and PR 10 artifacts) use — same names, same payload
+    shapes, ledgers fsynced as JSONL."""
+    import json
+    import os
+
+    from distributed_machine_learning_tpu.runtime.coordinator import (
+        read_abort,
+        read_joins,
+        read_restore_record,
+    )
+    from distributed_machine_learning_tpu.telemetry.aggregator import (
+        read_beats,
+        read_health_events,
+    )
+
+    gang = tmp_path / "gang"
+    tx = FileTransport(gang)
+    tx.publish_beat(2, {"rank": 2, "seq": 1, "step": 5, "beat_age": 0.0,
+                        "suspended": False, "done": False,
+                        "time": time.time()})
+    tx.declare_abort("boom", 1, peer=2)
+    tx.announce_join(3, {"rank": 3, "spare": False, "time": time.time()})
+    tx.write_restore_record(2, {5})
+    tx.append_health_event("restart", attempt=1, world=2)
+    tx.append_fault_entry({"index": 0, "kind": "kill_rank", "rank": 0,
+                           "at": 3})
+    tx.append_consumed(2, {"step": 5, "ids": [1, 2]})
+    names = set(os.listdir(gang))
+    assert {"beat_rank2.json", "abort.json", "join_rank3.json",
+            "restore_rank2.json", "gang_health.jsonl",
+            "faults_fired.jsonl",
+            "consumed_rank2.jsonl"} <= names
+    # The legacy (pre-transport) readers parse every channel.
+    assert read_beats(gang)[2]["step"] == 5
+    assert read_abort(gang)["by_rank"] == 1
+    assert read_joins(gang)[3]["spare"] is False
+    assert read_restore_record(gang, 2) == {5}
+    assert read_health_events(gang)[0]["kind"] == "restart"
+    with open(gang / "consumed_rank2.jsonl") as f:
+        assert json.loads(f.readline())["ids"] == [1, 2]

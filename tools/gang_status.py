@@ -36,66 +36,19 @@ import os
 import sys
 import time
 
-# Reader-side package modules only (telemetry/ + utils/timing are
-# stdlib-importable by construction; the jax-heavy runtime package is
-# never touched) — same bootstrap as tools/trace_summary.py.
+# Reader-side package modules only (telemetry/, utils/timing, and —
+# since ISSUE 12 — runtime/transport, all stdlib-importable by
+# construction; the jax-heavy submodules are never touched) — same
+# bootstrap as tools/trace_summary.py.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
+from distributed_machine_learning_tpu.runtime.transport import (  # noqa: E402,E501
+    FileTransport,
+)
 from distributed_machine_learning_tpu.telemetry.aggregator import (  # noqa: E402,E501
-    FAULT_LEDGER_FILE,
     aggregate_gang_metrics,
     median,
-    read_beats,
-    read_health_events,
 )
-from distributed_machine_learning_tpu.telemetry.sink import (  # noqa: E402
-    read_jsonl,
-)
-
-ABORT_FILE = "abort.json"  # runtime/coordinator.py's abort latch
-# runtime/coordinator.py's join/announcement channel (JOIN_PREFIX
-# there; duplicated so this tool stays importable without the jax-heavy
-# runtime package, like FAULT_LEDGER_FILE above).
-JOIN_PREFIX = "join_rank"
-
-
-def _read_json(path: str) -> dict | None:
-    try:
-        with open(path) as f:
-            payload = json.load(f)
-        return payload if isinstance(payload, dict) else None
-    except (OSError, json.JSONDecodeError):
-        return None
-
-
-def _ledger_entries(gang_dir: str) -> list[dict]:
-    try:
-        return [e for e in read_jsonl(os.path.join(gang_dir,
-                                                   FAULT_LEDGER_FILE))
-                if isinstance(e, dict)]
-    except OSError:
-        return []
-
-
-def _read_joins(gang_dir: str) -> dict[int, dict]:
-    """rank -> pending join/spare announcement (torn payloads skipped;
-    mirror of ``runtime/coordinator.py::read_joins`` without the
-    import)."""
-    out: dict[int, dict] = {}
-    try:
-        names = os.listdir(gang_dir)
-    except OSError:
-        return out
-    for name in names:
-        if not (name.startswith(JOIN_PREFIX) and name.endswith(".json")):
-            continue
-        rank_s = name[len(JOIN_PREFIX):-len(".json")]
-        if not rank_s.isdigit():
-            continue
-        payload = _read_json(os.path.join(gang_dir, name))
-        if payload is not None:
-            out[int(rank_s)] = payload
-    return out
 
 
 def _world_trajectory(health: list[dict], fallback: int) -> list[int]:
@@ -118,8 +71,14 @@ def _world_trajectory(health: list[dict], fallback: int) -> list[int]:
 
 
 def collect(gang_dir: str, telemetry_dir: str) -> dict:
-    """Everything the renderers need, as one JSON-ready dict."""
-    beats = read_beats(gang_dir)
+    """Everything the renderers need, as one JSON-ready dict.
+
+    Reads through the ``GangTransport`` snapshot API (ISSUE 12) — the
+    file backend here, since a status tool points at a directory; an
+    in-proc/tcp campaign mirrors its durable ledgers into the same
+    layout, so dead campaigns render identically."""
+    snap = FileTransport(gang_dir).snapshot()
+    beats = snap["beats"]
     # Staleness basis (dmlcheck DML001): NEVER this process's wall
     # clock vs timestamps other hosts wrote — on the shared mounts pods
     # use, reader-vs-writer clock skew of a minute is routine and would
@@ -139,7 +98,7 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
     # per-rank comparisons.
     reader_lag = (max(time.time() - newest_beat, 0.0)
                   if newest_beat is not None else None)
-    health = read_health_events(gang_dir)
+    health = snap["health"]
     # The live table's STRAGGLER column must match the beat files'
     # CURRENT rank numbering (a shrink renumbers survivors, while
     # verdict `rank` fields carry the original identity) and only the
@@ -189,7 +148,7 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
     # Ages are writer-clock vs the gang's freshest beat — peer-relative,
     # same rule as the rank rows; the reader's clock stays out of it.
     spare_rows, pending_joins = [], []
-    for rank, p in sorted(_read_joins(gang_dir).items()):
+    for rank, p in sorted(snap["joins"].items()):
         lag = (max(newest_beat - float(p["time"]), 0.0)
                if newest_beat is not None
                and isinstance(p.get("time"), (int, float)) else None)
@@ -200,17 +159,25 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
         else:
             row["at_step"] = p.get("at_step")
             pending_joins.append(row)
+    # The latest transport-health record the supervisor appended
+    # (backend + op/retry/timeout totals) — the lossy-transport
+    # post-mortem line.
+    transport_health = None
+    for e in health:
+        if e.get("kind") == "transport":
+            transport_health = e
     out = {
         "gang_dir": gang_dir,
         "world": len(rank_rows),
         "world_trajectory": _world_trajectory(health, len(rank_rows)),
-        "abort": _read_json(os.path.join(gang_dir, ABORT_FILE)),
+        "abort": snap["abort"],
         "freshest_beat_lag_s": reader_lag,
         "ranks": rank_rows,
         "spares": spare_rows,
         "pending_joins": pending_joins,
         "health": health,
-        "faults_fired": _ledger_entries(gang_dir),
+        "faults_fired": snap["faults_fired"],
+        "transport": transport_health,
     }
     if os.path.isdir(telemetry_dir):
         rollup = aggregate_gang_metrics(telemetry_dir)
@@ -231,6 +198,13 @@ def render(status: dict) -> str:
         lines.append(f"  freshest beat: {lag:.1f}s ago by this "
                      "reader's clock (approximate across hosts; "
                      "per-rank ages below are peer-relative)")
+    th = status.get("transport")
+    if th:
+        lines.append(
+            f"  transport: {th.get('backend', '?')} — "
+            f"{th.get('ops_total', 0)} op(s), "
+            f"{th.get('retries', 0)} retr{'y' if th.get('retries') == 1 else 'ies'}, "
+            f"{th.get('timeouts', 0)} timeout(s)")
     if status["ranks"]:
         lines.append(f"  {'rank':>4}  {'step':>6}  {'age':>8}  "
                      f"{'step_time':>10}  {'skew':>6}  state")
