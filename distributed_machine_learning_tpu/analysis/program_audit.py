@@ -184,6 +184,7 @@ def audit_ring_wire_accounting(
     mesh, length: int, schemes: Sequence[str] = ("none", "int8"),
     bucket_bytes: int = 8192, topk_frac: float = 0.125,
     label: str = "ring_all_reduce", topology: str | None = None,
+    codec_impl: str = "xla",
 ) -> tuple[list[Finding], dict]:
     """Compiled collective-permute bytes == static ``ring_wire_bytes``
     accounting, per wire scheme — the telemetry counter's number and
@@ -221,11 +222,13 @@ def audit_ring_wire_accounting(
     for scheme_name in schemes:
         if topology is not None:
             topo = Topology(t_inner, t_outer, outer_scheme=scheme_name,
-                            topk_frac=topk_frac, hd_max_bytes=0)
+                            topk_frac=topk_frac, hd_max_bytes=0,
+                            codec_impl=codec_impl)
             hlo = compile_ring_hlo(mesh, length, compress=scheme_name,
                                    topk_frac=topk_frac,
                                    bucket_bytes=bucket_bytes,
-                                   topology=topology, hd_max_bytes=0)
+                                   topology=topology, hd_max_bytes=0,
+                                   codec_impl=codec_impl)
             got = wire_bytes_from_hlo(hlo, inner=t_inner)
             want_axes = ring_wire_bytes_by_axis(
                 length, n, bucket_bytes=bucket_bytes, topology=topo)
@@ -281,10 +284,12 @@ def audit_ring_wire_accounting(
             continue
         hlo = compile_ring_hlo(mesh, length, compress=scheme_name,
                                topk_frac=topk_frac,
-                               bucket_bytes=bucket_bytes)
+                               bucket_bytes=bucket_bytes,
+                               codec_impl=codec_impl)
         got = wire_bytes_from_hlo(hlo)
         scheme = (None if scheme_name == "none"
-                  else get_wire_scheme(scheme_name, topk_frac=topk_frac))
+                  else get_wire_scheme(scheme_name, topk_frac=topk_frac,
+                                       codec_impl=codec_impl))
         want = ring_wire_bytes(length, n, bucket_bytes=bucket_bytes,
                                scheme=scheme)
         full_width = ring_wire_bytes(length, n, bucket_bytes=bucket_bytes)
@@ -322,7 +327,12 @@ def audit_ring_wire_accounting(
     return findings, table
 
 
-_CALLBACK_PRIMITIVES = ("pure_callback", "io_callback", "debug_callback")
+# ``debug_print`` is the Pallas-kernel spelling (``pl.debug_print``):
+# under the interpreter it is a host round-trip per grid step, and on
+# TPU a trace-slowing scalar dump — same class of leak as the XLA
+# callbacks, visible now that the walker descends kernel jaxprs.
+_CALLBACK_PRIMITIVES = ("pure_callback", "io_callback", "debug_callback",
+                        "debug_print")
 
 
 def audit_step_host_callbacks(fn, *args, label: str = "train_step",
@@ -334,11 +344,22 @@ def audit_step_host_callbacks(fn, *args, label: str = "train_step",
     1's DML004 (which can only see syncs the loop spells out).  ``fn``
     is traced (not compiled) with ``jax.make_jaxpr`` over ``args``
     (shape structs are fine); nested jaxprs (pjit/scan/cond bodies,
-    shard_map) are walked recursively."""
+    shard_map, AND ``pallas_call`` kernel bodies — a Pallas kernel's
+    params carry an *open* Jaxpr, not a ClosedJaxpr, so the walker
+    descends both spellings and the audit sees through the round-13
+    fused-kernel boundary) are walked recursively."""
     import jax
 
     jaxpr = jax.make_jaxpr(fn)(*args)
     hits: list[str] = []
+
+    def _sub(v):
+        # ClosedJaxpr carries .jaxpr; an open Jaxpr (pallas_call's
+        # kernel param) IS the walkable object itself.
+        inner = getattr(v, "jaxpr", None)
+        if inner is not None:
+            return inner
+        return v if hasattr(v, "eqns") else None
 
     def walk(jx):
         for eqn in jx.eqns:
@@ -346,12 +367,12 @@ def audit_step_host_callbacks(fn, *args, label: str = "train_step",
             if name in _CALLBACK_PRIMITIVES and name not in allowed:
                 hits.append(name)
             for v in eqn.params.values():
-                sub = getattr(v, "jaxpr", None)
+                sub = _sub(v)
                 if sub is not None:
                     walk(sub)
                 elif isinstance(v, (list, tuple)):
                     for item in v:
-                        s = getattr(item, "jaxpr", None)
+                        s = _sub(item)
                         if s is not None:
                             walk(s)
 
@@ -392,36 +413,75 @@ def _vggtest_setup():
     return model, init, jax.eval_shape(init)
 
 
-def audit_ring_step(mesh, global_batch: int = 16) -> list[Finding]:
-    """Compile the part3 ring train step for ``mesh``; run the donation
-    audit (every state leaf is donated via donate_argnums=(0,)), the
-    critical-path all-gather pass (the ring must have NONE — it is
-    permute-only), and the jaxpr host-callback pass."""
+def _audit_ring_strategy(mesh, strategy, label: str,
+                         global_batch: int = 16) -> list[Finding]:
+    """Shared body of the ring-step audits: compile the part3 train
+    step under ``strategy`` and run the donation, critical-path
+    (permute-only) and host-callback passes.  Stateful strategies
+    (error-feedback codecs) lower the inner 4-ary program so donation
+    covers the threaded residual too."""
     import jax
     import jax.numpy as jnp
 
-    from distributed_machine_learning_tpu.parallel.strategies import (
-        get_strategy,
-    )
     from distributed_machine_learning_tpu.train.step import make_train_step
 
     model, _, state_shape = _vggtest_setup()
-    step = make_train_step(model, get_strategy("ring"), mesh=mesh,
-                           augment=False)
+    step = make_train_step(model, strategy, mesh=mesh, augment=False)
     x = jax.ShapeDtypeStruct((global_batch, 32, 32, 3), jnp.float32)
     y = jax.ShapeDtypeStruct((global_batch,), jnp.int32)
-    hlo = step.lower(state_shape, x, y).compile().as_text()
     n_leaves = len(jax.tree_util.tree_leaves(state_shape))
-    findings = audit_donation(hlo, range(n_leaves), label="ring_step")
+    if getattr(strategy, "stateful", False):
+        res = jax.eval_shape(
+            lambda: step.fresh_sync_state(state_shape.params))
+        hlo = step.inner.lower(state_shape, x, y, res).compile().as_text()
+        n_res = len(jax.tree_util.tree_leaves(res))
+        # Flat entry params: state leaves, then x, y, then the residual
+        # (a copied residual would double the EF memory exactly where
+        # it is per-device by design).
+        donated = list(range(n_leaves)) + list(
+            range(n_leaves + 2, n_leaves + 2 + n_res))
+        cb_args = (step.inner, state_shape, x, y, res)
+    else:
+        hlo = step.lower(state_shape, x, y).compile().as_text()
+        donated = list(range(n_leaves))
+        cb_args = (step, state_shape, x, y)
+    findings = audit_donation(hlo, donated, label=label)
     findings += audit_critical_path_collectives(
-        hlo, kinds=("all-gather",), label="ring_step", severity="error")
-    findings += audit_step_host_callbacks(
-        step, state_shape, x, y, label="ring_step")
+        hlo, kinds=("all-gather",), label=label, severity="error")
+    findings += audit_step_host_callbacks(*cb_args, label=label)
     return findings
 
 
+def audit_ring_step(mesh, global_batch: int = 16,
+                    codec_impl: str | None = None) -> list[Finding]:
+    """Compile the part3 ring train step for ``mesh``; run the donation
+    audit (every state leaf is donated via donate_argnums=(0,)), the
+    critical-path all-gather pass (the ring must have NONE — it is
+    permute-only), and the jaxpr host-callback pass.
+
+    ``codec_impl`` (round 13): audit the COMPRESSED ring instead —
+    int8 + error feedback with the given codec implementation.  With
+    ``"pallas"`` this is the fused-kernel build: the audits must see
+    through the ``pallas_call`` boundary and prove the fused step is
+    still permute-only and fully donated (EF residual included), with
+    zero new baseline entries."""
+    from distributed_machine_learning_tpu.parallel.strategies import (
+        get_strategy,
+    )
+
+    if codec_impl is None:
+        return _audit_ring_strategy(
+            mesh, get_strategy("ring"), "ring_step",
+            global_batch=global_batch)
+    return _audit_ring_strategy(
+        mesh,
+        get_strategy("ring", compress="int8", codec_impl=codec_impl),
+        f"ring_step_int8_{codec_impl}", global_batch=global_batch)
+
+
 def audit_hier_ring_step(mesh, global_batch: int = 16,
-                         topology: str | None = None) -> list[Finding]:
+                         topology: str | None = None,
+                         codec_impl: str = "xla") -> list[Finding]:
     """Round 11: compile the part3 train step under the TOPOLOGY-aware
     hierarchical ring (int8 outer codec + error feedback — the
     stateful build, so donation covers the threaded residual too) and
@@ -436,40 +496,28 @@ def audit_hier_ring_step(mesh, global_batch: int = 16,
       the critical path means phase 3 re-serialized into the monolithic
       collective the explicit ring exists to replace;
     - no host callbacks in the jaxpr.
-    """
-    import jax
-    import jax.numpy as jnp
 
+    ``codec_impl="pallas"`` (round 13) audits the fused-kernel build of
+    the same program — the knob must not change any invariant.
+    """
     from distributed_machine_learning_tpu.parallel.strategies import (
         get_strategy,
     )
-    from distributed_machine_learning_tpu.train.step import make_train_step
 
     n = mesh.shape[mesh.axis_names[0]]
     if topology is None:
         topology = f"2x{n // 2}" if n % 2 == 0 else f"1x{n}"
-    model, _, state_shape = _vggtest_setup()
-    strategy = get_strategy("ring", compress="int8", topology=topology)
-    step = make_train_step(model, strategy, mesh=mesh, augment=False)
-    x = jax.ShapeDtypeStruct((global_batch, 32, 32, 3), jnp.float32)
-    y = jax.ShapeDtypeStruct((global_batch,), jnp.int32)
-    res = jax.eval_shape(lambda: step.fresh_sync_state(state_shape.params))
-    hlo = step.inner.lower(state_shape, x, y, res).compile().as_text()
-    n_leaves = len(jax.tree_util.tree_leaves(state_shape))
-    n_res = len(jax.tree_util.tree_leaves(res))
-    # Flat entry params: state leaves, then x, y, then the residual.
-    donated = list(range(n_leaves)) + list(
-        range(n_leaves + 2, n_leaves + 2 + n_res))
-    findings = audit_donation(hlo, donated, label="hier_ring_step")
-    findings += audit_critical_path_collectives(
-        hlo, kinds=("all-gather",), label="hier_ring_step",
-        severity="error")
-    findings += audit_step_host_callbacks(
-        step.inner, state_shape, x, y, res, label="hier_ring_step")
-    return findings
+    label = ("hier_ring_step" if codec_impl == "xla"
+             else f"hier_ring_step_{codec_impl}")
+    return _audit_ring_strategy(
+        mesh,
+        get_strategy("ring", compress="int8", topology=topology,
+                     codec_impl=codec_impl),
+        label, global_batch=global_batch)
 
 
-def audit_zero1_step(mesh, global_batch: int = 16) -> list[Finding]:
+def audit_zero1_step(mesh, global_batch: int = 16,
+                     fused_update: bool = False) -> list[Finding]:
     """Compile the OVERLAP-AWARE zero1 train step (the default build
     this audit gates since ISSUE 9) — both phases:
 
@@ -484,6 +532,14 @@ def audit_zero1_step(mesh, global_batch: int = 16) -> list[Finding]:
       donated operands — param_flat cannot alias the sharded output,
       and step/rng are wrapper-carried) must actually alias.
 
+    ``fused_update`` (round 13): audit the AdamW build with the fused
+    one-pass update kernel (``AdamWConfig(fused=True)``) — the update
+    program the overlap work can least afford to bloat.  The same
+    invariants must hold THROUGH the ``pallas_call`` boundary: the
+    fused moments still alias (the kernel's ``input_output_aliases``
+    must not break the jit-level donation), and the update program
+    stays gather-free.
+
     The legacy sync build (``overlap=False``) still exists for parity
     testing and the bench baseline; it is not audited here because its
     critical-path gather is now a *documented baseline*, not the
@@ -497,7 +553,17 @@ def audit_zero1_step(mesh, global_batch: int = 16) -> list[Finding]:
     )
 
     model, init_state, _ = _vggtest_setup()
-    z1, unravel, n_elems = shard_zero1_state(init_state(), mesh)
+    state = init_state()
+    if fused_update:
+        from distributed_machine_learning_tpu.train.adamw import (
+            AdamWConfig,
+            adamw_init,
+        )
+
+        cfg = AdamWConfig(fused=True)
+        state = state.replace(config=cfg,
+                              momentum=adamw_init(state.params))
+    z1, unravel, n_elems = shard_zero1_state(state, mesh)
     step = make_zero1_train_step(model, mesh, unravel, n_elems,
                                  augment=False, overlap=True)
     zshape = jax.eval_shape(lambda: z1)
@@ -516,13 +582,14 @@ def audit_zero1_step(mesh, global_batch: int = 16) -> list[Finding]:
     n_donated = len(jax.tree_util.tree_leaves(
         (zshape.momentum_shards, zshape.batch_stats)
     ))
+    suffix = "_fused" if fused_update else ""
     findings = audit_donation(
-        upd_hlo, range(1, 1 + n_donated), label="zero1_update")
+        upd_hlo, range(1, 1 + n_donated), label=f"zero1_update{suffix}")
     findings += audit_critical_path_collectives(
-        upd_hlo, kinds=("all-gather",), label="zero1_update",
+        upd_hlo, kinds=("all-gather",), label=f"zero1_update{suffix}",
         severity="error")
     findings += audit_critical_path_collectives(
-        gather_hlo, kinds=("all-gather",), label="zero1_gather",
+        gather_hlo, kinds=("all-gather",), label=f"zero1_gather{suffix}",
         severity="error")
     return findings
 
@@ -596,22 +663,30 @@ def audit_fsdp_perlayer_step(mesh, batch: int = 8, seq: int = 16
 
 def run_layer2(mesh=None) -> list[Finding]:
     """The full Layer-2 sweep ``tools/dmlcheck.py --layer2`` runs:
-    ring-step donation/collective/jaxpr audits (flat AND the round-11
-    topology-aware hierarchical build), the overlap-aware zero1
-    two-program audit (DML102 at ERROR severity since ISSUE 9), the
+    ring-step donation/collective/jaxpr audits (flat, the round-11
+    topology-aware hierarchical build, AND the round-13 fused-codec
+    build), the overlap-aware zero1 two-program audit (DML102 at ERROR
+    severity since ISSUE 9; reference and fused-AdamW builds), the
     per-layer-FSDP use-site-gather audit, and the wire-byte accounting
-    for every wire scheme — whole-ring and per-axis."""
+    for every wire scheme — whole-ring, per-axis, and through the
+    fused int8 kernels (the fusion must never change the wire)."""
     from distributed_machine_learning_tpu.runtime.mesh import make_mesh
 
     if mesh is None:
         mesh = make_mesh(8)
     findings = audit_ring_step(mesh)
+    findings += audit_ring_step(mesh, codec_impl="pallas")
     findings += audit_hier_ring_step(mesh)
     findings += audit_zero1_step(mesh)
+    findings += audit_zero1_step(mesh, fused_update=True)
     findings += audit_fsdp_perlayer_step(mesh)
     wire_findings, _ = audit_ring_wire_accounting(
         mesh, 4096, schemes=("none", "bf16", "int8", "topk"))
     findings += wire_findings
+    pallas_findings, _ = audit_ring_wire_accounting(
+        mesh, 4096, schemes=("int8",), codec_impl="pallas",
+        label="ring_all_reduce_pallas")
+    findings += pallas_findings
     n = mesh.shape[mesh.axis_names[0]]
     hier_findings, _ = audit_ring_wire_accounting(
         mesh, 4096, schemes=("none", "bf16", "int8", "topk"),

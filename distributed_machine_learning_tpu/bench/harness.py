@@ -81,6 +81,37 @@ def prepare_serving_params(master, quant: str | None, dtype=None):
     )
 
 
+def interleaved_ab(run_one: dict, iters: int, warmup: int = 1) -> dict:
+    """The interleaved A/B measurement protocol (grown as round 11's
+    ``--selector-ab``; one copy here so every A/B bench cancels drift
+    the same way).
+
+    ``run_one`` maps config name → ``fn(round_idx)`` running ONE
+    complete iteration of that config INCLUDING the host sync (block
+    on the value) — the function is the timed unit.  Each round runs
+    one iteration of EVERY config back-to-back, so the 1-core host's
+    ±5% sequential drift (thermal, scheduler, page cache) lands on all
+    configs equally and cancels in the comparison instead of
+    masquerading as a config cost — the failure mode of timing config
+    A's block and then config B's block.  ``warmup`` rounds run
+    untimed first (compile lands there, the reference's excluded
+    iteration 0).
+
+    Returns ``{name: [seconds, ...]}`` with ``iters`` timed samples
+    per config, in round order.
+    """
+    times: dict = {k: [] for k in run_one}
+    for r in range(warmup):
+        for fn in run_one.values():
+            fn(r)
+    for r in range(iters):
+        for k, fn in run_one.items():
+            t0 = time.perf_counter()
+            fn(r)
+            times[k].append(time.perf_counter() - t0)
+    return times
+
+
 def two_point_dispatch(dispatch, fetch, reps: int, chain: int) -> float:
     """The decode benches' shared timing harness: best-of-``reps`` over
     n chained dispatches closed by one host fetch, per-dispatch seconds

@@ -266,7 +266,8 @@ def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
                      bucket_bytes: int | None = None,
                      mean: bool = True,
                      topology: str | None = None,
-                     hd_max_bytes: int | None = None) -> str:
+                     hd_max_bytes: int | None = None,
+                     codec_impl: str = "xla") -> str:
     """jit-compile a bare bucketed ring all-reduce over ``mesh`` and
     return the optimized HLO text — backend-agnostic (the CPU test mesh
     compiles the same collective-permute program shape the TPU target
@@ -276,7 +277,12 @@ def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
     plan instead — ``compress`` becomes the OUTER axis's codec (the CLI
     mapping) and ``hd_max_bytes`` overrides the selector's
     small-bucket threshold (0 pins every bucket to the ring plans, a
-    large value pins them to halving-doubling)."""
+    large value pins them to halving-doubling).
+
+    ``codec_impl`` (round 13): compile the int8 codec as the fused
+    Pallas kernels (``"pallas"``) instead of the XLA ops — the DML103
+    audit runs both and asserts the kernel build moves the exact same
+    collective-permute bytes (the fusion must never change the wire)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -292,7 +298,8 @@ def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
 
     axis = mesh.axis_names[0]
     n = mesh.shape[axis]
-    scheme = get_wire_scheme(compress, topk_frac=topk_frac)
+    scheme = get_wire_scheme(compress, topk_frac=topk_frac,
+                             codec_impl=codec_impl)
     topo = None
     if topology is not None:
         from distributed_machine_learning_tpu.ops.topology import (
@@ -309,6 +316,7 @@ def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
             )
         topo = Topology(
             inner, outer, outer_scheme=compress, topk_frac=topk_frac,
+            codec_impl=codec_impl,
             hd_max_bytes=(DEFAULT_HD_MAX_BYTES if hd_max_bytes is None
                           else hd_max_bytes),
         )

@@ -182,17 +182,20 @@ def bench_selector_ab(world: int = 8, topology: str = "2x4",
                       model_name: str = "vggtest") -> list[dict]:
     """The selector acceptance instrument: INTERLEAVED A/B of the flat
     ring vs the selector's plans (hd for the small exact bucket, hier
-    with the codec) on the SAME batch stream — one iteration of each
-    config per round, so the 1-core host's ±5% sequential drift
-    cancels instead of masquerading as a plan cost (the PR-9 overlap
-    bench's protocol).  The bar: neither selected plan slower than
-    flat at p50."""
+    with the codec) on the SAME batch stream — the shared protocol of
+    ``bench/harness.py::interleaved_ab`` (one iteration of each config
+    per round, so the 1-core host's ±5% sequential drift cancels
+    instead of masquerading as a plan cost; the PR-9 overlap bench's
+    protocol).  The bar: neither selected plan slower than flat at
+    p50."""
     import dataclasses
-    import time as _time
 
     import jax
     import numpy as np
 
+    from distributed_machine_learning_tpu.bench.harness import (
+        interleaved_ab,
+    )
     from distributed_machine_learning_tpu.cli.common import (
         SEED,
         init_model_and_state,
@@ -239,22 +242,21 @@ def bench_selector_ab(world: int = 8, topology: str = "2x4",
         "hier_exact": _HierOnly(topology=topology),
     }
     steps, states = {}, {}
-    times: dict[str, list] = {k: [] for k in configs}
     for k, strat in configs.items():
         states[k] = init_model_and_state(
             model, config=SGDConfig(learning_rate=0.1, weight_decay=0.0)
         )
         steps[k] = make_train_step(model, strat, mesh=mesh, augment=False)
-        xs, ys = shard_batch(mesh, *batches[0])
-        states[k], loss = steps[k](states[k], xs, ys)  # compile
-        jax.block_until_ready(loss)
-    for rep in range(iters):
-        for k in configs:
+
+    def one_iter(k):
+        def run(rep):
             xs, ys = shard_batch(mesh, *batches[rep % len(batches)])
-            t0 = _time.perf_counter()
             states[k], loss = steps[k](states[k], xs, ys)
             jax.block_until_ready(loss)
-            times[k].append(_time.perf_counter() - t0)
+        return run
+
+    times = interleaved_ab({k: one_iter(k) for k in configs}, iters,
+                           warmup=1)
     rows = []
     flat_p50 = percentile_stats(times["flat"])["p50"]
     for k, ts in times.items():

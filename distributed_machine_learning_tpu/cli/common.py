@@ -227,6 +227,16 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                              "for large global batches (train/lars.py); "
                              "'adamw' is the decoupled-decay Adam "
                              "(train/adamw.py)")
+    parser.add_argument("--fused-update", dest="fused_update",
+                        action="store_true",
+                        help="run the AdamW update as the fused one-pass "
+                             "Pallas kernel (ops/pallas/fused_adamw.py): "
+                             "moment update, bias correction, weight "
+                             "decay, parameter update and the dtype cast "
+                             "in-register per tile — the round-13 "
+                             "update-phase lever; --optimizer adamw only "
+                             "(documented-ulp parity with the reference "
+                             "update)")
     parser.add_argument("--wire-dtype", dest="wire_dtype", default=None,
                         choices=["bfloat16"],
                         help="DEPRECATED: use --ring-compress bf16 (this "
@@ -245,6 +255,17 @@ def make_flag_parser(description: str) -> argparse.ArgumentParser:
                              "(values+indices).  int8/topk carry an "
                              "error-feedback residual across steps "
                              "(EF-SGD) unless --ring-no-error-feedback")
+    parser.add_argument("--ring-codec-impl", dest="ring_codec_impl",
+                        default="xla", choices=["xla", "pallas"],
+                        help="implementation of the int8 ring codec "
+                             "(round 13): 'pallas' runs each hop's "
+                             "dequantize-add-requantize and the EF "
+                             "residual as fused in-register kernels "
+                             "(ops/pallas/ring_codec.py) — bitwise-"
+                             "identical to 'xla', no dequantized "
+                             "partial in HBM; only --ring-compress "
+                             "int8 has kernels (bf16/topk keep the "
+                             "XLA path)")
     parser.add_argument("--ring-topk-frac", dest="ring_topk_frac",
                         default=0.125, type=float,
                         help="fraction of each ring chunk kept by "
@@ -505,6 +526,20 @@ def run_part(
         )
 
         opt_config = get_optimizer(args.optimizer)[0]()
+        if getattr(args, "fused_update", False):
+            from distributed_machine_learning_tpu.train.adamw import (
+                AdamWConfig,
+            )
+
+            if isinstance(opt_config, AdamWConfig):
+                import dataclasses
+
+                opt_config = dataclasses.replace(opt_config, fused=True)
+            else:
+                rank0_print(
+                    "WARNING: --fused-update applies to --optimizer adamw "
+                    f"only; {args.optimizer!r} runs its reference update."
+                )
         state = init_model_and_state(model, config=opt_config)
 
         # Unsynced-BN quirk mode (reference part3 parity: per-node running
@@ -675,6 +710,7 @@ def run_part(
             if ring_compress == "none":
                 ring_compress = "bf16"
         ring_topology = getattr(args, "ring_topology", None)
+        ring_codec_impl = getattr(args, "ring_codec_impl", "xla")
         if strategy_name == "ring":
             if ring_compress != "none":
                 strategy_kwargs["compress"] = ring_compress
@@ -684,6 +720,14 @@ def run_part(
                 strategy_kwargs["error_feedback"] = getattr(
                     args, "ring_error_feedback", True
                 )
+            if ring_codec_impl != "xla":
+                if ring_compress != "int8":
+                    rank0_print(
+                        "WARNING: --ring-codec-impl pallas has kernels for "
+                        "--ring-compress int8 only; "
+                        f"{ring_compress!r} runs the XLA path."
+                    )
+                strategy_kwargs["codec_impl"] = ring_codec_impl
             if ring_topology:
                 strategy_kwargs["topology"] = ring_topology
         elif ring_compress != "none":
@@ -749,6 +793,16 @@ def run_part(
             telemetry.registry.gauge("ring_compression_ratio").set(
                 strategy.compression_ratio(n_elems, world)
             )
+        if telemetry is not None:
+            # Which implementation actually ran, visible per step in the
+            # registry/trace (round 13): a bench or gang row claiming
+            # "fused" must show a nonzero counter, and a silent fallback
+            # to the XLA path shows as its absence.
+            if (getattr(strategy, "codec_impl", "xla") == "pallas"
+                    and getattr(strategy, "compress", "none") == "int8"):
+                telemetry.step_counters["fused_codec_steps"] = 1
+            if getattr(opt_config, "fused", False):
+                telemetry.step_counters["fused_update_steps"] = 1
         train_step = make_train_step(
             model, strategy, mesh=mesh,
             schedule=make_schedule(

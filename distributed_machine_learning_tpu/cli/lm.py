@@ -195,6 +195,12 @@ def make_parser():
                         "the reference's torch-semantics update")
     p.add_argument("--lr", default=None, type=float,
                    help="override the optimizer config's learning rate")
+    p.add_argument("--fused-update", dest="fused_update",
+                   action="store_true",
+                   help="run the AdamW update as the fused one-pass Pallas "
+                        "kernel (ops/pallas/fused_adamw.py) — moment "
+                        "update, bias correction, decay, parameter update "
+                        "and the bf16 cast in-register; adamw only")
     p.add_argument("--momentum-dtype", dest="momentum_dtype", default=None,
                    help="SGD momentum-buffer storage dtype (e.g. "
                         "bfloat16): halves optimizer-state memory, the "
@@ -331,6 +337,14 @@ def build(args):
                 "buffer dtype and refuses narrowing)"
             )
         cfg_kwargs["momentum_dtype"] = args.momentum_dtype
+    if getattr(args, "fused_update", False):
+        if args.optimizer != "adamw":
+            raise ValueError(
+                "--fused-update applies to --optimizer adamw only (the "
+                "fused kernel is the AdamW rule; got "
+                f"--optimizer {args.optimizer})"
+            )
+        cfg_kwargs["fused"] = True
     opt_config = cfg_cls(**cfg_kwargs)
     if args.fused_ce_chunks and args.parallel not in (
         "dp", "ring", "ulysses", "fsdp", "fsdp_pl"

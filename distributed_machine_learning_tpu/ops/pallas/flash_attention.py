@@ -54,12 +54,15 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # pltpu imports only resolve fully on TPU-capable installs
-    from jax.experimental.pallas import tpu as pltpu
-
-    _HAS_PLTPU = True
-except ImportError:  # pragma: no cover
-    _HAS_PLTPU = False
+# Interpret-mode selection and the pltpu import are the shared knobs of
+# ops/pallas/common.py (one decision for every kernel); ``_interpret``
+# stays importable from here — quant_matmul historically imported it
+# from this module, and that path keeps working as an alias.
+from distributed_machine_learning_tpu.ops.pallas.common import (
+    _HAS_PLTPU,
+    _interpret,
+    pltpu,
+)
 
 NEG_INF = -1e30
 _LANES = 128  # VMEM lane width: m/l scratch is (block_q, _LANES)
@@ -78,23 +81,6 @@ _LANES = 128  # VMEM lane width: m/l scratch is (block_q, _LANES)
 # under Mosaic — its exp is already pow2-based — but base-2 keeps the
 # kernel at the floor of what the lowering can emit.)
 LOG2E = 1.4426950408889634
-
-
-def _interpret() -> bool:
-    # An explicitly configured default device wins: a process whose
-    # highest-priority backend is a TPU can still route computations to
-    # virtual CPU devices (the multi-chip dryrun does exactly that), and
-    # Mosaic can't compile for CPU — interpret there.  The config also
-    # accepts plain strings ("cpu", "tpu:0"), so parse those too.
-    dev = jax.config.jax_default_device
-    if dev is not None:
-        platform = (
-            dev.platform
-            if hasattr(dev, "platform")
-            else str(dev).split(":")[0]
-        )
-        return platform != "tpu"
-    return jax.default_backend() != "tpu"
 
 
 def _compiler_params():

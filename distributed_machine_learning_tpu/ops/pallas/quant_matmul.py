@@ -37,16 +37,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from distributed_machine_learning_tpu.ops.pallas.flash_attention import (
+from distributed_machine_learning_tpu.ops.pallas.common import (
     _interpret,
+    tile_compiler_params,
 )
-
-try:
-    from jax.experimental.pallas import tpu as pltpu
-
-    _HAS_PLTPU = True
-except ImportError:  # pragma: no cover
-    _HAS_PLTPU = False
 
 
 def quantize_int8(w: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -73,19 +67,11 @@ def _kernel(x_ref, q_ref, s_ref, o_ref):
     o_ref[...] = (acc * s_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def _pick_block(n: int, target: int, quantum: int) -> int:
-    """Largest multiple-of-``quantum`` divisor of n that is <= target,
-    or n itself when n < quantum (Mosaic accepts a block equal to the
-    full array dim)."""
-    if n <= quantum:
-        return n
-    best = None
-    b = quantum
-    while b <= min(n, target):
-        if n % b == 0:
-            best = b
-        b += quantum
-    return best if best is not None else (n if n <= target else None)
+# Tiling helper hoisted to the shared kernel plumbing; the historical
+# private name keeps resolving for existing callers.
+from distributed_machine_learning_tpu.ops.pallas.common import (  # noqa: E402
+    pick_block as _pick_block,
+)
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "block_k"))
@@ -150,11 +136,7 @@ def int8_matmul(
     out_dtype = x.dtype
     x = x.astype(jnp.bfloat16)
     grid = (R // bR, K // bK)
-    kwargs = {}
-    if _HAS_PLTPU and not _interpret():
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")
-        )
+    kwargs = tile_compiler_params(("parallel", "parallel"))
     out = pl.pallas_call(
         _kernel,
         grid=grid,

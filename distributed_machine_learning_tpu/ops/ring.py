@@ -49,11 +49,16 @@ Systems", arxiv 1711.00705).  Three codecs behind one interface:
   of the literature — residual correction lives a layer up, in
   ``parallel/strategies.py::RingAllReduce(error_feedback=True)``.
 - ``int8`` — per-chunk symmetric int8 with one fp32 scale per chunk
-  (~4x fewer wire bytes), reusing the serving quantizer
-  (``ops/pallas/quant_matmul.py::quantize_int8``) on the chunk viewed
-  as a single output column.  Each reduce-scatter hop dequantizes,
-  adds in fp32, and requantizes — the dequantize–add–requantize fusion
-  of the compressed multi-hop all-reduce.
+  (~4x fewer wire bytes).  Each reduce-scatter hop dequantizes, adds
+  in fp32, and requantizes — the dequantize–add–requantize fusion of
+  the compressed multi-hop all-reduce.  Two implementations behind
+  ``codec_impl`` (round 13): ``"xla"`` spells the codec as separate
+  XLA ops (quantization arithmetic shared with the serving weight
+  quantizer's recipe — ``quantize_int8`` in
+  ``ops/pallas/quant_matmul.py`` — applied per chunk), ``"pallas"``
+  runs the fused in-register kernels of the shared codec module
+  ``ops/pallas/ring_codec.py`` (bitwise-identical payload, residual,
+  and output; no dequantized partial ever reaches HBM).
 - ``topk`` — magnitude top-k sparsification: (values, indices) on the
   wire, ``k = topk_frac × chunk``; the receiver scatter-adds.
 
@@ -108,6 +113,26 @@ class WireScheme:
     def payload_bytes(self, length: int, itemsize: int = 4) -> int:
         return length * itemsize
 
+    # -- fusion seams (round 13) ---------------------------------------
+    # The ring loops route every hop through these two methods instead
+    # of spelling encode/decode/add/residual inline, so a codec that
+    # owns fused kernels (Int8Scheme(impl="pallas")) can collapse each
+    # piece to one in-register pass.  The defaults reproduce the
+    # historical op-for-op XLA arithmetic exactly.
+
+    def encode_with_residual(self, v: jax.Array):
+        """``(payload, err)`` where ``err = v − decode(encode(v))`` is
+        the error-feedback send error this encode drops."""
+        enc = self.encode(v)
+        return enc, v - self.decode(enc, v.shape[0]).astype(v.dtype)
+
+    def decode_add(
+        self, payload: tuple[jax.Array, ...], acc: jax.Array, length: int
+    ) -> jax.Array:
+        """One arrival: decode ``payload`` and accumulate into ``acc``
+        (the reduce-scatter hop's dequantize–add)."""
+        return acc + self.decode(payload, length).astype(acc.dtype)
+
 
 class CastScheme(WireScheme):
     """Dtype cast on the wire (``bf16``): halves fp32 bytes, no metadata.
@@ -134,25 +159,91 @@ class CastScheme(WireScheme):
 
 class Int8Scheme(WireScheme):
     """Per-chunk symmetric int8 + one fp32 scale (~itemsize/1 ≈ 4x fewer
-    bytes for fp32 gradients).  Reuses the serving weight quantizer
-    (:func:`~distributed_machine_learning_tpu.ops.pallas.quant_matmul.quantize_int8`)
-    on the chunk viewed as a [L, 1] single-column matrix, so "per
-    output channel" degenerates to exactly the per-chunk scale the
-    compressed-ring recipe wants."""
+    bytes for fp32 gradients).  Both implementations share ONE recipe,
+    defined in the codec module ``ops/pallas/ring_codec.py``
+    (:func:`~distributed_machine_learning_tpu.ops.pallas.ring_codec.quantize_chunk_int8`):
+    the serving weight quantizer's symmetric ``scale = max|v|/127``
+    applied per chunk, with the scale's mantissa truncated to 16 bits
+    so every decode product ``q·scale`` is EXACT in f32 — the property
+    that makes the fused/XLA parity bitwise by construction (FMA
+    contraction cannot perturb an exact product) instead of at the
+    mercy of backend fusion decisions.
+
+    ``impl`` (round 13, the ``--ring-codec-impl`` knob): ``"xla"``
+    spells encode/decode/residual as separate XLA ops (the historical
+    build); ``"pallas"`` dispatches to the fused in-register kernels of
+    the same codec module — identical wire payload (bitwise),
+    identical residual, no dequantized partial in HBM.  The kernels
+    engage on f32 chunks (the dtype every ring path carries — flat
+    gradients ravel to f32); a non-f32 chunk falls back to the XLA
+    seams, because the kernels accumulate/subtract in f32 and round
+    once where the XLA seams compute in the chunk dtype — on f32 the
+    two coincide bit for bit, on narrower dtypes they would not, and
+    the bitwise contract must hold wherever the kernels run."""
 
     name = "int8"
 
+    def __init__(self, impl: str = "xla"):
+        if impl not in CODEC_IMPLS:
+            raise ValueError(
+                f"unknown int8 codec impl {impl!r}; choose from "
+                f"{CODEC_IMPLS} (the fused kernels live in "
+                "ops/pallas/ring_codec.py)"
+            )
+        self.impl = impl
+
     def encode(self, v):
-        from distributed_machine_learning_tpu.ops.pallas.quant_matmul import (
-            quantize_int8,
+        if self.impl == "pallas":
+            from distributed_machine_learning_tpu.ops.pallas.ring_codec import (
+                encode_int8,
+            )
+
+            return encode_int8(v)
+        from distributed_machine_learning_tpu.ops.pallas.ring_codec import (
+            quantize_chunk_int8,
         )
 
-        q, scale = quantize_int8(v[:, None])  # [L,1] → one column = one scale
-        return (q.reshape(-1), scale)
+        return quantize_chunk_int8(v)
+
+    def encode_with_residual(self, v):
+        # f32-only kernel engagement (see class docstring): the kernel
+        # subtracts in f32 and rounds the residual once, the XLA seam
+        # subtracts in the chunk dtype — identical bits on f32 only.
+        if self.impl != "pallas" or v.dtype != jnp.float32:
+            return super().encode_with_residual(v)
+        from distributed_machine_learning_tpu.ops.pallas.ring_codec import (
+            encode_int8_residual,
+        )
+
+        q, scale, err = encode_int8_residual(v)
+        return (q, scale), err
 
     def decode(self, payload, length):
         q, scale = payload
+        if self.impl == "pallas":
+            from distributed_machine_learning_tpu.ops.pallas.ring_codec import (
+                decode_int8,
+            )
+
+            return decode_int8(q, scale, length)
+        # Exact product (the truncated scale of ring_codec.chunk_scale
+        # bounds q·scale to 24 significand bits), so downstream
+        # adds/subtracts cannot be perturbed by FMA contraction —
+        # bitwise-identical to the fused kernel in any fusion context.
         return q.astype(jnp.float32) * scale  # scale is [1]; broadcasts
+
+    def decode_add(self, payload, acc, length):
+        # f32-only kernel engagement (see class docstring): the kernel
+        # accumulates in f32 and rounds the sum once, the XLA seam
+        # casts the decode then adds in the accumulator dtype.
+        if self.impl != "pallas" or acc.dtype != jnp.float32:
+            return super().decode_add(payload, acc, length)
+        from distributed_machine_learning_tpu.ops.pallas.ring_codec import (
+            decode_add_int8,
+        )
+
+        q, scale = payload
+        return decode_add_int8(q, scale, acc)
 
     def payload_bytes(self, length, itemsize=4):
         return length + 4  # int8 chunk + one fp32 scale
@@ -191,20 +282,39 @@ class TopKScheme(WireScheme):
 
 
 WIRE_SCHEMES = ("none", "bf16", "int8", "topk")
+CODEC_IMPLS = ("xla", "pallas")
 
 
-def get_wire_scheme(name: str, topk_frac: float = 0.125) -> WireScheme:
-    """Resolve a ``--ring-compress`` name to a codec instance."""
+def get_wire_scheme(
+    name: str, topk_frac: float = 0.125, codec_impl: str = "xla"
+) -> WireScheme:
+    """Resolve a ``--ring-compress`` name to a codec instance.
+
+    ``codec_impl`` (``--ring-codec-impl``): ``"pallas"`` routes the
+    int8 codec through the fused in-register kernels of
+    ``ops/pallas/ring_codec.py`` (bitwise-identical to the XLA build).
+    Only int8 has a kernel: ``none``/``bf16`` have nothing to fuse and
+    ``topk``'s top-k/scatter stays on the XLA path by design, so the
+    knob is a no-op for them.
+    """
+    if codec_impl not in CODEC_IMPLS:
+        raise ValueError(
+            f"unknown codec impl {codec_impl!r}; choose from "
+            f"{CODEC_IMPLS} (the fused int8 codec kernels live in "
+            "ops/pallas/ring_codec.py)"
+        )
     if name == "none":
         return WireScheme()
     if name == "bf16":
         return CastScheme(jnp.bfloat16)
     if name == "int8":
-        return Int8Scheme()
+        return Int8Scheme(impl=codec_impl)
     if name == "topk":
         return TopKScheme(topk_frac)
     raise ValueError(
-        f"unknown wire scheme {name!r}; choose from {WIRE_SCHEMES}"
+        f"unknown wire scheme {name!r}; choose from {WIRE_SCHEMES} "
+        "(codecs live in ops/ring.py, the fused int8 kernels in "
+        "ops/pallas/ring_codec.py)"
     )
 
 
@@ -304,21 +414,26 @@ def ring_all_reduce_flat(
         v = chunks[send_row]
         if scheme is None:
             recvd = lax.ppermute(v, axis_name, perm)
+            chunks = chunks.at[recv_row].add(recvd)
         else:
             # One hop of dequantize–add–requantize: encode the partial,
-            # permute the payload, decode on arrival; the requantize is
-            # the next hop's encode of the updated partial.
-            enc = scheme.encode(v)
-            recvd = scheme.decode(hop(enc), chunk).astype(x.dtype)
+            # permute the payload, decode-accumulate on arrival; the
+            # requantize is the next hop's encode of the updated
+            # partial.  Both pieces go through the scheme's fusion
+            # seams, so the fused codec (Int8Scheme(impl="pallas"))
+            # runs each as one in-register kernel.
             if account:
                 # Send error: the mass THIS encode drops from the
                 # downstream accumulation (decode(enc) is what the
                 # receiver actually adds) — observed by the sender,
                 # once per hop across the whole ring.
-                res_rows = res_rows.at[send_row].add(
-                    v - scheme.decode(enc, chunk).astype(x.dtype)
-                )
-        chunks = chunks.at[recv_row].add(recvd)
+                enc, err = scheme.encode_with_residual(v)
+                res_rows = res_rows.at[send_row].add(err)
+            else:
+                enc = scheme.encode(v)
+            chunks = chunks.at[recv_row].set(
+                scheme.decode_add(hop(enc), chunks[recv_row], chunk)
+            )
     # Rank r now owns the full sum of global chunk (r+1) mod n == row 1.
     own = chunks[1 % n]
     if mean:

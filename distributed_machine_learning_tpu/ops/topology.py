@@ -65,6 +65,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from distributed_machine_learning_tpu.ops.ring import (
+    CODEC_IMPLS,
     WIRE_SCHEMES,
     WireScheme,
     _bucket_bounds,
@@ -121,6 +122,9 @@ class Topology:
     where the wire is expensive) and leaves the inner axis exact, but
     the descriptor supports compressing either or both.
     ``hd_max_bytes``: the selector's small-bucket threshold.
+    ``codec_impl`` (round 13): the int8 codec implementation both axes
+    resolve — ``"pallas"`` runs the fused in-register kernels
+    (``ops/pallas/ring_codec.py``), bitwise-identical to ``"xla"``.
     """
 
     inner: int
@@ -129,6 +133,7 @@ class Topology:
     outer_scheme: str = "none"
     topk_frac: float = 0.125
     hd_max_bytes: int = DEFAULT_HD_MAX_BYTES
+    codec_impl: str = "xla"
 
     def __post_init__(self):
         if self.inner < 1 or self.outer < 1:
@@ -142,6 +147,11 @@ class Topology:
                     f"unknown wire scheme {name!r}; choose from "
                     f"{WIRE_SCHEMES}"
                 )
+        if self.codec_impl not in CODEC_IMPLS:
+            raise ValueError(
+                f"unknown codec impl {self.codec_impl!r}; choose from "
+                f"{CODEC_IMPLS}"
+            )
 
     @property
     def world(self) -> int:
@@ -151,7 +161,8 @@ class Topology:
 
     def axis_scheme(self, axis: str) -> WireScheme:
         name = self.inner_scheme if axis == "inner" else self.outer_scheme
-        return get_wire_scheme(name, topk_frac=self.topk_frac)
+        return get_wire_scheme(name, topk_frac=self.topk_frac,
+                               codec_impl=self.codec_impl)
 
     def _scheme_or_none(self, axis: str) -> WireScheme | None:
         s = self.axis_scheme(axis)
@@ -285,17 +296,22 @@ def hierarchical_all_reduce_flat(
         v = chunks[send_row]
         if inner_scheme is None:
             recvd = lax.ppermute(v, axis_name, perm_inner)
+            chunks = chunks.at[recv_row].add(recvd)
         else:
-            enc = inner_scheme.encode(v)
-            recvd = inner_scheme.decode(hop(enc), chunk).astype(x.dtype)
+            # Routed through the scheme's fusion seams (round 13) like
+            # the flat ring, so the fused int8 codec collapses each
+            # piece to one in-register kernel on this axis too.
             if account:
                 # Send error: mass this encode drops from the node-sum,
                 # hence from the total sum — sum units, sender-observed,
                 # once per hop (the flat ring's phase-1 bookkeeping).
-                res_rows = res_rows.at[send_row].add(
-                    v - inner_scheme.decode(enc, chunk).astype(x.dtype)
-                )
-        chunks = chunks.at[recv_row].add(recvd)
+                enc, err = inner_scheme.encode_with_residual(v)
+                res_rows = res_rows.at[send_row].add(err)
+            else:
+                enc = inner_scheme.encode(v)
+            chunks = chunks.at[recv_row].set(
+                inner_scheme.decode_add(hop(enc), chunks[recv_row], chunk)
+            )
     own = chunks[1 % inner]
 
     # Phase 2 — compressed ring all-reduce on the outer axis, SUM

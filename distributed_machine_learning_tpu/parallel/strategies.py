@@ -151,6 +151,15 @@ class RingAllReduce(SyncStrategy):
     ring.  Error feedback becomes per-axis but the residuals still sum
     to the total dropped mass — the stateful protocol is unchanged.
 
+    ``codec_impl`` ("xla"/"pallas", round 13 — ``--ring-codec-impl``):
+    the int8 codec's implementation.  ``"pallas"`` dispatches every
+    hop's dequantize–add–requantize (and the EF residual) through the
+    fused in-register kernels of ``ops/pallas/ring_codec.py`` —
+    bitwise-identical wire payload, output, and residual, with no
+    dequantized partial ever materialized in HBM.  Flat, hierarchical
+    inner/outer, and all-gather relay paths all follow the knob; only
+    int8 has kernels (``topk``/``bf16`` keep the XLA path).
+
     ``wire_dtype="bfloat16"`` is the deprecated spelling of
     ``compress="bf16"``.
     """
@@ -163,12 +172,21 @@ class RingAllReduce(SyncStrategy):
     topk_frac: float = 0.125
     error_feedback: bool = True
     topology: str | None = None
+    codec_impl: str = "xla"
 
     def __post_init__(self):
         if self.compress not in WIRE_SCHEMES:
             raise ValueError(
                 f"unknown ring compress scheme {self.compress!r}; choose "
                 f"from {WIRE_SCHEMES}"
+            )
+        from distributed_machine_learning_tpu.ops.ring import CODEC_IMPLS
+
+        if self.codec_impl not in CODEC_IMPLS:
+            raise ValueError(
+                f"unknown ring codec impl {self.codec_impl!r}; choose "
+                f"from {CODEC_IMPLS} (the fused int8 kernels live in "
+                "ops/pallas/ring_codec.py)"
             )
         if not 0.0 < self.topk_frac <= 1.0:
             raise ValueError(
@@ -193,7 +211,8 @@ class RingAllReduce(SyncStrategy):
         """The resolved :class:`~...ops.ring.WireScheme` (exact scheme
         for ``compress='none'`` without a legacy ``wire_dtype``)."""
         if self.compress != "none":
-            return get_wire_scheme(self.compress, topk_frac=self.topk_frac)
+            return get_wire_scheme(self.compress, topk_frac=self.topk_frac,
+                                   codec_impl=self.codec_impl)
         if self.wire_dtype is not None:
             from distributed_machine_learning_tpu.ops.ring import CastScheme
 
@@ -248,6 +267,7 @@ class RingAllReduce(SyncStrategy):
         return Topology(
             inner, outer,
             topk_frac=self.topk_frac,
+            codec_impl=self.codec_impl,
             **{scheme_axis: self.scheme().name},
         )
 

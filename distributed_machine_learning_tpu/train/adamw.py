@@ -45,6 +45,16 @@ class AdamWConfig:
     beta2: float = 0.999
     eps: float = 1e-8
     weight_decay: float = 0.01
+    #: Run the update as the fused one-pass Pallas kernel
+    #: (``ops/pallas/fused_adamw.py``; CLI ``--fused-update``) instead
+    #: of the XLA elementwise chain.  Same signature, same rule; held
+    #: to a documented ulp bound against the reference (the kernel's
+    #: FMA contraction may differ in the last bits — see the kernel
+    #: module docstring).  A config field rather than a step-builder
+    #: argument so every consumer of the optimizer registry — the
+    #: replicated step, zero1/fsdp and their overlap builds, the LM
+    #: steps — picks the kernel up with no builder changes.
+    fused: bool = False
 
 
 def adamw_init(params, config=None):
@@ -90,6 +100,22 @@ def adamw_update(params, moments, grads, config: AdamWConfig, lr=None, step=None
         adam_term = (m / bc1) / (jnp.sqrt(v / bc2) + config.eps)
         p32 = p32 - lr * (adam_term + config.weight_decay * p32)
         return p32.astype(p.dtype), m, v
+
+    if config.fused:
+        # One-pass Pallas kernel (ops/pallas/fused_adamw.py): moment
+        # update, bias correction, decay, parameter update, and the
+        # dtype cast in-register per tile — read 4, write 3, nothing
+        # between.  Same rule; documented-ulp parity with _update.
+        from distributed_machine_learning_tpu.ops.pallas.fused_adamw import (
+            fused_adamw_leaf,
+        )
+
+        def _update(p, m, v, g):  # noqa: F811 — fused twin of the above
+            return fused_adamw_leaf(
+                p, m, v, g, lr, bc1, bc2,
+                beta1=config.beta1, beta2=config.beta2, eps=config.eps,
+                weight_decay=config.weight_decay,
+            )
 
     flat = jax.tree_util.tree_map(
         _update, params, moments["mu"], moments["nu"], grads
