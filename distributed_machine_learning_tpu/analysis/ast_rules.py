@@ -21,6 +21,7 @@ from __future__ import annotations
 import ast
 import os
 import re
+import time
 from typing import Callable, Iterable, Iterator
 
 from distributed_machine_learning_tpu.analysis.findings import Finding
@@ -806,19 +807,242 @@ def check_socket_timeouts(ctx: FileContext) -> Iterator[Finding]:
                 "runtime/tools layer",
             )
     for fn in _functions(ctx.tree):
-        body_src = "\n".join(ctx.seg(s) for s in fn.body)
-        if any(tok in body_src for tok in _TIMEOUT_TOKENS):
-            continue
+        # Find a raw-socket construction FIRST: reconstructing body
+        # source (get_source_segment is O(file) per statement) for
+        # every socket-free function made this rule 6s of the <10s
+        # layer-1 budget.
+        sock_node = None
         for node in _walk_scope(fn.body, skip_functions=True):
             if (isinstance(node, ast.Call)
                     and _call_name(node) == "socket.socket"):
-                yield ctx.finding(
-                    "DML012", node,
-                    f"{fn.name}() constructs a raw socket but never "
-                    "calls settimeout — every blocking socket op in "
-                    "the gang control plane must be bounded",
-                )
+                sock_node = node
                 break
+        if sock_node is None:
+            continue
+        body_src = "\n".join(ctx.seg(s) for s in fn.body)
+        if any(tok in body_src for tok in _TIMEOUT_TOKENS):
+            continue
+        yield ctx.finding(
+            "DML012", sock_node,
+            f"{fn.name}() constructs a raw socket but never "
+            "calls settimeout — every blocking socket op in "
+            "the gang control plane must be bounded",
+        )
+
+
+# ---------------------------------------------------------------------------
+# DML013 / DML014 — lock discipline on the gang control plane (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+# Per-class lock-ownership map for the shared control-plane state: which
+# attributes are guarded, and which context-manager names count as
+# holding their lock when they appear in a `with`.  `_locked` is
+# InProcTransport's lock+epoch-fence contextmanager; methods whose NAME
+# ends in `_locked` are the documented caller-holds-the-lock convention
+# and are exempt (their callers are checked instead).  GangCoordinator
+# is deliberately absent: its counters are single-writer with
+# GIL-atomic cross-thread reads, not lock-owned shared state.
+_LOCK_OWNERSHIP = {
+    "InProcHub": {
+        "attrs": {"beats", "abort", "joins", "restore", "health",
+                  "faults", "consumed", "box", "epoch", "_version"},
+        "locks": {"lock", "_locked"},
+    },
+    "InProcTransport": {
+        "attrs": {"beats", "abort", "joins", "restore", "health",
+                  "faults", "consumed", "box", "epoch", "_version"},
+        "locks": {"lock", "_locked"},
+    },
+    "TcpGangServer": {
+        "attrs": {"_seen"},
+        "locks": {"_seen_lock", "lock", "_locked"},
+    },
+}
+
+_MUTATOR_METHODS = {"append", "pop", "clear", "setdefault", "popitem",
+                    "update", "extend", "add", "remove", "insert",
+                    "discard"}
+
+
+def _guarded_attr_of(node: ast.AST, attrs: set[str]) -> str | None:
+    """The guarded attribute a MUTATION node touches, else None.
+    Covers: `x.attr = v` / `x.attr += v`, `x.attr[k] = v`,
+    `del x.attr[k]`, and `x.attr.append(...)`-style mutator calls
+    (including through a `.setdefault(...)` chain)."""
+    def attr_of(value: ast.AST) -> str | None:
+        if isinstance(value, ast.Attribute) and value.attr in attrs:
+            return value.attr
+        return None
+
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            hit = attr_of(t)
+            if hit:
+                return hit
+            if isinstance(t, ast.Subscript):
+                hit = attr_of(t.value)
+                if hit:
+                    return hit
+    if isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                hit = attr_of(t.value)
+                if hit:
+                    return hit
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATOR_METHODS):
+        base = node.func.value
+        hit = attr_of(base)
+        if hit:
+            return hit
+        # hub.consumed.setdefault(r, []).append(...) — the mutator
+        # hangs off another call whose receiver is the guarded attr.
+        if isinstance(base, ast.Call) and isinstance(
+                base.func, ast.Attribute):
+            return attr_of(base.func.value)
+    return None
+
+
+def _tested_attr_of(ctx: FileContext, node: ast.AST,
+                    attrs: set[str]) -> str | None:
+    """The guarded attribute a CHECK node reads for a decision, else
+    None: `k in x.attr` / `not in`, `x.attr.get(...)`, and
+    `x.attr is (not) None`."""
+    def attr_of(value: ast.AST) -> str | None:
+        if isinstance(value, ast.Attribute) and value.attr in attrs:
+            return value.attr
+        return None
+
+    if isinstance(node, ast.Compare) and len(node.ops) == 1:
+        if isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            return attr_of(node.comparators[0])
+        if (isinstance(node.ops[0], (ast.Is, ast.IsNot))
+                and isinstance(node.comparators[0], ast.Constant)
+                and node.comparators[0].value is None):
+            return attr_of(node.left)
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"):
+        return attr_of(node.func.value)
+    return None
+
+
+def _innermost_lock_with(ctx: FileContext, node: ast.AST,
+                         lock_tokens: set[str]):
+    """The nearest enclosing `with` whose context expression's trailing
+    name is one of ``lock_tokens`` (e.g. ``self.lock``, ``hub.lock``,
+    ``self._locked("…")``, ``self._seen_lock``) — None when the node
+    runs lockless.  Stops at the enclosing function boundary: a nested
+    function's body does not inherit its definer's lock."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                tail = _dotted(item.context_expr).split(".")[-1]
+                if tail in lock_tokens:
+                    return anc
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+    return None
+
+
+def _mapped_class_methods(ctx: FileContext):
+    """(class spec, method) pairs for classes in the ownership map,
+    minus the exempt methods (`__init__` builds state before any
+    other thread can hold a reference; `*_locked` methods document
+    caller-holds-the-lock)."""
+    for cls in ast.walk(ctx.tree):
+        if (not isinstance(cls, ast.ClassDef)
+                or cls.name not in _LOCK_OWNERSHIP):
+            continue
+        spec = _LOCK_OWNERSHIP[cls.name]
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if stmt.name == "__init__" or stmt.name.endswith("_locked"):
+                continue
+            yield cls.name, spec, stmt
+
+
+@_rule(
+    "DML013", "shared control-plane state written outside its lock",
+    "ISSUE 15: every correctness claim of the gang transport "
+    "(exactly-once appends, first-writer-wins abort, epoch fencing) is "
+    "a property of mutations happening under the owning lock — one "
+    "unlocked write is a data race the interleaving explorer can only "
+    "find after the fact.",
+    _runtime_scope,
+)
+def check_unlocked_shared_writes(ctx: FileContext) -> Iterator[Finding]:
+    """Writes to the lock-owned attributes of ``InProcHub`` /
+    ``InProcTransport`` / ``TcpGangServer`` (per the per-class
+    ownership map) that are not lexically inside a ``with`` holding the
+    owning lock.  Direct assignment, subscript stores, ``del``, and
+    mutating method calls (``append``/``pop``/``clear``/…) all count."""
+    for cls_name, spec, fn in _mapped_class_methods(ctx):
+        for node in ast.walk(fn):
+            attr = _guarded_attr_of(node, spec["attrs"])
+            if attr is None:
+                continue
+            if _innermost_lock_with(ctx, node, spec["locks"]) is None:
+                yield ctx.finding(
+                    "DML013", node,
+                    f"{cls_name}.{fn.name} mutates shared attribute "
+                    f"{attr!r} outside its owning lock "
+                    f"({'/'.join(sorted(spec['locks']))}) — a data "
+                    "race on the gang control plane; hold the lock or "
+                    "rename the method *_locked and take it in every "
+                    "caller",
+                )
+
+
+@_rule(
+    "DML014", "check-then-act on shared state across lock scopes",
+    "ISSUE 15: PR 12's dedup store relied on a membership check and "
+    "the reservation insert being ONE critical section — split across "
+    "lock scopes, a duplicate op passes the check before the original "
+    "inserts and the append double-fires (the exact bug the layer-3 "
+    "dedup_inflight scenario replays).",
+    _runtime_scope,
+)
+def check_check_then_act(ctx: FileContext) -> Iterator[Finding]:
+    """A decision read of a guarded attribute (membership test,
+    ``.get``, ``is None``) whose own lock scope contains NO mutation of
+    that attribute, while the same function mutates it in a DIFFERENT
+    lock scope (or the test runs lockless) — the check and the act can
+    interleave with another thread's act.  The sanctioned idiom —
+    test + reservation write in one ``with`` block — does not fire."""
+    for cls_name, spec, fn in _mapped_class_methods(ctx):
+        mutations = []
+        for node in ast.walk(fn):
+            attr = _guarded_attr_of(node, spec["attrs"])
+            if attr is not None:
+                mutations.append((attr, node))
+        if not mutations:
+            continue
+        for node in ast.walk(fn):
+            attr = _tested_attr_of(ctx, node, spec["attrs"])
+            if attr is None:
+                continue
+            if not any(a == attr for a, _ in mutations):
+                continue
+            w = _innermost_lock_with(ctx, node, spec["locks"])
+            if w is not None and any(
+                    a == attr and m in set(ast.walk(w))
+                    for a, m in mutations):
+                continue   # check and act share one critical section
+            yield ctx.finding(
+                "DML014", node,
+                f"{cls_name}.{fn.name} checks {attr!r} "
+                + ("outside any lock" if w is None
+                   else "in one lock scope")
+                + " but mutates it in another — check-then-act race; "
+                "fold the test and the mutation into one critical "
+                "section",
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -847,13 +1071,16 @@ def iter_source_files(root: str | os.PathLike) -> Iterator[str]:
 
 def run_source(src: str, virtual_path: str,
                rules: Iterable[str] | None = None,
-               honor_virtual_header: bool = True) -> list[Finding]:
+               honor_virtual_header: bool = True,
+               timings: dict | None = None) -> list[Finding]:
     """Run Layer 1 over one source string as if it lived at
     ``virtual_path`` — the fixture-snippet entry point.  A
     ``# dmlcheck-virtual-path:`` header in the source overrides the
     argument (fixtures use it to opt into scoped rules); repo scans
     pass ``honor_virtual_header=False`` so findings always carry the
-    REAL path the baseline matches on."""
+    REAL path the baseline matches on.  ``timings`` (rule id →
+    seconds) accrues per-rule wall time across calls — the budget
+    telemetry ``dmlcheck --json`` reports."""
     if honor_virtual_header:
         m = VIRTUAL_PATH_RE.search(src)
         if m:
@@ -864,13 +1091,18 @@ def run_source(src: str, virtual_path: str,
         if rules is not None and rule.id not in rules:
             continue
         if rule.applies(ctx.path):
+            t0 = time.perf_counter()
             out.extend(rule.check(ctx))
+            if timings is not None:
+                timings[rule.id] = (timings.get(rule.id, 0.0)
+                                    + time.perf_counter() - t0)
     return out
 
 
 def run_layer1(root: str | os.PathLike,
                rules: Iterable[str] | None = None,
-               files: Iterable[str] | None = None) -> list[Finding]:
+               files: Iterable[str] | None = None,
+               timings: dict | None = None) -> list[Finding]:
     """Run every (or the selected) Layer-1 rule over the repo at
     ``root``; returns findings sorted by (file, line, rule).  Files
     that fail to parse yield a DML000 finding instead of crashing the
@@ -885,7 +1117,8 @@ def run_layer1(root: str | os.PathLike,
             continue
         try:
             findings.extend(run_source(src, rel, rules=rules,
-                                       honor_virtual_header=False))
+                                       honor_virtual_header=False,
+                                       timings=timings))
         except SyntaxError as e:
             findings.append(Finding(
                 rule="DML000", file=rel, line=e.lineno or 0,

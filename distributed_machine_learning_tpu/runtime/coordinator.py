@@ -101,6 +101,55 @@ GANG_HEALTH_FILE = "gang_health.jsonl"
 JOIN_PREFIX = "join_rank"
 
 
+# ---------------------------------------------------------------------------
+# Deterministic-scheduler seam (dmlcheck layer 3)
+# ---------------------------------------------------------------------------
+# ``analysis/interleave.py`` installs a cooperative scheduler here to
+# explore thread interleavings of the gang control plane under its own
+# control.  The hooks live in THIS module because it is the bottom of
+# the runtime import chain (``runtime/transport.py`` already imports
+# it, so the transport aliases these rather than the reverse).  With no
+# scheduler installed — every production and ordinary-test run — a
+# schedule point is one global read and a None test.
+
+_SCHED = None
+
+
+def install_scheduler(sched) -> None:
+    """Route every schedule point to ``sched`` (layer-3 exploration
+    only; one scheduler per process at a time)."""
+    global _SCHED
+    _SCHED = sched
+
+
+def uninstall_scheduler() -> None:
+    global _SCHED
+    _SCHED = None
+
+
+def _sched_point(label: str) -> None:
+    """A schedule point: under an installed scheduler the calling
+    thread (if registered with it) yields control here and resumes only
+    when scheduled.  ``label`` is structured ``channel:...[:r|:w]`` so
+    the explorer can judge independence of adjacent steps."""
+    sched = _SCHED
+    if sched is not None:
+        sched.point(label)
+
+
+def _sched_block(label: str, predicate) -> bool:
+    """A blocking schedule point: the thread is descheduled until
+    ``predicate()`` turns true (the seam for real waits like
+    ``_InFlight.wait`` — a cooperatively-scheduled thread must never
+    sit in a native wait the scheduler cannot see).  Returns True when
+    a scheduler handled the wait (the predicate now holds), False when
+    the caller must fall back to its real blocking wait."""
+    sched = _SCHED
+    if sched is not None:
+        return sched.block(label, predicate)
+    return False
+
+
 def _beat_path(gang_dir: str, rank: int) -> str:
     return os.path.join(gang_dir, f"{_BEAT_PREFIX}{rank}.json")
 
@@ -610,6 +659,7 @@ class GangCoordinator:
         election would then lose its only common point the moment any
         rank saved once after a restart."""
         self._valid_steps.add(int(step))
+        _sched_point("coord:restore:rmw")
         prior = self.transport.read_restore_record(self.rank)
         if prior:
             self._valid_steps |= prior
@@ -646,6 +696,7 @@ class GangCoordinator:
 
     # -- internals -------------------------------------------------------
     def _write_beat(self) -> None:
+        _sched_point("coord:beat:w")
         with self._write_lock:
             self._write_beat_locked()
 

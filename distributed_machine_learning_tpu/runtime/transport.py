@@ -90,6 +90,17 @@ _MUTATING_OPS = frozenset({
 })
 
 
+# Deterministic-scheduler seam (dmlcheck layer 3): the hooks live in
+# runtime/coordinator.py — the bottom of the runtime import chain,
+# which this module already imports — and are aliased here so every
+# schedule point on the transport hot paths is one plain call.  With
+# no scheduler installed (every production run) a point is a global
+# read + None test; ``_sched_block`` returns False and the caller
+# falls back to its real blocking wait.
+_sched_point = _coord._sched_point
+_sched_block = _coord._sched_block
+
+
 class TransportError(RuntimeError):
     """A gang-transport operation failed for good (retries exhausted,
     or the channel is severed).  The coordinator treats a persistent
@@ -539,14 +550,17 @@ class InProcHub:
     # share the restored state and save commits (on a real pod this is a
     # host-side broadcast collective; in-proc it is a dict).
     def box_put(self, key, value) -> None:
+        _sched_point("hub:box:w")
         with self.lock:
             self.box[key] = value
 
     def box_get(self, key, default=None):
+        _sched_point("hub:box:r")
         with self.lock:
             return self.box.get(key, default)
 
     def clear(self, restore_records: bool, fault_ledger: bool) -> None:
+        _sched_point("hub:clear:w")
         with self.lock:
             self.epoch += 1
             self.beats.clear()
@@ -584,25 +598,36 @@ class InProcTransport(GangTransport):
         self.hub = hub
         self._epoch = hub.epoch if bind_epoch else None
 
-    def _check_epoch(self) -> None:
-        if self._epoch is not None and self._epoch != self.hub.epoch:
-            raise TransportError(
-                f"stale transport handle (epoch {self._epoch}, hub at "
-                f"{self.hub.epoch}): this member was drained and the "
-                "gang state cleared"
-            )
-
-    def _do_publish_beat(self, rank: int, payload: dict) -> None:
-        self._check_epoch()
+    @contextlib.contextmanager
+    def _locked(self, label: str):
+        """Enter the hub's critical section for one operation: schedule
+        point (layer-3 seam), lock, THEN the epoch fence — checked
+        INSIDE the lock, atomic with the caller's read/mutate.  The
+        fence used to run before the acquire: a drained zombie thread
+        could pass the check, lose the CPU to the supervisor's
+        ``clear`` (which advances the epoch), and then write into the
+        NEXT attempt's state — the check-then-act race layer 3's
+        epoch-fence scenario explores (and whose broken form survives
+        as ``analysis/interleave.py``'s ``epoch-unlocked`` mutation)."""
+        _sched_point(label)
         hub = self.hub
         with hub.lock:
+            if self._epoch is not None and self._epoch != hub.epoch:
+                raise TransportError(
+                    f"stale transport handle (epoch {self._epoch}, hub "
+                    f"at {hub.epoch}): this member was drained and the "
+                    "gang state cleared"
+                )
+            yield hub
+
+    def _do_publish_beat(self, rank: int, payload: dict) -> None:
+        with self._locked("hub:beats:w") as hub:
             hub._version += 1
             hub.beats[rank] = (hub._version, dict(payload))
 
     def _do_read_beat(self, rank: int):
-        self._check_epoch()
-        with self.hub.lock:
-            entry = self.hub.beats.get(rank)
+        with self._locked("hub:beats:r") as hub:
+            entry = hub.beats.get(rank)
             # Payloads are replaced wholesale on publish and treated
             # read-only by every consumer, so reads hand out the stored
             # reference: N ranks re-reading N beats every barrier poll
@@ -611,106 +636,92 @@ class InProcTransport(GangTransport):
             return (entry[0], entry[1]) if entry else None
 
     def _do_read_beats(self) -> dict[int, tuple]:
-        self._check_epoch()
-        with self.hub.lock:
-            return dict(self.hub.beats)
+        with self._locked("hub:beats:r") as hub:
+            return dict(hub.beats)
 
     def _do_declare_abort(self, reason, by_rank, peer) -> bool:
-        self._check_epoch()
         payload = {"reason": reason, "by_rank": by_rank,
                    "time": time.time()}
         if peer is not None:
             payload["peer"] = peer
-        with self.hub.lock:
-            if self.hub.abort is not None:
+        with self._locked("hub:abort:w") as hub:
+            if hub.abort is not None:
                 return False
-            self.hub.abort = payload
+            hub.abort = payload
             return True
 
     def _do_read_abort(self):
-        self._check_epoch()
-        with self.hub.lock:
-            return dict(self.hub.abort) if self.hub.abort else None
+        with self._locked("hub:abort:r") as hub:
+            return dict(hub.abort) if hub.abort else None
 
     def _do_announce_join(self, rank: int, payload: dict) -> None:
-        self._check_epoch()
-        with self.hub.lock:
-            self.hub.joins[rank] = dict(payload)
+        with self._locked("hub:joins:w") as hub:
+            hub.joins[rank] = dict(payload)
 
     def _do_read_joins(self):
-        self._check_epoch()
-        with self.hub.lock:
-            return {r: dict(p) for r, p in self.hub.joins.items()}
+        with self._locked("hub:joins:r") as hub:
+            return {r: dict(p) for r, p in hub.joins.items()}
 
     def _do_consume_join(self, rank: int) -> None:
-        self._check_epoch()
-        with self.hub.lock:
-            self.hub.joins.pop(rank, None)
+        with self._locked("hub:joins:w") as hub:
+            hub.joins.pop(rank, None)
 
     def _do_write_restore(self, rank: int, steps: list[int]) -> None:
-        self._check_epoch()
-        with self.hub.lock:
-            self.hub.restore[rank] = list(steps)
+        with self._locked("hub:restore:w") as hub:
+            hub.restore[rank] = list(steps)
 
     def _do_read_restore(self, rank: int):
-        self._check_epoch()
-        with self.hub.lock:
-            steps = self.hub.restore.get(rank)
+        with self._locked("hub:restore:r") as hub:
+            steps = hub.restore.get(rank)
             return set(steps) if steps is not None else None
 
     def _do_append_health(self, payload: dict) -> None:
-        self._check_epoch()
         # Mirror writes happen INSIDE the hub lock: the on-disk ledger
         # order must match the authoritative in-memory order (the
         # fault ledger's loss/recovery masking is explicitly
         # order-aware), and hub.lock is an RLock so the ledger paths
         # stay one critical section.
-        with self.hub.lock:
-            self.hub.health.append(dict(payload))
-            if self.hub.mirror_dir is not None:
+        with self._locked("hub:health:w") as hub:
+            hub.health.append(dict(payload))
+            if hub.mirror_dir is not None:
                 append_jsonl_fsync(
-                    os.path.join(self.hub.mirror_dir,
+                    os.path.join(hub.mirror_dir,
                                  _coord.GANG_HEALTH_FILE), payload)
 
     def _do_read_health(self) -> list[dict]:
-        self._check_epoch()
-        with self.hub.lock:
-            return [dict(e) for e in self.hub.health]
+        with self._locked("hub:health:r") as hub:
+            return [dict(e) for e in hub.health]
 
     def _do_append_fault(self, entry: dict) -> None:
-        self._check_epoch()
-        with self.hub.lock:
-            self.hub.faults.append(dict(entry))
-            if self.hub.mirror_dir is not None:
+        with self._locked("hub:faults:w") as hub:
+            hub.faults.append(dict(entry))
+            if hub.mirror_dir is not None:
                 append_jsonl_fsync(
-                    os.path.join(self.hub.mirror_dir,
+                    os.path.join(hub.mirror_dir,
                                  "faults_fired.jsonl"), entry)
 
     def _do_read_faults(self) -> list[dict]:
-        self._check_epoch()
-        with self.hub.lock:
-            return [dict(e) for e in self.hub.faults]
+        with self._locked("hub:faults:r") as hub:
+            return [dict(e) for e in hub.faults]
 
     def _do_append_consumed(self, orig_rank: int, payload: dict) -> None:
-        self._check_epoch()
-        with self.hub.lock:
-            self.hub.consumed.setdefault(orig_rank, []).append(
+        with self._locked("hub:consumed:w") as hub:
+            hub.consumed.setdefault(orig_rank, []).append(
                 dict(payload))
-            if self.hub.mirror_dir is not None:
+            if hub.mirror_dir is not None:
                 append_jsonl_fsync(
                     os.path.join(
-                        self.hub.mirror_dir,
+                        hub.mirror_dir,
                         f"{_coord.CONSUMED_PREFIX}{orig_rank}.jsonl"),
                     payload)
 
     def _do_read_consumed(self, orig_rank: int | None) -> list[dict]:
-        self._check_epoch()
-        with self.hub.lock:
+        with self._locked("hub:consumed:r") as hub:
             if orig_rank is not None:
                 return [dict(e)
-                        for e in self.hub.consumed.get(orig_rank, ())]
-            return [dict(e) for r in sorted(self.hub.consumed)
-                    for e in self.hub.consumed[r]]
+                        for e in hub.consumed.get(orig_rank, ())]
+            return [dict(e) for r in sorted(hub.consumed)
+                    for e in hub.consumed[r]]
 
     def _do_clear(self, restore_records: bool, fault_ledger: bool) -> None:
         self.hub.clear(restore_records, fault_ledger)
@@ -818,22 +829,35 @@ class TcpGangServer:
     _DEDUP_CAP = 65536
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 mirror_dir=None, io_timeout_s: float = 10.0):
+                 mirror_dir=None, io_timeout_s: float = 10.0,
+                 listen: bool = True):
         self.hub = InProcHub(mirror_dir=mirror_dir)
         self._state = InProcTransport(self.hub)
         self._seen: OrderedDict[str, object] = OrderedDict()
         self._seen_lock = threading.Lock()
-        self._server = _TcpServerCore((host, port), _TcpHandler)
-        self._server.dispatch = self.dispatch
-        self._server.io_timeout_s = io_timeout_s
+        self.io_timeout_s = float(io_timeout_s)
+        # ``listen=False`` builds the dispatch/dedup state machine with
+        # no socket at all — the layer-3 explorer drives ``dispatch()``
+        # directly, so exploring a schedule never binds a port.
+        self._server = None
+        if listen:
+            self._server = _TcpServerCore((host, port), _TcpHandler)
+            self._server.dispatch = self.dispatch
+            self._server.io_timeout_s = self.io_timeout_s
         self._thread: threading.Thread | None = None
 
     @property
     def address(self) -> str:
+        if self._server is None:
+            raise RuntimeError("server built with listen=False has no "
+                               "address")
         host, port = self._server.server_address[:2]
         return f"{host}:{port}"
 
     def start(self) -> "TcpGangServer":
+        if self._server is None:
+            raise RuntimeError("server built with listen=False cannot "
+                               "serve")
         if self._thread is not None:
             raise RuntimeError("server already started")
         self._thread = threading.Thread(
@@ -845,6 +869,8 @@ class TcpGangServer:
         return self
 
     def stop(self) -> None:
+        if self._server is None:
+            return
         if self._thread is not None:
             self._server.shutdown()
             self._thread.join(timeout=5)
@@ -873,6 +899,7 @@ class TcpGangServer:
         # shorter than a slow mirror fsync) must wait for the original's
         # result, never re-apply — check-then-apply outside the lock
         # would double-append and break exactly-once.
+        _sched_point("tcp:seen:w:reserve")
         with self._seen_lock:
             if op_id in self._seen:  # membership: a result may be None
                 entry = self._seen[op_id]
@@ -881,6 +908,7 @@ class TcpGangServer:
                 self._seen[op_id] = entry
         if isinstance(entry, _InFlight):
             if entry.claim():  # this thread owns the apply
+                _sched_point("tcp:apply")
                 try:
                     result = self._apply(op, req)
                 except BaseException as exc:
@@ -891,13 +919,40 @@ class TcpGangServer:
                     entry.fail(exc)
                     raise
                 entry.finish(result)
+                _sched_point("tcp:seen:w:store")
                 with self._seen_lock:
                     self._seen[op_id] = result
-                    while len(self._seen) > self._DEDUP_CAP:
-                        self._seen.popitem(last=False)
+                    self._evict_seen_locked()
                 return result
-            return entry.wait(self._server.io_timeout_s)
+            if _sched_block("tcp:inflight:wait", entry._done.is_set):
+                # The scheduler descheduled this thread until the
+                # original settled; the zero-timeout wait just fetches
+                # the result (or re-raises the original's failure).
+                return entry.wait(0)
+            return entry.wait(self.io_timeout_s)
         return entry  # already-completed result, cached
+
+    def _evict_seen_locked(self) -> None:
+        """Trim the dedup store to ``_DEDUP_CAP``, oldest first — but
+        NEVER a still-``_InFlight`` reservation (caller holds
+        ``_seen_lock``).  Evicting one would forget that its op is
+        being applied right now, so the op's retry would miss the
+        dedup store, re-apply, and break exactly-once; in-flight
+        entries rotate to the young end instead (the store runs over
+        cap until they settle).  The pre-fix form — a plain
+        ``popitem(last=False)`` loop — survives as the layer-3
+        mutation-test seed (``analysis/interleave.py``,
+        ``MUTATIONS['dedup-evict']``): the explorer must rediscover
+        this bug whenever it is re-introduced."""
+        excess = len(self._seen) - self._DEDUP_CAP
+        for _ in range(len(self._seen)):
+            if excess <= 0:
+                break
+            op_id, entry = self._seen.popitem(last=False)
+            if isinstance(entry, _InFlight):
+                self._seen[op_id] = entry
+            else:
+                excess -= 1
 
     def _apply(self, op: str, req: dict):
         s = self._state

@@ -1,0 +1,32 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/transport.py
+"""DML013 clean cases: every mutation of guarded state under the
+owning lock, plus the two sanctioned exemptions — ``__init__`` (no
+other thread holds a reference yet) and ``*_locked`` methods (the
+caller-holds-the-lock convention)."""
+import threading
+
+
+class InProcHub:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.beats = {}
+        self.abort = None
+        self.health = []
+
+    def publish(self, rank, payload):
+        with self.lock:
+            self.beats[rank] = (1, dict(payload))
+
+    def latch(self, payload):
+        with self.lock:
+            if self.abort is None:
+                self.abort = dict(payload)
+
+    def record(self, payload):
+        with self.lock:
+            self.health.append(dict(payload))
+            self._trim_locked()
+
+    def _trim_locked(self):
+        # Caller holds self.lock (the *_locked naming convention).
+        del self.health[:-4096]

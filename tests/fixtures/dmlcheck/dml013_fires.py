@@ -1,0 +1,26 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/transport.py
+"""DML013 firing cases: lock-owned shared state of the gang control
+plane mutated without holding the owning lock — the data race every
+transport correctness claim (exactly-once, first-writer-wins abort)
+sits on."""
+import threading
+
+
+class InProcHub:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.beats = {}
+        self.abort = None
+        self.health = []
+
+    def publish(self, rank, payload):
+        self.beats[rank] = (1, dict(payload))   # unlocked store
+
+    def latch(self, payload):
+        self.abort = dict(payload)              # unlocked assign
+
+    def record(self, payload):
+        self.health.append(dict(payload))       # unlocked mutator call
+
+    def wipe(self):
+        self.beats.clear()                      # unlocked clear

@@ -1,0 +1,23 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/transport.py
+"""DML014 clean case: the sanctioned reservation idiom — membership
+check and insert in ONE critical section, so a duplicate either sees
+the reservation or loses the race to make it; reads with no mutation
+anywhere in the function are also fine."""
+import threading
+
+
+class TcpGangServer:
+    def __init__(self):
+        self._seen = {}
+        self._seen_lock = threading.Lock()
+
+    def dispatch(self, op_id, result):
+        with self._seen_lock:
+            if op_id in self._seen:
+                return self._seen[op_id]
+            self._seen[op_id] = result
+        return result
+
+    def peek(self, op_id):
+        with self._seen_lock:
+            return self._seen.get(op_id)

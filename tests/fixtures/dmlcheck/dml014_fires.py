@@ -1,0 +1,21 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/transport.py
+"""DML014 firing case: the dedup-store membership check and the
+reservation insert split across two lock scopes — a duplicate op can
+pass the check before the original inserts, and the append
+double-fires (the PR-12 bug the layer-3 dedup_inflight scenario
+replays)."""
+import threading
+
+
+class TcpGangServer:
+    def __init__(self):
+        self._seen = {}
+        self._seen_lock = threading.Lock()
+
+    def dispatch(self, op_id, result):
+        with self._seen_lock:
+            known = op_id in self._seen
+        if not known:
+            with self._seen_lock:
+                self._seen[op_id] = result
+        return known

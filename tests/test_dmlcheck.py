@@ -92,6 +92,18 @@ def test_package_is_clean():
     assert suppressed, "baseline matched nothing — matching is broken"
 
 
+def test_lock_discipline_rules_find_nothing_in_runtime():
+    """ISSUE 15: DML013/DML014 (lock-ownership + check-then-act over
+    the gang control plane) must find ZERO issues in the real
+    ``runtime/transport.py`` / ``runtime/coordinator.py`` — genuine
+    findings get fixed in-PR (the epoch fence moved inside the lock),
+    never baselined."""
+    findings = run_layer1(REPO, rules={"DML013", "DML014"})
+    assert findings == [], [
+        f"{f.rule} {f.location()}: {f.snippet or f.message}"
+        for f in findings]
+
+
 def test_scan_covers_the_tree_but_not_fixtures():
     files = list(iter_source_files(REPO))
     assert any(f.startswith("distributed_machine_learning_tpu/runtime/")
@@ -188,6 +200,14 @@ def test_tool_clean_run_and_json():
     assert len(verdict["suppressed"]) >= 3
     assert verdict["baseline_unused"] == []
     assert "DML001" in verdict["rules_run"]
+    # Per-layer / per-rule timing (ISSUE 15): budget regressions must
+    # be visible in CI output.  Layers 2/3 did not run here → 0.
+    timing = verdict["timing"]
+    assert {"layer1_s", "layer2_s", "layer3_s", "rules"} <= set(timing)
+    assert 0 < timing["layer1_s"] < 10.0
+    assert timing["layer2_s"] == 0 and timing["layer3_s"] == 0
+    for rule_id in ("DML001", "DML012", "DML013", "DML014"):
+        assert rule_id in timing["rules"]
     res = _run_tool("--list-rules")
     assert res.returncode == 0
     for rule_id in RULES:

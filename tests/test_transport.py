@@ -558,3 +558,88 @@ def test_file_backend_layout_is_byte_compatible(tmp_path):
     assert read_health_events(gang)[0]["kind"] == "restart"
     with open(gang / "consumed_rank2.jsonl") as f:
         assert json.loads(f.readline())["ids"] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# Concurrent writers (ISSUE 15): the real-threads smoke complement to
+# the layer-3 interleaving explorer
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", BACKENDS)
+def test_concurrent_writers_exactly_once_and_mirror_order(name, tmp_path):
+    """N real threads appending to ONE health ledger through each
+    backend — tcp with every writer's first append dropped so the
+    retry path is exercised — asserting every append applied exactly
+    once, per-writer order preserved, and the durable mirror
+    order-consistent with the authoritative ledger.  Layer 3 explores
+    these interleavings deterministically; this is the uncontrolled
+    real-scheduler smoke over the same invariants."""
+    import json as _json
+
+    from distributed_machine_learning_tpu.runtime.coordinator import (
+        GANG_HEALTH_FILE,
+    )
+
+    n_writers, n_appends = 4, 5
+    server = None
+    if name == "file":
+        gang = tmp_path / "gang"
+        ledger = gang / GANG_HEALTH_FILE
+
+        def make():
+            return FileTransport(gang)
+    elif name == "inproc":
+        hub = InProcHub(mirror_dir=tmp_path / "mirror")
+        ledger = tmp_path / "mirror" / GANG_HEALTH_FILE
+
+        def make():
+            return InProcTransport(hub)
+    else:
+        server = TcpGangServer(mirror_dir=tmp_path / "mirror").start()
+        ledger = tmp_path / "mirror" / GANG_HEALTH_FILE
+
+        def make():
+            # Every writer's first append_health response is dropped:
+            # the client retries with the SAME op_id and the server's
+            # dedup store must absorb it.
+            chaos = TransportChaos(drop=[("append_health", 1)])
+            return TcpTransport(server.address, chaos=chaos,
+                                backoff_s=0.01)
+    try:
+        errors: list[BaseException] = []
+
+        def writer(i):
+            try:
+                tx = make()
+                for j in range(n_appends):
+                    tx.append_health_event("mark", w=i, n=j)
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(n_writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert not errors, errors
+
+        rows = make().read_health_events()
+        keys = [(e["w"], e["n"]) for e in rows]
+        want = [(i, j) for i in range(n_writers)
+                for j in range(n_appends)]
+        assert sorted(keys) == want, (
+            "exactly-once broken: " + repr(sorted(keys)))
+        for i in range(n_writers):
+            mine = [n for (w, n) in keys if w == i]
+            assert mine == sorted(mine), (
+                f"writer {i}'s appends reordered: {mine}")
+        with open(ledger) as f:
+            mirror = [( _json.loads(line)["w"], _json.loads(line)["n"])
+                      for line in f if line.strip()]
+        assert mirror == keys, (
+            "on-disk mirror order diverged from the ledger")
+    finally:
+        if server is not None:
+            server.stop()

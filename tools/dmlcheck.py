@@ -6,7 +6,9 @@ Usage::
 
     python tools/dmlcheck.py [ROOT] [--json] [--rules DML001,DML004]
                              [--baseline FILE | --no-baseline]
-                             [--layer2] [--list-rules]
+                             [--layer2] [--layer3 [--quick]]
+                             [--mutate NAME,NAME] [--repro-dir DIR]
+                             [--replay FILE] [--list-rules]
                              [--write-baseline]
 
 Layer 1 (default, stdlib-only, no jax import, <10 s): the AST rules in
@@ -14,7 +16,13 @@ Layer 1 (default, stdlib-only, no jax import, <10 s): the AST rules in
 package + tools + tests sources.  ``--layer2`` additionally compiles
 the ring and zero1 train steps on an 8-virtual-device CPU mesh and runs
 the jaxpr/HLO audit passes (donation taken, no critical-path
-all-gather, wire-byte accounting) — slower, imports jax.
+all-gather, wire-byte accounting) — slower, imports jax.  ``--layer3``
+runs the deterministic interleaving explorer over the gang-transport
+scenarios (``analysis/interleave.py``): ``--quick`` keeps it to the
+exhaustive small configs (CI-sized, <30 s); a violated invariant
+(DML301, DML302 for deadlocks) carries a minimized schedule trace and
+a reproducer file ``--replay`` re-runs bit-for-bit.  ``--mutate``
+re-introduces a known-bug seed (the mutation-test gate).
 
 Exit codes: 0 clean (every finding baselined, no stale baseline
 entries), 1 non-baselined ERROR findings or stale entries, 2 usage /
@@ -38,6 +46,8 @@ import argparse
 import json
 import os
 import sys
+import tempfile
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -76,6 +86,37 @@ def _run_layer2():
     return run_layer2()
 
 
+def _run_replay(path: str, as_json: bool) -> int:
+    """Re-run the exact interleaving a layer-3 reproducer recorded.
+    Exit 1 when the failure reproduces (the deterministic-CI-failure
+    contract: two replays of one file fail identically), 0 when the
+    schedule now passes (the bug is fixed — delete the file), 2 on a
+    malformed/unknown reproducer."""
+    from distributed_machine_learning_tpu.analysis.interleave import (
+        format_trace,
+        replay_file,
+    )
+
+    try:
+        verdict = replay_file(path)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"dmlcheck: bad reproducer {path}: {e}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(verdict, indent=1))
+    else:
+        print(f"replay {verdict['scenario']} ({verdict['size']}"
+              + (f", mutate={verdict['mutate']}" if verdict["mutate"]
+                 else "") + "):")
+        print(format_trace(verdict["trace"]))
+        for v in verdict["violations"]:
+            print(f"  VIOLATION: {v}")
+        if not verdict["reproduced"]:
+            print("  schedule passes now — fixed; delete the "
+                  "reproducer")
+    return 1 if verdict["reproduced"] else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__,
@@ -96,6 +137,26 @@ def main(argv=None) -> int:
     parser.add_argument("--layer2", action="store_true",
                         help="also compile train steps and run the "
                              "jaxpr/HLO audit passes (imports jax)")
+    parser.add_argument("--layer3", action="store_true",
+                        help="also run the deterministic interleaving "
+                             "explorer over the gang-transport "
+                             "scenarios (DML301/DML302)")
+    parser.add_argument("--quick", action="store_true",
+                        help="layer 3: exhaustive small configs only "
+                             "(CI-sized, <30s)")
+    parser.add_argument("--mutate", default=None,
+                        help="layer 3: comma-separated known-bug "
+                             "seeds to re-introduce (mutation-test "
+                             "gate); see analysis/interleave.py "
+                             "MUTATIONS")
+    parser.add_argument("--repro-dir", default=None,
+                        help="layer 3: directory for reproducer files "
+                             "(default: <tmp>/dmlcheck-repros)")
+    parser.add_argument("--replay", default=None, metavar="FILE",
+                        help="re-run the exact interleaving a "
+                             "reproducer recorded, print the "
+                             "annotated trace, exit 1 if it still "
+                             "fails (deterministic)")
     parser.add_argument("--list-rules", action="store_true")
     parser.add_argument("--write-baseline", action="store_true",
                         help="print a baseline skeleton for the "
@@ -108,11 +169,15 @@ def main(argv=None) -> int:
             print(f"        incident: {r.incident}")
         return 0
 
+    if args.replay:
+        return _run_replay(args.replay, as_json=args.json)
+
     LAYER2_RULES = {"DML101", "DML102", "DML103", "DML104"}
+    LAYER3_RULES = {"DML301", "DML302"}
     rules = None
     if args.rules:
         rules = {r.strip() for r in args.rules.split(",") if r.strip()}
-        unknown = rules - set(RULES) - LAYER2_RULES
+        unknown = rules - set(RULES) - LAYER2_RULES - LAYER3_RULES
         if unknown:
             print(f"dmlcheck: unknown rule id(s): {sorted(unknown)}",
                   file=sys.stderr)
@@ -124,16 +189,57 @@ def main(argv=None) -> int:
                   f"{sorted(rules & LAYER2_RULES)} are Layer-2 program "
                   "audits — add --layer2 to run them", file=sys.stderr)
             return 2
+        if rules & LAYER3_RULES and not args.layer3:
+            print("dmlcheck: rule(s) "
+                  f"{sorted(rules & LAYER3_RULES)} are Layer-3 "
+                  "interleaving checks — add --layer3 to run them",
+                  file=sys.stderr)
+            return 2
+    if args.mutate and not args.layer3:
+        print("dmlcheck: --mutate only applies to --layer3",
+              file=sys.stderr)
+        return 2
 
     root = os.path.abspath(args.root)
+    rule_timings: dict = {}
+    timing = {"layer1_s": 0.0, "layer2_s": 0.0, "layer3_s": 0.0,
+              "rules": rule_timings}
+    t0 = time.perf_counter()
     findings = run_layer1(
         root, rules=None if rules is None
-        else {r for r in rules if r in RULES})
+        else {r for r in rules if r in RULES},
+        timings=rule_timings)
+    timing["layer1_s"] = round(time.perf_counter() - t0, 3)
     if args.layer2:
+        t0 = time.perf_counter()
         l2 = _run_layer2()
+        timing["layer2_s"] = round(time.perf_counter() - t0, 3)
         if rules is not None:
             l2 = [f for f in l2 if f.rule in rules]
         findings += l2
+    layer3_stats = None
+    if args.layer3:
+        from distributed_machine_learning_tpu.analysis.interleave import (
+            run_layer3,
+        )
+
+        mutate = tuple(m.strip() for m in (args.mutate or "").split(",")
+                       if m.strip())
+        repro_dir = args.repro_dir or os.path.join(
+            tempfile.gettempdir(), "dmlcheck-repros")
+        t0 = time.perf_counter()
+        try:
+            l3, layer3_stats = run_layer3(
+                quick=args.quick, mutate=mutate, repro_dir=repro_dir)
+        except ValueError as e:
+            print(f"dmlcheck: {e}", file=sys.stderr)
+            return 2
+        timing["layer3_s"] = round(time.perf_counter() - t0, 3)
+        for name, entry in layer3_stats["scenarios"].items():
+            rule_timings[f"layer3:{name}"] = entry["seconds"]
+        if rules is not None:
+            l3 = [f for f in l3 if f.rule in rules]
+        findings += l3
 
     baseline = []
     if not args.no_baseline:
@@ -166,6 +272,11 @@ def main(argv=None) -> int:
         payload["errors"] = len(errors)
         payload["advisories"] = len(advisories)
         payload["clean"] = not errors and not unused
+        timing["rules"] = {k: round(v, 4)
+                           for k, v in sorted(rule_timings.items())}
+        payload["timing"] = timing
+        if layer3_stats is not None:
+            payload["layer3"] = layer3_stats
         print(json.dumps(payload, indent=1))
     else:
         for f in errors:
