@@ -845,12 +845,16 @@ def check_socket_timeouts(ctx: FileContext) -> Iterator[Finding]:
 _LOCK_OWNERSHIP = {
     "InProcHub": {
         "attrs": {"beats", "abort", "joins", "restore", "health",
-                  "faults", "consumed", "box", "epoch", "_version"},
+                  "faults", "consumed", "box", "epoch", "_version",
+                  "serving_requests", "serving_results",
+                  "serving_drain", "serving_epoch", "serving_role"},
         "locks": {"lock", "_locked"},
     },
     "InProcTransport": {
         "attrs": {"beats", "abort", "joins", "restore", "health",
-                  "faults", "consumed", "box", "epoch", "_version"},
+                  "faults", "consumed", "box", "epoch", "_version",
+                  "serving_requests", "serving_results",
+                  "serving_drain", "serving_epoch", "serving_role"},
         "locks": {"lock", "_locked"},
     },
     "TcpGangServer": {

@@ -16,13 +16,15 @@ module makes them testable deterministically:
   the quick configs (≤3 threads / ≤8 ops), with label-based
   partial-order pruning and a bounded-preemption filter for the larger
   ``full`` configs.
-- :data:`SCENARIOS` — six bounded gang protocols (abort race, join
+- :data:`SCENARIOS` — seven bounded gang protocols (abort race, join
   duplicate delivery, ledger append storm, dedup-cache hit racing a
   slow in-flight apply, beat publish vs batched reads, epoch fence vs
-  zombie thread), each with invariants checked after every terminal
-  schedule.
+  zombie thread, serving drain/promote handoff vs a retiring
+  replica's late result), each with invariants checked after every
+  terminal schedule.
 - :data:`MUTATIONS` — the known-bug seeds (the pre-fix dedup eviction,
-  the pre-fix epoch check outside the lock).  The mutation-test gate:
+  the pre-fix epoch check outside the lock, the pre-fix serving
+  result fence).  The mutation-test gate:
   with a seed applied, the explorer must rediscover the bug
   deterministically; on the fixed tree it must exit clean.
 - Reproducers — a failing schedule serializes to JSON
@@ -706,6 +708,74 @@ def _build_epoch_fence() -> _Scenario:
                       ("supervisor", supervisor)], check)
 
 
+def _build_drain_promote() -> _Scenario:
+    """The serving drain/promote handoff (ISSUE 16): replica 7 holds
+    request "x" in flight while the router retires it (the epoch-fence
+    bump) and promotes spare 9 in its place, re-dispatching "x" to the
+    survivor if 7's result never arrived.  Invariants: "x" is
+    delivered exactly once through the router's first-result-wins
+    collection, and a post from the RETIRED epoch never lands in the
+    results channel after the handoff — the atomic check-and-append
+    that ``MUTATIONS['result-unfenced']`` breaks open.
+    """
+    hub = InProcHub()
+    router_t = InProcTransport(hub)
+    zombie_t = InProcTransport(hub)
+    spare_t = InProcTransport(hub)
+    # Pre-schedule setup: 7 is live, "x" dispatched and taken (in
+    # flight on the soon-to-be-drained replica).
+    router_t.set_serving_role(7, "live")
+    e0 = router_t.read_serving(7)["epoch"]
+    router_t.push_request(7, {"rid": "x", "epoch": e0})
+    assert zombie_t.take_requests(7, 1), "setup: take must claim x"
+    delivered: list = []
+    seen_rids: set = set()
+    outcome: dict = {}
+
+    def collect():
+        for res in router_t.take_results(8):
+            if res.get("rid") in seen_rids:
+                outcome["duplicates"] = outcome.get("duplicates", 0) + 1
+                continue
+            seen_rids.add(res.get("rid"))
+            delivered.append(res)
+
+    def zombie():
+        # The draining replica's late post, racing its own demotion.
+        ok = zombie_t.post_result(7, e0, {"rid": "x", "who": "zombie"})
+        outcome["zombie"] = "delivered" if ok else "fenced"
+
+    def router():
+        collect()
+        router_t.retire_replica(7)     # the epoch-fenced handoff
+        router_t.set_serving_role(9, "live")
+        if not any(r.get("rid") == "x" for r in delivered):
+            # 7 never answered: re-dispatch to the promoted spare.
+            e9 = router_t.read_serving(9)["epoch"]
+            router_t.push_request(9, {"rid": "x", "epoch": e9})
+            for req in spare_t.take_requests(9, 1):
+                spare_t.post_result(9, e9, {"rid": req.get("rid"),
+                                            "who": "spare"})
+        collect()
+
+    def check():
+        v = []
+        xs = [r.get("who") for r in delivered if r.get("rid") == "x"]
+        if len(xs) != 1:
+            v.append(f"request x delivered {len(xs)} time(s) by {xs} "
+                     "(want exactly once)")
+        leftover = [{k: x for k, x in r.items() if k != "time"}
+                    for r in hub.serving_results
+                    if r.get("rid") == "x"]
+        if leftover:
+            v.append("retired replica's late result landed in the "
+                     "results channel AFTER the drain/promote handoff "
+                     f"(epoch fence broken): {leftover}")
+        return v
+
+    return _Scenario([("zombie", zombie), ("router", router)], check)
+
+
 # name -> {"quick": build, "full": build, "quick_max": int,
 #          "full_max": int, "invariant": str}
 SCENARIOS = {
@@ -751,6 +821,14 @@ SCENARIOS = {
         "invariant": "a drained epoch's thread never mutates hub "
                      "state past the clear",
     },
+    "drain_promote": {
+        "quick": _build_drain_promote,
+        "full": _build_drain_promote,
+        "quick_max": 3000, "full_max": 20000,
+        "invariant": "a retired replica's late result is fenced and "
+                     "every request delivers exactly once across the "
+                     "drain/promote handoff",
+    },
 }
 
 
@@ -781,12 +859,31 @@ def _locked_epoch_unlocked(self, label: str):
         yield hub
 
 
+def _post_result_unfenced(self, replica, epoch, payload):
+    # The pre-fix serving fence: the poster's epoch checked BEFORE
+    # the lock that appends the result, with an explicit schedule
+    # point in the TOCTOU window — a retiring replica can pass the
+    # stale check, park in the gap through retire_replica's epoch
+    # bump, and land its zombie result after the handoff.
+    _transport._sched_point("hub:sresults:w")
+    hub = self.hub
+    if int(epoch) != hub.serving_epoch.get(int(replica), 0):
+        return False
+    _transport._sched_point("hub:sepoch:gap")
+    with hub.lock:
+        hub.serving_results.append(
+            dict(payload, replica=int(replica), epoch=int(epoch)))
+    return True
+
+
 # name -> (class, attr, broken replacement)
 MUTATIONS = {
     "dedup-evict": (TcpGangServer, "_evict_seen_locked",
                     _evict_seen_naive),
     "epoch-unlocked": (InProcTransport, "_locked",
                        _locked_epoch_unlocked),
+    "result-unfenced": (InProcTransport, "_do_post_result",
+                        _post_result_unfenced),
 }
 
 

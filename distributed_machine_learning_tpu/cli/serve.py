@@ -1,0 +1,268 @@
+"""Launch an elastic serving fleet over the gang control plane
+(ISSUE 16).
+
+Fleet mode (the default) builds the router and a pool of replica
+workers over the chosen ``--gang-transport``, promotes ``--replicas``
+of them live (the rest stay warm spares), fires ``--requests``
+synthetic prompts at the admission queue, waits for the fleet to
+drain, and prints the latency quantiles, the exactly-once audit, and
+the resilience summary.  Exit status is the audit verdict: 0 only when
+every admitted request completed exactly once.
+
+    python -m distributed_machine_learning_tpu.cli.serve \
+        --replicas 4 --spares 2 --requests 200 \
+        --gang-transport inproc
+
+    # same fleet coordinating through a directory / a tcp gang server:
+    python -m distributed_machine_learning_tpu.cli.serve \
+        --replicas 2 --spares 1 --requests 50 \
+        --gang-transport file --gang-dir /tmp/serve
+    python -m distributed_machine_learning_tpu.cli.serve \
+        --replicas 4 --spares 2 --requests 200 --gang-transport tcp
+
+Worker mode joins an EXISTING tcp fleet from another process — the
+subprocess-replica shape the slow chaos campaign uses:
+
+    python -m distributed_machine_learning_tpu.cli.serve \
+        --role worker --rank 3 --address 127.0.0.1:4242 \
+        [--tx-chaos partition@40]
+
+``--drain-after N`` demos the graceful-drain protocol mid-load:
+after N completions, replica 0 is drained, finishes its in-flight
+requests, and demotes to spare with zero drops.
+
+The decode step is synthetic by default (echo + checksum token, with
+``--service-time`` of simulated work) so the fleet story is testable
+without a model; ``inference/generate.py::make_serving_step`` is the
+production step-callable this slot takes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+
+def synthetic_step(service_time_s: float = 0.0):
+    """A model-free decode step: echoes each prompt plus one checksum
+    token, sleeping ``service_time_s`` per micro-batch to simulate
+    decode work."""
+
+    def step(prompts):
+        if service_time_s > 0:
+            time.sleep(service_time_s)
+        return [list(p) + [(sum(p) + len(p)) % 97] for p in prompts]
+
+    return step
+
+
+def _parse_tx_chaos(spec: str):
+    from distributed_machine_learning_tpu.runtime.faults import (
+        TransportChaos,
+    )
+
+    kind, _, arg = spec.partition("@")
+    if kind == "partition" and arg.isdigit():
+        return TransportChaos(partition_after=int(arg))
+    raise ValueError(
+        f"bad --tx-chaos {spec!r} (expected partition@AFTER_OPS)")
+
+
+def _run_worker(args) -> int:
+    from distributed_machine_learning_tpu.runtime.serving_worker import (
+        ServingWorkerConfig,
+        run_serving_worker,
+    )
+    from distributed_machine_learning_tpu.runtime.transport import (
+        make_transport,
+    )
+
+    chaos = _parse_tx_chaos(args.tx_chaos) if args.tx_chaos else None
+    tx = make_transport("tcp", address=args.address, chaos=chaos)
+    stop = threading.Event()
+    summary = run_serving_worker(
+        tx, args.rank, synthetic_step(args.service_time), stop,
+        ServingWorkerConfig(micro_batch=args.micro_batch))
+    print(f"worker rank {args.rank}: {summary}")
+    return 0
+
+
+def _run_fleet(args) -> int:
+    from distributed_machine_learning_tpu.runtime.faults import FaultEvents
+    from distributed_machine_learning_tpu.runtime.serving import (
+        Overloaded,
+        ServingConfig,
+        ServingRouter,
+    )
+    from distributed_machine_learning_tpu.runtime.serving_worker import (
+        ServingWorkerConfig,
+        start_worker_thread,
+    )
+    from distributed_machine_learning_tpu.runtime.transport import (
+        FileTransport,
+        InProcHub,
+        InProcTransport,
+        TcpGangServer,
+        TcpTransport,
+    )
+    from distributed_machine_learning_tpu.utils.summary import (
+        resilience_summary,
+    )
+
+    world = args.replicas + args.spares
+    server = None
+    if args.gang_transport == "inproc":
+        hub = InProcHub(mirror_dir=args.gang_dir)
+        make_tx = lambda: InProcTransport(hub)  # noqa: E731
+    elif args.gang_transport == "file":
+        if not args.gang_dir:
+            print("--gang-transport file requires --gang-dir",
+                  file=sys.stderr)
+            return 2
+        make_tx = lambda: FileTransport(args.gang_dir)  # noqa: E731
+    else:  # tcp: host the gang server in-process, clients on the wire
+        server = TcpGangServer(mirror_dir=args.gang_dir).start()
+        address = server.address
+        make_tx = lambda: TcpTransport(address,  # noqa: E731
+                                       backoff_s=0.01)
+
+    events = FaultEvents()
+    router = ServingRouter(
+        make_tx(),
+        ServingConfig(replicas=args.replicas,
+                      max_queue=args.max_queue,
+                      micro_batch=args.micro_batch,
+                      replica_timeout_s=args.replica_timeout),
+        events=events)
+    stop = threading.Event()
+    wcfg = ServingWorkerConfig(micro_batch=args.micro_batch)
+    workers = [start_worker_thread(make_tx(), rank,
+                                   synthetic_step(args.service_time),
+                                   stop, wcfg)
+               for rank in range(world)]
+    router_thread = threading.Thread(target=router.run, args=(stop,),
+                                     name="serve-router", daemon=True)
+    router_thread.start()
+
+    rng_state = 12345
+    drained = args.drain_after <= 0
+    try:
+        for i in range(args.requests):
+            rng_state = (1103515245 * rng_state + 12345) % (1 << 31)
+            prompt = [1 + (rng_state >> s) % 13 for s in (3, 7, 11)][
+                :1 + rng_state % 3]
+            while True:
+                try:
+                    router.submit(prompt)
+                    break
+                except Overloaded:
+                    time.sleep(0.005)  # explicit back-pressure: retry
+            if not drained and router.completed >= args.drain_after:
+                drained = True
+                router.drain(0)
+        if not drained:
+            # Submission outpaced completion: wait for the threshold so
+            # the drain demo still happens mid-completion.
+            deadline = time.monotonic() + args.timeout
+            while (router.completed < args.drain_after
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            drained = True
+            router.drain(0)
+        ok = router.wait_idle(args.timeout)
+    finally:
+        verdict = router.close()
+        stop.set()
+        for t, _ in workers:
+            t.join(timeout=5)
+        router_thread.join(timeout=5)
+        if server is not None:
+            server.stop()
+
+    lat = verdict["latency"]
+    print(f"fleet: {args.replicas} replicas + {args.spares} spares "
+          f"over {args.gang_transport}")
+    print(f"requests: {verdict['completed']}/{verdict['admitted']} "
+          f"completed, {verdict['rejected']} rejected at admission, "
+          f"{verdict['duplicates_discarded']} duplicates discarded")
+    print(f"fleet events: {verdict['promotions']} promotions, "
+          f"{verdict['evictions']} evictions, "
+          f"{verdict['drains']} drains")
+    if lat.get("p50") is not None:
+        print(f"latency: p50 {lat['p50'] * 1e3:.1f} ms  "
+              f"p95 {lat['p95'] * 1e3:.1f} ms  "
+              f"p99 {lat['p99'] * 1e3:.1f} ms")
+    print(resilience_summary(events))
+    if not ok or not verdict["exactly_once"]:
+        print("FAILED: not every admitted request completed exactly "
+              "once", file=sys.stderr)
+        return 1
+    print("exactly-once audit: PASS")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--role", choices=("fleet", "worker"),
+                    default="fleet",
+                    help="fleet: router + worker pool in this process; "
+                         "worker: join an existing tcp fleet")
+    ap.add_argument("--replicas", type=int, default=4,
+                    help="target live replicas (fleet mode)")
+    ap.add_argument("--spares", type=int, default=1,
+                    help="warm spares kept ready for promotion")
+    ap.add_argument("--requests", type=int, default=100,
+                    help="synthetic requests to fire (fleet mode)")
+    ap.add_argument("--max-queue", dest="max_queue", type=int,
+                    default=64,
+                    help="admission bound: open requests past this "
+                         "raise Overloaded")
+    ap.add_argument("--micro-batch", dest="micro_batch", type=int,
+                    default=4, help="requests per dispatch")
+    ap.add_argument("--service-time", dest="service_time", type=float,
+                    default=0.0,
+                    help="simulated decode seconds per micro-batch")
+    ap.add_argument("--replica-timeout", dest="replica_timeout",
+                    type=float, default=2.0,
+                    help="beat staleness that evicts a replica")
+    ap.add_argument("--drain-after", dest="drain_after", type=int,
+                    default=0,
+                    help="gracefully drain replica 0 after this many "
+                         "completions (0: never)")
+    ap.add_argument("--timeout", type=float, default=60.0,
+                    help="fleet-idle deadline before declaring failure")
+    ap.add_argument("--gang-transport", dest="gang_transport",
+                    choices=("file", "inproc", "tcp"),
+                    default="inproc", help="control-plane backend")
+    ap.add_argument("--gang-dir", dest="gang_dir", default=None,
+                    help="file backend directory / inproc+tcp ledger "
+                         "mirror for post-mortem gang_status")
+    ap.add_argument("--address", default=None,
+                    help="worker mode: host:port of the fleet's gang "
+                         "server")
+    ap.add_argument("--rank", type=int, default=0,
+                    help="worker mode: this replica's rank")
+    ap.add_argument("--tx-chaos", dest="tx_chaos", default=None,
+                    help="worker mode: 'partition@AFTER_OPS' severs "
+                         "this worker's channel after that many "
+                         "transport ops")
+    args = ap.parse_args(argv)
+
+    if args.role == "worker":
+        if not args.address:
+            ap.error("--role worker requires --address")
+        return _run_worker(args)
+    if args.replicas < 1:
+        ap.error(f"--replicas must be >= 1, got {args.replicas}")
+    if args.spares < 0:
+        ap.error(f"--spares must be >= 0, got {args.spares}")
+    if args.tx_chaos:
+        ap.error("--tx-chaos is a worker-mode flag (the fleet's own "
+                 "channels must stay healthy)")
+    return _run_fleet(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -306,3 +306,56 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return fn(params, jnp.asarray(prompt, jnp.int32), rng)
+
+
+def make_serving_step(
+    model,
+    params,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    quantize: str | None = None,
+    top_p: float | None = None,
+    rng=None,
+):
+    """The step-callable seam for the serving fleet (ISSUE 16): wrap
+    the batch-static decode program as ``step(prompts) -> outputs``
+    over plain python token lists — the signature
+    ``runtime/serving_worker.py`` drives, so the worker serves requests
+    without forking this module.
+
+    Ragged micro-batches are grouped by prompt length and each group
+    runs as one batched call (the program stays batch-static; expect
+    one jit cache entry per distinct ``(batch, length)`` shape — a
+    router with a fixed ``micro_batch`` converges on a handful).  The
+    RNG threads through calls so repeated sampling steps never reuse a
+    key.
+    """
+    fn = make_generate_fn(model, max_new_tokens, temperature, top_k,
+                          quantize=quantize, top_p=top_p)
+    if quantize == "int8":
+        from distributed_machine_learning_tpu.ops.quant import (
+            quantize_lm_params,
+        )
+
+        params = quantize_lm_params(params)
+    state = {"rng": rng if rng is not None else jax.random.PRNGKey(0)}
+
+    def step(prompts):
+        if any(len(p) == 0 for p in prompts):
+            raise ValueError("serving step got an empty prompt")
+        outs: list = [None] * len(prompts)
+        by_len: dict[int, list[int]] = {}
+        for i, p in enumerate(prompts):
+            by_len.setdefault(len(p), []).append(i)
+        for length in sorted(by_len):
+            idxs = by_len[length]
+            batch = jnp.asarray([list(map(int, prompts[i]))
+                                 for i in idxs], jnp.int32)
+            state["rng"], call_rng = jax.random.split(state["rng"])
+            tokens = jax.device_get(fn(params, batch, call_rng))
+            for row, i in zip(tokens.tolist(), idxs):
+                outs[i] = [int(t) for t in row]
+        return outs
+
+    return step

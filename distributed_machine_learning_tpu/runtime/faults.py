@@ -181,6 +181,9 @@ class FaultEvents:
     ckpt_fallbacks: int = 0     # restore fell back past an invalid checkpoint
     transport_retries: int = 0  # gang-transport ops re-attempted (backoff)
     transport_timeouts: int = 0  # gang-transport ops that timed out/dropped
+    replica_evictions: int = 0  # serving replicas evicted (dead or slow)
+    drains: int = 0             # serving replicas drained gracefully
+    request_rejects: int = 0    # serving requests rejected at admission
 
     def __setattr__(self, name: str, value) -> None:
         # Mirror every increment into the telemetry registry AS IT

@@ -1,0 +1,455 @@
+"""Elastic serving fleet — the router side (ISSUE 16).
+
+Five PRs of gang machinery (heartbeats + health ledger, warm spares,
+straggler detection, the pluggable transport with op-id exactly-once,
+the layer-3 race detector) served *training only*.  This module
+re-aims that control plane at a replicated inference tier:
+
+- :class:`ServingRouter` owns a **bounded request queue with admission
+  control**: past ``max_queue`` open requests, :meth:`submit` raises
+  :class:`Overloaded` — an explicit, counted rejection, never a silent
+  drop.
+- Admitted requests are dispatched in **micro-batches** to N live
+  replica ranks (each a ``runtime/serving_worker.py`` loop driving the
+  batch-static ``inference/generate.py`` decode step through the
+  step-callable seam).
+- Replica lifecycle reuses the gang primitives: **liveness** from the
+  beat channel (change-signatures + the router's monotonic clock —
+  never cross-host wall time, DML001); **eviction of slow replicas**
+  via the PR 6 :class:`StragglerDetector` fed per-replica service
+  times, with ``--straggler-policy=replace`` semantics (demote, then
+  promote a warm spare in its place); **elastic grow** under sustained
+  queue pressure by promoting spares that announced on the join
+  channel with prefetched verified checkpoints (promotion is
+  O(restore), PR 10); **graceful drain** for redeploy — stop
+  dispatching, finish in-flight, then demote to spare.
+- The drain/demote handoff is **epoch-fenced** at the transport
+  (``retire_replica`` bumps the replica's serving epoch atomically
+  with reclaiming its queue; a late ``post_result`` from the old epoch
+  is discarded at the hub) — the protocol dmlcheck layer 3 explores as
+  ``drain_promote``.  On top of the fence the router keeps a request
+  ledger with **first-result-wins** per ``rid``: a request completed
+  by a dying replica *and* re-dispatched to a survivor delivers
+  exactly once, with the duplicate counted, never returned.
+
+Telemetry: per-request latency lands in a ``serving_request_latency_s``
+histogram built on the ISSUE 16 latency bucket preset
+(``default_latency_buckets`` — the train-step buckets flattened
+millisecond p99s into one bucket); fleet gauges ``serving_replicas`` /
+``serving_queue_depth`` and counters ``serving_evictions`` /
+``serving_rejects`` flow through the same registry, mirrored into
+``FaultEvents.replica_evictions`` / ``drains`` / ``request_rejects``
+for the ``resilience_summary`` rows.  Lifecycle edges append
+``serve_promote`` / ``serve_evict`` / ``serve_demote`` health-ledger
+events and :meth:`close` appends a final ``serving`` summary record —
+what ``tools/gang_status.py`` renders as the serving view.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+
+from distributed_machine_learning_tpu.runtime.faults import FaultEvents
+from distributed_machine_learning_tpu.telemetry import get_telemetry
+from distributed_machine_learning_tpu.telemetry.aggregator import (
+    StragglerDetector,
+)
+from distributed_machine_learning_tpu.telemetry.registry import (
+    Histogram,
+    default_latency_buckets,
+)
+
+
+class Overloaded(RuntimeError):
+    """Admission control rejected the request: the bounded queue is at
+    capacity.  Explicit back-pressure the caller can act on (shed,
+    retry with backoff) — the router never silently drops an admitted
+    request, so it must never silently absorb an unadmittable one."""
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Router policy knobs.  Defaults suit the in-proc chaos campaigns;
+    ``cli/serve.py`` maps its flags onto these."""
+
+    replicas: int = 2           # target live replicas (heal up to this)
+    max_replicas: int | None = None  # pressure-grow ceiling (None: +spares)
+    max_queue: int = 64         # admission bound on OPEN requests
+    micro_batch: int = 4        # requests per dispatch to one replica
+    max_outstanding: int = 8    # per-replica in-flight cap (backpressure)
+    poll_s: float = 0.005       # run() pump cadence
+    replica_timeout_s: float = 2.0   # beat-staleness eviction threshold
+    straggler_multiple: float = 4.0  # PR 6 detector: x median
+    straggler_consecutive: int = 3
+    grow_watermark: float = 0.75     # queue fraction that counts as pressure
+    grow_patience: int = 5           # consecutive pressured pumps to grow
+
+
+@dataclasses.dataclass
+class _Replica:
+    """Router-side record of one live replica."""
+
+    epoch: int
+    sig: object = None            # last beat change-signature seen
+    sig_mono: float = 0.0         # router monotonic time sig last changed
+    in_flight: set = dataclasses.field(default_factory=set)  # rids
+    served: int = 0
+    service_s: float | None = None  # last reported micro-batch service time
+    draining: bool = False
+
+
+class ServingRouter:
+    """The fleet control plane: admission, dispatch, collection,
+    liveness, eviction, promotion, drain.  Thread-safe: ``submit`` may
+    be called from any number of client threads while one owner drives
+    :meth:`pump` (or :meth:`run` on its own thread)."""
+
+    def __init__(self, transport, config: ServingConfig | None = None,
+                 events: FaultEvents | None = None):
+        self.tx = transport
+        self.cfg = config or ServingConfig()
+        self.events = events if events is not None else FaultEvents()
+        self._lock = threading.RLock()
+        self._queue: collections.deque[str] = collections.deque()
+        self._ledger: dict[str, dict] = {}
+        self._replicas: dict[int, _Replica] = {}
+        self._rid_seq = 0
+        self._open = 0            # admitted, not yet completed
+        self._closed = False
+        self._pressure = 0
+        self.rejected = 0
+        self.completed = 0
+        self.duplicates_discarded = 0
+        self.unknown_results = 0
+        self.redispatches = 0
+        self.promotions = 0
+        self.evictions = 0
+        self.drains_done = 0
+        self._ever_evicted: set[int] = set()
+        self._detector = StragglerDetector(
+            multiple=self.cfg.straggler_multiple,
+            consecutive=self.cfg.straggler_consecutive,
+            min_ranks=2,
+        )
+        # The latency histogram exists even with no telemetry sink
+        # configured (quantiles feed the SLO assertions directly); with
+        # a sink it is the registry's own instrument, so it streams.
+        tel = get_telemetry()
+        if tel is not None:
+            self.latency = tel.registry.histogram(
+                "serving_request_latency_s",
+                buckets=default_latency_buckets())
+            self._g_replicas = tel.registry.gauge("serving_replicas")
+            self._g_depth = tel.registry.gauge("serving_queue_depth")
+            self._c_evict = tel.registry.counter("serving_evictions")
+            self._c_reject = tel.registry.counter("serving_rejects")
+        else:
+            self.latency = Histogram(
+                "serving_request_latency_s", (),
+                buckets=default_latency_buckets())
+            self._g_replicas = self._g_depth = None
+            self._c_evict = self._c_reject = None
+
+    # -- admission -------------------------------------------------------
+    def submit(self, prompt, rid: str | None = None) -> str:
+        """Admit one request (or raise :class:`Overloaded`).  Returns
+        the request id; poll :meth:`result` or :meth:`wait_idle` for
+        completion."""
+        with self._lock:
+            if self._closed:
+                raise Overloaded("router is closed to new requests")
+            if self._open >= self.cfg.max_queue:
+                self.rejected += 1
+                self.events.request_rejects += 1
+                if self._c_reject is not None:
+                    self._c_reject.inc()
+                raise Overloaded(
+                    f"queue full ({self._open}/{self.cfg.max_queue} "
+                    "open requests)")
+            if rid is None:
+                self._rid_seq += 1
+                rid = f"r{self._rid_seq}"
+            if rid in self._ledger:
+                raise ValueError(f"duplicate rid {rid!r}")
+            self._ledger[rid] = {
+                "rid": rid, "prompt": prompt, "state": "queued",
+                "replica": None, "epoch": None, "dispatches": 0,
+                "submit_mono": time.monotonic(), "result": None,
+                "latency_s": None,
+            }
+            self._queue.append(rid)
+            self._open += 1
+            return rid
+
+    def result(self, rid: str) -> dict | None:
+        """The ledger entry for ``rid`` (a copy), or None if unknown."""
+        with self._lock:
+            entry = self._ledger.get(rid)
+            return dict(entry) if entry is not None else None
+
+    # -- lifecycle edges -------------------------------------------------
+    def _promote_locked(self, rank: int, now: float) -> None:
+        self.tx.set_serving_role(rank, "live")
+        epoch = self.tx.read_serving(rank)["epoch"]
+        self._replicas[rank] = _Replica(epoch=epoch, sig_mono=now)
+        self._detector.reset_rank(rank)  # fresh straggler episode
+        self.tx.consume_join(rank)
+        self.promotions += 1
+        self.events.spare_promotions += 1
+        self.tx.append_health_event("serve_promote", rank=rank,
+                                    epoch=epoch)
+
+    def _retire_locked(self, rank: int) -> int:
+        """The epoch-fenced handoff: ``retire_replica`` bumps the fence
+        and reclaims the queued requests in one atomic transport op;
+        everything that was admitted but not completed goes back on the
+        queue for survivors."""
+        rep = self._replicas.pop(rank)
+        undelivered = self.tx.retire_replica(rank)
+        requeue = {r.get("rid") for r in undelivered}
+        requeue.update(rep.in_flight)
+        n = 0
+        for rid in sorted(requeue, key=self._submit_order):
+            entry = self._ledger.get(rid)
+            if entry is None or entry["state"] == "done":
+                continue
+            entry["state"] = "queued"
+            entry["replica"] = None
+            self._queue.append(rid)
+            self.redispatches += 1
+            n += 1
+        self.events.spare_demotions += 1
+        return n
+
+    def _submit_order(self, rid: str) -> float:
+        entry = self._ledger.get(rid)
+        return entry["submit_mono"] if entry else float("inf")
+
+    def _evict_locked(self, rank: int, why: str, now: float) -> None:
+        n = self._retire_locked(rank)
+        self.evictions += 1
+        self._ever_evicted.add(rank)
+        self.events.replica_evictions += 1
+        if self._c_evict is not None:
+            self._c_evict.inc()
+        self.tx.append_health_event("serve_evict", rank=rank, why=why,
+                                    requeued=n)
+
+    def drain(self, rank: int) -> bool:
+        """Begin a graceful drain: stop dispatching to ``rank``, let it
+        finish in-flight work, then demote it to spare (completed by a
+        later :meth:`pump` once its in-flight set empties)."""
+        with self._lock:
+            rep = self._replicas.get(rank)
+            if rep is None or rep.draining:
+                return False
+            rep.draining = True
+        self.tx.set_drain(rank, True)
+        self.tx.append_health_event("serve_drain", rank=rank)
+        return True
+
+    # -- the pump --------------------------------------------------------
+    def pump(self) -> None:
+        """One control iteration: collect results, judge liveness and
+        stragglers, complete drains, dispatch, grow."""
+        now = time.monotonic()
+        # 1. Collect first: a dying replica's last posts must be
+        # credited before its eviction re-queues their rids.
+        for res in self.tx.take_results(64):
+            self._complete(res, now)
+        beats = self.tx.read_beats()
+        with self._lock:
+            self._observe_beats_locked(beats, now)
+            self._judge_stragglers_locked(now)
+            self._finish_drains_locked(now)
+            self._dispatch_locked()
+            self._grow_locked(now)
+            if self._g_replicas is not None:
+                self._g_replicas.set(len(self._replicas))
+                self._g_depth.set(len(self._queue))
+
+    def _observe_beats_locked(self, beats: dict, now: float) -> None:
+        for rank, rep in list(self._replicas.items()):
+            entry = beats.get(rank)
+            if entry is not None and entry[0] != rep.sig:
+                rep.sig = entry[0]
+                rep.sig_mono = now
+                payload = entry[1] or {}
+                st = payload.get("service_time_s")
+                if st is not None:
+                    rep.service_s = float(st)
+            if now - rep.sig_mono > self.cfg.replica_timeout_s:
+                self._evict_locked(rank, "dead (beat stale)", now)
+
+    def _judge_stragglers_locked(self, now: float) -> None:
+        samples = {rank: rep.service_s
+                   for rank, rep in self._replicas.items()
+                   if not rep.draining}
+        for verdict in self._detector.update(samples):
+            if verdict.rank in self._replicas:
+                self._evict_locked(
+                    verdict.rank,
+                    f"straggler {verdict.ratio:.1f}x median", now)
+
+    def _finish_drains_locked(self, now: float) -> None:
+        for rank, rep in list(self._replicas.items()):
+            if rep.draining and not rep.in_flight:
+                n = self._retire_locked(rank)
+                self.drains_done += 1
+                self.events.drains += 1
+                self.tx.append_health_event("serve_demote", rank=rank,
+                                            why="drained", requeued=n)
+
+    def _dispatch_locked(self) -> None:
+        while self._queue:
+            ready = [(len(rep.in_flight), rank)
+                     for rank, rep in self._replicas.items()
+                     if not rep.draining
+                     and len(rep.in_flight) < self.cfg.max_outstanding]
+            if not ready:
+                return
+            _, rank = min(ready)
+            rep = self._replicas[rank]
+            room = self.cfg.max_outstanding - len(rep.in_flight)
+            for _ in range(min(self.cfg.micro_batch, room,
+                               len(self._queue))):
+                rid = self._queue.popleft()
+                entry = self._ledger[rid]
+                entry["state"] = "dispatched"
+                entry["replica"] = rank
+                entry["epoch"] = rep.epoch
+                entry["dispatches"] += 1
+                rep.in_flight.add(rid)
+                self.tx.push_request(rank, {
+                    "rid": rid, "prompt": entry["prompt"],
+                    "epoch": rep.epoch,
+                })
+
+    def _grow_locked(self, now: float) -> None:
+        live = sum(1 for rep in self._replicas.values()
+                   if not rep.draining)
+        deficit = max(0, self.cfg.replicas - live)
+        if len(self._queue) >= self.cfg.grow_watermark * self.cfg.max_queue:
+            self._pressure += 1
+        else:
+            self._pressure = 0
+        want = deficit
+        if self._pressure >= self.cfg.grow_patience:
+            ceiling = self.cfg.max_replicas
+            if ceiling is None or live + deficit < ceiling:
+                want += 1
+                self._pressure = 0
+        if want <= 0:
+            return
+        joins = self.tx.read_joins()
+        # Prefer spares that were never evicted: an evicted-then-
+        # re-announced rank only comes back when nobody cleaner exists.
+        spares = sorted(
+            (r for r, p in joins.items()
+             if p.get("spare") and r not in self._replicas),
+            key=lambda r: (r in self._ever_evicted, r))
+        for rank in spares[:want]:
+            self._promote_locked(rank, now)
+
+    def _complete(self, res: dict, now: float) -> None:
+        with self._lock:
+            rid = res.get("rid")
+            entry = self._ledger.get(rid)
+            if entry is None:
+                self.unknown_results += 1
+                return
+            if entry["state"] == "done":
+                # First-result-wins: the replica died AFTER posting but
+                # before the router observed it, so the rid was
+                # re-dispatched and a survivor answered too.  One
+                # delivery, one counted duplicate.
+                self.duplicates_discarded += 1
+                return
+            owner = self._replicas.get(entry.get("replica"))
+            if owner is not None:
+                owner.in_flight.discard(rid)
+                owner.served += 1
+            entry["state"] = "done"
+            entry["result"] = res.get("output")
+            entry["latency_s"] = now - entry["submit_mono"]
+            self.latency.observe(entry["latency_s"])
+            self.completed += 1
+            self._open -= 1
+
+    # -- driving ---------------------------------------------------------
+    def run(self, stop_event: threading.Event) -> None:
+        """Pump until ``stop_event`` — the router's own thread target."""
+        while not stop_event.is_set():
+            self.pump()
+            stop_event.wait(self.cfg.poll_s)
+
+    def wait_idle(self, timeout_s: float,
+                  stop_event: threading.Event | None = None) -> bool:
+        """Block until every admitted request completed (True) or the
+        deadline passed (False).  Safe from a client thread while a
+        router thread pumps."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._open == 0:
+                    return True
+            if stop_event is not None and stop_event.is_set():
+                return False
+            time.sleep(0.005)
+        with self._lock:
+            return self._open == 0
+
+    # -- audit / shutdown ------------------------------------------------
+    def audit(self) -> dict:
+        """The exactly-once verdict the chaos campaigns assert on: every
+        admitted request must be completed exactly once — duplicates
+        discarded and rejects are *counted*, loss is a failure."""
+        with self._lock:
+            states = collections.Counter(
+                e["state"] for e in self._ledger.values())
+            q = self.latency.quantiles()
+            return {
+                "admitted": len(self._ledger),
+                "completed": self.completed,
+                "open": self._open,
+                "states": dict(states),
+                "rejected": self.rejected,
+                "duplicates_discarded": self.duplicates_discarded,
+                "unknown_results": self.unknown_results,
+                "redispatches": self.redispatches,
+                "promotions": self.promotions,
+                "evictions": self.evictions,
+                "drains": self.drains_done,
+                "exactly_once": (self._open == 0
+                                 and states.get("done", 0)
+                                 == len(self._ledger)),
+                "latency": q,
+            }
+
+    def close(self) -> dict:
+        """Stop admitting, append the ``serving`` summary health record
+        (the ``tools/gang_status.py`` serving view), and return the
+        final audit."""
+        with self._lock:
+            self._closed = True
+        verdict = self.audit()
+        with self._lock:
+            live = len(self._replicas)
+            depth = len(self._queue)
+        self.tx.append_health_event(
+            "serving", replicas=live, queue_depth=depth,
+            completed=verdict["completed"],
+            admitted=verdict["admitted"],
+            rejected=verdict["rejected"],
+            duplicates_discarded=verdict["duplicates_discarded"],
+            evictions=verdict["evictions"], drains=verdict["drains"],
+            promotions=verdict["promotions"],
+            exactly_once=verdict["exactly_once"],
+            p50=verdict["latency"].get("p50"),
+            p95=verdict["latency"].get("p95"),
+            p99=verdict["latency"].get("p99"),
+        )
+        return verdict

@@ -1,0 +1,154 @@
+"""Serving replica worker — the fleet's rank-side loop (ISSUE 16).
+
+One worker = one rank's thread (in-proc campaigns) or process
+(``cli/serve.py --role worker`` over tcp/file), driving the replica
+state machine the router controls through the serving channels:
+
+``spare``: announce on the join channel (``spare=True``, refreshed
+every heartbeat, with the newest prefetched checkpoint step — the PR
+10 warm-spare contract, so promotion is O(restore) not O(init)) and
+poll ``read_serving`` for a promotion.
+
+``live``: publish beats (liveness + the last micro-batch service time
+the router's straggler detector judges), pop micro-batches off this
+rank's request queue, run the injected ``step_fn`` (production: the
+``inference/generate.py`` step-callable seam,
+``make_serving_step``), and post one result per request **under the
+serving epoch bound at promotion**.  When the router retires this
+replica (drain completed, or eviction) the epoch advances: the
+worker's next ``read_serving`` shows a new epoch/role and it falls
+back to spare mode — and any result it was still holding posts as a
+fenced no-op (``post_result`` → False), never a duplicate.
+
+The loop mirrors ``runtime/inproc_worker.py``: ``TransportError``
+means this worker is severed from the control plane (hub cleared, tcp
+partition) and it retires quietly — the router's beat-staleness
+eviction re-dispatches whatever it owned.  This module is
+deliberately jax-free: ``step_fn`` is the only compute seam.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from distributed_machine_learning_tpu.runtime.transport import (
+    GangTransport,
+    TransportError,
+)
+
+
+@dataclasses.dataclass
+class ServingWorkerConfig:
+    heartbeat_interval: float = 0.05  # beat + spare-announce cadence
+    micro_batch: int = 4              # max requests per take
+    poll_s: float = 0.005             # idle request-poll cadence
+
+
+def run_serving_worker(tx: GangTransport, rank: int, step_fn,
+                       stop_event: threading.Event,
+                       cfg: ServingWorkerConfig | None = None, *,
+                       prefetch_fn=None, on_restore=None) -> dict:
+    """Drive one replica until ``stop_event`` (a campaign's kill switch
+    doubles as the worker's death) or the control plane severs.
+
+    ``step_fn(prompts) -> outputs``: the compute seam — one output per
+    prompt, order-aligned.  ``prefetch_fn() -> int | None``: called
+    while spare, returns the newest verified checkpoint step to
+    advertise.  ``on_restore(prefetched_step)``: called once per
+    promotion — where a real replica restores params (O(restore));
+    tests count the calls.
+
+    Returns a summary dict (served counts, restores) for audits.
+    """
+    cfg = cfg or ServingWorkerConfig()
+    seq = 0
+    served = 0
+    fenced = 0
+    restores = 0
+    last_service: float | None = None
+    bound_epoch: int | None = None
+    prefetched = None
+    last_announce = -1.0
+    last_beat = -1.0
+    try:
+        while not stop_event.is_set():
+            state = tx.read_serving(rank)
+            if state["role"] != "live":
+                bound_epoch = None
+                now = time.monotonic()
+                if (last_announce < 0
+                        or now - last_announce
+                        >= cfg.heartbeat_interval):
+                    if prefetch_fn is not None:
+                        prefetched = prefetch_fn()
+                    tx.announce_join(rank, {
+                        "rank": rank, "spare": True, "kind": "serving",
+                        "prefetched_step": prefetched,
+                        "time": time.time(),
+                    })
+                    last_announce = now
+                stop_event.wait(cfg.poll_s)
+                continue
+            if bound_epoch != state["epoch"]:
+                # Promoted (or re-promoted into a fresh epoch): restore
+                # before serving, and post every future result under
+                # THIS epoch — the fence that makes a late post after
+                # retirement a no-op instead of a duplicate.
+                bound_epoch = state["epoch"]
+                restores += 1
+                last_announce = -1.0
+                if on_restore is not None:
+                    on_restore(prefetched)
+            now = time.monotonic()
+            if last_beat < 0 or now - last_beat >= cfg.heartbeat_interval:
+                seq += 1
+                tx.publish_beat(rank, {
+                    "rank": rank, "seq": seq, "kind": "serving",
+                    "served": served, "service_time_s": last_service,
+                    "time": time.time(),
+                })
+                last_beat = now
+            reqs = tx.take_requests(rank, cfg.micro_batch)
+            if not reqs:
+                stop_event.wait(cfg.poll_s)
+                continue
+            t0 = time.perf_counter()
+            outs = step_fn([r.get("prompt") for r in reqs])
+            last_service = time.perf_counter() - t0
+            for req, out in zip(reqs, outs):
+                ok = tx.post_result(rank, bound_epoch, {
+                    "rid": req.get("rid"), "output": out,
+                    "service_time_s": last_service,
+                })
+                if ok:
+                    served += 1
+                else:
+                    # Retired mid-batch: the fence already handed the
+                    # rest of this work to survivors.
+                    fenced += 1
+                    break
+    except TransportError:
+        pass  # severed from the control plane: retire quietly
+    return {"rank": rank, "served": served, "fenced": fenced,
+            "restores": restores}
+
+
+def start_worker_thread(tx: GangTransport, rank: int, step_fn,
+                        stop_event: threading.Event,
+                        cfg: ServingWorkerConfig | None = None,
+                        **kwargs) -> tuple[threading.Thread, dict]:
+    """Spawn :func:`run_serving_worker` on a daemon thread; the second
+    element collects the worker's summary once it exits (campaign
+    audits read it after joining)."""
+    out: dict = {}
+
+    def _run():
+        out.update(run_serving_worker(tx, rank, step_fn, stop_event,
+                                      cfg, **kwargs))
+
+    t = threading.Thread(target=_run, name=f"serve-worker-{rank}",
+                         daemon=True)
+    t.start()
+    return t, out

@@ -87,6 +87,13 @@ _MUTATING_OPS = frozenset({
     "publish_beat", "declare_abort", "announce_join", "consume_join",
     "write_restore", "append_health", "append_fault", "append_consumed",
     "clear",
+    # Serving-plane channels (ISSUE 16).  ``take_requests`` and
+    # ``take_results`` are destructive pops, so the dedup matters MOST
+    # there: a tcp retry after a lost response must return the batch
+    # the original pop claimed, never pop a second one — that is the
+    # request-level exactly-once the serving router builds on.
+    "push_request", "take_requests", "post_result", "take_results",
+    "set_drain", "set_role", "retire_replica",
 })
 
 
@@ -321,6 +328,73 @@ class GangTransport:
         return self._do_read_consumed(
             None if orig_rank is None else int(orig_rank))
 
+    # -- serving-plane channels (ISSUE 16) -------------------------------
+    # The replicated-inference tier reuses the gang control plane and
+    # adds four channels: a per-replica inbound request queue, a shared
+    # completed-result queue, a per-replica drain latch, and the
+    # role/epoch record that fences a retired replica's late writes.
+    def push_request(self, replica: int, payload: dict) -> None:
+        """Enqueue one request onto ``replica``'s inbound queue.  The
+        router stamps each payload with ``rid`` and the replica's
+        serving epoch; the transport treats it as opaque."""
+        self._count("push_request")
+        self._do_push_request(int(replica), dict(payload))
+
+    def take_requests(self, replica: int, max_n: int = 1) -> list[dict]:
+        """Destructively pop up to ``max_n`` pending requests from
+        ``replica``'s queue, FIFO.  On tcp the op_id dedup makes a
+        retried take return the ORIGINAL batch — a request can be
+        claimed by at most one take."""
+        self._count("take_requests")
+        return self._do_take_requests(int(replica), int(max_n))
+
+    def post_result(self, replica: int, epoch: int,
+                    payload: dict) -> bool:
+        """Append one completed result — ACCEPTED only when ``epoch``
+        matches the replica's current serving epoch (checked atomically
+        with the append).  Returns False for a fenced (stale-epoch)
+        post: a drained/evicted replica's late result is discarded at
+        the hub, never double-delivered."""
+        self._count("post_result")
+        return bool(self._do_post_result(int(replica), int(epoch),
+                                         dict(payload)))
+
+    def take_results(self, max_n: int = 16) -> list[dict]:
+        """Destructively pop up to ``max_n`` completed results (the
+        router's collection read)."""
+        self._count("take_results")
+        return self._do_take_results(int(max_n))
+
+    def set_drain(self, replica: int, draining: bool = True) -> None:
+        """Set/clear ``replica``'s drain latch: a draining replica
+        finishes its in-flight work but the router stops dispatching
+        to it."""
+        self._count("set_drain")
+        self._do_set_drain(int(replica), bool(draining))
+
+    def set_serving_role(self, replica: int, role: str) -> None:
+        """Record ``replica``'s role (``"live"`` or ``"spare"``) — the
+        promotion edge of the replica state machine."""
+        self._count("set_role")
+        self._do_set_role(int(replica), str(role))
+
+    def retire_replica(self, replica: int) -> list[dict]:
+        """Demote ``replica`` in ONE atomic step: bump its serving
+        epoch (fencing any in-flight ``post_result`` from the old
+        epoch), flip its role back to ``spare``, clear its drain
+        latch, and return whatever requests were still queued for it —
+        the router re-dispatches those to survivors."""
+        self._count("retire_replica")
+        return self._do_retire_replica(int(replica))
+
+    def read_serving(self, replica: int | None = None) -> dict:
+        """One replica's ``{role, epoch, drain, queued}`` record, or
+        (``None``) the whole serving plane: ``{replicas: {rank:
+        record}, results: depth}`` — the status-tool read."""
+        self._count("read_serving")
+        return self._do_read_serving(
+            None if replica is None else int(replica))
+
     def clear_gang_state(self, restore_records: bool = False,
                          fault_ledger: bool | None = None) -> None:
         """Same contract as ``coordinator.clear_gang_state``: beats and
@@ -345,6 +419,7 @@ class GangTransport:
             "joins": self.read_joins(),
             "health": self.read_health_events(),
             "faults_fired": self.read_fault_entries(),
+            "serving": self.read_serving(),
         }
 
     def close(self) -> None:
@@ -500,6 +575,168 @@ class FileTransport(GangTransport):
         _coord.clear_gang_state(self.gang_dir,
                                 restore_records=restore_records,
                                 fault_ledger=fault_ledger)
+        if fault_ledger:
+            self._clear_serving()
+
+    # -- serving channels: spool directories under gang_dir/serving ------
+    # Queues are one-file-per-request spools; a pop CLAIMS a file with
+    # an atomic os.rename before reading it, so two competing takers can
+    # never both consume the same request.  File names carry a
+    # per-handle counter (FIFO per writer) plus a uuid suffix so
+    # concurrent writers never collide.
+    _SERVING_DIR = "serving"
+
+    def _serving_path(self, *parts) -> str:
+        return os.path.join(self.gang_dir, self._SERVING_DIR, *parts)
+
+    def _serving_seq_name(self) -> str:
+        with self._stats_lock:
+            seq = getattr(self, "_serving_seq", 0) + 1
+            self._serving_seq = seq
+        return f"{seq:010d}_{uuid.uuid4().hex[:8]}.json"
+
+    @staticmethod
+    def _read_json(path: str):
+        try:
+            with open(path) as f:
+                entry = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        return entry if isinstance(entry, dict) else None
+
+    def _spool_push(self, subdir: str, payload: dict) -> None:
+        self._ensure_dir()
+        d = self._serving_path(subdir)
+        os.makedirs(d, exist_ok=True)
+        _coord._write_atomic(os.path.join(d, self._serving_seq_name()),
+                             payload)
+
+    def _spool_take(self, subdir: str, max_n: int) -> list[dict]:
+        d = self._serving_path(subdir)
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            return []
+        out: list[dict] = []
+        for name in names:
+            if len(out) >= max_n or not name.endswith(".json"):
+                continue
+            path = os.path.join(d, name)
+            claimed = f"{path}.take{os.getpid()}.{threading.get_ident()}"
+            try:
+                os.rename(path, claimed)  # atomic claim: one winner
+            except OSError:
+                continue  # another taker won this file
+            entry = self._read_json(claimed)
+            with contextlib.suppress(OSError):
+                os.remove(claimed)
+            if entry is not None:
+                out.append(entry)
+        return out
+
+    def _do_push_request(self, replica: int, payload: dict) -> None:
+        self._spool_push(f"requests_r{replica}", payload)
+
+    def _do_take_requests(self, replica: int, max_n: int) -> list[dict]:
+        return self._spool_take(f"requests_r{replica}", max_n)
+
+    def _do_post_result(self, replica: int, epoch: int,
+                        payload: dict) -> bool:
+        cur = self._read_json(
+            self._serving_path(f"epoch_r{replica}.json")) or {}
+        if int(epoch) != int(cur.get("epoch", 0)):
+            return False
+        self._spool_push("results",
+                         dict(payload, replica=replica, epoch=int(epoch)))
+        return True
+
+    def _do_take_results(self, max_n: int) -> list[dict]:
+        return self._spool_take("results", max_n)
+
+    def _do_set_drain(self, replica: int, draining: bool) -> None:
+        self._ensure_dir()
+        os.makedirs(self._serving_path(), exist_ok=True)
+        _coord._write_atomic(self._serving_path(f"drain_r{replica}.json"),
+                             {"drain": bool(draining)})
+
+    def _do_set_role(self, replica: int, role: str) -> None:
+        self._ensure_dir()
+        os.makedirs(self._serving_path(), exist_ok=True)
+        _coord._write_atomic(self._serving_path(f"role_r{replica}.json"),
+                             {"role": role})
+
+    def _do_retire_replica(self, replica: int) -> list[dict]:
+        self._ensure_dir()
+        os.makedirs(self._serving_path(), exist_ok=True)
+        cur = self._read_json(
+            self._serving_path(f"epoch_r{replica}.json")) or {}
+        _coord._write_atomic(
+            self._serving_path(f"epoch_r{replica}.json"),
+            {"epoch": int(cur.get("epoch", 0)) + 1})
+        self._do_set_role(replica, "spare")
+        with contextlib.suppress(OSError):
+            os.remove(self._serving_path(f"drain_r{replica}.json"))
+        return self._spool_take(f"requests_r{replica}", 1 << 30)
+
+    def _replica_record(self, replica: int) -> dict:
+        role = self._read_json(
+            self._serving_path(f"role_r{replica}.json")) or {}
+        epoch = self._read_json(
+            self._serving_path(f"epoch_r{replica}.json")) or {}
+        drain = self._read_json(
+            self._serving_path(f"drain_r{replica}.json")) or {}
+        try:
+            queued = sum(
+                n.endswith(".json") for n in os.listdir(
+                    self._serving_path(f"requests_r{replica}")))
+        except OSError:
+            queued = 0
+        return {"role": role.get("role", "spare"),
+                "epoch": int(epoch.get("epoch", 0)),
+                "drain": bool(drain.get("drain", False)),
+                "queued": queued}
+
+    def _do_read_serving(self, replica: int | None) -> dict:
+        if replica is not None:
+            return self._replica_record(replica)
+        replicas: dict[int, dict] = {}
+        try:
+            names = os.listdir(self._serving_path())
+        except OSError:
+            names = []
+        for name in names:
+            for prefix in ("role_r", "epoch_r", "drain_r"):
+                if name.startswith(prefix) and name.endswith(".json"):
+                    rank_s = name[len(prefix):-len(".json")]
+                    if rank_s.isdigit():
+                        replicas.setdefault(int(rank_s), {})
+        for rank in list(replicas):
+            replicas[rank] = self._replica_record(rank)
+        try:
+            results = sum(
+                n.endswith(".json")
+                for n in os.listdir(self._serving_path("results")))
+        except OSError:
+            results = 0
+        return {"replicas": replicas, "results": results}
+
+    def _clear_serving(self) -> None:
+        root = self._serving_path()
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return
+        for name in names:
+            path = os.path.join(root, name)
+            if os.path.isdir(path):
+                for inner in os.listdir(path):
+                    with contextlib.suppress(OSError):
+                        os.remove(os.path.join(path, inner))
+                with contextlib.suppress(OSError):
+                    os.rmdir(path)
+            else:
+                with contextlib.suppress(OSError):
+                    os.remove(path)
 
 
 # ---------------------------------------------------------------------------
@@ -543,6 +780,14 @@ class InProcHub:
         self.faults: list[dict] = []
         self.consumed: dict[int, list[dict]] = {}
         self.box: dict = {}
+        # Serving-plane state (ISSUE 16): per-replica request queues,
+        # the shared result queue, the drain latches, and the
+        # role/epoch records that fence retired replicas.
+        self.serving_requests: dict[int, list[dict]] = {}
+        self.serving_results: list[dict] = []
+        self.serving_drain: dict[int, bool] = {}
+        self.serving_epoch: dict[int, int] = {}
+        self.serving_role: dict[int, str] = {}
         self._version = 0
 
     # -- the broadcast box (in-proc worker extension) --------------------
@@ -573,6 +818,11 @@ class InProcHub:
                 self.faults.clear()
                 self.consumed.clear()
                 self.joins.clear()
+                self.serving_requests.clear()
+                self.serving_results.clear()
+                self.serving_drain.clear()
+                self.serving_epoch.clear()
+                self.serving_role.clear()
         if self.mirror_dir is not None:
             _coord.clear_gang_state(self.mirror_dir,
                                     restore_records=restore_records,
@@ -722,6 +972,84 @@ class InProcTransport(GangTransport):
                         for e in hub.consumed.get(orig_rank, ())]
             return [dict(e) for r in sorted(hub.consumed)
                     for e in hub.consumed[r]]
+
+    # -- serving channels ------------------------------------------------
+    # Schedule-point labels: the queue channels get structured
+    # ``hub:<channel>:w`` labels (independent channels prune against
+    # each other in the layer-3 POR), while ``retire_replica`` and the
+    # cross-channel snapshot read get deliberately NON-structured
+    # labels so the explorer treats them as conflicting with every
+    # serving op — they touch several channels in one critical section.
+    def _do_push_request(self, replica: int, payload: dict) -> None:
+        with self._locked("hub:srequests:w") as hub:
+            hub.serving_requests.setdefault(replica, []).append(
+                dict(payload))
+
+    def _do_take_requests(self, replica: int, max_n: int) -> list[dict]:
+        with self._locked("hub:srequests:w") as hub:
+            q = hub.serving_requests.get(replica)
+            if not q:
+                return []
+            out = q[:max_n]
+            del q[:max_n]
+            return [dict(e) for e in out]
+
+    def _do_post_result(self, replica: int, epoch: int,
+                        payload: dict) -> bool:
+        # The drain/promote fence: the epoch is compared INSIDE the
+        # lock, atomic with the append.  A retired replica's late post
+        # (its epoch was bumped by ``retire_replica``) returns False
+        # and touches nothing — the check-then-act race the layer-3
+        # ``drain_promote`` scenario explores, whose broken form
+        # survives as ``analysis/interleave.py``'s ``result-unfenced``
+        # mutation.
+        with self._locked("hub:sresults:w") as hub:
+            if int(epoch) != hub.serving_epoch.get(replica, 0):
+                return False
+            hub.serving_results.append(
+                dict(payload, replica=replica, epoch=int(epoch)))
+            return True
+
+    def _do_take_results(self, max_n: int) -> list[dict]:
+        with self._locked("hub:sresults:w") as hub:
+            out = hub.serving_results[:max_n]
+            del hub.serving_results[:max_n]
+            return [dict(e) for e in out]
+
+    def _do_set_drain(self, replica: int, draining: bool) -> None:
+        with self._locked("hub:sdrain:w") as hub:
+            hub.serving_drain[replica] = bool(draining)
+
+    def _do_set_role(self, replica: int, role: str) -> None:
+        with self._locked("hub:srole:w") as hub:
+            hub.serving_role[replica] = role
+
+    def _do_retire_replica(self, replica: int) -> list[dict]:
+        with self._locked("hub:serving:retire") as hub:
+            hub.serving_epoch[replica] = \
+                hub.serving_epoch.get(replica, 0) + 1
+            undelivered = hub.serving_requests.pop(replica, [])
+            hub.serving_role[replica] = "spare"
+            hub.serving_drain.pop(replica, None)
+            return [dict(e) for e in undelivered]
+
+    def _replica_record_locked(self, hub: InProcHub,
+                               replica: int) -> dict:
+        return {"role": hub.serving_role.get(replica, "spare"),
+                "epoch": hub.serving_epoch.get(replica, 0),
+                "drain": bool(hub.serving_drain.get(replica, False)),
+                "queued": len(hub.serving_requests.get(replica, ()))}
+
+    def _do_read_serving(self, replica: int | None) -> dict:
+        with self._locked("hub:serving:snapshot") as hub:
+            if replica is not None:
+                return self._replica_record_locked(hub, replica)
+            ranks = (set(hub.serving_role) | set(hub.serving_epoch)
+                     | set(hub.serving_drain)
+                     | set(hub.serving_requests))
+            return {"replicas": {r: self._replica_record_locked(hub, r)
+                                 for r in sorted(ranks)},
+                    "results": len(hub.serving_results)}
 
     def _do_clear(self, restore_records: bool, fault_ledger: bool) -> None:
         self.hub.clear(restore_records, fault_ledger)
@@ -1015,6 +1343,34 @@ class TcpGangServer:
             self.hub.clear(bool(req["restore_records"]),
                            bool(req["fault_ledger"]))
             return None
+        if op == "push_request":
+            s._do_push_request(int(req["rank"]), req["payload"])
+            return None
+        if op == "take_requests":
+            return s._do_take_requests(int(req["rank"]),
+                                       int(req["max_n"]))
+        if op == "post_result":
+            return s._do_post_result(int(req["rank"]),
+                                     int(req["epoch"]), req["payload"])
+        if op == "take_results":
+            return s._do_take_results(int(req["max_n"]))
+        if op == "set_drain":
+            s._do_set_drain(int(req["rank"]), bool(req["draining"]))
+            return None
+        if op == "set_role":
+            s._do_set_role(int(req["rank"]), req["role"])
+            return None
+        if op == "retire_replica":
+            return s._do_retire_replica(int(req["rank"]))
+        if op == "read_serving":
+            rank = req.get("rank")
+            state = s._do_read_serving(
+                None if rank is None else int(rank))
+            if rank is None:
+                state = dict(state,
+                             replicas={str(r): rec for r, rec
+                                       in state["replicas"].items()})
+            return state
         raise ValueError(f"unknown transport op {op!r}")
 
 
@@ -1201,6 +1557,38 @@ class TcpTransport(GangTransport):
     def _do_clear(self, restore_records, fault_ledger):
         self._call("clear", restore_records=restore_records,
                    fault_ledger=fault_ledger)
+
+    # serving channels — all mutating ops ride the op_id dedup, so a
+    # retried take/post is a result fetch, never a second pop/append.
+    def _do_push_request(self, replica, payload):
+        self._call("push_request", rank=replica, payload=payload)
+
+    def _do_take_requests(self, replica, max_n):
+        return self._call("take_requests", rank=replica, max_n=max_n)
+
+    def _do_post_result(self, replica, epoch, payload):
+        return bool(self._call("post_result", rank=replica,
+                               epoch=epoch, payload=payload))
+
+    def _do_take_results(self, max_n):
+        return self._call("take_results", max_n=max_n)
+
+    def _do_set_drain(self, replica, draining):
+        self._call("set_drain", rank=replica, draining=draining)
+
+    def _do_set_role(self, replica, role):
+        self._call("set_role", rank=replica, role=role)
+
+    def _do_retire_replica(self, replica):
+        return self._call("retire_replica", rank=replica)
+
+    def _do_read_serving(self, replica):
+        state = self._call("read_serving", rank=replica)
+        if replica is None:
+            state = dict(state,
+                         replicas={int(r): rec for r, rec
+                                   in state["replicas"].items()})
+        return state
 
     # cadence: each monitor poll is ONE batched read_beats round trip,
     # and the interval grows with the world so the whole gang's request

@@ -45,6 +45,7 @@ from distributed_machine_learning_tpu.telemetry.registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    default_latency_buckets,
 )
 from distributed_machine_learning_tpu.telemetry.sink import (
     JsonlSink,
@@ -67,6 +68,7 @@ from distributed_machine_learning_tpu.telemetry.aggregator import (
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "default_latency_buckets",
     "JsonlSink", "read_jsonl", "write_prometheus",
     "SpanTracer", "read_trace",
     "GangRollup", "HeartbeatSampler", "StragglerDetector",

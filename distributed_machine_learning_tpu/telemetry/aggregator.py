@@ -291,6 +291,14 @@ class StragglerDetector:
                 self.flagged.discard(rank)  # recovery re-arms the flag
         return verdicts
 
+    def reset_rank(self, rank: int) -> None:
+        """Forget ``rank``'s episode state — the replace-policy hook:
+        when a flagged rank is evicted and a new incarnation admitted
+        (serving re-promotion, gang replace), the new one must be
+        judged fresh, not inherit the old flag."""
+        self._streak.pop(rank, None)
+        self.flagged.discard(rank)
+
 
 @dataclasses.dataclass
 class GangRollup:

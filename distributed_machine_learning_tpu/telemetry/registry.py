@@ -42,6 +42,23 @@ def default_time_buckets() -> tuple[float, ...]:
     return tuple(out)
 
 
+def default_latency_buckets() -> tuple[float, ...]:
+    """Request-latency seconds buckets, 0.5 ms .. ~16 s at √2 steps —
+    the ISSUE 16 bugfix preset.  Histogram buckets are fixed at
+    construction, and :func:`default_time_buckets`' doubling grid
+    (tuned for multi-second train steps) puts an entire
+    millisecond-scale serving distribution inside one or two buckets,
+    flattening p50/p95/p99 into the same interpolated value.  The √2
+    ratio doubles the resolution exactly where per-request latencies
+    live while still reaching tail-amplification territory."""
+    out = []
+    b = 5e-4
+    while b < 16.0:
+        out.append(b)
+        b *= 2.0 ** 0.5
+    return tuple(out)
+
+
 class Counter:
     """Monotonic counter.  ``inc`` with a negative amount is an error —
     a decreasing "counter" is a gauge wearing the wrong name."""

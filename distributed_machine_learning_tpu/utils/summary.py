@@ -76,6 +76,9 @@ _EVENT_LABELS = {
     "ckpt_fallbacks": "restores fell back past bad checkpoints",
     "transport_retries": "gang-transport ops retried (backoff)",
     "transport_timeouts": "gang-transport ops timed out/dropped",
+    "replica_evictions": "serving replicas evicted (dead/slow)",
+    "drains": "serving replicas drained gracefully",
+    "request_rejects": "serving requests rejected (overload)",
 }
 
 

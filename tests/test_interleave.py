@@ -3,7 +3,7 @@ explorer over the gang control plane.
 
 Tier-1 keystones: ``test_quick_sweep_is_clean_and_bounded`` (the
 fixed tree survives exhaustive-small-config exploration — the layer-3
-analogue of ``test_package_is_clean``) and the two mutation gates
+analogue of ``test_package_is_clean``) and the mutation gates
 (with a known bug re-introduced the explorer MUST rediscover it
 deterministically, and its reproducer must replay to the same failure
 twice).  The scaled-up full sweep rides behind ``slow``.
@@ -191,6 +191,20 @@ def test_epoch_fence_bug_is_rediscovered(tmp_path):
     assert "zombie" in trace and "hub:epoch:gap" in trace
 
 
+def test_drain_promote_bug_is_rediscovered(tmp_path):
+    # ISSUE 16: the serving drain/promote handoff.  With the result
+    # fence's epoch check hoisted outside the lock, a retiring
+    # replica's late post parks in the TOCTOU window through the
+    # epoch bump and lands AFTER the handoff.
+    f, repro = _gate(tmp_path, "drain_promote", "result-unfenced")
+    assert "late result" in f.message
+    r1 = replay_file(repro)
+    r2 = replay_file(repro)
+    assert r1 == r2 and r1["reproduced"]
+    trace = format_trace(r1["trace"])
+    assert "zombie" in trace and "hub:sepoch:gap" in trace
+
+
 def test_mutations_restore_the_fixed_methods(tmp_path):
     orig_evict = TcpGangServer.__dict__["_evict_seen_locked"]
     orig_locked = InProcTransport.__dict__["_locked"]
@@ -213,7 +227,8 @@ def test_unknown_mutation_and_scenario_are_loud():
             pass
     with pytest.raises(ValueError, match="unknown scenario"):
         run_layer3(quick=True, scenarios=["no_such_protocol"])
-    assert set(MUTATIONS) == {"dedup-evict", "epoch-unlocked"}
+    assert set(MUTATIONS) == {"dedup-evict", "epoch-unlocked",
+                              "result-unfenced"}
 
 
 # ---------------------------------------------------------------------------

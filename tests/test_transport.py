@@ -277,6 +277,89 @@ def test_coordinator_detects_dead_peer_over_backend(backend):
 
 
 # ---------------------------------------------------------------------------
+# Serving channels (ISSUE 16): the router/replica contract
+# ---------------------------------------------------------------------------
+
+
+def test_serving_request_spool_is_fifo_and_destructive(backend):
+    _, make = backend
+    router, worker = make(), make()
+    assert worker.take_requests(0, 8) == []
+    for i in range(5):
+        router.push_request(0, {"rid": f"r{i}", "i": i})
+    # FIFO in dispatch order, destructive in micro-batch slices.
+    assert [r["rid"] for r in worker.take_requests(0, 2)] == ["r0", "r1"]
+    assert [r["rid"] for r in worker.take_requests(0, 8)] == ["r2", "r3",
+                                                              "r4"]
+    assert worker.take_requests(0, 8) == []
+    # Queues are per-replica: rank 1's spool is invisible to rank 0.
+    router.push_request(1, {"rid": "other"})
+    assert worker.take_requests(0, 8) == []
+    assert [r["rid"] for r in worker.take_requests(1, 8)] == ["other"]
+
+
+def test_serving_result_fence_retire_and_roles(backend):
+    _, make = backend
+    router, worker = make(), make()
+    # Every rank starts as a spare; promotion is an explicit write.
+    assert router.read_serving(0)["role"] == "spare"
+    router.set_serving_role(0, "live")
+    state = router.read_serving(0)
+    assert state["role"] == "live" and state["drain"] is False
+    e0 = state["epoch"]
+    # Posts under the bound epoch land; any other epoch is fenced.
+    assert worker.post_result(0, e0, {"rid": "a", "out": [1]}) is True
+    assert worker.post_result(0, e0 + 1, {"rid": "ghost"}) is False
+    got = router.take_results(8)
+    assert [r["rid"] for r in got] == ["a"]
+    assert got[0]["replica"] == 0 and got[0]["epoch"] == e0
+    assert router.take_results(8) == []  # destructive
+    # Drain is a latch the worker observes via read_serving.
+    router.set_drain(0, True)
+    assert router.read_serving(0)["drain"] is True
+    # Retire is the atomic handoff: epoch bump, queue reclaim, role
+    # back to spare, drain cleared.
+    router.push_request(0, {"rid": "undelivered"})
+    undelivered = router.retire_replica(0)
+    assert [r["rid"] for r in undelivered] == ["undelivered"]
+    after = router.read_serving(0)
+    assert after == {"role": "spare", "epoch": e0 + 1,
+                     "drain": False, "queued": 0}
+    # The retired epoch's late post bounces off the fence...
+    assert worker.post_result(0, e0, {"rid": "late"}) is False
+    assert router.take_results(8) == []
+    # ...while the re-promoted epoch serves normally.
+    assert worker.post_result(0, e0 + 1, {"rid": "b"}) is True
+    assert [r["rid"] for r in router.take_results(8)] == ["b"]
+
+
+def test_serving_state_reaches_fleet_view_and_snapshot(backend):
+    _, make = backend
+    tx, peer = make(), make()
+    tx.set_serving_role(1, "live")
+    tx.push_request(1, {"rid": "q"})
+    tx.set_drain(2, True)
+    fleet = peer.read_serving()
+    assert fleet["replicas"][1] == {"role": "live", "epoch": 0,
+                                    "drain": False, "queued": 1}
+    assert fleet["replicas"][2]["drain"] is True
+    assert fleet["results"] == 0
+    snap = peer.snapshot()
+    assert snap["serving"]["replicas"][1]["queued"] == 1
+
+
+def test_serving_state_is_wiped_with_the_gang(backend):
+    _, make = backend
+    tx = make()
+    tx.set_serving_role(0, "live")
+    tx.push_request(0, {"rid": "x"})
+    tx.post_result(0, 0, {"rid": "x"})
+    tx.clear_gang_state(fault_ledger=True)
+    fleet = make().read_serving()
+    assert fleet["replicas"] == {} and fleet["results"] == 0
+
+
+# ---------------------------------------------------------------------------
 # TCP robustness layer: the lossy-medium claims, tested not asserted
 # ---------------------------------------------------------------------------
 
@@ -405,6 +488,47 @@ def test_tcp_duplicate_racing_inflight_original_applies_once(tcp_server):
     assert len(results) == 2
     assert len(TcpTransport(tcp_server.address)
                .read_fault_entries()) == 1
+
+
+def test_tcp_dropped_serving_push_applies_exactly_once(tcp_server):
+    """The serving channels ride the same op_id dedup as the ledgers:
+    a dropped ``push_request`` is retried and the request lands in the
+    replica's spool exactly once — a retried request is re-dispatched
+    without duplication."""
+    events = FaultEvents()
+    chaos = TransportChaos(drop=[("push_request", 1)])
+    tx = TcpTransport(tcp_server.address, events=events, chaos=chaos,
+                      backoff_s=0.01)
+    tx.push_request(0, {"rid": "only"})
+    assert tx.stats()["retries"] >= 1
+    reader = TcpTransport(tcp_server.address)
+    assert reader.read_serving(0)["queued"] == 1
+    assert [r["rid"] for r in reader.take_requests(0, 8)] == ["only"]
+
+
+def test_tcp_retried_take_returns_the_same_batch(tcp_server):
+    """``take_requests`` is DESTRUCTIVE, so a response lost after the
+    server applied is the nasty case: the batch is already popped.  The
+    retry reuses the op_id, and the dedup layer answers with the SAME
+    batch instead of an empty second pop — no request is stranded."""
+    tx = TcpTransport(tcp_server.address)
+    tx.push_request(3, {"rid": "precious"})
+    req = {"op": "take_requests", "rank": 3, "max_n": 8,
+           "op_id": "take-retry-1"}
+    first = tx._roundtrip(dict(req))
+    assert [r["rid"] for r in first] == ["precious"]
+    # The retry after the lost response: a result fetch, not a re-pop.
+    assert tx._roundtrip(dict(req)) == first
+    assert tx.take_requests(3, 8) == []
+
+
+def test_tcp_duplicated_post_result_lands_once(tcp_server):
+    chaos = TransportChaos(duplicate=[("post_result", 1)])
+    tx = TcpTransport(tcp_server.address, chaos=chaos, backoff_s=0.01)
+    assert tx.post_result(5, 0, {"rid": "x", "out": [1]}) is True
+    reader = TcpTransport(tcp_server.address)
+    assert [r["rid"] for r in reader.take_results(8)] == ["x"]
+    assert reader.take_results(8) == []
 
 
 def test_tcp_delay_is_survived(tcp_server):

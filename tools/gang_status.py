@@ -18,7 +18,11 @@ telemetry plane the workers stream (default ``<gang-dir>/telemetry``):
   in ``--json``;
 - the cross-rank rollup from the per-rank metrics streams
   (``telemetry/aggregator.py``): per-rank throughput, whole-run
-  p95/max step-time skew, offline straggler verdicts.
+  p95/max step-time skew, offline straggler verdicts;
+- the serving view (ISSUE 16): replica states (role / serving epoch /
+  drain latch / queue depth) from the transport snapshot, the router's
+  final SLO summary record, and the promotion / eviction / drain
+  history from the health ledger.
 
 Live mode (``--watch N``) re-renders every N seconds; everything
 tolerates the artifacts of a crash (torn lines, frozen beat files) —
@@ -166,6 +170,20 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
     for e in health:
         if e.get("kind") == "transport":
             transport_health = e
+    # The serving view (ISSUE 16): the router's final summary record,
+    # its lifecycle history (promotions / evictions / drains), and the
+    # live serving-plane state from the transport snapshot (replica
+    # roles/epochs/drain latches and queue depths — non-empty while a
+    # fleet is up or when a file-backend fleet died mid-flight).
+    serving_summary = None
+    serving_history = []
+    for e in health:
+        kind = e.get("kind")
+        if kind == "serving":
+            serving_summary = e
+        elif kind in ("serve_promote", "serve_evict", "serve_drain",
+                      "serve_demote"):
+            serving_history.append(e)
     out = {
         "gang_dir": gang_dir,
         "world": len(rank_rows),
@@ -178,6 +196,9 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
         "health": health,
         "faults_fired": snap["faults_fired"],
         "transport": transport_health,
+        "serving": serving_summary,
+        "serving_history": serving_history,
+        "serving_state": snap.get("serving"),
     }
     if os.path.isdir(telemetry_dir):
         rollup = aggregate_gang_metrics(telemetry_dir)
@@ -287,6 +308,54 @@ def render(status: dict) -> str:
                and e.get("target") != e.get("rank") else "")
         lines.append(f"  fault fired: {e.get('kind')} rank "
                      f"{e.get('rank')} at {e.get('at')}{tgt}")
+
+    sv = status.get("serving")
+    sv_hist = status.get("serving_history") or []
+    sv_state = status.get("serving_state") or {}
+    sv_replicas = sv_state.get("replicas") or {}
+    if sv or sv_hist or sv_replicas:
+        lines.append("== Serving fleet ==")
+    if sv:
+        lines.append(
+            f"  fleet: {sv.get('replicas', '?')} live replica(s), "
+            f"queue depth {sv.get('queue_depth', '?')} — "
+            f"{sv.get('completed', 0)}/{sv.get('admitted', 0)} "
+            f"completed, {sv.get('rejected', 0)} rejected, "
+            f"{sv.get('duplicates_discarded', 0)} duplicate(s) "
+            "discarded")
+        lines.append(
+            f"  events: {sv.get('promotions', 0)} promotion(s), "
+            f"{sv.get('evictions', 0)} eviction(s), "
+            f"{sv.get('drains', 0)} drain(s); exactly-once: "
+            f"{'PASS' if sv.get('exactly_once') else 'FAIL'}")
+        if sv.get("p99") is not None:
+            lines.append(
+                f"  latency: p50 {sv.get('p50', 0) * 1e3:.1f} ms  "
+                f"p95 {sv.get('p95', 0) * 1e3:.1f} ms  "
+                f"p99 {sv['p99'] * 1e3:.1f} ms")
+    for rank_s, rec in sorted(sv_replicas.items(),
+                              key=lambda kv: int(kv[0])):
+        state = ("draining" if rec.get("drain")
+                 else rec.get("role", "?"))
+        lines.append(f"  replica {rank_s}: {state}, epoch "
+                     f"{rec.get('epoch', 0)}, "
+                     f"{rec.get('queued', 0)} queued request(s)")
+    for e in sv_hist:
+        kind = e.get("kind")
+        if kind == "serve_promote":
+            lines.append(f"  promote: spare {e.get('rank')} -> live "
+                         f"replica (serving epoch {e.get('epoch')})")
+        elif kind == "serve_evict":
+            lines.append(f"  evict: replica {e.get('rank')} — "
+                         f"{e.get('why', '?')} "
+                         f"({e.get('requeued', 0)} request(s) "
+                         "re-dispatched)")
+        elif kind == "serve_drain":
+            lines.append(f"  drain: replica {e.get('rank')} stopped "
+                         "admitting, finishing in-flight")
+        else:  # serve_demote
+            lines.append(f"  demote: replica {e.get('rank')} -> spare "
+                         f"({e.get('why', '?')})")
 
     rollup = status.get("rollup")
     if rollup:
