@@ -86,6 +86,7 @@ class ServingConfig:
     straggler_consecutive: int = 3
     grow_watermark: float = 0.75     # queue fraction that counts as pressure
     grow_patience: int = 5           # consecutive pressured pumps to grow
+    retain_done: int = 1024          # completed entries kept in the ledger
 
 
 @dataclasses.dataclass
@@ -115,6 +116,16 @@ class ServingRouter:
         self._lock = threading.RLock()
         self._queue: collections.deque[str] = collections.deque()
         self._ledger: dict[str, dict] = {}
+        # The ledger holds at most ``retain_done`` completed entries: a
+        # long-running router would otherwise retain every prompt +
+        # result forever.  Compacted entries survive as counters plus a
+        # bounded rid tombstone set (so a dead replica's very late
+        # duplicate still classifies as a duplicate, not "unknown").
+        self._done_fifo: collections.deque[str] = collections.deque()
+        self._compacted = 0
+        self._tombstones: collections.OrderedDict[str, None] = \
+            collections.OrderedDict()
+        self._tombstone_cap = max(1024, 4 * self.cfg.retain_done)
         self._replicas: dict[int, _Replica] = {}
         self._rid_seq = 0
         self._open = 0            # admitted, not yet completed
@@ -185,7 +196,9 @@ class ServingRouter:
             return rid
 
     def result(self, rid: str) -> dict | None:
-        """The ledger entry for ``rid`` (a copy), or None if unknown."""
+        """The ledger entry for ``rid`` (a copy), or None if unknown —
+        including a completed entry the ledger already compacted away
+        (``retain_done`` bounds how long results are retained)."""
         with self._lock:
             entry = self._ledger.get(rid)
             return dict(entry) if entry is not None else None
@@ -214,7 +227,10 @@ class ServingRouter:
         n = 0
         for rid in sorted(requeue, key=self._submit_order):
             entry = self._ledger.get(rid)
-            if entry is None or entry["state"] == "done":
+            # Only "dispatched" entries go back on the queue: "done"
+            # was already delivered, and "queued" is already IN the
+            # queue — appending it twice would double-dispatch.
+            if entry is None or entry["state"] != "dispatched":
                 continue
             entry["state"] = "queued"
             entry["replica"] = None
@@ -317,7 +333,16 @@ class ServingRouter:
             for _ in range(min(self.cfg.micro_batch, room,
                                len(self._queue))):
                 rid = self._queue.popleft()
-                entry = self._ledger[rid]
+                entry = self._ledger.get(rid)
+                if entry is None or entry["state"] != "queued":
+                    # Stale queue entry: an eviction requeued the rid,
+                    # then the dead replica's late result completed it
+                    # (or compaction dropped it) while it still sat in
+                    # the queue.  Re-dispatching a done rid would reset
+                    # it to "dispatched" and let the survivor's answer
+                    # drive _open negative — exactly-once demands one
+                    # completion per rid, ever.
+                    continue
                 entry["state"] = "dispatched"
                 entry["replica"] = rank
                 entry["epoch"] = rep.epoch
@@ -359,7 +384,10 @@ class ServingRouter:
             rid = res.get("rid")
             entry = self._ledger.get(rid)
             if entry is None:
-                self.unknown_results += 1
+                if rid in self._tombstones:
+                    self.duplicates_discarded += 1
+                else:
+                    self.unknown_results += 1
                 return
             if entry["state"] == "done":
                 # First-result-wins: the replica died AFTER posting but
@@ -378,6 +406,14 @@ class ServingRouter:
             self.latency.observe(entry["latency_s"])
             self.completed += 1
             self._open -= 1
+            self._done_fifo.append(rid)
+            while len(self._done_fifo) > self.cfg.retain_done:
+                old = self._done_fifo.popleft()
+                self._ledger.pop(old, None)
+                self._compacted += 1
+                self._tombstones[old] = None
+                while len(self._tombstones) > self._tombstone_cap:
+                    self._tombstones.popitem(last=False)
 
     # -- driving ---------------------------------------------------------
     def run(self, stop_event: threading.Event) -> None:
@@ -410,11 +446,17 @@ class ServingRouter:
         with self._lock:
             states = collections.Counter(
                 e["state"] for e in self._ledger.values())
+            # Compacted entries were all "done" — they left the ledger
+            # but still count toward the exactly-once arithmetic.
+            if self._compacted:
+                states["done"] += self._compacted
+            admitted = len(self._ledger) + self._compacted
             q = self.latency.quantiles()
             return {
-                "admitted": len(self._ledger),
+                "admitted": admitted,
                 "completed": self.completed,
                 "open": self._open,
+                "compacted": self._compacted,
                 "states": dict(states),
                 "rejected": self.rejected,
                 "duplicates_discarded": self.duplicates_discarded,
@@ -424,8 +466,7 @@ class ServingRouter:
                 "evictions": self.evictions,
                 "drains": self.drains_done,
                 "exactly_once": (self._open == 0
-                                 and states.get("done", 0)
-                                 == len(self._ledger)),
+                                 and states.get("done", 0) == admitted),
                 "latency": q,
             }
 

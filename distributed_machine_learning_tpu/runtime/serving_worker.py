@@ -18,7 +18,12 @@ serving epoch bound at promotion**.  When the router retires this
 replica (drain completed, or eviction) the epoch advances: the
 worker's next ``read_serving`` shows a new epoch/role and it falls
 back to spare mode — and any result it was still holding posts as a
-fenced no-op (``post_result`` → False), never a duplicate.
+fenced no-op (``post_result`` → False), never a duplicate.  Requests
+carry their dispatch epoch: a taken request stamped NEWER than the
+bound epoch (this rank was retired and re-promoted between the
+worker's serving read and its take) is pushed back and the worker
+rebinds before serving it, so no request is burned under a fence that
+is guaranteed to reject it.
 
 The loop mirrors ``runtime/inproc_worker.py``: ``TransportError``
 means this worker is severed from the control plane (hub cleared, tcp
@@ -66,6 +71,7 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
     seq = 0
     served = 0
     fenced = 0
+    repushed = 0
     restores = 0
     last_service: float | None = None
     bound_epoch: int | None = None
@@ -114,6 +120,38 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
             if not reqs:
                 stop_event.wait(cfg.poll_s)
                 continue
+            # Fence check BEFORE compute: the router stamps every
+            # request with its dispatch epoch.  A stamp NEWER than the
+            # bound means this rank was retired and re-promoted between
+            # read_serving and the take — running the request under the
+            # stale bound would fence its post and strand it in the new
+            # replica's in-flight set forever (the rank keeps beating,
+            # so no eviction requeues it): push it back onto our own
+            # queue and re-read the serving state to rebind first.  A
+            # stamp OLDER than the bound is a zombie from a retired
+            # epoch (the router requeued its rid at retirement) — drop
+            # it as fenced rather than re-push it in a cycle no future
+            # epoch can ever serve.
+            keep = []
+            newer = []
+            for r in reqs:
+                e = r.get("epoch")
+                if e is None or e == bound_epoch:
+                    keep.append(r)
+                elif e > bound_epoch:
+                    newer.append(r)
+                else:
+                    fenced += 1
+            if newer:
+                for r in newer:
+                    tx.push_request(rank, r)
+                repushed += len(newer)
+            reqs = keep
+            if not reqs:
+                if newer:
+                    continue  # rebind via read_serving first
+                stop_event.wait(cfg.poll_s)
+                continue
             t0 = time.perf_counter()
             outs = step_fn([r.get("prompt") for r in reqs])
             last_service = time.perf_counter() - t0
@@ -132,7 +170,7 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
     except TransportError:
         pass  # severed from the control plane: retire quietly
     return {"rank": rank, "served": served, "fenced": fenced,
-            "restores": restores}
+            "repushed": repushed, "restores": restores}
 
 
 def start_worker_thread(tx: GangTransport, rank: int, step_fn,

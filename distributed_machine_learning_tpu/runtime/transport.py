@@ -72,6 +72,11 @@ import time
 import uuid
 from collections import OrderedDict
 
+try:  # posix only; the file backend falls back to post-then-reverify
+    import fcntl
+except ImportError:  # pragma: no cover
+    fcntl = None
+
 from distributed_machine_learning_tpu.runtime import coordinator as _coord
 
 TRANSPORT_BACKENDS = ("file", "inproc", "tcp")
@@ -448,6 +453,9 @@ class FileTransport(GangTransport):
         # pointed at a post-mortem mount, or a typo'd path) must not
         # mutate the filesystem.
         self._dir_ready = False
+        # Orphaned-claim GC state: claim path -> (stat signature,
+        # monotonic time this handle first saw that signature).
+        self._claim_seen: dict[str, tuple] = {}
 
     def _ensure_dir(self) -> None:
         if not self._dir_ready:
@@ -583,8 +591,14 @@ class FileTransport(GangTransport):
     # an atomic os.rename before reading it, so two competing takers can
     # never both consume the same request.  File names carry a
     # per-handle counter (FIFO per writer) plus a uuid suffix so
-    # concurrent writers never collide.
+    # concurrent writers never collide.  A claim orphaned by a crashed
+    # taker (renamed but never read+removed) is garbage-collected: a
+    # claim a scanner observes with an UNCHANGED stat signature for
+    # ``_TAKE_ORPHAN_S`` of its own monotonic clock (change-signatures,
+    # never cross-host wall time — DML001) is renamed back to its spool
+    # name, restoring it to takes, retire reclaim, and the queued count.
     _SERVING_DIR = "serving"
+    _TAKE_ORPHAN_S = 30.0
 
     def _serving_path(self, *parts) -> str:
         return os.path.join(self.gang_dir, self._SERVING_DIR, *parts)
@@ -604,12 +618,13 @@ class FileTransport(GangTransport):
             return None
         return entry if isinstance(entry, dict) else None
 
-    def _spool_push(self, subdir: str, payload: dict) -> None:
+    def _spool_push(self, subdir: str, payload: dict) -> str:
         self._ensure_dir()
         d = self._serving_path(subdir)
         os.makedirs(d, exist_ok=True)
-        _coord._write_atomic(os.path.join(d, self._serving_seq_name()),
-                             payload)
+        path = os.path.join(d, self._serving_seq_name())
+        _coord._write_atomic(path, payload)
+        return path
 
     def _spool_take(self, subdir: str, max_n: int) -> list[dict]:
         d = self._serving_path(subdir)
@@ -618,7 +633,32 @@ class FileTransport(GangTransport):
         except OSError:
             return []
         out: list[dict] = []
+        claims: set[str] = set()
         for name in names:
+            if ".take" in name:
+                # GC an orphaned claim: the taker crashed between its
+                # rename and the read+remove.  Staleness is this
+                # handle's monotonic clock over an unchanged stat
+                # signature; once stale, the claim is renamed back to
+                # its spool name and is claimable on the next scan.
+                path = os.path.join(d, name)
+                claims.add(path)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    self._claim_seen.pop(path, None)
+                    continue
+                sig = (st.st_mtime_ns, st.st_size)
+                seen = self._claim_seen.get(path)
+                now = time.monotonic()
+                if seen is None or seen[0] != sig:
+                    self._claim_seen[path] = (sig, now)
+                elif now - seen[1] > self._TAKE_ORPHAN_S:
+                    with contextlib.suppress(OSError):
+                        os.rename(path, os.path.join(
+                            d, name.split(".take", 1)[0]))
+                    self._claim_seen.pop(path, None)
+                continue
             if len(out) >= max_n or not name.endswith(".json"):
                 continue
             path = os.path.join(d, name)
@@ -632,6 +672,11 @@ class FileTransport(GangTransport):
                 os.remove(claimed)
             if entry is not None:
                 out.append(entry)
+        # Forget claims that vanished (their takers finished normally).
+        prefix = d + os.sep
+        for p in [p for p in self._claim_seen
+                  if p.startswith(prefix) and p not in claims]:
+            self._claim_seen.pop(p, None)
         return out
 
     def _do_push_request(self, replica: int, payload: dict) -> None:
@@ -640,15 +685,56 @@ class FileTransport(GangTransport):
     def _do_take_requests(self, replica: int, max_n: int) -> list[dict]:
         return self._spool_take(f"requests_r{replica}", max_n)
 
+    @contextlib.contextmanager
+    def _replica_fence(self, replica: int):
+        """Cross-process mutual exclusion between a result post's
+        epoch check + spool push and ``retire_replica``'s epoch bump —
+        the file-backend equivalent of the hub lock the in-proc fence
+        holds, so the 'checked atomically with the append' contract is
+        real, not check-then-act.  No-op without fcntl (the post path
+        re-verifies after the push instead)."""
+        if fcntl is None:
+            yield
+            return
+        self._ensure_dir()
+        os.makedirs(self._serving_path(), exist_ok=True)
+        fd = os.open(self._serving_path(f"fence_r{replica}.lock"),
+                     os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            with contextlib.suppress(OSError):
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
     def _do_post_result(self, replica: int, epoch: int,
                         payload: dict) -> bool:
-        cur = self._read_json(
-            self._serving_path(f"epoch_r{replica}.json")) or {}
-        if int(epoch) != int(cur.get("epoch", 0)):
-            return False
-        self._spool_push("results",
-                         dict(payload, replica=replica, epoch=int(epoch)))
-        return True
+        epoch_path = self._serving_path(f"epoch_r{replica}.json")
+        with self._replica_fence(replica):
+            cur = self._read_json(epoch_path) or {}
+            if int(epoch) != int(cur.get("epoch", 0)):
+                return False
+            posted = self._spool_push(
+                "results",
+                dict(payload, replica=replica, epoch=int(epoch)))
+        if fcntl is not None:
+            return True
+        # Lock-free fallback: a retire_replica may have bumped the
+        # epoch between the read and the push.  Re-verify and reclaim
+        # the stale-epoch file; if the router consumed it first, it
+        # was delivered (the router's ledger dedups regardless).
+        cur = self._read_json(epoch_path) or {}
+        if int(epoch) == int(cur.get("epoch", 0)):
+            return True
+        claimed = f"{posted}.take{os.getpid()}.{threading.get_ident()}"
+        try:
+            os.rename(posted, claimed)
+        except OSError:
+            return True
+        with contextlib.suppress(OSError):
+            os.remove(claimed)
+        return False
 
     def _do_take_results(self, max_n: int) -> list[dict]:
         return self._spool_take("results", max_n)
@@ -668,11 +754,12 @@ class FileTransport(GangTransport):
     def _do_retire_replica(self, replica: int) -> list[dict]:
         self._ensure_dir()
         os.makedirs(self._serving_path(), exist_ok=True)
-        cur = self._read_json(
-            self._serving_path(f"epoch_r{replica}.json")) or {}
-        _coord._write_atomic(
-            self._serving_path(f"epoch_r{replica}.json"),
-            {"epoch": int(cur.get("epoch", 0)) + 1})
+        with self._replica_fence(replica):
+            cur = self._read_json(
+                self._serving_path(f"epoch_r{replica}.json")) or {}
+            _coord._write_atomic(
+                self._serving_path(f"epoch_r{replica}.json"),
+                {"epoch": int(cur.get("epoch", 0)) + 1})
         self._do_set_role(replica, "spare")
         with contextlib.suppress(OSError):
             os.remove(self._serving_path(f"drain_r{replica}.json"))

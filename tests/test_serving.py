@@ -227,6 +227,143 @@ def test_make_serving_step_seam_matches_generate():
         step([[1], []])
 
 
+def test_late_result_after_requeue_is_not_redispatched():
+    """REVIEW fix: a rid requeued by an eviction and then completed by
+    the dead replica's late-collected result must NOT be dispatched
+    again off the queue — re-dispatching a done rid reset it to
+    "dispatched", drove the open count negative when the survivor
+    answered too, failed the exactly-once audit, and hung wait_idle."""
+    hub = InProcHub()
+    tx = InProcTransport(hub)
+    router = ServingRouter(
+        InProcTransport(hub),
+        ServingConfig(replicas=1, replica_timeout_s=60.0))
+    tx.announce_join(0, {"rank": 0, "spare": True, "kind": "serving",
+                         "time": time.time()})
+    router.pump()
+    assert sorted(router._replicas) == [0]
+    rid = router.submit([1, 2])
+    router.pump()  # dispatched to replica 0
+    # Replica 0 serves the request, but BEFORE the router collects the
+    # result it judges 0 dead and evicts it — requeueing the rid.
+    reqs = tx.take_requests(0, 8)
+    assert [r["rid"] for r in reqs] == [rid]
+    assert tx.post_result(0, reqs[0]["epoch"],
+                          {"rid": rid, "output": [9]}) is True
+    with router._lock:
+        router._evict_locked(0, "test: presumed dead", time.monotonic())
+    assert router.result(rid)["state"] == "queued"
+    # A survivor joins; the next pump collects the late result FIRST,
+    # then must skip the stale queue entry instead of re-dispatching.
+    tx.announce_join(1, {"rank": 1, "spare": True, "kind": "serving",
+                         "time": time.time()})
+    router.pump()
+    assert router.result(rid)["state"] == "done"
+    with router._lock:
+        assert router._replicas[1].in_flight == set()
+    assert tx.take_requests(1, 8) == []
+    verdict = router.audit()
+    assert verdict["exactly_once"], verdict
+    assert verdict["completed"] == 1 and verdict["open"] == 0
+    assert verdict["duplicates_discarded"] == 0
+    assert router.wait_idle(1.0)
+
+
+class _StaleReadTx(InProcTransport):
+    """Forces the retired-and-re-promoted race deterministically: the
+    first time the worker observes its rank live, retire + re-promote
+    the rank and push a request stamped with the NEW epoch — then hand
+    the worker the pre-retire (stale) view it just read."""
+
+    def __init__(self, hub, admin, rank):
+        super().__init__(hub)
+        self._admin = admin
+        self._rank = rank
+        self._raced = False
+
+    def read_serving(self, replica=None):
+        state = super().read_serving(replica)
+        if (not self._raced and replica == self._rank
+                and state.get("role") == "live"):
+            self._raced = True
+            self._admin.retire_replica(self._rank)
+            self._admin.set_serving_role(self._rank, "live")
+            e = self._admin.read_serving(self._rank)["epoch"]
+            self._admin.push_request(self._rank, {
+                "rid": "z", "prompt": [1, 2], "epoch": e})
+        return state
+
+
+def test_worker_repushes_requests_stamped_with_a_newer_epoch():
+    """REVIEW fix: rank retired and re-promoted between the worker's
+    serving read and its take — the taken requests carry the NEW
+    epoch.  The worker must push them back and rebind instead of
+    running them under the stale bound (where every post is fenced and
+    the requests strand in the new replica's in-flight set forever,
+    since the rank keeps beating and is never evicted)."""
+    hub = InProcHub()
+    admin = InProcTransport(hub)
+    worker_tx = _StaleReadTx(hub, admin, rank=4)
+    stop = threading.Event()
+    t, out = start_worker_thread(
+        worker_tx, 4, _step, stop,
+        ServingWorkerConfig(heartbeat_interval=0.01))
+    deadline = time.monotonic() + 10.0
+    while 4 not in admin.read_joins():
+        assert time.monotonic() < deadline, "spare never announced"
+        time.sleep(0.002)
+    admin.set_serving_role(4, "live")  # epoch 0; the racer moves the
+    # rank to epoch 1 on the worker's next serving read.
+    results = []
+    while not results:
+        assert time.monotonic() < deadline, "request z never served"
+        results = admin.take_results(8)
+        time.sleep(0.002)
+    stop.set()
+    t.join(5.0)
+    assert [r["rid"] for r in results] == ["z"]
+    assert results[0]["epoch"] == 1  # served under the REBOUND epoch
+    assert out["repushed"] == 1 and out["served"] == 1
+    assert out["fenced"] == 0 and out["restores"] == 2
+
+
+def test_completed_entries_compact_and_late_duplicates_classify():
+    """REVIEW fix: the ledger retains at most ``retain_done`` completed
+    entries (prompt/result payloads are dropped; counters keep the
+    audit exact), and a very late duplicate for a compacted rid still
+    counts as a duplicate, never an unknown result."""
+    hub = InProcHub()
+    tx = InProcTransport(hub)
+    router = ServingRouter(
+        InProcTransport(hub),
+        ServingConfig(replicas=1, replica_timeout_s=60.0,
+                      retain_done=3))
+    tx.announce_join(0, {"rank": 0, "spare": True, "kind": "serving",
+                         "time": time.time()})
+    router.pump()
+    rids = [router.submit([i]) for i in range(8)]
+    deadline = time.monotonic() + 10.0
+    while router.completed < 8:
+        assert time.monotonic() < deadline, router.audit()
+        router.pump()
+        for req in tx.take_requests(0, 8):
+            tx.post_result(0, req["epoch"],
+                           {"rid": req["rid"], "output": [0]})
+    with router._lock:
+        assert len(router._ledger) == 3
+    assert router.result(rids[0]) is None  # compacted away
+    assert router.result(rids[-1])["state"] == "done"
+    # A dead replica's very late duplicate for a compacted rid.
+    tx.post_result(0, 0, {"rid": rids[0], "output": [0]})
+    router.pump()
+    verdict = router.audit()
+    assert verdict["admitted"] == verdict["completed"] == 8
+    assert verdict["compacted"] == 5
+    assert verdict["exactly_once"], verdict
+    assert verdict["duplicates_discarded"] == 1
+    assert verdict["unknown_results"] == 0
+
+
 # ---------------------------------------------------------------------------
 # Tier-1 campaigns
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ peers within ``peer_timeout_s``.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -357,6 +358,58 @@ def test_serving_state_is_wiped_with_the_gang(backend):
     tx.clear_gang_state(fault_ledger=True)
     fleet = make().read_serving()
     assert fleet["replicas"] == {} and fleet["results"] == 0
+
+
+def test_file_spool_orphaned_take_claim_is_garbage_collected(tmp_path):
+    """REVIEW fix: a taker that crashes between its rename-claim and
+    the read+remove would orphan the request forever (invisible to
+    takes, retire reclaim, and the queued count).  A claim older than
+    the GC threshold is renamed back and becomes claimable again."""
+    tx = FileTransport(tmp_path / "gang")
+    tx._TAKE_ORPHAN_S = 0.05  # shrink the staleness window for the test
+    tx.push_request(0, {"rid": "orphan"})
+    d = os.path.join(tx.gang_dir, "serving", "requests_r0")
+    (name,) = os.listdir(d)
+    os.rename(os.path.join(d, name),
+              os.path.join(d, f"{name}.take999.1"))
+    # Invisible while claimed and fresh (the owner may still read it);
+    # this first scan registers the claim's stat signature.
+    assert tx.take_requests(0, 8) == []
+    assert tx.read_serving(0)["queued"] == 0
+    # Unchanged past the staleness window: the next scan restores it,
+    # the one after claims it.
+    time.sleep(0.1)
+    assert tx.take_requests(0, 8) == []
+    assert tx.read_serving(0)["queued"] == 1
+    assert [r["rid"] for r in tx.take_requests(0, 8)] == ["orphan"]
+
+
+def test_file_post_result_reverify_reclaims_on_raced_retire(
+        tmp_path, monkeypatch):
+    """REVIEW fix: the file backend's epoch fence must be atomic with
+    the result push.  Without fcntl it falls back to push-then-
+    reverify: a ``retire_replica`` landing between the epoch read and
+    the push must not leave a stale-epoch result in the spool."""
+    from distributed_machine_learning_tpu.runtime import (
+        transport as transport_mod,
+    )
+    monkeypatch.setattr(transport_mod, "fcntl", None)
+    tx = FileTransport(tmp_path / "gang")
+    tx.set_serving_role(0, "live")
+    real_push = tx._spool_push
+
+    def racing_push(subdir, payload):
+        path = real_push(subdir, payload)
+        FileTransport(tx.gang_dir).retire_replica(0)  # the TOCTOU race
+        return path
+
+    monkeypatch.setattr(tx, "_spool_push", racing_push)
+    assert tx.post_result(0, 0, {"rid": "stale"}) is False
+    monkeypatch.setattr(tx, "_spool_push", real_push)
+    assert tx.take_results(8) == []  # the stale file was reclaimed
+    # The post-retire epoch serves normally.
+    assert tx.post_result(0, 1, {"rid": "ok"}) is True
+    assert [r["rid"] for r in tx.take_results(8)] == ["ok"]
 
 
 # ---------------------------------------------------------------------------
