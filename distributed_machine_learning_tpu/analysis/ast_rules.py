@@ -1050,6 +1050,131 @@ def check_check_then_act(ctx: FileContext) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# DML015 — serving spans / stage journeys must close on every exit (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+# Stage names that OPEN work on a request (a replica has taken
+# ownership and the stage histograms now expect a terminal stamp) vs
+# the stamps that END a journey leg.  ``taken`` is deliberately NOT an
+# open stage: the ``take_requests`` wrapper stamps it and returns —
+# ownership of the close belongs to the worker loop consuming the
+# batch, which the rule checks separately.
+_OPEN_STAGE_NAMES = frozenset({"bound", "computed"})
+_TERMINAL_STAGE_NAMES = frozenset(
+    {"posted", "completed", "requeued", "fenced", "dropped"})
+
+
+def _stage_of_stamp(node: ast.Call) -> str | None:
+    """Literal stage name of a ``stamp_stage(payload, "stage", …)``
+    call, else None (dynamic stage names are invisible to this rule)."""
+    if _call_name(node).split(".")[-1] != "stamp_stage":
+        return None
+    if (len(node.args) >= 2 and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)):
+        return node.args[1].value
+    return None
+
+
+def _with_item_names(scope_body: list) -> set[str]:
+    """Names appearing inside a ``with``-item context expression in the
+    scope — ``span = tel.span(…) if tel else nullcontext()`` followed by
+    ``with span:`` is the sanctioned conditional-span idiom."""
+    names: set[str] = set()
+    for node in _walk_scope(scope_body, skip_functions=True):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+    return names
+
+
+@_rule(
+    "DML015",
+    "serving span or stage journey opened without a close on every exit",
+    "ISSUE 17: per-stage histograms and merged Perfetto timelines are "
+    "only trustworthy when every span object reaches __exit__ and every "
+    "open-stage stamp (bound/computed) has a terminal stamp "
+    "(posted/completed/requeued/fenced/dropped) reachable in the same "
+    "function — an abandoned span or journey silently skews stage "
+    "latencies and hides the very stall the trace exists to show.",
+    _pkg_or_tools,
+)
+def check_unclosed_serving_spans(ctx: FileContext) -> Iterator[Finding]:
+    """Two shapes of abandoned observability state:
+
+    A. a ``…span(…)`` tracer call that is not context-managed — not a
+       ``with`` item, not returned (the ``Telemetry.span`` forwarding
+       idiom: the caller manages it), not handed to ``enter_context``,
+       and not assigned to a name later used as a ``with`` item in the
+       same scope.  Any exception then skips ``__exit__`` and the trace
+       keeps a torn span;
+    B. a function that stamps an OPEN stage (``bound``/``computed``)
+       but contains NO terminal stamp anywhere — no exit path of that
+       function can ever close the journey it opened, so a fence,
+       requeue, or crash leaves the record dangling.
+    """
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if not (name == "span" or name.endswith(".span")):
+            continue
+        managed = False
+        assigned: set[str] = set()
+        scope_body = ctx.tree.body
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.withitem, ast.Return)):
+                managed = True
+                break
+            if (isinstance(anc, ast.Call)
+                    and _call_name(anc).split(".")[-1]
+                    == "enter_context"):
+                managed = True
+                break
+            if isinstance(anc, (ast.Assign, ast.AnnAssign,
+                                ast.NamedExpr)):
+                targets = (anc.targets if isinstance(anc, ast.Assign)
+                           else [anc.target])
+                for t in targets:
+                    assigned.update(_assigned_names(t))
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope_body = anc.body
+                break
+        if managed:
+            continue
+        if assigned and assigned & _with_item_names(scope_body):
+            continue
+        yield ctx.finding(
+            "DML015", node,
+            f"span object from {name!r} is never context-managed — "
+            "not a `with` item, not returned, not enter_context-ed, "
+            "not assigned to a name a later `with` uses; an exception "
+            "skips its __exit__ and the trace keeps a torn span",
+        )
+    for fn in _functions(ctx.tree):
+        opens: list[tuple[str, ast.Call]] = []
+        closes = False
+        for node in _walk_scope(fn.body, skip_functions=True):
+            if not isinstance(node, ast.Call):
+                continue
+            stage = _stage_of_stamp(node)
+            if stage in _OPEN_STAGE_NAMES:
+                opens.append((stage, node))
+            elif stage in _TERMINAL_STAGE_NAMES:
+                closes = True
+        if opens and not closes:
+            stage, node = opens[0]
+            yield ctx.finding(
+                "DML015", node,
+                f"{fn.name} stamps open stage {stage!r} but contains "
+                "no terminal stamp (posted/completed/requeued/fenced/"
+                "dropped) — no exit path of this function can close "
+                "the journey it opened",
+            )
+
+
+# ---------------------------------------------------------------------------
 # Runner
 # ---------------------------------------------------------------------------
 

@@ -35,6 +35,23 @@ The decode step is synthetic by default (echo + checksum token, with
 ``--service-time`` of simulated work) so the fleet story is testable
 without a model; ``inference/generate.py::make_serving_step`` is the
 production step-callable this slot takes.
+
+Observability (ISSUE 17): ``--telemetry-dir`` gives every serving
+process its own instance-tagged stream (``registry.router.json`` with
+the per-stage latency histograms, ``trace.router.json`` +
+``trace.replica<r>.json`` request spans that ``tools/trace_merge.py``
+fuses into one Perfetto timeline), and repeatable ``--slo`` objectives
+(``p99<=250ms``, ``reject_ratio<=5%``) run a live burn-rate engine
+whose end-of-run verdict fails the exit status:
+
+    python -m distributed_machine_learning_tpu.cli.serve \
+        --replicas 2 --spares 1 --requests 100 \
+        --gang-dir /tmp/serve --telemetry-dir /tmp/serve/telemetry \
+        --slo 'p99<=250ms' --slo 'reject_ratio<=0.05'
+
+``tools/serve_status.py /tmp/serve`` then renders the per-stage
+quantiles, per-replica compute skew, and SLO burn state — and
+``--postmortem RID`` one request's full stage-event timeline.
 """
 
 from __future__ import annotations
@@ -70,6 +87,19 @@ def _parse_tx_chaos(spec: str):
         f"bad --tx-chaos {spec!r} (expected partition@AFTER_OPS)")
 
 
+def _instance_telemetry(args, instance: str):
+    """One instance-tagged Telemetry over ``--telemetry-dir`` (or None
+    when the flag is unset).  ``enabled=True`` bypasses the rank-0
+    gate: every serving process owns its own stream — the collision
+    safety comes from the instance tag, not from writing nothing."""
+    if not args.telemetry_dir:
+        return None
+    from distributed_machine_learning_tpu.telemetry import Telemetry
+
+    return Telemetry(args.telemetry_dir, instance=instance,
+                     enabled=True)
+
+
 def _run_worker(args) -> int:
     from distributed_machine_learning_tpu.runtime.serving_worker import (
         ServingWorkerConfig,
@@ -82,9 +112,15 @@ def _run_worker(args) -> int:
     chaos = _parse_tx_chaos(args.tx_chaos) if args.tx_chaos else None
     tx = make_transport("tcp", address=args.address, chaos=chaos)
     stop = threading.Event()
-    summary = run_serving_worker(
-        tx, args.rank, synthetic_step(args.service_time), stop,
-        ServingWorkerConfig(micro_batch=args.micro_batch))
+    tel = _instance_telemetry(args, f"replica{args.rank}")
+    try:
+        summary = run_serving_worker(
+            tx, args.rank, synthetic_step(args.service_time), stop,
+            ServingWorkerConfig(micro_batch=args.micro_batch),
+            telemetry=tel)
+    finally:
+        if tel is not None:
+            tel.close()
     print(f"worker rank {args.rank}: {summary}")
     return 0
 
@@ -128,6 +164,20 @@ def _run_fleet(args) -> int:
         make_tx = lambda: TcpTransport(address,  # noqa: E731
                                        backoff_s=0.01)
 
+    slo = None
+    if args.slo:
+        from distributed_machine_learning_tpu.telemetry.slo import (
+            SLOEngine,
+        )
+
+        slo = SLOEngine(args.slo,
+                        short_window_s=args.slo_short_window,
+                        long_window_s=args.slo_long_window,
+                        burn_threshold=args.slo_burn_threshold)
+    router_tel = _instance_telemetry(args, "router")
+    worker_tels = [_instance_telemetry(args, f"replica{rank}")
+                   for rank in range(world)]
+
     events = FaultEvents()
     router = ServingRouter(
         make_tx(),
@@ -135,12 +185,13 @@ def _run_fleet(args) -> int:
                       max_queue=args.max_queue,
                       micro_batch=args.micro_batch,
                       replica_timeout_s=args.replica_timeout),
-        events=events)
+        events=events, telemetry=router_tel, slo=slo)
     stop = threading.Event()
     wcfg = ServingWorkerConfig(micro_batch=args.micro_batch)
     workers = [start_worker_thread(make_tx(), rank,
                                    synthetic_step(args.service_time),
-                                   stop, wcfg)
+                                   stop, wcfg,
+                                   telemetry=worker_tels[rank])
                for rank in range(world)]
     router_thread = threading.Thread(target=router.run, args=(stop,),
                                      name="serve-router", daemon=True)
@@ -178,6 +229,9 @@ def _run_fleet(args) -> int:
         for t, _ in workers:
             t.join(timeout=5)
         router_thread.join(timeout=5)
+        for tel in (router_tel, *worker_tels):
+            if tel is not None:
+                tel.close()
         if server is not None:
             server.stop()
 
@@ -195,12 +249,23 @@ def _run_fleet(args) -> int:
               f"p95 {lat['p95'] * 1e3:.1f} ms  "
               f"p99 {lat['p99'] * 1e3:.1f} ms")
     print(resilience_summary(events))
+    rc = 0
+    if slo is not None:
+        from distributed_machine_learning_tpu.telemetry.slo import (
+            format_verdict,
+        )
+
+        slo_verdict = slo.verdict()
+        print(format_verdict(slo_verdict))
+        if not slo_verdict["ok"]:
+            print("FAILED: SLO objectives violated", file=sys.stderr)
+            rc = 1
     if not ok or not verdict["exactly_once"]:
         print("FAILED: not every admitted request completed exactly "
               "once", file=sys.stderr)
         return 1
     print("exactly-once audit: PASS")
-    return 0
+    return rc
 
 
 def main(argv=None) -> int:
@@ -239,6 +304,28 @@ def main(argv=None) -> int:
     ap.add_argument("--gang-dir", dest="gang_dir", default=None,
                     help="file backend directory / inproc+tcp ledger "
                          "mirror for post-mortem gang_status")
+    ap.add_argument("--telemetry-dir", dest="telemetry_dir",
+                    default=None,
+                    help="per-instance telemetry artifacts (router + "
+                         "one stream per replica): stage histograms "
+                         "in registry.router.json, request spans in "
+                         "trace.<instance>.json for trace_merge")
+    ap.add_argument("--slo", action="append", default=[],
+                    metavar="SPEC",
+                    help="declare an objective, e.g. p99<=250ms or "
+                         "reject_ratio<=0.05 (repeatable); the run "
+                         "fails when one is violated or its burn-rate "
+                         "alert fires")
+    ap.add_argument("--slo-short-window", dest="slo_short_window",
+                    type=float, default=5.0,
+                    help="burn-rate short window, seconds")
+    ap.add_argument("--slo-long-window", dest="slo_long_window",
+                    type=float, default=60.0,
+                    help="burn-rate long window, seconds")
+    ap.add_argument("--slo-burn-threshold", dest="slo_burn_threshold",
+                    type=float, default=2.0,
+                    help="alert when BOTH windows burn error budget "
+                         "above this multiple of the sustainable rate")
     ap.add_argument("--address", default=None,
                     help="worker mode: host:port of the fleet's gang "
                          "server")

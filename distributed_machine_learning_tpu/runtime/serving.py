@@ -43,6 +43,19 @@ for the ``resilience_summary`` rows.  Lifecycle edges append
 ``serve_promote`` / ``serve_evict`` / ``serve_demote`` health-ledger
 events and :meth:`close` appends a final ``serving`` summary record —
 what ``tools/gang_status.py`` renders as the serving view.
+
+Request-scoped tracing (ISSUE 17): every admitted request carries a
+stage-event record (see ``runtime/transport.py::SERVING_STAGES``) the
+router opens at admission and closes at completion; worker-side stamps
+merge back in with the posted result.  At completion the rank-local
+stage deltas feed ``serving_stage_latency_s{stage=...}`` histograms,
+the shared :class:`StragglerDetector` (via
+``telemetry.aggregator.serving_stage_samples`` — the ``computed``
+deltas ARE the per-replica service times, replacing the old beat-borne
+copy), an optional :class:`~..telemetry.slo.SLOEngine`, and — when
+``record_requests`` is on — a ``serve_request`` health-ledger record
+``tools/serve_status.py --postmortem RID`` reconstructs timelines
+from.
 """
 
 from __future__ import annotations
@@ -53,14 +66,34 @@ import threading
 import time
 
 from distributed_machine_learning_tpu.runtime.faults import FaultEvents
+from distributed_machine_learning_tpu.runtime.transport import (
+    stamp_stage,
+)
 from distributed_machine_learning_tpu.telemetry import get_telemetry
 from distributed_machine_learning_tpu.telemetry.aggregator import (
     StragglerDetector,
+    serving_stage_samples,
 )
 from distributed_machine_learning_tpu.telemetry.registry import (
     Histogram,
     default_latency_buckets,
 )
+
+# Stages whose rank-local deltas are observed into the per-stage
+# latency histograms at completion.  On the happy path that is the
+# full journey decomposition: ``queued`` (admission → queue append,
+# router clock), ``dispatched`` (queue wait, router clock), ``bound``
+# (fence check after take, replica clock), ``computed`` (the compute
+# interval, replica clock), ``posted`` (result append, replica clock),
+# ``completed`` (dispatch → collection round trip, router clock).
+# ``requeued`` rides along on the failure path — the time a request
+# sat on a replica that died under it.  ``admitted``/``taken`` open
+# each actor's local chain (dt is None by construction: the prior
+# stamp crossed a process boundary) and ``fenced``/``dropped`` record
+# discards, so none of those carry durations.
+_HISTOGRAM_STAGES = frozenset(
+    {"queued", "dispatched", "bound", "computed", "posted",
+     "completed", "requeued"})
 
 
 class Overloaded(RuntimeError):
@@ -87,6 +120,7 @@ class ServingConfig:
     grow_watermark: float = 0.75     # queue fraction that counts as pressure
     grow_patience: int = 5           # consecutive pressured pumps to grow
     retain_done: int = 1024          # completed entries kept in the ledger
+    record_requests: bool = True     # serve_request ledger records (ISSUE 17)
 
 
 @dataclasses.dataclass
@@ -109,10 +143,12 @@ class ServingRouter:
     :meth:`pump` (or :meth:`run` on its own thread)."""
 
     def __init__(self, transport, config: ServingConfig | None = None,
-                 events: FaultEvents | None = None):
+                 events: FaultEvents | None = None, *,
+                 telemetry=None, slo=None):
         self.tx = transport
         self.cfg = config or ServingConfig()
         self.events = events if events is not None else FaultEvents()
+        self.slo = slo  # an SLOEngine fed one observe() per outcome
         self._lock = threading.RLock()
         self._queue: collections.deque[str] = collections.deque()
         self._ledger: dict[str, dict] = {}
@@ -148,21 +184,45 @@ class ServingRouter:
         # The latency histogram exists even with no telemetry sink
         # configured (quantiles feed the SLO assertions directly); with
         # a sink it is the registry's own instrument, so it streams.
-        tel = get_telemetry()
+        # An explicit ``telemetry=`` beats the process-wide install —
+        # the router may be one of several instances sharing a process
+        # (in-proc fleets), each with its own instance-tagged artifacts.
+        tel = telemetry if telemetry is not None else get_telemetry()
+        self._tel = tel
+        self._stage_hist: dict[str, Histogram] = {}
         if tel is not None:
             self.latency = tel.registry.histogram(
                 "serving_request_latency_s",
                 buckets=default_latency_buckets())
             self._g_replicas = tel.registry.gauge("serving_replicas")
             self._g_depth = tel.registry.gauge("serving_queue_depth")
+            self._g_inflight = tel.registry.gauge("serving_inflight")
             self._c_evict = tel.registry.counter("serving_evictions")
             self._c_reject = tel.registry.counter("serving_rejects")
         else:
             self.latency = Histogram(
                 "serving_request_latency_s", (),
                 buckets=default_latency_buckets())
-            self._g_replicas = self._g_depth = None
+            self._g_replicas = self._g_depth = self._g_inflight = None
             self._c_evict = self._c_reject = None
+
+    def _stage_latency(self, stage: str) -> Histogram:
+        """Get-or-create the ``serving_stage_latency_s{stage=...}``
+        histogram — a registry instrument when telemetry is on (it
+        streams into registry.json), a local one otherwise (quantiles
+        still feed audits and tests)."""
+        h = self._stage_hist.get(stage)
+        if h is None:
+            if self._tel is not None:
+                h = self._tel.registry.histogram(
+                    "serving_stage_latency_s",
+                    buckets=default_latency_buckets(), stage=stage)
+            else:
+                h = Histogram("serving_stage_latency_s",
+                              (("stage", stage),),
+                              buckets=default_latency_buckets())
+            self._stage_hist[stage] = h
+        return h
 
     # -- admission -------------------------------------------------------
     def submit(self, prompt, rid: str | None = None) -> str:
@@ -177,6 +237,8 @@ class ServingRouter:
                 self.events.request_rejects += 1
                 if self._c_reject is not None:
                     self._c_reject.inc()
+                if self.slo is not None:
+                    self.slo.observe(rejected=True)
                 raise Overloaded(
                     f"queue full ({self._open}/{self.cfg.max_queue} "
                     "open requests)")
@@ -185,12 +247,15 @@ class ServingRouter:
                 rid = f"r{self._rid_seq}"
             if rid in self._ledger:
                 raise ValueError(f"duplicate rid {rid!r}")
-            self._ledger[rid] = {
+            entry = {
                 "rid": rid, "prompt": prompt, "state": "queued",
                 "replica": None, "epoch": None, "dispatches": 0,
                 "submit_mono": time.monotonic(), "result": None,
-                "latency_s": None,
+                "latency_s": None, "events": [],
             }
+            stamp_stage(entry, "admitted", "router")
+            stamp_stage(entry, "queued", "router")
+            self._ledger[rid] = entry
             self._queue.append(rid)
             self._open += 1
             return rid
@@ -233,6 +298,11 @@ class ServingRouter:
             if entry is None or entry["state"] != "dispatched":
                 continue
             entry["state"] = "queued"
+            # dt here is dispatched -> requeued on the router clock:
+            # how long the request sat on the replica that just died
+            # (or drained) under it.
+            stamp_stage(entry, "requeued", "router",
+                        replica=entry["replica"])
             entry["replica"] = None
             self._queue.append(rid)
             self.redispatches += 1
@@ -286,6 +356,9 @@ class ServingRouter:
             if self._g_replicas is not None:
                 self._g_replicas.set(len(self._replicas))
                 self._g_depth.set(len(self._queue))
+                self._g_inflight.set(sum(
+                    len(rep.in_flight)
+                    for rep in self._replicas.values()))
 
     def _observe_beats_locked(self, beats: dict, now: float) -> None:
         for rank, rep in list(self._replicas.items()):
@@ -293,10 +366,11 @@ class ServingRouter:
             if entry is not None and entry[0] != rep.sig:
                 rep.sig = entry[0]
                 rep.sig_mono = now
-                payload = entry[1] or {}
-                st = payload.get("service_time_s")
-                if st is not None:
-                    rep.service_s = float(st)
+                # Beats carry LIVENESS only: per-replica service times
+                # now flow from the request event stream (the
+                # ``computed`` stage deltas, see _complete) — one
+                # detector feed shared with training instead of a
+                # second bookkeeping path off the beat channel.
             if now - rep.sig_mono > self.cfg.replica_timeout_s:
                 self._evict_locked(rank, "dead (beat stale)", now)
 
@@ -347,10 +421,16 @@ class ServingRouter:
                 entry["replica"] = rank
                 entry["epoch"] = rep.epoch
                 entry["dispatches"] += 1
+                # dt here is queued -> dispatched on the router clock:
+                # the queue wait.
+                stamp_stage(entry, "dispatched", "router",
+                            disp=entry["dispatches"], replica=rank)
                 rep.in_flight.add(rid)
                 self.tx.push_request(rank, {
                     "rid": rid, "prompt": entry["prompt"],
                     "epoch": rep.epoch,
+                    "dispatch": entry["dispatches"],
+                    "events": entry["events"],
                 })
 
     def _grow_locked(self, now: float) -> None:
@@ -380,6 +460,7 @@ class ServingRouter:
             self._promote_locked(rank, now)
 
     def _complete(self, res: dict, now: float) -> None:
+        record = None
         with self._lock:
             rid = res.get("rid")
             entry = self._ledger.get(rid)
@@ -393,8 +474,10 @@ class ServingRouter:
                 # First-result-wins: the replica died AFTER posting but
                 # before the router observed it, so the rid was
                 # re-dispatched and a survivor answered too.  One
-                # delivery, one counted duplicate.
+                # delivery, one counted duplicate — recorded on the
+                # winner's timeline so a postmortem shows the race.
                 self.duplicates_discarded += 1
+                stamp_stage(entry, "dropped", "router", why="duplicate")
                 return
             owner = self._replicas.get(entry.get("replica"))
             if owner is not None:
@@ -403,7 +486,45 @@ class ServingRouter:
             entry["state"] = "done"
             entry["result"] = res.get("output")
             entry["latency_s"] = now - entry["submit_mono"]
+            # Merge the worker-side journey (taken/bound/computed/
+            # posted, stamped on the replica's own clock) into the
+            # authoritative ledger record, then close it.  Router
+            # stamps in the posted copy would be duplicates of what
+            # the ledger already holds.
+            for ev in res.get("events") or ():
+                if isinstance(ev, dict) and ev.get("by") != "router":
+                    entry["events"].append(dict(ev))
+            # dt here is dispatched -> completed on the router clock:
+            # the full dispatch round trip (the worker stages nest
+            # inside it — summing them alongside would double-count).
+            stamp_stage(entry, "completed", "router")
             self.latency.observe(entry["latency_s"])
+            for ev in entry["events"]:
+                dt = ev.get("dt")
+                if dt is not None and ev["stage"] in _HISTOGRAM_STAGES:
+                    self._stage_latency(ev["stage"]).observe(dt)
+            # The straggler feed (shared detector code path): the
+            # ``computed`` deltas are per-replica compute intervals.
+            for rank, dur in serving_stage_samples(
+                    entry["events"], stage="computed").items():
+                rep = self._replicas.get(rank)
+                if rep is not None:
+                    rep.service_s = dur
+            if self.slo is not None:
+                self.slo.observe(latency_s=entry["latency_s"])
+            if self._tel is not None:
+                tr = self._tel.tracer
+                t1 = tr.now()
+                tr.complete("request", t1 - entry["latency_s"], t1,
+                            rid=rid, dispatches=entry["dispatches"],
+                            replica=entry.get("replica"))
+            if self.cfg.record_requests:
+                record = {
+                    "rid": rid, "state": "done",
+                    "latency_s": entry["latency_s"],
+                    "dispatches": entry["dispatches"],
+                    "events": [dict(ev) for ev in entry["events"]],
+                }
             self.completed += 1
             self._open -= 1
             self._done_fifo.append(rid)
@@ -414,6 +535,10 @@ class ServingRouter:
                 self._tombstones[old] = None
                 while len(self._tombstones) > self._tombstone_cap:
                     self._tombstones.popitem(last=False)
+        if record is not None:
+            # Outside the lock: on tcp this is a network round trip,
+            # and submit() from client threads must not block on it.
+            self.tx.append_health_event("serve_request", **record)
 
     # -- driving ---------------------------------------------------------
     def run(self, stop_event: threading.Event) -> None:
@@ -468,6 +593,9 @@ class ServingRouter:
                 "exactly_once": (self._open == 0
                                  and states.get("done", 0) == admitted),
                 "latency": q,
+                "stage_latency": {
+                    s: h.quantiles()
+                    for s, h in sorted(self._stage_hist.items())},
             }
 
     def close(self) -> dict:

@@ -41,6 +41,8 @@ import time
 from distributed_machine_learning_tpu.runtime.transport import (
     GangTransport,
     TransportError,
+    carry_stage_context,
+    stamp_stage,
 )
 
 
@@ -54,7 +56,8 @@ class ServingWorkerConfig:
 def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                        stop_event: threading.Event,
                        cfg: ServingWorkerConfig | None = None, *,
-                       prefetch_fn=None, on_restore=None) -> dict:
+                       prefetch_fn=None, on_restore=None,
+                       telemetry=None) -> dict:
     """Drive one replica until ``stop_event`` (a campaign's kill switch
     doubles as the worker's death) or the control plane severs.
 
@@ -63,11 +66,23 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
     while spare, returns the newest verified checkpoint step to
     advertise.  ``on_restore(prefetched_step)``: called once per
     promotion — where a real replica restores params (O(restore));
-    tests count the calls.
+    tests count the calls.  ``telemetry``: this replica's own
+    instance-tagged :class:`~..telemetry.Telemetry` — one ``request``
+    span per take→outcome lands in its Chrome trace, which
+    ``tools/trace_merge.py`` re-homes next to the router's track.
+
+    Requests that carry an ``events`` record (ISSUE 17) are stamped at
+    every stage on THIS replica's monotonic clock: ``taken`` (in the
+    transport wrapper), ``bound`` after the fence check, ``computed``
+    after ``step_fn``, ``posted`` at the post (wrapper again) — and on
+    the failure paths ``requeued`` (newer-epoch repush) / ``fenced``
+    (zombie drop), so every exit closes the record.
 
     Returns a summary dict (served counts, restores) for audits.
     """
     cfg = cfg or ServingWorkerConfig()
+    tracer = telemetry.tracer if telemetry is not None else None
+    by = f"replica{rank}"
     seq = 0
     served = 0
     fenced = 0
@@ -120,6 +135,7 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
             if not reqs:
                 stop_event.wait(cfg.poll_s)
                 continue
+            t_take = time.perf_counter()
             # Fence check BEFORE compute: the router stamps every
             # request with its dispatch epoch.  A stamp NEWER than the
             # bound means this rank was retired and re-promoted between
@@ -142,8 +158,25 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                     newer.append(r)
                 else:
                     fenced += 1
+                    if isinstance(r.get("events"), list):
+                        stamp_stage(r, "fenced", by, epoch=e,
+                                    bound=bound_epoch)
+                    if tracer is not None:
+                        tracer.complete("request", t_take,
+                                        time.perf_counter(),
+                                        rid=r.get("rid"), rank=rank,
+                                        stage="fenced")
             if newer:
                 for r in newer:
+                    if isinstance(r.get("events"), list):
+                        stamp_stage(r, "requeued", by,
+                                    epoch=r.get("epoch"),
+                                    bound=bound_epoch)
+                    if tracer is not None:
+                        tracer.complete("request", t_take,
+                                        time.perf_counter(),
+                                        rid=r.get("rid"), rank=rank,
+                                        stage="requeued")
                     tx.push_request(rank, r)
                 repushed += len(newer)
             reqs = keep
@@ -152,14 +185,30 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                     continue  # rebind via read_serving first
                 stop_event.wait(cfg.poll_s)
                 continue
+            for r in reqs:
+                if isinstance(r.get("events"), list):
+                    # dt: taken -> bound, the fence-check interval.
+                    stamp_stage(r, "bound", by, epoch=bound_epoch)
             t0 = time.perf_counter()
             outs = step_fn([r.get("prompt") for r in reqs])
             last_service = time.perf_counter() - t0
+            for r in reqs:
+                if isinstance(r.get("events"), list):
+                    # dt: bound -> computed, this replica's compute
+                    # interval — the straggler detector's sample.
+                    stamp_stage(r, "computed", by)
             for req, out in zip(reqs, outs):
-                ok = tx.post_result(rank, bound_epoch, {
-                    "rid": req.get("rid"), "output": out,
-                    "service_time_s": last_service,
-                })
+                ok = tx.post_result(rank, bound_epoch,
+                                    carry_stage_context(req, {
+                                        "rid": req.get("rid"),
+                                        "output": out,
+                                        "service_time_s": last_service,
+                                    }))
+                if tracer is not None:
+                    tracer.complete("request", t_take,
+                                    time.perf_counter(),
+                                    rid=req.get("rid"), rank=rank,
+                                    stage="posted" if ok else "fenced")
                 if ok:
                     served += 1
                 else:

@@ -120,6 +120,85 @@ class TransportError(RuntimeError):
     gang — peer death, seen from the inside."""
 
 
+# ---------------------------------------------------------------------------
+# Request-scoped stage events (ISSUE 17)
+# ---------------------------------------------------------------------------
+# A serving request that carries an ``events`` list gets one stage
+# record appended at every hop of its journey: router-side (admitted,
+# queued, dispatched, requeued, dropped, completed) and worker-side
+# (taken, bound, computed, posted, fenced).  The record is
+# ``{"stage", "by", "dt"}`` where ``dt`` is the seconds since the SAME
+# actor's previous stamp on this request, measured on that actor's own
+# monotonic clock — or None when the previous stamp came from another
+# process.  The clock anchor rides the payload as the private
+# ``_mono_last``/``_mono_by`` pair and is STRIPPED at every wire
+# crossing (``push_request``/``post_result``), so no timestamp is ever
+# compared across hosts (the DML001 discipline): only rank-local
+# deltas travel.  Stamping for ``taken``/``posted`` lives in the
+# GangTransport base wrappers below, which run exactly once per
+# LOGICAL operation on every backend — tcp retries happen underneath,
+# inside ``_call``, and the op-id dedup returns the original effect,
+# so stage events inherit the same exactly-once guarantee as the ops
+# that carry them.
+
+SERVING_STAGES = ("admitted", "queued", "dispatched", "taken", "bound",
+                  "computed", "posted", "completed",
+                  "requeued", "fenced", "dropped")
+
+# Terminal stages: after one of these, the actor that stamped it holds
+# no further obligation for the request (dmlcheck DML015 keys on this
+# split — an open stage without a terminal stamp on some exit path is
+# an abandoned record that silently skews the stage histograms).
+SERVING_TERMINAL_STAGES = frozenset(
+    {"posted", "completed", "requeued", "fenced", "dropped"})
+
+_STAGE_CLOCK_KEYS = ("_mono_last", "_mono_by")
+
+
+def stamp_stage(payload: dict, stage: str, by: str, **extra) -> dict:
+    """Append one stage event to ``payload["events"]`` and advance the
+    payload's per-actor monotonic anchor.  ``dt`` is filled only when
+    the previous stamp was made by the same ``by`` (same process) —
+    never a cross-host clock comparison."""
+    now = time.monotonic()
+    dt = None
+    if payload.get("_mono_by") == by:
+        last = payload.get("_mono_last")
+        if isinstance(last, (int, float)):
+            dt = now - float(last)
+    payload["_mono_last"] = now
+    payload["_mono_by"] = by
+    ev = {"stage": str(stage), "by": str(by), "dt": dt}
+    if "dispatch" in payload:
+        ev["disp"] = payload["dispatch"]
+    ev.update(extra)
+    payload.setdefault("events", []).append(ev)
+    return ev
+
+
+def strip_stage_clock(payload: dict) -> dict:
+    """Remove the private monotonic anchor before a payload crosses the
+    wire: monotonic values are meaningless in another process, and
+    leaving them attached would invite exactly the cross-host
+    comparison the event schema exists to avoid."""
+    for k in _STAGE_CLOCK_KEYS:
+        payload.pop(k, None)
+    return payload
+
+
+def carry_stage_context(src: dict, dst: dict) -> dict:
+    """Move the trace context (events + dispatch tag + clock anchor)
+    from a taken request onto the result being posted for it, so the
+    worker-side stamps reach the router.  No-op for requests submitted
+    without tracing."""
+    if isinstance(src.get("events"), list):
+        dst["events"] = src["events"]
+        for k in ("dispatch", *_STAGE_CLOCK_KEYS):
+            if k in src:
+                dst[k] = src[k]
+    return dst
+
+
 def append_jsonl_fsync(path: str | os.PathLike, entry: dict) -> None:
     """Append one JSON line to a ledger file, flushed AND fsynced
     before returning (dmlcheck DML002): ledger consumers include
@@ -341,17 +420,34 @@ class GangTransport:
     def push_request(self, replica: int, payload: dict) -> None:
         """Enqueue one request onto ``replica``'s inbound queue.  The
         router stamps each payload with ``rid`` and the replica's
-        serving epoch; the transport treats it as opaque."""
+        serving epoch; the transport treats it as opaque — except the
+        trace context: a payload carrying an ``events`` list has its
+        private monotonic anchor stripped here (monotonic values never
+        cross the wire, DML001) and its events copied so the caller's
+        record cannot alias the queued one."""
         self._count("push_request")
-        self._do_push_request(int(replica), dict(payload))
+        payload = dict(payload)
+        if isinstance(payload.get("events"), list):
+            payload["events"] = [dict(e) for e in payload["events"]]
+            strip_stage_clock(payload)
+        self._do_push_request(int(replica), payload)
 
     def take_requests(self, replica: int, max_n: int = 1) -> list[dict]:
         """Destructively pop up to ``max_n`` pending requests from
         ``replica``'s queue, FIFO.  On tcp the op_id dedup makes a
         retried take return the ORIGINAL batch — a request can be
-        claimed by at most one take."""
+        claimed by at most one take.  Traced requests are stamped
+        ``taken`` here: the wrapper runs in the worker's process on
+        every backend, exactly once per logical take (retries collapse
+        below it), so the stamp is both on the right clock and
+        exactly-once."""
         self._count("take_requests")
-        return self._do_take_requests(int(replica), int(max_n))
+        reqs = self._do_take_requests(int(replica), int(max_n))
+        by = f"replica{int(replica)}"
+        for r in reqs:
+            if isinstance(r.get("events"), list):
+                stamp_stage(r, "taken", by)
+        return reqs
 
     def post_result(self, replica: int, epoch: int,
                     payload: dict) -> bool:
@@ -359,10 +455,19 @@ class GangTransport:
         matches the replica's current serving epoch (checked atomically
         with the append).  Returns False for a fenced (stale-epoch)
         post: a drained/evicted replica's late result is discarded at
-        the hub, never double-delivered."""
+        the hub, never double-delivered.  A traced result is stamped
+        ``posted`` on a COPY of its event record (a fenced post's stamp
+        is discarded with the post — the caller's record never shows a
+        delivery that did not happen), clock anchor stripped before the
+        wire."""
         self._count("post_result")
+        payload = dict(payload)
+        if isinstance(payload.get("events"), list):
+            payload["events"] = [dict(e) for e in payload["events"]]
+            stamp_stage(payload, "posted", f"replica{int(replica)}")
+            strip_stage_clock(payload)
         return bool(self._do_post_result(int(replica), int(epoch),
-                                         dict(payload)))
+                                         payload))
 
     def take_results(self, max_n: int = 16) -> list[dict]:
         """Destructively pop up to ``max_n`` completed results (the

@@ -64,6 +64,13 @@ from distributed_machine_learning_tpu.telemetry.aggregator import (
     aggregate_gang_metrics,
     discover_rank_streams,
     publish_rollup,
+    serving_stage_samples,
+)
+from distributed_machine_learning_tpu.telemetry.slo import (
+    SLOEngine,
+    SLOSpec,
+    format_verdict,
+    parse_slo,
 )
 
 __all__ = [
@@ -74,6 +81,8 @@ __all__ = [
     "GangRollup", "HeartbeatSampler", "StragglerDetector",
     "StragglerVerdict", "aggregate_gang_metrics",
     "discover_rank_streams", "publish_rollup",
+    "serving_stage_samples",
+    "SLOEngine", "SLOSpec", "format_verdict", "parse_slo",
     "Telemetry", "telemetry_from_flags",
     "get_telemetry", "set_telemetry", "instance_file",
 ]

@@ -300,6 +300,39 @@ class StragglerDetector:
         self.flagged.discard(rank)
 
 
+_SERVING_BY_RE = re.compile(r"^replica(\d+)$")
+
+
+def serving_stage_samples(events, stage: str = "computed"
+                          ) -> dict[int, float]:
+    """Per-replica duration samples for one stage out of a request's
+    stage-event record (ISSUE 17) — ``{rank: dt_seconds}`` for every
+    ``stage`` event stamped by a ``replica<r>`` actor with a rank-local
+    delta attached (``dt`` is None when the prior stamp crossed a
+    process boundary; those carry no duration and are skipped).
+
+    This is the serving feed for :class:`StragglerDetector`: the
+    ``computed`` event's ``dt`` is exactly the replica's compute
+    interval (``computed`` − ``bound`` on that replica's own monotonic
+    clock), so serving eviction and training straggler detection judge
+    through one detector code path instead of the router keeping its
+    own service-time bookkeeping off the beat channel.  When a request
+    was attempted on several replicas (requeue after a death), the last
+    sample per rank wins — the freshest observation of that replica.
+    """
+    out: dict[int, float] = {}
+    for ev in events or ():
+        if not isinstance(ev, dict) or ev.get("stage") != stage:
+            continue
+        dt = ev.get("dt")
+        if not isinstance(dt, (int, float)):
+            continue
+        m = _SERVING_BY_RE.match(str(ev.get("by", "")))
+        if m:
+            out[int(m.group(1))] = float(dt)
+    return out
+
+
 @dataclasses.dataclass
 class GangRollup:
     """Everything :func:`aggregate_gang_metrics` derives from a gang's

@@ -1,0 +1,57 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/serving_worker.py
+"""DML015 clean cases: every sanctioned span idiom (with-item,
+conditional span assigned then with-ed, Telemetry.span forwarding via
+return, enter_context) and a worker loop whose stage journey always
+reaches a terminal stamp (requeued/fenced/posted) on every exit path."""
+import contextlib
+
+from distributed_machine_learning_tpu.runtime.transport import stamp_stage
+
+
+def with_item_span(tracer, rid):
+    with tracer.span("request", rid=rid):
+        return do_work(rid)
+
+
+def conditional_span(tel, rid):
+    span = (tel.span("request", rid=rid)
+            if tel is not None else contextlib.nullcontext())
+    with span:
+        return do_work(rid)
+
+
+class Telemetry:
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def span(self, name, **args):
+        return self.tracer.span(name, **args)   # caller manages it
+
+
+def stacked_span(tracer, rid):
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(tracer.span("request", rid=rid))
+        return do_work(rid)
+
+
+def full_journey(reqs, step_fn, rank, epoch, bound_epoch, tx):
+    by = f"replica{rank}"
+    keep = []
+    for req in reqs:
+        if epoch != bound_epoch:
+            stamp_stage(req, "requeued", by, epoch=epoch)
+            tx.push_request(req)
+            continue
+        stamp_stage(req, "bound", by, epoch=bound_epoch)
+        keep.append(req)
+    outs = step_fn([r["prompt"] for r in keep])
+    for req in keep:
+        stamp_stage(req, "computed", by)
+    for req, out in zip(keep, outs):
+        if not tx.post_result(rank, bound_epoch, dict(req, output=out)):
+            stamp_stage(req, "fenced", by, epoch=bound_epoch)
+    return outs
+
+
+def do_work(rid):
+    return rid

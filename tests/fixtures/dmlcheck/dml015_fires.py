@@ -1,0 +1,31 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/serving_worker.py
+"""DML015 firing cases: serving observability state opened without a
+guaranteed close — a bare span object whose __exit__ any exception can
+skip, and a worker-loop body that stamps an open stage (bound/computed)
+with no terminal stamp (posted/completed/requeued/fenced/dropped)
+anywhere in the same function."""
+from distributed_machine_learning_tpu.runtime.transport import stamp_stage
+
+
+def leaky_span(tracer, rid):
+    span = tracer.span("request", rid=rid)   # never used as a `with`
+    do_work(rid)
+    span.__exit__(None, None, None)          # skipped on any exception
+
+
+def bare_span_call(tel):
+    tel.span("request", rid="r1")            # span object dropped
+
+
+def half_journey(reqs, step_fn, rank):
+    by = f"replica{rank}"
+    for req in reqs:
+        stamp_stage(req, "bound", by)
+    outs = step_fn([r["prompt"] for r in reqs])
+    for req in reqs:
+        stamp_stage(req, "computed", by)
+    return outs                              # no terminal stamp at all
+
+
+def do_work(rid):
+    return rid

@@ -42,6 +42,7 @@ from distributed_machine_learning_tpu.telemetry.aggregator import (
     publish_rollup,
     read_beats,
     read_health_events,
+    serving_stage_samples,
 )
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -498,7 +499,7 @@ def test_trace_merge_fuses_one_track_per_rank(tmp_path, capsys):
     tool = _load_tool("trace_merge")
     assert tool.main([str(tel)]) == 0
     out = capsys.readouterr().out
-    assert "2 rank(s)" in out and "rank1:1" in out
+    assert "2 stream(s)" in out and "rank1:1" in out
     with open(tel / "trace.merged.json") as f:
         merged = json.load(f)  # strictly-valid JSON, always
     events = merged["traceEvents"]
@@ -513,6 +514,87 @@ def test_trace_merge_fuses_one_track_per_rank(tmp_path, capsys):
     empty = tmp_path / "empty"
     empty.mkdir()
     assert tool.main([str(empty)]) == 2
+
+
+def test_trace_merge_rehomes_serving_streams_with_flow_links(
+        tmp_path, capsys):
+    """ISSUE 17: serving streams (``trace.router.json`` /
+    ``trace.replica<r>.json``) are re-homed above
+    :data:`SERVING_PID_BASE` so they can never collide with rank
+    tracks, and ``request`` spans sharing a rid across processes are
+    stitched with flow arrows — one ``s`` + one ``f`` per rid that
+    actually crosses a pid boundary."""
+    tel = tmp_path / "tel"
+    tel.mkdir()
+
+    def _stream(name, spans):
+        tr = SpanTracer(tel / name, enabled=True)
+        t0 = tr.now()
+        for i, (sname, args) in enumerate(spans):
+            tr.complete(sname, t0 + i * 0.01, t0 + i * 0.01 + 0.005,
+                        **args)
+        tr.close()
+
+    _stream("trace.rank0.json", [("compute", {"step": 0})])
+    _stream("trace.router.json", [
+        ("request", {"rid": "r1"}),
+        ("request", {"rid": "r2"}),
+        ("request", {"rid": "solo"}),   # router-only: no flow link
+    ])
+    _stream("trace.replica0.json", [
+        ("request", {"rid": "r1", "rank": 0, "stage": "posted"}),
+    ])
+    _stream("trace.replica1.json", [
+        ("request", {"rid": "r2", "rank": 1, "stage": "posted"}),
+    ])
+
+    tool = _load_tool("trace_merge")
+    assert tool.main([str(tel)]) == 0
+    out = capsys.readouterr().out
+    assert "4 stream(s)" in out
+    assert "router:3" in out and "replica0:1" in out
+
+    with open(tel / "trace.merged.json") as f:
+        merged = json.load(f)
+    events = merged["traceEvents"]
+    base = tool.SERVING_PID_BASE
+    real = [e for e in events
+            if e.get("ph") != "M" and e.get("name") != "request_flow"]
+    assert {e["pid"] for e in real} == {0, base, base + 1, base + 2}
+    meta = {e["pid"]: e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert meta == {0: "rank 0", base: "serve router",
+                    base + 1: "serve replica 0",
+                    base + 2: "serve replica 1"}
+
+    flows = [e for e in events if e.get("name") == "request_flow"]
+    assert len(flows) == 4                       # 2 rids x (s + f)
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+    assert len(by_id) == 2                       # "solo" got no link
+    for pair in by_id.values():
+        assert sorted(e["ph"] for e in pair) == ["f", "s"]
+        assert len({e["pid"] for e in pair}) == 2
+        assert all(e["pid"] >= base for e in pair)
+
+
+def test_serving_stage_samples_feed_the_straggler_detector():
+    """ISSUE 17 satellite: the aggregator derives per-replica compute
+    durations straight from the request event stream — last sample per
+    rank wins, non-replica actors and malformed events are ignored."""
+    events = [
+        {"stage": "computed", "by": "replica0", "dt": 0.01},
+        {"stage": "computed", "by": "replica2", "dt": 0.05},
+        {"stage": "computed", "by": "replica2", "dt": 0.07},  # last wins
+        {"stage": "bound", "by": "replica1", "dt": 0.5},      # wrong stage
+        {"stage": "computed", "by": "router", "dt": 0.02},    # not a replica
+        {"stage": "computed", "by": "replica3", "dt": None},  # no duration
+        "garbage",
+    ]
+    assert serving_stage_samples(events) == {0: 0.01, 2: 0.07}
+    assert serving_stage_samples(None) == {}
+    assert serving_stage_samples(events, stage="bound") == {1: 0.5}
 
 
 def test_trace_summary_counts_instants(tmp_path):

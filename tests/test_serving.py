@@ -11,6 +11,7 @@ rides behind ``slow``.
 """
 
 import importlib.util
+import json
 import os
 import subprocess
 import sys
@@ -31,16 +32,19 @@ from distributed_machine_learning_tpu.runtime.serving_worker import (
     start_worker_thread,
 )
 from distributed_machine_learning_tpu.runtime.transport import (
+    FileTransport,
     InProcHub,
     InProcTransport,
     TcpGangServer,
     TcpTransport,
 )
+from distributed_machine_learning_tpu.telemetry import Telemetry
 from distributed_machine_learning_tpu.telemetry.registry import (
     Histogram,
     default_latency_buckets,
     default_time_buckets,
 )
+from distributed_machine_learning_tpu.telemetry.tracer import read_trace
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -119,8 +123,14 @@ def test_latency_buckets_resolve_millisecond_tails():
 
 def test_straggler_replica_is_replaced_by_a_spare():
     """PR 6 replace semantics re-aimed at serving: a replica whose
-    reported service time stays >4x the fleet median for 3 consecutive
-    judgments is demoted and a warm spare promoted in its place."""
+    compute intervals stay >4x the fleet median for 3 consecutive
+    judgments is demoted and a warm spare promoted in its place.
+
+    ISSUE 17 moved the detector feed off the beat channel and onto the
+    request event stream (the ``computed`` stage deltas — the shared
+    ``serving_stage_samples`` code path), so this test fabricates
+    completions with deterministic compute intervals instead of beats
+    with service times."""
     hub = InProcHub()
     tx = InProcTransport(hub)
     events = FaultEvents()
@@ -133,12 +143,20 @@ def test_straggler_replica_is_replaced_by_a_spare():
                                 "kind": "serving", "time": time.time()})
     router.pump()  # heal: promote 3 of the 4 spares
     assert sorted(router._replicas) == [0, 1, 2]
-    for seq in range(1, 5):
-        for rank in range(3):
-            tx.publish_beat(rank, {
-                "rank": rank, "seq": seq, "kind": "serving",
-                "service_time_s": 0.5 if rank == 2 else 0.05,
-                "time": time.time()})
+    for _ in range(9):
+        router.submit([1, 2])
+    router.pump()  # dispatch across the three replicas
+    for rank in range(3):
+        for req in tx.take_requests(rank, 8):
+            # A deterministic compute interval in the stage record:
+            # rank 2's is 10x the others' — the straggler signal.
+            req["events"].append({
+                "stage": "computed", "by": f"replica{rank}",
+                "dt": 0.5 if rank == 2 else 0.05})
+            assert tx.post_result(rank, req["epoch"], {
+                "rid": req["rid"], "output": req["prompt"],
+                "events": req["events"]})
+    for _ in range(4):  # collect, then 3 consecutive judgments
         router.pump()
     assert router.evictions == 1
     assert events.replica_evictions == 1
@@ -618,3 +636,320 @@ def test_tcp_subprocess_replica_partition_is_healed(tmp_path):
     assert verdict["admitted"] == verdict["completed"] == 120
     assert verdict["evictions"] >= 1  # the partitioned rank
     assert events.replica_evictions >= 1
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped tracing + SLO observability (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+# The documented happy-path journey (runtime/transport.py::SERVING_STAGES
+# minus the failure stamps): what every completed record must show.
+EXPECTED_JOURNEY = ["admitted", "queued", "dispatched", "taken",
+                    "bound", "computed", "posted", "completed"]
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _observed_fleet(tmp_path, backend, world, step_fn, *,
+                    replicas=2, replica_timeout_s=5.0):
+    """Router + workers with instance-tagged telemetry, over the file
+    or inproc (dir-mirrored) backend — both leave a readable gang dir
+    for the offline tools."""
+    gang = str(tmp_path / "gang")
+    teldir = str(tmp_path / "telemetry")
+    if backend == "inproc":
+        hub = InProcHub(mirror_dir=gang)
+        make_tx = lambda: InProcTransport(hub)  # noqa: E731
+    else:
+        os.makedirs(gang, exist_ok=True)
+        make_tx = lambda: FileTransport(gang)  # noqa: E731
+    router_tel = Telemetry(teldir, instance="router", enabled=True)
+    worker_tels = [Telemetry(teldir, instance=f"replica{r}", enabled=True)
+                   for r in range(world)]
+    router = ServingRouter(
+        make_tx(),
+        ServingConfig(replicas=replicas, max_queue=64, micro_batch=2,
+                      replica_timeout_s=replica_timeout_s, poll_s=0.002),
+        telemetry=router_tel)
+    fleet = []
+    for rank in range(world):
+        stop = threading.Event()
+        t, out = start_worker_thread(
+            make_tx(), rank, step_fn, stop,
+            ServingWorkerConfig(heartbeat_interval=0.02),
+            telemetry=worker_tels[rank])
+        fleet.append((rank, stop, t, out))
+    return gang, teldir, router, router_tel, worker_tels, fleet
+
+
+def _teardown_fleet(router, rt, stop_router, fleet, router_tel,
+                    worker_tels):
+    verdict = router.close()
+    stop_router.set()
+    for _, stop, t, _ in fleet:
+        stop.set()
+        t.join(5.0)
+    rt.join(5.0)
+    router_tel.close()
+    for tel in worker_tels:
+        tel.close()
+    return verdict
+
+
+@pytest.mark.parametrize("backend", ["inproc", "file"])
+def test_request_journey_lands_in_every_artifact_plane(tmp_path,
+                                                       backend):
+    """The ISSUE 17 acceptance path on both single-host backends: a
+    served request's journey shows up (a) as the documented stage-event
+    sequence in the ledger record, (b) as per-stage histograms in the
+    router's registry snapshot, (c) in the offline serve_status
+    renderings including --postmortem, and (d) as a merged Perfetto
+    timeline with router + replica tracks and the request span on both
+    sides of a flow link."""
+    gang, teldir, router, router_tel, worker_tels, fleet = \
+        _observed_fleet(tmp_path, backend, world=2, step_fn=_step)
+    stop_router = threading.Event()
+    rt = threading.Thread(target=router.run, args=(stop_router,),
+                          daemon=True)
+    rt.start()
+    rids = []
+    try:
+        for i in range(8):
+            rids.append(router.submit([1 + i, 2]))
+        assert router.wait_idle(60.0), router.audit()
+        records = [router.result(rid) for rid in rids]
+    finally:
+        verdict = _teardown_fleet(router, rt, stop_router, fleet,
+                                  router_tel, worker_tels)
+    assert verdict["exactly_once"], verdict
+    assert verdict["admitted"] == verdict["completed"] == 8
+
+    # (a) The ledger record carries the full documented journey, with
+    # rank-local deltas only: dt is None exactly where the previous
+    # stamp crossed a process boundary (DML001 — no cross-host deltas).
+    for rec in records:
+        stages = [e["stage"] for e in rec["events"]]
+        assert stages == EXPECTED_JOURNEY, stages
+        by_stage = {e["stage"]: e for e in rec["events"]}
+        assert by_stage["admitted"]["dt"] is None   # first stamp ever
+        assert by_stage["taken"]["dt"] is None      # crossed the wire
+        for stage in ("queued", "dispatched", "bound", "computed",
+                      "posted", "completed"):
+            assert by_stage[stage]["dt"] >= 0.0, by_stage[stage]
+        for stage in ("admitted", "queued", "dispatched", "completed"):
+            assert by_stage[stage]["by"] == "router"
+        worker_by = by_stage["taken"]["by"]
+        assert worker_by in ("replica0", "replica1")
+        for stage in ("bound", "computed", "posted"):
+            assert by_stage[stage]["by"] == worker_by
+        assert by_stage["dispatched"]["disp"] == 1
+        assert by_stage["taken"]["disp"] == 1   # rides the payload tag
+        for ev in rec["events"]:
+            assert "_mono_last" not in ev and "_mono_by" not in ev
+
+    # Router-clock stage intervals partition the end-to-end latency:
+    # queued + dispatched + completed ≈ total (worker stages nest
+    # INSIDE completed's dispatch round trip — summing all eight would
+    # double-count).  Means are exact sums, so the tolerance is only
+    # clock-read placement, not histogram interpolation.
+    means = {s: h.sum / h.count
+             for s, h in router._stage_hist.items() if h.count}
+    router_clock = (means["queued"] + means["dispatched"]
+                    + means["completed"])
+    e2e = router.latency.sum / router.latency.count
+    assert abs(router_clock - e2e) < 0.25 * e2e + 0.05, (means, e2e)
+    sl = verdict["stage_latency"]
+    p50_sum = sum(sl[s]["p50"]
+                  for s in ("queued", "dispatched", "completed"))
+    assert p50_sum < 4.0 * verdict["latency"]["p50"] + 0.05
+
+    # (b) The registry snapshot streams the per-stage histograms.
+    with open(os.path.join(teldir, "registry.router.json")) as f:
+        reg = json.load(f)
+    stage_rows = {h["labels"]["stage"]: h for h in reg["histograms"]
+                  if h["name"] == "serving_stage_latency_s"}
+    assert {"queued", "dispatched", "bound", "computed", "posted",
+            "completed"} <= set(stage_rows)
+    assert all(row["count"] == 8 for row in stage_rows.values())
+    gauge_names = {g["name"] for g in reg["gauges"]}
+    assert {"serving_queue_depth", "serving_inflight",
+            "serving_replicas"} <= gauge_names
+
+    # (c) serve_status renders the same story offline, from the dirs.
+    serve_status = _load_tool("serve_status")
+    status = serve_status.collect(gang, teldir)
+    assert len(status["requests"]) == 8
+    assert set(status["stages"]) >= {"computed", "completed"}
+    assert [r["rank"] for r in status["replicas"]] == [0, 1]
+    rendered = serve_status.render(status)
+    assert "Per-stage latency" in rendered
+    assert "Per-replica compute" in rendered
+    pm = serve_status.render_postmortem(status, rids[0])
+    assert pm is not None and f"Postmortem {rids[0]}" in pm
+    for stage in EXPECTED_JOURNEY:
+        assert stage in pm
+    assert serve_status.render_postmortem(status, "no-such-rid") is None
+    slo = serve_status.slo_replay(status["requests"], ["p99<=30s"],
+                                  short_window_s=5.0, long_window_s=60.0,
+                                  burn_threshold=2.0)
+    assert slo["ok"] is True and slo["replayed"] == 8
+
+    # (d) trace_merge fuses router + replica streams into named tracks
+    # in their own pid block, with the request flow-linked by rid.
+    trace_merge = _load_tool("trace_merge")
+    merged, counts = trace_merge.merge_traces(teldir)
+    assert set(counts) == {"router", "replica0", "replica1"}
+    assert counts["router"] == 8
+    events = merged["traceEvents"]
+    base = trace_merge.SERVING_PID_BASE
+    spans = [e for e in events
+             if e.get("ph") == "X" and e.get("name") == "request"]
+    for rid in rids:
+        pids = {e["pid"] for e in spans if e["args"].get("rid") == rid}
+        assert base in pids, f"{rid} missing its router span"
+        assert pids & {base + 1, base + 2}, (
+            f"{rid} missing its replica span")
+    flows = [e for e in events if e.get("name") == "request_flow"]
+    assert len(flows) == 2 * 8   # one s + one f per request
+    meta = {e["pid"]: e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert meta[base] == "serve router"
+    assert meta[base + 1] == "serve replica 0"
+    assert meta[base + 2] == "serve replica 1"
+
+
+@pytest.mark.faultinject
+def test_chaos_kill_replica_mid_compute_terminates_the_record(tmp_path):
+    """ISSUE 17 chaos proof: a replica wedges mid-compute holding a
+    dispatched request.  The router evicts it on beat staleness and the
+    record shows the victim's leg TERMINATED — ``requeued`` after
+    ``dispatched`` — then a single ``completed`` on the promoted
+    survivor; the victim's own late post is fenced, and every replica
+    trace span is closed with a terminal outcome."""
+    t_start = time.monotonic()
+    release = threading.Event()
+    poison = [13, 13, 13]
+
+    def step(prompts):
+        if poison in [list(p) for p in prompts]:
+            release.wait(30.0)
+        return _step(prompts)
+
+    gang, teldir, router, router_tel, worker_tels, fleet = \
+        _observed_fleet(tmp_path, "inproc", world=3, step_fn=step,
+                        replicas=2, replica_timeout_s=0.4)
+    stop_router = threading.Event()
+    rt = threading.Thread(target=router.run, args=(stop_router,),
+                          daemon=True)
+    rt.start()
+    try:
+        deadline = time.monotonic() + 30.0
+        while True:
+            with router._lock:
+                if len(router._replicas) == 2:
+                    break
+            assert time.monotonic() < deadline, "fleet never warmed up"
+            time.sleep(0.005)
+        rid = router.submit(poison)
+        for i in range(10):
+            router.submit([1 + i])
+        deadline = time.monotonic() + 30.0
+        while router.evictions < 1:
+            assert time.monotonic() < deadline, (
+                "stalled replica never evicted")
+            time.sleep(0.005)
+        release.set()   # un-wedge: survivors serve the requeued work
+        assert router.wait_idle(60.0), router.audit()
+        rec = router.result(rid)
+    finally:
+        release.set()
+        verdict = _teardown_fleet(router, rt, stop_router, fleet,
+                                  router_tel, worker_tels)
+    assert verdict["exactly_once"], verdict
+    assert verdict["admitted"] == verdict["completed"] == 11
+    assert verdict["evictions"] == 1
+
+    # The poisoned request's record: dispatched -> requeued (victim's
+    # leg terminated by the router) -> dispatched again -> completed
+    # ONCE, with the second leg's worker stamps from a different rank.
+    stages = [e["stage"] for e in rec["events"]]
+    first_disp = stages.index("dispatched")
+    requeue_at = stages.index("requeued")
+    assert first_disp < requeue_at, stages
+    assert stages.count("dispatched") >= 2
+    assert stages.count("completed") == 1
+    assert stages.index("completed") > requeue_at
+    requeue_ev = rec["events"][requeue_at]
+    assert requeue_ev["by"] == "router"
+    victim = requeue_ev["replica"]
+    assert victim is not None
+    serving_leg = [e for e in rec["events"] if e["stage"] == "computed"]
+    assert serving_leg and all(
+        e["by"] != f"replica{victim}" for e in serving_leg)
+    # The requeue interval reached the stage histograms.
+    assert verdict["stage_latency"].get("requeued", {}).get("count", 0) \
+        or "requeued" in verdict["stage_latency"]
+
+    # No unclosed spans: every request span in every replica trace is a
+    # complete event with a terminal outcome — including the victim's
+    # fenced late post.
+    outcomes = []
+    for r in range(3):
+        path = os.path.join(teldir, f"trace.replica{r}.json")
+        if not os.path.exists(path):
+            continue
+        for e in read_trace(path):
+            if isinstance(e, dict) and e.get("name") == "request":
+                assert e.get("ph") == "X" and e.get("dur", -1) >= 0
+                stage = (e.get("args") or {}).get("stage")
+                assert stage in ("posted", "fenced", "requeued"), e
+                outcomes.append((e["args"].get("rank"), stage))
+    assert (victim, "fenced") in outcomes, outcomes
+
+    # The postmortem renders the full story from the mirrored ledger.
+    serve_status = _load_tool("serve_status")
+    pm = serve_status.render_postmortem(
+        serve_status.collect(gang, teldir), rid)
+    assert pm is not None and "requeued" in pm and "completed" in pm
+    elapsed = time.monotonic() - t_start
+    assert elapsed < CHAOS_BUDGET_S, (
+        f"mid-compute chaos took {elapsed:.1f}s (cap {CHAOS_BUDGET_S}s)")
+
+
+@pytest.mark.faultinject
+def test_cli_serve_slo_verdict_gates_exit_status(tmp_path):
+    """--slo end to end: a generous objective passes (rc 0) and leaves
+    the telemetry artifacts; an impossible objective over deliberately
+    slow service prints a failing verdict and exits 1."""
+    base = [sys.executable, "-m",
+            "distributed_machine_learning_tpu.cli.serve",
+            "--replicas", "2", "--spares", "0", "--requests", "30",
+            "--gang-transport", "inproc", "--timeout", "60"]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    teldir = str(tmp_path / "tel")
+    ok = subprocess.run(
+        [*base, "--telemetry-dir", teldir, "--slo", "p99<=30s",
+         "--slo", "reject_ratio<=50%"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "exactly-once audit: PASS" in ok.stdout
+    assert "slo p99<=30s: PASS" in ok.stdout
+    assert "slo verdict: PASS" in ok.stdout
+    assert os.path.exists(os.path.join(teldir, "registry.router.json"))
+    assert os.path.exists(os.path.join(teldir, "trace.router.json"))
+    assert os.path.exists(os.path.join(teldir, "trace.replica0.json"))
+
+    bad = subprocess.run(
+        [*base, "--service-time", "0.02", "--slo", "p99<=1ms"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "exactly-once audit: PASS" in bad.stdout  # delivery still ok
+    assert "slo p99<=1ms: FAIL" in bad.stdout
+    assert "slo verdict: FAIL" in bad.stdout
+    assert "SLO objectives violated" in bad.stderr

@@ -1,0 +1,227 @@
+"""SLO engine (ISSUE 17): spec parsing, multi-window burn-rate
+alerting, and the end-of-run verdict ``cli/serve.py`` prints.
+
+Every timestamp below is injected (``now=``) — the engine never reads a
+clock in these tests, which is what makes the burn-rate assertions
+deterministic (and is the DML001-compliant mode ``tools/serve_status.py``
+replays dead runs in).  The keystone pair is
+``test_stall_flips_alert_and_verdict`` /
+``test_same_load_without_stall_passes``: identical synthetic load, one
+with an injected stall window, one without — the acceptance proof that
+the alert and the failing verdict are caused by the stall and nothing
+else.
+"""
+
+import pytest
+
+from distributed_machine_learning_tpu.telemetry.slo import (
+    SLOEngine,
+    SLOSpec,
+    format_verdict,
+    parse_slo,
+)
+
+# ---------------------------------------------------------------------------
+# parse_slo
+# ---------------------------------------------------------------------------
+
+
+def test_parse_latency_objectives():
+    spec = parse_slo("p99<=250ms")
+    assert spec.kind == "latency"
+    assert spec.threshold == pytest.approx(0.25)
+    assert spec.budget == pytest.approx(0.01)
+
+    assert parse_slo("p95<=0.1").threshold == pytest.approx(0.1)
+    assert parse_slo("p95<=0.1").budget == pytest.approx(0.05)
+    assert parse_slo("p50<=1s").threshold == pytest.approx(1.0)
+    assert parse_slo("p99.9<=1s").budget == pytest.approx(0.001)
+    assert parse_slo("p90<=500us").threshold == pytest.approx(5e-4)
+
+
+def test_parse_ratio_objectives():
+    spec = parse_slo("reject_ratio<=5%")
+    assert spec.kind == "reject_ratio"
+    assert spec.threshold == pytest.approx(0.05)
+    assert spec.budget == pytest.approx(0.05)
+    assert parse_slo("error_ratio<=0.01").budget == pytest.approx(0.01)
+
+
+@pytest.mark.parametrize("bad", [
+    "p99=250ms",          # no <=
+    "p0<=1ms",            # quantile out of range
+    "p100<=1ms",          # not a valid pNN
+    "latency<=250ms",     # unknown objective
+    "error_ratio<=1.5",   # ratio out of (0, 1)
+    "reject_ratio<=0",    # ratio out of (0, 1)
+    "p99<=-5ms",          # non-positive bound
+])
+def test_parse_rejects_malformed_specs(bad):
+    with pytest.raises(ValueError):
+        parse_slo(bad)
+
+
+def test_engine_accepts_specs_and_strings():
+    engine = SLOEngine([parse_slo("p99<=250ms"), "error_ratio<=1%"])
+    assert [o.kind for o in engine.objectives] == ["latency",
+                                                   "error_ratio"]
+    assert all(isinstance(o, SLOSpec) for o in engine.objectives)
+
+
+def test_engine_validates_windows_and_threshold():
+    with pytest.raises(ValueError):
+        SLOEngine(["p99<=1s"], short_window_s=10.0, long_window_s=5.0)
+    with pytest.raises(ValueError):
+        SLOEngine(["p99<=1s"], short_window_s=0.0)
+    with pytest.raises(ValueError):
+        SLOEngine(["p99<=1s"], burn_threshold=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate alerting — the acceptance pair
+# ---------------------------------------------------------------------------
+
+def _run_load(engine, *, stall=None, n=400, dt=0.25, good_s=0.02,
+              stall_s=2.0):
+    """n requests, one every ``dt`` seconds of injected time; requests
+    inside the ``stall`` interval (t0, t1) take ``stall_s`` instead of
+    ``good_s``.  Returns all alerts fired during the run."""
+    fired = []
+    for i in range(n):
+        t = i * dt
+        lat = good_s
+        if stall is not None and stall[0] <= t < stall[1]:
+            lat = stall_s
+        fired.extend(engine.observe(latency_s=lat, now=t))
+    return fired
+
+
+def test_stall_flips_alert_and_verdict():
+    engine = SLOEngine(["p99<=250ms"], short_window_s=5.0,
+                       long_window_s=60.0, burn_threshold=2.0)
+    fired = _run_load(engine, stall=(40.0, 55.0))
+    assert fired, "sustained stall did not fire a burn-rate alert"
+    alert = fired[0]
+    assert alert["slo"] == "p99<=250ms"
+    assert 40.0 <= alert["at"] <= 60.0
+    assert alert["short_burn"] > 2.0 and alert["long_burn"] > 2.0
+    verdict = engine.verdict()
+    assert verdict["ok"] is False
+    (row,) = verdict["objectives"]
+    assert row["ok"] is False and row["alerts"] >= 1
+    assert "FAIL" in format_verdict(verdict)
+
+
+def test_same_load_without_stall_passes():
+    engine = SLOEngine(["p99<=250ms"], short_window_s=5.0,
+                       long_window_s=60.0, burn_threshold=2.0)
+    fired = _run_load(engine, stall=None)
+    assert fired == []
+    verdict = engine.verdict()
+    assert verdict["ok"] is True
+    (row,) = verdict["objectives"]
+    assert row["bad"] == 0 and row["relevant"] == 400
+    assert "slo verdict: PASS" in format_verdict(verdict)
+
+
+def test_quiet_tail_does_not_erase_a_mid_run_alert():
+    """The documented semantics: a sustained mid-run breach fails the
+    run even when a long good tail pulls the whole-run bad fraction
+    back under budget."""
+    engine = SLOEngine(["p95<=250ms"], short_window_s=5.0,
+                       long_window_s=60.0, burn_threshold=2.0)
+    _run_load(engine, stall=(40.0, 50.0), n=4000)
+    assert engine.alerts
+    verdict = engine.verdict()
+    (row,) = verdict["objectives"]
+    assert row["bad_ratio"] <= row["budget"], "tail should dilute ratio"
+    assert verdict["ok"] is False, "alert must still fail the verdict"
+
+
+def test_short_burst_does_not_page():
+    """The multi-window rule's whole point: a burst that is over before
+    the long window burns never alerts — the short window alone is not
+    evidence of a sustained problem."""
+    engine = SLOEngine(["error_ratio<=5%"], short_window_s=5.0,
+                       long_window_s=60.0, burn_threshold=2.0)
+    for i in range(120):                       # 60 s of good history
+        engine.observe(latency_s=0.01, now=i * 0.5)
+    fired = []
+    for j in range(2):                         # 2-outcome burst
+        fired.extend(engine.observe(latency_s=0.01, error=True,
+                                    now=60.0 + j * 0.1))
+    assert fired == [], "ended burst paged despite a cold long window"
+    # ...but the SAME failure rate sustained does alert.
+    for j in range(40):
+        fired.extend(engine.observe(latency_s=0.01, error=True,
+                                    now=61.0 + j * 0.5))
+    assert fired, "sustained failures never alerted"
+
+
+def test_recovery_rearms_the_alert_episode():
+    engine = SLOEngine(["error_ratio<=10%"], short_window_s=5.0,
+                       long_window_s=20.0, burn_threshold=2.0)
+
+    def episode(t0):
+        out = []
+        for j in range(20):
+            out.extend(engine.observe(error=True, now=t0 + j * 0.5))
+        return out
+
+    def recover(t0):
+        out = []
+        for j in range(60):
+            out.extend(engine.observe(error=False, now=t0 + j * 0.5))
+        return out
+
+    first = episode(0.0)
+    assert len(first) == 1, "episode must alert exactly once"
+    assert episode(10.0) == [], "same episode must not re-alert"
+    recover(20.0)
+    second = episode(60.0)
+    assert len(second) == 1, "recovery must re-arm the alert"
+    assert len(engine.alerts) == 2
+
+
+# ---------------------------------------------------------------------------
+# Outcome-kind relevance
+# ---------------------------------------------------------------------------
+
+def test_rejections_are_invisible_to_latency_objectives():
+    engine = SLOEngine(["p99<=250ms", "reject_ratio<=10%"],
+                       short_window_s=5.0, long_window_s=20.0,
+                       burn_threshold=2.0)
+    for i in range(50):
+        engine.observe(rejected=True, now=i * 0.1)
+    verdict = engine.verdict()
+    by_slo = {r["slo"]: r for r in verdict["objectives"]}
+    assert by_slo["p99<=250ms"]["relevant"] == 0
+    assert by_slo["p99<=250ms"]["ok"] is True       # no evidence
+    assert by_slo["reject_ratio<=10%"]["relevant"] == 50
+    assert by_slo["reject_ratio<=10%"]["ok"] is False
+    assert any(a["slo"] == "reject_ratio<=10%" for a in engine.alerts)
+
+
+def test_errors_count_against_error_ratio_not_rejects():
+    engine = SLOEngine(["error_ratio<=50%", "reject_ratio<=50%"],
+                       short_window_s=5.0, long_window_s=20.0,
+                       burn_threshold=2.0)
+    engine.observe(latency_s=0.01, error=True, now=0.0)
+    engine.observe(rejected=True, now=0.1)
+    engine.observe(latency_s=0.01, now=0.2)
+    by_slo = {r["slo"]: r for r in engine.verdict()["objectives"]}
+    # error_ratio judges admitted requests only: 1 error of 2 admitted.
+    assert by_slo["error_ratio<=50%"]["relevant"] == 2
+    assert by_slo["error_ratio<=50%"]["bad"] == 1
+    # reject_ratio judges every admission attempt: 1 reject of 3.
+    assert by_slo["reject_ratio<=50%"]["relevant"] == 3
+    assert by_slo["reject_ratio<=50%"]["bad"] == 1
+
+
+def test_format_verdict_names_every_objective():
+    engine = SLOEngine(["p99<=250ms", "error_ratio<=1%"])
+    engine.observe(latency_s=0.01, now=0.0)
+    text = format_verdict(engine.verdict())
+    assert "slo p99<=250ms: PASS" in text
+    assert "slo error_ratio<=1%: PASS" in text
+    assert text.endswith("(0 alert(s) fired)")

@@ -820,3 +820,63 @@ def test_concurrent_writers_exactly_once_and_mirror_order(name, tmp_path):
     finally:
         if server is not None:
             server.stop()
+
+
+def test_tcp_stage_events_stamped_exactly_once_under_retries(tcp_server):
+    """ISSUE 17: stage stamping lives in the base wrappers, ABOVE the
+    op-id retry machinery — a dropped ``push_request``, a dropped
+    ``take_requests`` (the destructive one), and a duplicated
+    ``post_result`` each stamp their stage once per LOGICAL op, so a
+    lossy wire can never double-stamp a journey.  The private monotonic
+    anchor never crosses the wire with the payload."""
+    from distributed_machine_learning_tpu.runtime.transport import (
+        carry_stage_context,
+        stamp_stage,
+    )
+
+    chaos = TransportChaos(drop=[("push_request", 1)])
+    router = TcpTransport(tcp_server.address, chaos=chaos,
+                          backoff_s=0.01)
+    entry = {"rid": "j1", "prompt": [7], "epoch": 0, "dispatch": 1,
+             "events": []}
+    stamp_stage(entry, "admitted", "router")
+    stamp_stage(entry, "queued", "router")
+    stamp_stage(entry, "dispatched", "router")
+    router.push_request(0, entry)   # dropped once -> retried
+    assert router.stats()["retries"] >= 1
+    assert ("drop", "push_request", 1) in chaos.fired
+    # The caller's record keeps its own clock anchor (the router keeps
+    # stamping on it later); the wire copy must not.
+    assert "_mono_last" in entry
+
+    wchaos = TransportChaos(drop=[("take_requests", 1)],
+                            duplicate=[("post_result", 1)])
+    worker = TcpTransport(tcp_server.address, chaos=wchaos,
+                          backoff_s=0.01)
+    (req,) = worker.take_requests(0, 8)
+    assert worker.stats()["retries"] >= 1
+    # One "taken" stamp despite the dropped-and-retried destructive op,
+    # and dt None: the previous stamp was another process's clock.
+    assert [e["stage"] for e in req["events"]] == [
+        "admitted", "queued", "dispatched", "taken"]
+    assert req["events"][-1] == {"stage": "taken", "by": "replica0",
+                                 "dt": None, "disp": 1}
+    assert all(e["dt"] is None or e["dt"] >= 0
+               for e in req["events"])
+
+    stamp_stage(req, "bound", "replica0", epoch=0)
+    stamp_stage(req, "computed", "replica0")
+    assert worker.post_result(0, 0, carry_stage_context(req, {
+        "rid": "j1", "output": [7, 7]})) is True
+    assert ("duplicate", "post_result", 1) in wchaos.fired
+
+    reader = TcpTransport(tcp_server.address)
+    (res,) = reader.take_results(8)
+    assert reader.take_results(8) == []   # duplicated post landed once
+    stages = [e["stage"] for e in res["events"]]
+    assert stages == ["admitted", "queued", "dispatched", "taken",
+                      "bound", "computed", "posted"]
+    assert stages.count("posted") == 1
+    assert res["events"][-1]["by"] == "replica0"
+    assert res["events"][-1]["dt"] >= 0   # computed -> posted, one clock
+    assert "_mono_last" not in res and "_mono_by" not in res

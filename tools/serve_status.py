@@ -1,0 +1,275 @@
+#!/usr/bin/env python3
+"""Live / post-mortem serving-fleet status — stdlib-only, jax-free.
+
+The serving complement of ``tools/gang_status.py`` (ISSUE 17): where
+that tool renders gang health, this one renders the REQUEST view of a
+serving fleet from two artifact planes:
+
+- the coordination dir (``--gang-dir`` of ``cli/serve.py``): the
+  transport snapshot (replica roles / serving epochs / drain latches /
+  queue depths), the router's final ``serving`` summary, and the
+  per-request ``serve_request`` health-ledger records the router
+  appends at each completion — each carrying the request's full
+  stage-event journey (see ``runtime/transport.py::SERVING_STAGES``);
+- the telemetry dir (default ``<gang-dir>/telemetry``): the router's
+  ``registry.router.json`` snapshot with the live
+  ``serving_stage_latency_s{stage=...}`` histograms and fleet gauges.
+
+Renders per-stage p50/p95/p99, per-replica compute time + skew, queue
+depth / in-flight, and — with ``--slo`` objectives — the SLO burn
+state replayed over the completion records (writer-clock timestamps
+compared among themselves only, never against this reader's clock:
+the DML001 rule).  ``--postmortem RID`` reconstructs one request's
+complete event timeline — the "why was THIS request slow" debugging
+workflow: every stage, who stamped it, and the rank-local delta since
+that actor's previous stamp.
+
+Usage:  python tools/serve_status.py <gang-dir> [--telemetry DIR]
+                 [--slo SPEC ...] [--postmortem RID] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+from distributed_machine_learning_tpu.runtime.transport import (  # noqa: E402,E501
+    FileTransport,
+)
+from distributed_machine_learning_tpu.telemetry.aggregator import (  # noqa: E402,E501
+    median,
+    serving_stage_samples,
+)
+from distributed_machine_learning_tpu.telemetry.slo import (  # noqa: E402,E501
+    SLOEngine,
+    format_verdict,
+)
+
+# registry snapshots a serving run may have left, most specific first:
+# the router's instance-tagged file, then the single-process default.
+_REGISTRY_CANDIDATES = ("registry.router.json", "registry.json")
+
+
+def _load_registry(telemetry_dir: str) -> dict | None:
+    for name in _REGISTRY_CANDIDATES:
+        path = os.path.join(telemetry_dir, name)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+    return None
+
+
+def collect(gang_dir: str, telemetry_dir: str) -> dict:
+    """Everything the renderers need, as one JSON-ready dict."""
+    snap = FileTransport(gang_dir).snapshot()
+    health = snap["health"]
+    summary = None
+    requests = []
+    for e in health:
+        kind = e.get("kind")
+        if kind == "serving":
+            summary = e
+        elif kind == "serve_request":
+            requests.append(e)
+    # Per-replica compute intervals out of the event stream — the same
+    # ``computed``-delta feed the router's straggler judgement uses.
+    compute: dict[int, list[float]] = {}
+    for rec in requests:
+        for rank, dt in serving_stage_samples(
+                rec.get("events"), stage="computed").items():
+            compute.setdefault(rank, []).append(dt)
+    means = {rank: sum(v) / len(v) for rank, v in compute.items() if v}
+    med = median(means.values())
+    replica_rows = [
+        {"rank": rank, "requests": len(compute[rank]),
+         "compute_mean_s": means[rank],
+         "skew": (means[rank] / med) if med > 0 else None}
+        for rank in sorted(means)]
+    # Live per-stage quantiles from the router's registry snapshot.
+    stages = {}
+    gauges = {}
+    reg = _load_registry(telemetry_dir)
+    if reg is not None:
+        for h in reg.get("histograms", ()):
+            if h.get("name") == "serving_stage_latency_s":
+                stage = (h.get("labels") or {}).get("stage")
+                if stage:
+                    stages[stage] = h
+        for g in reg.get("gauges", ()):
+            if g.get("name") in ("serving_queue_depth",
+                                 "serving_inflight",
+                                 "serving_replicas"):
+                gauges[g["name"]] = g.get("value")
+    return {
+        "gang_dir": gang_dir,
+        "serving_state": snap.get("serving"),
+        "summary": summary,
+        "requests": requests,
+        "replicas": replica_rows,
+        "stages": stages,
+        "gauges": gauges,
+    }
+
+
+def slo_replay(requests: list[dict], specs: list[str], *,
+               short_window_s: float, long_window_s: float,
+               burn_threshold: float) -> dict:
+    """Replay the completion records through an :class:`SLOEngine`.
+
+    Timestamps are the ROUTER's own ``time`` fields replayed in order —
+    one writer's clock compared to itself, so the reader's clock never
+    enters (DML001).  Covers completed requests only: admission rejects
+    leave no ledger record, so the whole-run reject count lives in the
+    ``serving`` summary, not here."""
+    engine = SLOEngine(specs, short_window_s=short_window_s,
+                       long_window_s=long_window_s,
+                       burn_threshold=burn_threshold)
+    rows = [r for r in requests
+            if isinstance(r.get("time"), (int, float))
+            and isinstance(r.get("latency_s"), (int, float))]
+    for r in sorted(rows, key=lambda r: r["time"]):
+        engine.observe(latency_s=r["latency_s"], now=r["time"])
+    verdict = engine.verdict()
+    verdict["replayed"] = len(rows)
+    return verdict
+
+
+def render_postmortem(status: dict, rid: str) -> str | None:
+    """One request's full journey from its ``serve_request`` record, or
+    None when the ledgers hold no completed record for ``rid``."""
+    rec = None
+    for e in status["requests"]:
+        if e.get("rid") == rid:
+            rec = e  # last record wins (there should be exactly one)
+    if rec is None:
+        return None
+    lat = rec.get("latency_s")
+    lines = [f"== Postmortem {rid} ==",
+             f"  completed in "
+             + (f"{lat * 1e3:.2f} ms" if lat is not None else "?")
+             + f" after {rec.get('dispatches', '?')} dispatch(es)"]
+    lines.append(f"  {'stage':>10}  {'by':>10}  {'dt':>10}  detail")
+    for ev in rec.get("events") or ():
+        if not isinstance(ev, dict):
+            continue
+        dt = ev.get("dt")
+        dt_s = f"{dt * 1e3:.3f}ms" if isinstance(dt, (int, float)) \
+            else "-"
+        detail = "  ".join(
+            f"{k}={ev[k]}" for k in sorted(ev)
+            if k not in ("stage", "by", "dt"))
+        lines.append(f"  {ev.get('stage', '?'):>10}  "
+                     f"{ev.get('by', '?'):>10}  {dt_s:>10}  {detail}")
+    return "\n".join(lines)
+
+
+def render(status: dict, slo_verdict: dict | None = None) -> str:
+    lines = [f"== Serving fleet {status['gang_dir']} =="]
+    sv = status.get("summary")
+    if sv:
+        lines.append(
+            f"  {sv.get('completed', 0)}/{sv.get('admitted', 0)} "
+            f"completed, {sv.get('rejected', 0)} rejected, "
+            f"{sv.get('evictions', 0)} eviction(s), "
+            f"{sv.get('drains', 0)} drain(s); exactly-once: "
+            f"{'PASS' if sv.get('exactly_once') else 'FAIL'}")
+    g = status.get("gauges") or {}
+    if g:
+        lines.append(
+            f"  live: {g.get('serving_replicas', '?')} replica(s), "
+            f"queue depth {g.get('serving_queue_depth', '?')}, "
+            f"{g.get('serving_inflight', '?')} in flight")
+    state = status.get("serving_state") or {}
+    for rank_s, rec in sorted((state.get("replicas") or {}).items(),
+                              key=lambda kv: int(kv[0])):
+        role = "draining" if rec.get("drain") else rec.get("role", "?")
+        lines.append(f"  replica {rank_s}: {role}, epoch "
+                     f"{rec.get('epoch', 0)}, "
+                     f"{rec.get('queued', 0)} queued request(s)")
+    stages = status.get("stages") or {}
+    if stages:
+        lines.append("== Per-stage latency ==")
+        lines.append(f"  {'stage':>10}  {'count':>6}  {'p50':>10}  "
+                     f"{'p95':>10}  {'p99':>10}")
+        for stage, h in sorted(stages.items()):
+            lines.append(
+                f"  {stage:>10}  {h.get('count', 0):>6}  "
+                f"{h.get('p50', 0) * 1e3:>8.2f}ms  "
+                f"{h.get('p95', 0) * 1e3:>8.2f}ms  "
+                f"{h.get('p99', 0) * 1e3:>8.2f}ms")
+    if status.get("replicas"):
+        lines.append("== Per-replica compute ==")
+        for r in status["replicas"]:
+            skew = f"{r['skew']:.2f}x" if r["skew"] is not None else "-"
+            lines.append(
+                f"  replica {r['rank']}: {r['requests']} request(s), "
+                f"mean compute {r['compute_mean_s'] * 1e3:.2f} ms, "
+                f"skew {skew}")
+    if slo_verdict is not None:
+        lines.append(f"== SLO burn state "
+                     f"({slo_verdict.get('replayed', 0)} completion(s) "
+                     "replayed) ==")
+        lines.append(format_verdict(slo_verdict))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("gang_dir", help="the fleet coordination dir "
+                                         "(--gang-dir of cli/serve.py)")
+    parser.add_argument("--telemetry", default=None,
+                        help="telemetry dir (default: "
+                             "<gang-dir>/telemetry)")
+    parser.add_argument("--slo", action="append", default=[],
+                        metavar="SPEC",
+                        help="objective to evaluate over the completion "
+                             "records, e.g. p99<=250ms or "
+                             "reject_ratio<=0.05 (repeatable)")
+    parser.add_argument("--slo-short-window", type=float, default=5.0)
+    parser.add_argument("--slo-long-window", type=float, default=60.0)
+    parser.add_argument("--slo-burn-threshold", type=float, default=2.0)
+    parser.add_argument("--postmortem", default=None, metavar="RID",
+                        help="print one request's full event timeline")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable dump instead of tables")
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.gang_dir):
+        print(f"not a directory: {args.gang_dir}", file=sys.stderr)
+        return 2
+    telemetry_dir = args.telemetry or os.path.join(args.gang_dir,
+                                                   "telemetry")
+    status = collect(args.gang_dir, telemetry_dir)
+    if args.postmortem is not None:
+        text = render_postmortem(status, args.postmortem)
+        if text is None:
+            print(f"no completed serve_request record for rid "
+                  f"{args.postmortem!r} in {args.gang_dir} (still in "
+                  "flight, rejected, or records disabled)",
+                  file=sys.stderr)
+            return 1
+        print(text)
+        return 0
+    verdict = None
+    if args.slo:
+        verdict = slo_replay(
+            status["requests"], args.slo,
+            short_window_s=args.slo_short_window,
+            long_window_s=args.slo_long_window,
+            burn_threshold=args.slo_burn_threshold)
+    if args.json:
+        out = dict(status)
+        out["slo"] = verdict
+        print(json.dumps(out, indent=1))
+    else:
+        print(render(status, verdict))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

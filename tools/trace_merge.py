@@ -22,6 +22,17 @@ track is continuous across shrinks.  Torn final events (a killed rank)
 and unterminated arrays are tolerated by construction — the readers
 drop exactly the record the crash destroyed.
 
+Serving streams (ISSUE 17): a serving fleet writes ``trace.router.json``
+plus ``trace.replica<r>.json`` — and before this PR they ALL recorded
+pid 0 and collided with each other (and with train rank 0) in a merged
+timeline.  Serving streams now get their own pid block starting at
+:data:`SERVING_PID_BASE` (router first, then replicas in rank order),
+named ``serve router`` / ``serve replica <r>`` and sorted after the
+train ranks.  ``request`` spans that share an ``args.rid`` across
+processes (the router's end-to-end span and each replica's
+take→outcome span) are flow-linked by rid, so Perfetto draws the
+request hopping processes as one connected arrow chain.
+
 Usage:  python tools/trace_merge.py <telemetry-dir> [-o OUT.json]
 """
 
@@ -32,6 +43,7 @@ import json
 import os
 import re
 import sys
+import zlib
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
@@ -41,6 +53,11 @@ from distributed_machine_learning_tpu.telemetry.tracer import (  # noqa: E402,E5
 
 _TRACE_FILE_RE = re.compile(r"^trace\.rank(\d+)\.json$")
 _RANK_DIR_RE = re.compile(r"^rank(\d+)$")
+_SERVE_FILE_RE = re.compile(r"^trace\.(router|replica(\d+))\.json$")
+
+# Serving tracks live in their own pid block so they can never collide
+# with train-rank pids (rank == pid) in the same telemetry dir.
+SERVING_PID_BASE = 1000
 
 
 def discover_rank_traces(root: str) -> dict[int, str]:
@@ -63,27 +80,89 @@ def discover_rank_traces(root: str) -> dict[int, str]:
     return out
 
 
-def merge_traces(root: str) -> tuple[dict, dict[int, int]]:
-    """(merged trace object, rank -> event count).
+def discover_serving_traces(root: str) -> dict[str, str]:
+    """``"router"``/``"replica<r>"`` -> trace path — the serving-fleet
+    streams ``cli/serve.py`` writes via instance-tagged telemetry."""
+    out: dict[str, str] = {}
+    if not os.path.isdir(root):
+        return out
+    for name in sorted(os.listdir(root)):
+        m = _SERVE_FILE_RE.match(name)
+        if m:
+            out.setdefault(m.group(1), os.path.join(root, name))
+    return out
 
-    The result is the Chrome JSON Object Format (``{"traceEvents":
-    [...]}``) — strictly-valid JSON whatever state the inputs were
-    killed in, with one metadata-named process track per rank.
+
+def _serving_pid(label: str) -> int:
+    """router -> base; replica r -> base+1+r (stable, rank-ordered)."""
+    if label == "router":
+        return SERVING_PID_BASE
+    return SERVING_PID_BASE + 1 + int(label[len("replica"):])
+
+
+def _request_flow_links(events: list[dict]) -> list[dict]:
+    """Flow events (ph ``s``/``f``) linking ``request`` spans that
+    share an ``args.rid`` across DIFFERENT pids — the router's
+    end-to-end span and each replica attempt become one arrow chain in
+    Perfetto.  Spans confined to one process need no link."""
+    by_rid: dict[str, list[dict]] = {}
+    for e in events:
+        args = e.get("args")
+        if (e.get("ph") == "X" and e.get("name") == "request"
+                and isinstance(args, dict)
+                and args.get("rid") is not None):
+            by_rid.setdefault(str(args["rid"]), []).append(e)
+    links: list[dict] = []
+    for rid, spans in sorted(by_rid.items()):
+        if len({e.get("pid") for e in spans}) < 2:
+            continue
+        spans = sorted(spans, key=lambda e: e.get("ts", 0))
+        fid = zlib.crc32(rid.encode())
+        for i, e in enumerate(spans):
+            links.append({
+                "name": "request_flow", "cat": "serving", "id": fid,
+                "ph": "s" if i == 0 else "f",
+                **({} if i == 0 else {"bp": "e"}),
+                "ts": e.get("ts", 0), "pid": e.get("pid", 0),
+                "tid": e.get("tid", 0),
+            })
+    return links
+
+
+def merge_traces(root: str) -> tuple[dict, dict[str, int]]:
+    """(merged trace object, stream label -> event count).
+
+    Labels are ``rank<r>`` for train streams and ``router`` /
+    ``replica<r>`` for serving streams.  The result is the Chrome JSON
+    Object Format (``{"traceEvents": [...]}``) — strictly-valid JSON
+    whatever state the inputs were killed in, with one metadata-named
+    process track per stream.
     """
-    traces = discover_rank_traces(root)
     events: list[dict] = []
-    counts: dict[int, int] = {}
-    for rank, path in sorted(traces.items()):
-        rank_events = [e for e in read_trace(path) if isinstance(e, dict)]
-        for e in rank_events:
+    counts: dict[str, int] = {}
+
+    def _add_stream(label: str, pid: int, path: str, pname: str,
+                    sort_index: int) -> None:
+        stream = [e for e in read_trace(path) if isinstance(e, dict)]
+        for e in stream:
             e = dict(e)
-            e["pid"] = rank  # every rank thinks it's pid 0: re-home it
+            e["pid"] = pid  # every stream thinks it's pid 0: re-home it
             events.append(e)
-        counts[rank] = len(rank_events)
-        events.append({"name": "process_name", "ph": "M", "pid": rank,
-                       "args": {"name": f"rank {rank}"}})
+        counts[label] = len(stream)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": pname}})
         events.append({"name": "process_sort_index", "ph": "M",
-                       "pid": rank, "args": {"sort_index": rank}})
+                       "pid": pid, "args": {"sort_index": sort_index}})
+
+    for rank, path in sorted(discover_rank_traces(root).items()):
+        _add_stream(f"rank{rank}", rank, path, f"rank {rank}", rank)
+    serving = discover_serving_traces(root)
+    for label in sorted(serving, key=_serving_pid):
+        pid = _serving_pid(label)
+        pname = ("serve router" if label == "router"
+                 else f"serve replica {label[len('replica'):]}")
+        _add_stream(label, pid, serving[label], pname, pid)
+    events.extend(_request_flow_links(events))
     # Chronological order is not required by the format but makes the
     # merged file diffable and stream-readable; metadata events carry
     # no ts and sort first.
@@ -96,7 +175,9 @@ def main(argv=None) -> int:
     parser.add_argument("telemetry_dir",
                         help="gang telemetry dir holding per-rank "
                              "traces (trace.rank<r>.json or "
-                             "rank<r>/trace.json)")
+                             "rank<r>/trace.json) and/or serving "
+                             "streams (trace.router.json, "
+                             "trace.replica<r>.json)")
     parser.add_argument("-o", "--out", default=None,
                         help="output path (default: "
                              "<telemetry-dir>/trace.merged.json)")
@@ -107,7 +188,8 @@ def main(argv=None) -> int:
     merged, counts = merge_traces(args.telemetry_dir)
     if not counts:
         print(f"no per-rank traces under {args.telemetry_dir} "
-              "(expected trace.rank<r>.json or rank<r>/trace.json)",
+              "(expected trace.rank<r>.json, rank<r>/trace.json, "
+              "trace.router.json or trace.replica<r>.json)",
               file=sys.stderr)
         return 2
     out = args.out or os.path.join(args.telemetry_dir,
@@ -118,11 +200,12 @@ def main(argv=None) -> int:
     os.replace(tmp, out)
     spans = [e["ts"] for e in merged["traceEvents"] if "ts" in e]
     dur_s = (max(spans) - min(spans)) / 1e6 if spans else 0.0
-    per_rank = "  ".join(f"rank{r}:{n}" for r, n in sorted(counts.items()))
+    per_stream = "  ".join(f"{label}:{n}"
+                           for label, n in sorted(counts.items()))
     print(f"merged {sum(counts.values())} event(s) from "
-          f"{len(counts)} rank(s) spanning {dur_s:.1f}s -> {out}")
-    print(f"  {per_rank}")
-    print("  open in ui.perfetto.dev (one process track per rank)")
+          f"{len(counts)} stream(s) spanning {dur_s:.1f}s -> {out}")
+    print(f"  {per_stream}")
+    print("  open in ui.perfetto.dev (one process track per stream)")
     return 0
 
 
