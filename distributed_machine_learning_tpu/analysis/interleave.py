@@ -16,17 +16,19 @@ module makes them testable deterministically:
   the quick configs (≤3 threads / ≤8 ops), with label-based
   partial-order pruning and a bounded-preemption filter for the larger
   ``full`` configs.
-- :data:`SCENARIOS` — seven bounded gang protocols (abort race, join
+- :data:`SCENARIOS` — eight bounded gang protocols (abort race, join
   duplicate delivery, ledger append storm, dedup-cache hit racing a
   slow in-flight apply, beat publish vs batched reads, epoch fence vs
   zombie thread, serving drain/promote handoff vs a retiring
-  replica's late result), each with invariants checked after every
+  replica's late result, weight hot-swap commit vs an old-version
+  compute's late post), each with invariants checked after every
   terminal schedule.
 - :data:`MUTATIONS` — the known-bug seeds (the pre-fix dedup eviction,
   the pre-fix epoch check outside the lock, the pre-fix serving
-  result fence).  The mutation-test gate:
-  with a seed applied, the explorer must rediscover the bug
-  deterministically; on the fixed tree it must exit clean.
+  result fence, the pre-fix weight-swap version fence).  The
+  mutation-test gate: with a seed applied, the explorer must
+  rediscover the bug deterministically; on the fixed tree it must
+  exit clean.
 - Reproducers — a failing schedule serializes to JSON
   (:func:`save_reproducer`); ``dmlcheck --replay FILE`` re-runs that
   exact interleaving (:func:`replay_file`), so a CI failure is a
@@ -776,6 +778,81 @@ def _build_drain_promote() -> _Scenario:
     return _Scenario([("zombie", zombie), ("router", router)], check)
 
 
+def _build_weight_swap() -> _Scenario:
+    """The continuous-deployment hot-swap (ISSUE 18): replica 7 serves
+    weights v1 with request "x" in flight while the deploy controller
+    stages v2 and the swap commits (the worker's drain-then-commit
+    edge).  Invariants: "x" completes exactly once — either the
+    old-version compute's post landed BEFORE the commit (the graceful
+    drain) or it is fenced and the post-swap compute answers — and a
+    post from the OLD weights version never lands in the results
+    channel after the swap committed.  The atomic
+    version-check-and-append that ``MUTATIONS['swap-unfenced']``
+    breaks open.
+    """
+    hub = InProcHub()
+    deploy_t = InProcTransport(hub)
+    zombie_t = InProcTransport(hub)
+    fresh_t = InProcTransport(hub)
+    # Pre-schedule setup: 7 is live on committed weights v1, "x"
+    # dispatched and taken (in flight on the old-version compute).
+    deploy_t.set_serving_role(7, "live")
+    deploy_t.set_weights(7, 1, {"step": 100})
+    deploy_t.commit_weights(7, 1)
+    e0 = deploy_t.read_serving(7)["epoch"]
+    deploy_t.push_request(7, {"rid": "x", "epoch": e0})
+    assert zombie_t.take_requests(7, 1), "setup: take must claim x"
+    delivered: list = []
+    seen_rids: set = set()
+    outcome: dict = {}
+
+    def collect():
+        for res in deploy_t.take_results(8):
+            if res.get("rid") in seen_rids:
+                outcome["duplicates"] = outcome.get("duplicates", 0) + 1
+                continue
+            seen_rids.add(res.get("rid"))
+            delivered.append(res)
+
+    def zombie():
+        # The old-version compute's post, racing the swap commit.
+        ok = zombie_t.post_result(7, e0, {"rid": "x", "who": "v1"},
+                                  version=1)
+        outcome["zombie"] = "delivered" if ok else "fenced"
+
+    def deployer():
+        # Stage v2, commit the swap, then redispatch "x" to the
+        # post-swap compute if the old-version result never arrived —
+        # the controller's zero-dropped-requests obligation.
+        deploy_t.set_weights(7, 2, {"step": 200})
+        deploy_t.commit_weights(7, 2)
+        collect()
+        if not any(r.get("rid") == "x" for r in delivered):
+            deploy_t.push_request(7, {"rid": "x", "epoch": e0})
+            for req in fresh_t.take_requests(7, 1):
+                fresh_t.post_result(7, e0, {"rid": req.get("rid"),
+                                            "who": "v2"}, version=2)
+        collect()
+
+    def check():
+        v = []
+        leftover = [{k: x for k, x in r.items() if k != "time"}
+                    for r in hub.serving_results
+                    if r.get("rid") == "x"]
+        whos = [r.get("who") for r in delivered if r.get("rid") == "x"]
+        n = len(whos) + len(leftover) + outcome.get("duplicates", 0)
+        if n != 1:
+            v.append(
+                f"request x completed {n} time(s) (delivered by "
+                f"{whos}, {outcome.get('duplicates', 0)} duplicate(s),"
+                f" leftover {leftover}) — an old-version post landed "
+                "after the swap committed (want exactly once)")
+        return v
+
+    return _Scenario([("zombie", zombie), ("deployer", deployer)],
+                     check)
+
+
 # name -> {"quick": build, "full": build, "quick_max": int,
 #          "full_max": int, "invariant": str}
 SCENARIOS = {
@@ -829,6 +906,14 @@ SCENARIOS = {
                      "every request delivers exactly once across the "
                      "drain/promote handoff",
     },
+    "weight_swap": {
+        "quick": _build_weight_swap,
+        "full": _build_weight_swap,
+        "quick_max": 4000, "full_max": 20000,
+        "invariant": "an old-version compute's late post is fenced "
+                     "at the swap commit and every request delivers "
+                     "exactly once across the weight hot-swap",
+    },
 }
 
 
@@ -859,18 +944,51 @@ def _locked_epoch_unlocked(self, label: str):
         yield hub
 
 
-def _post_result_unfenced(self, replica, epoch, payload):
+def _post_result_unfenced(self, replica, epoch, payload, version=None):
     # The pre-fix serving fence: the poster's epoch checked BEFORE
     # the lock that appends the result, with an explicit schedule
     # point in the TOCTOU window — a retiring replica can pass the
     # stale check, park in the gap through retire_replica's epoch
-    # bump, and land its zombie result after the handoff.
+    # bump, and land its zombie result after the handoff.  (The
+    # weights-version fence stays correct — inside the lock — so this
+    # seed breaks exactly the epoch invariant, nothing else.)
     _transport._sched_point("hub:sresults:w")
     hub = self.hub
     if int(epoch) != hub.serving_epoch.get(int(replica), 0):
         return False
     _transport._sched_point("hub:sepoch:gap")
     with hub.lock:
+        if version is not None:
+            wrec = hub.serving_weights.get(int(replica)) or {}
+            if int(version) != int(wrec.get("version", 0)):
+                return False
+            payload = dict(payload, version=int(version))
+        hub.serving_results.append(
+            dict(payload, replica=int(replica), epoch=int(epoch)))
+    return True
+
+
+def _post_result_swap_unfenced(self, replica, epoch, payload,
+                               version=None):
+    # The pre-fix weight-swap fence: the poster's weights VERSION
+    # checked BEFORE the lock that appends the result, with an
+    # explicit schedule point in the TOCTOU window — an old-version
+    # compute can pass the stale check, park in the gap through
+    # commit_weights' version flip, and land its result after the
+    # swap committed.  (The epoch fence stays correct — inside the
+    # lock — so this seed breaks exactly the swap invariant.)
+    _transport._sched_point("hub:sresults:w")
+    hub = self.hub
+    if version is not None:
+        wrec = hub.serving_weights.get(int(replica)) or {}
+        if int(version) != int(wrec.get("version", 0)):
+            return False
+    _transport._sched_point("hub:swv:gap")
+    with hub.lock:
+        if int(epoch) != hub.serving_epoch.get(int(replica), 0):
+            return False
+        if version is not None:
+            payload = dict(payload, version=int(version))
         hub.serving_results.append(
             dict(payload, replica=int(replica), epoch=int(epoch)))
     return True
@@ -884,6 +1002,8 @@ MUTATIONS = {
                        _locked_epoch_unlocked),
     "result-unfenced": (InProcTransport, "_do_post_result",
                         _post_result_unfenced),
+    "swap-unfenced": (InProcTransport, "_do_post_result",
+                      _post_result_swap_unfenced),
 }
 
 

@@ -1,0 +1,507 @@
+"""Train-to-serve continuous deployment (ISSUE 18).
+
+The training side writes verified checkpoints; the serving side
+(ISSUE 16/17) runs a replicated fleet with epoch-fenced results, SLO
+burn rates, and request-scoped traces.  This module closes the loop:
+a :class:`DeployController` watches the training run's checkpoint
+stream and rolls every new step onto the live fleet with zero dropped
+requests, a canaried quality gate, and an automatic, *counted*
+rollback path.
+
+The pipeline, end to end:
+
+1. **Watch** — :func:`~..train.checkpoint.latest_checkpoint` walks the
+   step directory newest-first through the PR 13 verified chain:
+   quarantined dirs are skipped without touching their data, torn or
+   digest-mismatched checkpoints are quarantined and counted, and
+   only a checkpoint that fully verifies is ever considered for
+   deployment.  No unverified bytes reach a replica.
+
+2. **Reshard + requantize** — :func:`load_serving_weights` restores
+   the train-layout state (dp / zero1 / fsdp at any world size)
+   through ``reshard_restore`` onto the serving layout (world 1),
+   rebuilds the params tree, and re-quantizes to int8 through the
+   serving quantizer (``ops/quant.py::quantize_lm_params``).  The
+   per-leaf LOGICAL digests are then re-verified **post-requantize**:
+   the exact f32 vector the quantizer consumed is re-raveled and its
+   sha256 compared against the manifest's logical leaf digest — the
+   end-to-end chain covers every hop from the trainer's save to the
+   quantizer's input, not just the restore.
+
+3. **Fenced hot-swap** — per replica, a two-phase handoff over the
+   transport's versioned-weights channel: :meth:`~.transport
+   .GangTransport.set_weights` *stages* the new version (the replica
+   keeps serving — and completing — old-version work; nothing drops),
+   the worker drains its in-flight micro-batch, loads, and
+   :meth:`~.transport.GangTransport.commit_weights` flips the
+   committed version atomically with the result fence at the hub.  A
+   late post from an old-version compute can never complete a
+   new-version rid — the protocol dmlcheck layer 3 explores as
+   ``weight_swap`` (and whose seeded TOCTOU bug ``--mutate
+   swap-unfenced`` rediscovers).  Both ops ride the PR 12 op-id dedup:
+   exactly-once staging under forced tcp retries.
+
+4. **Canary** — the router steers a deterministic traffic slice
+   (every Nth dispatch) at the swapped replicas; the controller
+   compares per-version latency and a quality probe between canary
+   and stable over a bounded window, with a deploy-scoped
+   :class:`~..telemetry.slo.SLOEngine` watching burn rates on the
+   canary's outcomes alone.
+
+5. **Promote / roll back** — a clean window swaps the rest of the
+   fleet and counts ``canary_promotions``; a regression (quality,
+   latency ratio, SLO burn, or a canary that dies mid-swap) re-swaps
+   every touched replica back to the prior verified version and
+   counts ``canary_rollbacks`` — never silent.  Every edge lands in
+   the health ledger (``weight_swap`` / ``deploy_canary`` /
+   ``deploy_promote`` / ``deploy_rollback``) and mirrors into the
+   telemetry registry through :class:`~.faults.FaultEvents`, so
+   ``tools/serve_status.py`` renders the deployment state machine
+   after the fact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from distributed_machine_learning_tpu.runtime.faults import FaultEvents
+from distributed_machine_learning_tpu.train.checkpoint import (
+    CheckpointVerifyError,
+    checkpoint_manifest,
+    latest_checkpoint,
+    quarantine_checkpoint,
+    reshard_restore,
+)
+
+
+def _sha256_arr(arr) -> str:
+    return hashlib.sha256(
+        np.ascontiguousarray(np.asarray(arr)).tobytes()).hexdigest()
+
+
+def tree_digest(tree) -> str:
+    """Deterministic sha256 over a pytree's leaves (traversal order is
+    the pytree order — stable for a fixed structure).  The QUANTIZED
+    tree's digest is the deployed version's identity: two deploys of
+    bit-identical serving weights get the same digest, and the digest
+    in the swap history lets a postmortem tie a served answer back to
+    the exact weights that produced it."""
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def load_serving_weights(path, template_params=None, *, events=None):
+    """Checkpoint → serving weights, through the full verified chain.
+
+    Restores the train-layout state at ``path`` onto the serving
+    layout (world 1) via ``reshard_restore`` (manifest file digests +
+    logical leaf digests verified there, quarantine on mismatch),
+    rebuilds the params tree — zero1/fsdp flat vectors are sliced to
+    their logical prefix and unraveled through ``template_params``'
+    structure — and re-quantizes to int8 through the serving
+    quantizer.  Then the **post-requantize** check: the f32 vector the
+    quantizer actually consumed is re-raveled and its sha256 compared
+    against the manifest's logical leaf digest (``param_flat`` /
+    ``param_shards``; dp checkpoints compare against the restore-time
+    ravel) — a corruption anywhere between the trainer's save and the
+    quantizer's input fails loudly and quarantines the checkpoint.
+
+    Returns ``{"params", "quantized", "meta", "spec"}`` where ``meta``
+    is the transport-ready ``set_weights`` payload: ``{"step", "path",
+    "digest", "layout"}`` with ``digest`` the quantized tree's
+    identity (:func:`tree_digest`).
+    """
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from distributed_machine_learning_tpu.ops.quant import (
+        quantize_lm_params,
+    )
+
+    path = os.path.abspath(os.fspath(path))
+    manifest = checkpoint_manifest(path) or {}
+    state, spec = reshard_restore(path, world=1, events=events)
+    if spec.layout == "dp":
+        params = state.params
+        expected = _sha256_arr(ravel_pytree(params)[0])
+    else:
+        if template_params is None:
+            raise ValueError(
+                f"restoring a {spec.layout} checkpoint for serving "
+                "needs template_params (the flat layouts don't record "
+                "the unravel)")
+        flat_key = ("param_shards" if spec.layout == "fsdp"
+                    else "param_flat")
+        unravel = ravel_pytree(template_params)[1]
+        vec = np.asarray(getattr(state, flat_key))
+        logical = np.ascontiguousarray(vec[: spec.n_elems])
+        params = unravel(jnp.asarray(logical))
+        expected = (manifest.get("leaves", {})
+                    .get(flat_key, {}).get("sha256"))
+        if expected is None:  # manifest-less legacy save
+            expected = _sha256_arr(logical)
+    quantized = quantize_lm_params(params)
+    # Post-requantize verification: digest the exact f32 logical
+    # content the quantizer consumed, AFTER quantization ran, against
+    # the manifest's logical leaf digest.
+    got = _sha256_arr(ravel_pytree(params)[0])
+    if got != expected:
+        quarantine_checkpoint(
+            path, f"post-requantize digest mismatch ({got[:12]}…)")
+        if events is not None:
+            events.ckpt_verify_failures += 1
+        raise CheckpointVerifyError(
+            f"checkpoint {path}: serving params failed post-requantize "
+            f"verification (got {got[:12]}…, want {expected[:12]}…)")
+    step = int(np.asarray(state.step))
+    meta = {"step": step, "path": path,
+            "digest": tree_digest(quantized), "layout": spec.layout}
+    return {"params": params, "quantized": quantized,
+            "meta": meta, "spec": spec}
+
+
+@dataclasses.dataclass
+class DeployConfig:
+    """Controller policy.  Defaults suit the in-proc campaigns;
+    ``cli/deploy.py`` maps its flags onto these."""
+
+    checkpoint_dir: str = ""
+    canary_replicas: int = 1     # how many replicas take the canary
+    canary_every_n: int = 3      # traffic slice: every Nth dispatch
+    canary_window: int = 12      # canary completions needed to judge
+    max_latency_ratio: float = 3.0  # canary p50 vs stable p50 gate
+    max_bad_ratio: float = 0.0   # quality-probe failure ratio tolerated
+    commit_timeout_s: float = 5.0   # per-replica wait for worker commit
+    judge_timeout_s: float = 30.0   # canary window fill deadline
+    poll_s: float = 0.01         # watcher cadence
+    slo: tuple = ()              # canary-scoped objectives ("p99<=250ms",)
+    burn_threshold: float = 2.0
+
+
+class DeployController:
+    """The train-to-serve deployment state machine:
+    ``idle → swapping → canary → promoted | rolled_back``.
+
+    Wire-up: the controller takes the fleet's transport and its
+    :class:`~.serving.ServingRouter`, registers itself as the router's
+    ``on_complete`` hook (per-outcome latency + posted weights
+    version), and drives swaps over the transport's versioned-weights
+    channel.  ``quality_fn(outcome) -> bool`` is the deploy-time
+    quality probe — e.g. ``cli/deploy.py`` checks the synthetic
+    step's checksum token; a model probe would score a step-loss
+    eval.  ``template_params`` is the unravel donor for zero1/fsdp
+    checkpoints (see :func:`load_serving_weights`).  ``now_fn``
+    injects a deterministic clock for the SLO windows (tests).
+    """
+
+    def __init__(self, tx, router, cfg: DeployConfig, *,
+                 events: FaultEvents | None = None, telemetry=None,
+                 template_params=None, quality_fn=None, now_fn=None):
+        self.tx = tx
+        self.router = router
+        self.cfg = cfg
+        self.events = events if events is not None else router.events
+        self._tel = telemetry
+        self._template = template_params
+        self._quality = quality_fn
+        self._now = now_fn if now_fn is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._stats: dict[int, dict] = {}
+        self._slo = None          # deploy-scoped engine, one per canary
+        self._candidate: int | None = None  # version the canary judges
+        self.state = "idle"
+        self.deployed_version = 0
+        self.deployed_meta: dict = {}
+        self.history: list[dict] = []   # every committed swap, in order
+        self.deploys: list[dict] = []   # one row per deploy() outcome
+        self._last_step: int | None = None
+        self._seq = 0
+        self._pending: dict | None = None
+        router.on_complete = self._on_complete
+
+    # -- the router's per-outcome feed -----------------------------------
+    def _on_complete(self, outcome: dict) -> None:
+        v = outcome.get("version")
+        if v is None:
+            return
+        v = int(v)
+        lat = outcome.get("latency_s")
+        ok = True
+        if self._quality is not None:
+            ok = bool(self._quality(outcome))
+        with self._lock:
+            st = self._stats.get(v)
+            if st is None:
+                st = self._stats[v] = {
+                    "count": 0, "bad": 0, "lat": deque(maxlen=256)}
+            st["count"] += 1
+            if not ok:
+                st["bad"] += 1
+            if lat is not None:
+                st["lat"].append(float(lat))
+            if self._slo is not None and v == self._candidate:
+                self._slo.observe(latency_s=lat, error=not ok,
+                                  now=self._now())
+
+    def _stats_since(self, version: int, base: dict) -> dict:
+        """Counts since the canary opened (``base`` snapshots the
+        per-version tallies at deploy start); p50 over the bounded
+        recent-latency window — for the brand-new canary version that
+        IS the canary window."""
+        st = self._stats.get(version) or {"count": 0, "bad": 0,
+                                          "lat": deque()}
+        b = base.get(version) or {"count": 0, "bad": 0}
+        lats = sorted(st["lat"])
+        return {
+            "count": st["count"] - b["count"],
+            "bad": st["bad"] - b["bad"],
+            "p50": lats[len(lats) // 2] if lats else None,
+        }
+
+    # -- one replica's two-phase swap ------------------------------------
+    def _swap(self, rank: int, version: int, meta: dict,
+              *, why: str) -> bool:
+        """Stage ``version`` on ``rank`` and wait for the worker's
+        commit.  True iff the committed version reached ``version``
+        within the timeout — a replica that dies mid-swap times out
+        here and the caller takes the rollback path."""
+        cur = (self.tx.read_serving(rank).get("weights") or {})
+        if int(cur.get("version", 0) or 0) == int(version):
+            self.router.note_weights(rank, version)
+            return True
+        self.tx.set_weights(rank, version, meta)
+        deadline = time.monotonic() + self.cfg.commit_timeout_s
+        while time.monotonic() < deadline:
+            rec = (self.tx.read_serving(rank).get("weights") or {})
+            if int(rec.get("version", 0) or 0) == int(version):
+                self.router.note_weights(rank, version)
+                self.events.weight_swaps += 1
+                self.history.append({
+                    "rank": rank, "version": int(version),
+                    "step": meta.get("step"), "why": why,
+                    "digest": meta.get("digest")})
+                self.tx.append_health_event(
+                    "weight_swap", rank=rank, version=int(version),
+                    step=meta.get("step"), why=why)
+                if self._tel is not None:
+                    self._tel.tracer.instant(
+                        "weight_swap", rank=rank, version=int(version))
+                return True
+            time.sleep(self.cfg.poll_s)
+        return False
+
+    def _live_ranks(self) -> list[int]:
+        return sorted(self.router.audit()["weight_versions"])
+
+    # -- the deploy state machine ----------------------------------------
+    def deploy(self, path, *, wait: bool = True) -> dict:
+        """Roll the checkpoint at ``path`` onto the fleet.  Returns the
+        deploy row: ``{"outcome": "promoted" | "rolled_back", ...}``.
+        ``wait=False`` stops after the canary swap (callers drive
+        :meth:`judge` themselves — the chaos campaigns do, so they can
+        kill replicas mid-window)."""
+        loaded = load_serving_weights(
+            path, self._template, events=self.events)
+        meta = loaded["meta"]
+        self._seq += 1
+        version = self._seq
+        prev_version, prev_meta = self.deployed_version, self.deployed_meta
+        ranks = self._live_ranks()
+        canary = ranks[: max(1, self.cfg.canary_replicas)]
+        rest = [r for r in ranks if r not in canary]
+        with self._lock:
+            self._candidate = version
+            self._slo = self._make_slo()
+            base = {v: {"count": st["count"], "bad": st["bad"]}
+                    for v, st in self._stats.items()}
+        self.state = "swapping"
+        swapped: list[int] = []
+        for rank in canary:
+            if self._swap(rank, version, meta, why="canary"):
+                swapped.append(rank)
+            else:
+                return self._rollback(
+                    swapped, version, prev_version, prev_meta,
+                    reason=f"replica {rank} failed to commit v{version}")
+        self.router.set_canary(canary, self.cfg.canary_every_n)
+        self.state = "canary"
+        self.tx.append_health_event(
+            "deploy_canary", version=version, step=meta.get("step"),
+            ranks=list(canary), every_n=self.cfg.canary_every_n)
+        ctx = {"version": version, "meta": meta, "canary": canary,
+               "rest": rest, "swapped": swapped,
+               "prev_version": prev_version, "prev_meta": prev_meta,
+               "base": base}
+        if not wait:
+            self._pending = ctx
+            return {"outcome": "canary", "version": version}
+        return self.judge(ctx)
+
+    def judge(self, ctx: dict | None = None) -> dict:
+        """Fill the canary window, compare versions, then promote or
+        roll back.  Separated from :meth:`deploy` so campaigns can
+        inject chaos between the canary swap and the judgement."""
+        if ctx is None:
+            ctx = self._pending
+        version, meta = ctx["version"], ctx["meta"]
+        prev_version, prev_meta = ctx["prev_version"], ctx["prev_meta"]
+        base = ctx["base"]
+        deadline = time.monotonic() + self.cfg.judge_timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                cn = self._stats_since(version, base)["count"]
+            if cn >= self.cfg.canary_window:
+                break
+            time.sleep(self.cfg.poll_s)
+        with self._lock:
+            cstat = self._stats_since(version, base)
+            sstat = self._stats_since(prev_version, base)
+            alerts = list(self._slo.alerts) if self._slo else []
+        reason = None
+        if cstat["count"] == 0:
+            reason = "canary starved: no completions in the window"
+        elif cstat["bad"] > self.cfg.max_bad_ratio * cstat["count"]:
+            reason = (f"quality regression: {cstat['bad']}/"
+                      f"{cstat['count']} canary answers failed the probe")
+        elif alerts:
+            reason = (f"SLO burn on canary: {alerts[0]['slo']} "
+                      f"(short burn {alerts[0]['short_burn']:.1f}x)")
+        elif (cstat["p50"] is not None and sstat["p50"] is not None
+              and sstat["p50"] > 0
+              and cstat["p50"] > self.cfg.max_latency_ratio
+              * sstat["p50"]):
+            reason = (f"latency regression: canary p50 "
+                      f"{cstat['p50']:.4f}s vs stable "
+                      f"{sstat['p50']:.4f}s "
+                      f"(> {self.cfg.max_latency_ratio:.1f}x)")
+        if reason is not None:
+            return self._rollback(ctx["swapped"], version,
+                                  prev_version, prev_meta, reason=reason)
+        # Clean window: promote the rest of the fleet.
+        for rank in ctx["rest"]:
+            if self._swap(rank, version, meta, why="promote"):
+                ctx["swapped"].append(rank)
+            else:
+                return self._rollback(
+                    ctx["swapped"], version, prev_version, prev_meta,
+                    reason=f"replica {rank} failed to commit v{version} "
+                           "during promote")
+        self.router.clear_canary()
+        self.state = "promoted"
+        self.deployed_version = version
+        self.deployed_meta = meta
+        self.events.canary_promotions += 1
+        self.tx.append_health_event(
+            "deploy_promote", version=version, step=meta.get("step"),
+            canary=cstat, stable=sstat)
+        row = {"outcome": "promoted", "version": version,
+               "step": meta.get("step"), "canary": cstat,
+               "stable": sstat}
+        self.deploys.append(row)
+        self._teardown_canary()
+        return row
+
+    def _rollback(self, swapped: list[int], version: int,
+                  prev_version: int, prev_meta: dict, *,
+                  reason: str) -> dict:
+        """Re-swap every touched replica back to the prior verified
+        version.  Counted and ledgered — never silent.  A replica that
+        also fails the rollback commit (it died) is left to the
+        router's beat-staleness eviction, which requeues its work."""
+        self.router.clear_canary()
+        failed: list[int] = []
+        for rank in swapped:
+            if not self._swap(rank, prev_version, prev_meta,
+                              why="rollback"):
+                failed.append(rank)
+        self.state = "rolled_back"
+        self.events.canary_rollbacks += 1
+        self.tx.append_health_event(
+            "deploy_rollback", version=version,
+            to_version=prev_version, reason=reason,
+            unrecovered=failed)
+        row = {"outcome": "rolled_back", "version": version,
+               "to_version": prev_version, "reason": reason,
+               "unrecovered": failed}
+        self.deploys.append(row)
+        self._teardown_canary()
+        return row
+
+    def _teardown_canary(self) -> None:
+        with self._lock:
+            self._candidate = None
+            self._slo = None
+        self._pending = None
+
+    def _make_slo(self):
+        if not self.cfg.slo:
+            return None
+        from distributed_machine_learning_tpu.telemetry.slo import (
+            SLOEngine,
+        )
+
+        return SLOEngine(self.cfg.slo,
+                         burn_threshold=self.cfg.burn_threshold,
+                         now_fn=self._now)
+
+    # -- the watcher -----------------------------------------------------
+    def poll_once(self) -> dict | None:
+        """One watcher iteration: deploy the newest verified checkpoint
+        if it is newer than the last one deployed (or attempted — a
+        checkpoint that rolled back is not retried forever)."""
+        if not self.cfg.checkpoint_dir:
+            return None
+        path = latest_checkpoint(self.cfg.checkpoint_dir, self.events)
+        if path is None:
+            return None
+        step = int(os.path.basename(path)[5:])
+        if self._last_step is not None and step <= self._last_step:
+            return None
+        self._last_step = step
+        try:
+            return self.deploy(path)
+        except CheckpointVerifyError as exc:
+            # load_serving_weights quarantined it; the NEXT poll walks
+            # the fallback chain past it.  Surface the failure.
+            self.tx.append_health_event(
+                "deploy_verify_failed", step=step, error=str(exc))
+            self._last_step = step - 1 if step > 0 else None
+            return {"outcome": "verify_failed", "step": step,
+                    "error": str(exc)}
+
+    def run(self, stop_event: threading.Event,
+            interval_s: float = 0.1) -> None:
+        """The watcher loop — the controller's own thread target."""
+        while not stop_event.is_set():
+            self.poll_once()
+            stop_event.wait(interval_s)
+
+    def summary(self) -> dict:
+        """The deployment view ``tools/serve_status.py`` renders."""
+        with self._lock:
+            per_version = {
+                v: {"count": st["count"], "bad": st["bad"]}
+                for v, st in sorted(self._stats.items())}
+        return {
+            "state": self.state,
+            "deployed_version": self.deployed_version,
+            "deployed_step": self.deployed_meta.get("step"),
+            "swaps": len(self.history),
+            "history": list(self.history),
+            "deploys": list(self.deploys),
+            "per_version": per_version,
+        }

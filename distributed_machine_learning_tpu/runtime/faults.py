@@ -184,6 +184,9 @@ class FaultEvents:
     replica_evictions: int = 0  # serving replicas evicted (dead or slow)
     drains: int = 0             # serving replicas drained gracefully
     request_rejects: int = 0    # serving requests rejected at admission
+    weight_swaps: int = 0       # replica weight hot-swaps committed
+    canary_promotions: int = 0  # deploys promoted fleet-wide (clean canary)
+    canary_rollbacks: int = 0   # deploys rolled back (regression/SLO burn)
 
     def __setattr__(self, name: str, value) -> None:
         # Mirror every increment into the telemetry registry AS IT

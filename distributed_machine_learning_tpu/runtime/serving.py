@@ -134,6 +134,7 @@ class _Replica:
     served: int = 0
     service_s: float | None = None  # last reported micro-batch service time
     draining: bool = False
+    wv: int = 0                   # weights version the router believes
 
 
 class ServingRouter:
@@ -176,6 +177,17 @@ class ServingRouter:
         self.evictions = 0
         self.drains_done = 0
         self._ever_evicted: set[int] = set()
+        # Continuous deployment (ISSUE 18): the deploy controller tells
+        # the router which ranks carry canary weights and what slice of
+        # traffic to steer at them (deterministic, counter-based — no
+        # randomness, so campaigns replay).  ``on_complete`` is the
+        # controller's per-outcome feed: called outside the lock with
+        # {rid, replica, wv, version, latency_s, prompt, output} so the
+        # canary judgement sees every completion's weights version.
+        self._canary: set[int] = set()
+        self._canary_every = 0
+        self._canary_seq = 0
+        self.on_complete = None
         self._detector = StragglerDetector(
             multiple=self.cfg.straggler_multiple,
             consecutive=self.cfg.straggler_consecutive,
@@ -249,7 +261,8 @@ class ServingRouter:
                 raise ValueError(f"duplicate rid {rid!r}")
             entry = {
                 "rid": rid, "prompt": prompt, "state": "queued",
-                "replica": None, "epoch": None, "dispatches": 0,
+                "replica": None, "epoch": None, "wv": None,
+                "dispatches": 0,
                 "submit_mono": time.monotonic(), "result": None,
                 "latency_s": None, "events": [],
             }
@@ -271,8 +284,11 @@ class ServingRouter:
     # -- lifecycle edges -------------------------------------------------
     def _promote_locked(self, rank: int, now: float) -> None:
         self.tx.set_serving_role(rank, "live")
-        epoch = self.tx.read_serving(rank)["epoch"]
-        self._replicas[rank] = _Replica(epoch=epoch, sig_mono=now)
+        srv = self.tx.read_serving(rank)
+        epoch = srv["epoch"]
+        wv = int((srv.get("weights") or {}).get("version", 0) or 0)
+        self._replicas[rank] = _Replica(epoch=epoch, sig_mono=now,
+                                        wv=wv)
         self._detector.reset_rank(rank)  # fresh straggler episode
         self.tx.consume_join(rank)
         self.promotions += 1
@@ -337,6 +353,31 @@ class ServingRouter:
         self.tx.append_health_event("serve_drain", rank=rank)
         return True
 
+    # -- continuous deployment (ISSUE 18) --------------------------------
+    def note_weights(self, rank: int, version: int) -> None:
+        """The deploy controller observed ``rank`` commit ``version``:
+        record it so dispatches stamp the weights version the request
+        is expected to be answered under (``entry["wv"]``)."""
+        with self._lock:
+            rep = self._replicas.get(rank)
+            if rep is not None:
+                rep.wv = int(version)
+
+    def set_canary(self, ranks, every_n: int) -> None:
+        """Steer a deterministic traffic slice at the canary ranks:
+        every ``every_n``-th replica pick routes to a canary (when one
+        has dispatch room), the rest to the stable pool.  Counter-based
+        — identical request streams produce identical routing, so the
+        chaos campaigns replay.  ``every_n=0`` (or no ranks) clears the
+        slice and dispatch falls back to pure least-loaded."""
+        with self._lock:
+            self._canary = {int(r) for r in ranks}
+            self._canary_every = max(0, int(every_n))
+            self._canary_seq = 0
+
+    def clear_canary(self) -> None:
+        self.set_canary((), 0)
+
     # -- the pump --------------------------------------------------------
     def pump(self) -> None:
         """One control iteration: collect results, judge liveness and
@@ -393,6 +434,23 @@ class ServingRouter:
                 self.tx.append_health_event("serve_demote", rank=rank,
                                             why="drained", requeued=n)
 
+    def _pick_replica_locked(self, ready: list) -> int:
+        """Choose the next dispatch target from ``ready`` (a list of
+        ``(in_flight, rank)``).  With a canary slice active, every
+        ``every_n``-th pick prefers the canary pool (least-loaded
+        within it), the rest the stable pool; an empty preferred pool
+        falls back to the other so neither side ever starves."""
+        if self._canary and self._canary_every:
+            canary = [t for t in ready if t[1] in self._canary]
+            stable = [t for t in ready if t[1] not in self._canary]
+            self._canary_seq += 1
+            if self._canary_seq % self._canary_every == 0:
+                pool = canary or stable
+            else:
+                pool = stable or canary
+            return min(pool)[1]
+        return min(ready)[1]
+
     def _dispatch_locked(self) -> None:
         while self._queue:
             ready = [(len(rep.in_flight), rank)
@@ -401,7 +459,7 @@ class ServingRouter:
                      and len(rep.in_flight) < self.cfg.max_outstanding]
             if not ready:
                 return
-            _, rank = min(ready)
+            rank = self._pick_replica_locked(ready)
             rep = self._replicas[rank]
             room = self.cfg.max_outstanding - len(rep.in_flight)
             for _ in range(min(self.cfg.micro_batch, room,
@@ -420,6 +478,7 @@ class ServingRouter:
                 entry["state"] = "dispatched"
                 entry["replica"] = rank
                 entry["epoch"] = rep.epoch
+                entry["wv"] = rep.wv
                 entry["dispatches"] += 1
                 # dt here is queued -> dispatched on the router clock:
                 # the queue wait.
@@ -461,6 +520,7 @@ class ServingRouter:
 
     def _complete(self, res: dict, now: float) -> None:
         record = None
+        outcome = None
         with self._lock:
             rid = res.get("rid")
             entry = self._ledger.get(rid)
@@ -485,6 +545,10 @@ class ServingRouter:
                 owner.served += 1
             entry["state"] = "done"
             entry["result"] = res.get("output")
+            # The hub-stamped weights version that produced this
+            # answer (ISSUE 18) — what a postmortem ties a served
+            # output back to.
+            entry["version"] = res.get("version")
             entry["latency_s"] = now - entry["submit_mono"]
             # Merge the worker-side journey (taken/bound/computed/
             # posted, stamped on the replica's own clock) into the
@@ -523,7 +587,20 @@ class ServingRouter:
                     "rid": rid, "state": "done",
                     "latency_s": entry["latency_s"],
                     "dispatches": entry["dispatches"],
+                    "version": res.get("version"),
                     "events": [dict(ev) for ev in entry["events"]],
+                }
+            if self.on_complete is not None:
+                # The deploy controller's per-outcome feed: the posted
+                # ``version`` is authoritative (the hub's fence stamped
+                # it), ``wv`` is what the router expected at dispatch.
+                outcome = {
+                    "rid": rid, "replica": entry.get("replica"),
+                    "wv": entry.get("wv"),
+                    "version": res.get("version"),
+                    "latency_s": entry["latency_s"],
+                    "prompt": entry.get("prompt"),
+                    "output": entry.get("result"),
                 }
             self.completed += 1
             self._open -= 1
@@ -535,6 +612,10 @@ class ServingRouter:
                 self._tombstones[old] = None
                 while len(self._tombstones) > self._tombstone_cap:
                     self._tombstones.popitem(last=False)
+        if outcome is not None:
+            # Outside the lock: the controller's hook may read router
+            # state (audit) or talk to the transport.
+            self.on_complete(outcome)
         if record is not None:
             # Outside the lock: on tcp this is a network round trip,
             # and submit() from client threads must not block on it.
@@ -592,6 +673,10 @@ class ServingRouter:
                 "drains": self.drains_done,
                 "exactly_once": (self._open == 0
                                  and states.get("done", 0) == admitted),
+                "weight_versions": {
+                    rank: rep.wv
+                    for rank, rep in sorted(self._replicas.items())},
+                "canary": sorted(self._canary),
                 "latency": q,
                 "stage_latency": {
                     s: h.quantiles()

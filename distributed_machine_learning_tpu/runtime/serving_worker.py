@@ -57,7 +57,7 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                        stop_event: threading.Event,
                        cfg: ServingWorkerConfig | None = None, *,
                        prefetch_fn=None, on_restore=None,
-                       telemetry=None) -> dict:
+                       on_swap=None, telemetry=None) -> dict:
     """Drive one replica until ``stop_event`` (a campaign's kill switch
     doubles as the worker's death) or the control plane severs.
 
@@ -66,7 +66,16 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
     while spare, returns the newest verified checkpoint step to
     advertise.  ``on_restore(prefetched_step)``: called once per
     promotion — where a real replica restores params (O(restore));
-    tests count the calls.  ``telemetry``: this replica's own
+    tests count the calls.  ``on_swap(version, record) -> step_fn |
+    None``: the weight hot-swap seam (ISSUE 18) — called between
+    micro-batches when the deploy controller staged a new weights
+    version for this rank (``set_weights``), AFTER every in-flight
+    result posted under the old version (the drain).  It loads the
+    staged weights (production: rebuild the ``make_serving_step``
+    callable from the record's checkpoint path) and may return a
+    replacement step function; the worker then ``commit_weights`` —
+    the hub-atomic fence move — and serves every later request under
+    the new version.  ``telemetry``: this replica's own
     instance-tagged :class:`~..telemetry.Telemetry` — one ``request``
     span per take→outcome lands in its Chrome trace, which
     ``tools/trace_merge.py`` re-homes next to the router's track.
@@ -88,8 +97,10 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
     fenced = 0
     repushed = 0
     restores = 0
+    swaps = 0
     last_service: float | None = None
     bound_epoch: int | None = None
+    bound_version: int | None = None
     prefetched = None
     last_announce = -1.0
     last_beat = -1.0
@@ -98,6 +109,7 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
             state = tx.read_serving(rank)
             if state["role"] != "live":
                 bound_epoch = None
+                bound_version = None
                 now = time.monotonic()
                 if (last_announce < 0
                         or now - last_announce
@@ -118,16 +130,43 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                 # THIS epoch — the fence that makes a late post after
                 # retirement a no-op instead of a duplicate.
                 bound_epoch = state["epoch"]
+                bound_version = None  # rebind to the committed record
                 restores += 1
                 last_announce = -1.0
                 if on_restore is not None:
                     on_restore(prefetched)
+            wrec = state.get("weights") or {}
+            if bound_version is None:
+                bound_version = int(wrec.get("version", 0) or 0)
+            pending = wrec.get("pending")
+            if pending is not None and int(pending) != bound_version:
+                # Hot-swap point (ISSUE 18): the deploy controller
+                # staged a new weights version for this rank.  We are
+                # between micro-batches here — every in-flight result
+                # already posted under the OLD version, which is the
+                # zero-dropped-requests drain the two-phase protocol
+                # promises.  Load the staged weights, then commit: the
+                # hub flips the committed version atomically with the
+                # result fence, so an old-version zombie's late post
+                # can never complete a post-swap rid.
+                pending = int(pending)
+                if on_swap is not None:
+                    new_step = on_swap(pending, dict(wrec))
+                    if new_step is not None:
+                        step_fn = new_step
+                tx.commit_weights(rank, pending)
+                bound_version = pending
+                swaps += 1
+                if tracer is not None:
+                    tracer.instant("weight_swap", rank=rank,
+                                   version=bound_version)
             now = time.monotonic()
             if last_beat < 0 or now - last_beat >= cfg.heartbeat_interval:
                 seq += 1
                 tx.publish_beat(rank, {
                     "rank": rank, "seq": seq, "kind": "serving",
                     "served": served, "service_time_s": last_service,
+                    "weight_version": bound_version,
                     "time": time.time(),
                 })
                 last_beat = now
@@ -203,7 +242,7 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                                         "rid": req.get("rid"),
                                         "output": out,
                                         "service_time_s": last_service,
-                                    }))
+                                    }), version=bound_version)
                 if tracer is not None:
                     tracer.complete("request", t_take,
                                     time.perf_counter(),
@@ -219,7 +258,8 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
     except TransportError:
         pass  # severed from the control plane: retire quietly
     return {"rank": rank, "served": served, "fenced": fenced,
-            "repushed": repushed, "restores": restores}
+            "repushed": repushed, "restores": restores, "swaps": swaps,
+            "weight_version": bound_version}
 
 
 def start_worker_thread(tx: GangTransport, rank: int, step_fn,

@@ -99,6 +99,11 @@ _MUTATING_OPS = frozenset({
     # request-level exactly-once the serving router builds on.
     "push_request", "take_requests", "post_result", "take_results",
     "set_drain", "set_role", "retire_replica",
+    # Continuous-deployment weight channel (ISSUE 18): staging and
+    # committing a weights version both mutate the per-replica weight
+    # record the post fence reads, so a tcp retry must be a result
+    # fetch, never a second stage/commit.
+    "set_weights", "commit_weights",
 })
 
 
@@ -450,24 +455,29 @@ class GangTransport:
         return reqs
 
     def post_result(self, replica: int, epoch: int,
-                    payload: dict) -> bool:
+                    payload: dict, version: int | None = None) -> bool:
         """Append one completed result — ACCEPTED only when ``epoch``
         matches the replica's current serving epoch (checked atomically
         with the append).  Returns False for a fenced (stale-epoch)
         post: a drained/evicted replica's late result is discarded at
-        the hub, never double-delivered.  A traced result is stamped
-        ``posted`` on a COPY of its event record (a fenced post's stamp
-        is discarded with the post — the caller's record never shows a
-        delivery that did not happen), clock anchor stripped before the
-        wire."""
+        the hub, never double-delivered.  ``version`` (ISSUE 18): the
+        weights version the compute was bound to; when given it is
+        checked — inside the SAME atomic section — against the
+        replica's committed weights version, so a late post from an
+        old-version compute can never complete a request after the
+        hot-swap committed.  A traced result is stamped ``posted`` on a
+        COPY of its event record (a fenced post's stamp is discarded
+        with the post — the caller's record never shows a delivery
+        that did not happen), clock anchor stripped before the wire."""
         self._count("post_result")
         payload = dict(payload)
         if isinstance(payload.get("events"), list):
             payload["events"] = [dict(e) for e in payload["events"]]
             stamp_stage(payload, "posted", f"replica{int(replica)}")
             strip_stage_clock(payload)
-        return bool(self._do_post_result(int(replica), int(epoch),
-                                         payload))
+        return bool(self._do_post_result(
+            int(replica), int(epoch), payload,
+            None if version is None else int(version)))
 
     def take_results(self, max_n: int = 16) -> list[dict]:
         """Destructively pop up to ``max_n`` completed results (the
@@ -488,6 +498,30 @@ class GangTransport:
         self._count("set_role")
         self._do_set_role(int(replica), str(role))
 
+    def set_weights(self, replica: int, version: int,
+                    meta: dict | None = None) -> None:
+        """Stage a new weights version for ``replica`` (ISSUE 18): the
+        deployment controller's announce edge.  ``meta`` (checkpoint
+        step, digest, path…) rides the record so the worker's swap
+        callback knows what to load.  Staging does NOT move the fence:
+        the replica keeps posting under its committed version until it
+        drains its in-flight micro-batch and calls
+        :meth:`commit_weights` — that is the zero-dropped-requests
+        half of the swap protocol."""
+        self._count("set_weights")
+        self._do_set_weights(int(replica), int(version),
+                             dict(meta or {}))
+
+    def commit_weights(self, replica: int, version: int) -> bool:
+        """Commit ``replica``'s weights version — the swap's fence
+        move, atomic at the hub with the :meth:`post_result` version
+        check: from this op on, a post carrying the OLD version is
+        fenced (returns False), so an old-version compute can never
+        complete a new-version request.  Called by the worker after it
+        drained in-flight work and loaded the staged weights."""
+        self._count("commit_weights")
+        return bool(self._do_commit_weights(int(replica), int(version)))
+
     def retire_replica(self, replica: int) -> list[dict]:
         """Demote ``replica`` in ONE atomic step: bump its serving
         epoch (fencing any in-flight ``post_result`` from the old
@@ -498,9 +532,12 @@ class GangTransport:
         return self._do_retire_replica(int(replica))
 
     def read_serving(self, replica: int | None = None) -> dict:
-        """One replica's ``{role, epoch, drain, queued}`` record, or
-        (``None``) the whole serving plane: ``{replicas: {rank:
-        record}, results: depth}`` — the status-tool read."""
+        """One replica's ``{role, epoch, drain, queued, weights}``
+        record, or (``None``) the whole serving plane: ``{replicas:
+        {rank: record}, results: depth}`` — the status-tool read.
+        ``weights`` is ``{version, pending, …meta}``: the committed
+        weights version fencing this replica's posts, plus any staged
+        (not yet committed) version and its deploy metadata."""
         self._count("read_serving")
         return self._do_read_serving(
             None if replica is None else int(replica))
@@ -813,24 +850,42 @@ class FileTransport(GangTransport):
                 fcntl.flock(fd, fcntl.LOCK_UN)
             os.close(fd)
 
+    def _weights_path(self, replica: int) -> str:
+        return self._serving_path(f"weights_r{replica}.json")
+
+    def _weights_ok(self, replica: int, version: int | None) -> bool:
+        if version is None:
+            return True
+        cur = self._read_json(self._weights_path(replica)) or {}
+        return int(version) == int(cur.get("version", 0))
+
     def _do_post_result(self, replica: int, epoch: int,
-                        payload: dict) -> bool:
+                        payload: dict, version: int | None) -> bool:
         epoch_path = self._serving_path(f"epoch_r{replica}.json")
         with self._replica_fence(replica):
             cur = self._read_json(epoch_path) or {}
             if int(epoch) != int(cur.get("epoch", 0)):
                 return False
+            # The weight-swap fence (ISSUE 18), under the SAME flock
+            # commit_weights takes: a post from an old-version compute
+            # after the swap committed is discarded here, atomic with
+            # the append.
+            if not self._weights_ok(replica, version):
+                return False
+            if version is not None:
+                payload = dict(payload, version=int(version))
             posted = self._spool_push(
                 "results",
                 dict(payload, replica=replica, epoch=int(epoch)))
         if fcntl is not None:
             return True
-        # Lock-free fallback: a retire_replica may have bumped the
-        # epoch between the read and the push.  Re-verify and reclaim
-        # the stale-epoch file; if the router consumed it first, it
-        # was delivered (the router's ledger dedups regardless).
+        # Lock-free fallback: a retire_replica (or commit_weights) may
+        # have moved a fence between the read and the push.  Re-verify
+        # and reclaim the stale file; if the router consumed it first,
+        # it was delivered (the router's ledger dedups regardless).
         cur = self._read_json(epoch_path) or {}
-        if int(epoch) == int(cur.get("epoch", 0)):
+        if (int(epoch) == int(cur.get("epoch", 0))
+                and self._weights_ok(replica, version)):
             return True
         claimed = f"{posted}.take{os.getpid()}.{threading.get_ident()}"
         try:
@@ -855,6 +910,29 @@ class FileTransport(GangTransport):
         os.makedirs(self._serving_path(), exist_ok=True)
         _coord._write_atomic(self._serving_path(f"role_r{replica}.json"),
                              {"role": role})
+
+    def _do_set_weights(self, replica: int, version: int,
+                        meta: dict) -> None:
+        self._ensure_dir()
+        os.makedirs(self._serving_path(), exist_ok=True)
+        with self._replica_fence(replica):
+            cur = self._read_json(self._weights_path(replica)) or {}
+            committed = int(cur.get("version", 0))
+            rec = dict(meta)
+            rec["version"] = committed
+            rec["pending"] = int(version)
+            _coord._write_atomic(self._weights_path(replica), rec)
+
+    def _do_commit_weights(self, replica: int, version: int) -> bool:
+        self._ensure_dir()
+        os.makedirs(self._serving_path(), exist_ok=True)
+        with self._replica_fence(replica):
+            cur = self._read_json(self._weights_path(replica)) or {}
+            cur["version"] = int(version)
+            if cur.get("pending") == int(version):
+                cur["pending"] = None
+            _coord._write_atomic(self._weights_path(replica), cur)
+        return True
 
     def _do_retire_replica(self, replica: int) -> list[dict]:
         self._ensure_dir()
@@ -883,10 +961,15 @@ class FileTransport(GangTransport):
                     self._serving_path(f"requests_r{replica}")))
         except OSError:
             queued = 0
+        weights = self._read_json(self._weights_path(replica)) or {}
+        wrec = dict(weights)
+        wrec["version"] = int(weights.get("version", 0))
+        wrec.setdefault("pending", None)
         return {"role": role.get("role", "spare"),
                 "epoch": int(epoch.get("epoch", 0)),
                 "drain": bool(drain.get("drain", False)),
-                "queued": queued}
+                "queued": queued,
+                "weights": wrec}
 
     def _do_read_serving(self, replica: int | None) -> dict:
         if replica is not None:
@@ -897,7 +980,7 @@ class FileTransport(GangTransport):
         except OSError:
             names = []
         for name in names:
-            for prefix in ("role_r", "epoch_r", "drain_r"):
+            for prefix in ("role_r", "epoch_r", "drain_r", "weights_r"):
                 if name.startswith(prefix) and name.endswith(".json"):
                     rank_s = name[len(prefix):-len(".json")]
                     if rank_s.isdigit():
@@ -980,6 +1063,10 @@ class InProcHub:
         self.serving_drain: dict[int, bool] = {}
         self.serving_epoch: dict[int, int] = {}
         self.serving_role: dict[int, str] = {}
+        # Per-replica weight records (ISSUE 18): {"version": committed,
+        # "pending": staged-or-None, ...deploy meta} — the version
+        # fence post_result checks atomically with its append.
+        self.serving_weights: dict[int, dict] = {}
         self._version = 0
 
     # -- the broadcast box (in-proc worker extension) --------------------
@@ -1015,6 +1102,7 @@ class InProcHub:
                 self.serving_drain.clear()
                 self.serving_epoch.clear()
                 self.serving_role.clear()
+                self.serving_weights.clear()
         if self.mirror_dir is not None:
             _coord.clear_gang_state(self.mirror_dir,
                                     restore_records=restore_records,
@@ -1187,17 +1275,25 @@ class InProcTransport(GangTransport):
             return [dict(e) for e in out]
 
     def _do_post_result(self, replica: int, epoch: int,
-                        payload: dict) -> bool:
+                        payload: dict, version: int | None) -> bool:
         # The drain/promote fence: the epoch is compared INSIDE the
         # lock, atomic with the append.  A retired replica's late post
         # (its epoch was bumped by ``retire_replica``) returns False
         # and touches nothing — the check-then-act race the layer-3
         # ``drain_promote`` scenario explores, whose broken form
         # survives as ``analysis/interleave.py``'s ``result-unfenced``
-        # mutation.
+        # mutation.  The weights version (ISSUE 18) is fenced in the
+        # SAME critical section — its hoisted-check form is the
+        # ``swap-unfenced`` mutation the ``weight_swap`` scenario
+        # rediscovers.
         with self._locked("hub:sresults:w") as hub:
             if int(epoch) != hub.serving_epoch.get(replica, 0):
                 return False
+            if version is not None:
+                wrec = hub.serving_weights.get(replica) or {}
+                if int(version) != int(wrec.get("version", 0)):
+                    return False
+                payload = dict(payload, version=int(version))
             hub.serving_results.append(
                 dict(payload, replica=replica, epoch=int(epoch)))
             return True
@@ -1216,6 +1312,30 @@ class InProcTransport(GangTransport):
         with self._locked("hub:srole:w") as hub:
             hub.serving_role[replica] = role
 
+    def _do_set_weights(self, replica: int, version: int,
+                        meta: dict) -> None:
+        # Non-structured label: the weight record is read by the post
+        # fence (hub:sresults:w) and the snapshot — staging must
+        # conflict with both in the layer-3 POR, not prune against
+        # them as an independent channel.
+        with self._locked("hub:serving:setw") as hub:
+            cur = hub.serving_weights.get(replica) or {}
+            rec = dict(meta)
+            rec["version"] = int(cur.get("version", 0))
+            rec["pending"] = int(version)
+            hub.serving_weights[replica] = rec
+
+    def _do_commit_weights(self, replica: int, version: int) -> bool:
+        # The swap's fence move: committed version flips under the hub
+        # lock, atomic with every concurrent post's version check.
+        with self._locked("hub:serving:commitw") as hub:
+            rec = dict(hub.serving_weights.get(replica) or {})
+            rec["version"] = int(version)
+            if rec.get("pending") == int(version):
+                rec["pending"] = None
+            hub.serving_weights[replica] = rec
+            return True
+
     def _do_retire_replica(self, replica: int) -> list[dict]:
         with self._locked("hub:serving:retire") as hub:
             hub.serving_epoch[replica] = \
@@ -1227,10 +1347,14 @@ class InProcTransport(GangTransport):
 
     def _replica_record_locked(self, hub: InProcHub,
                                replica: int) -> dict:
+        wrec = dict(hub.serving_weights.get(replica) or {})
+        wrec["version"] = int(wrec.get("version", 0))
+        wrec.setdefault("pending", None)
         return {"role": hub.serving_role.get(replica, "spare"),
                 "epoch": hub.serving_epoch.get(replica, 0),
                 "drain": bool(hub.serving_drain.get(replica, False)),
-                "queued": len(hub.serving_requests.get(replica, ()))}
+                "queued": len(hub.serving_requests.get(replica, ())),
+                "weights": wrec}
 
     def _do_read_serving(self, replica: int | None) -> dict:
         with self._locked("hub:serving:snapshot") as hub:
@@ -1238,7 +1362,8 @@ class InProcTransport(GangTransport):
                 return self._replica_record_locked(hub, replica)
             ranks = (set(hub.serving_role) | set(hub.serving_epoch)
                      | set(hub.serving_drain)
-                     | set(hub.serving_requests))
+                     | set(hub.serving_requests)
+                     | set(hub.serving_weights))
             return {"replicas": {r: self._replica_record_locked(hub, r)
                                  for r in sorted(ranks)},
                     "results": len(hub.serving_results)}
@@ -1542,8 +1667,10 @@ class TcpGangServer:
             return s._do_take_requests(int(req["rank"]),
                                        int(req["max_n"]))
         if op == "post_result":
-            return s._do_post_result(int(req["rank"]),
-                                     int(req["epoch"]), req["payload"])
+            version = req.get("version")
+            return s._do_post_result(
+                int(req["rank"]), int(req["epoch"]), req["payload"],
+                None if version is None else int(version))
         if op == "take_results":
             return s._do_take_results(int(req["max_n"]))
         if op == "set_drain":
@@ -1552,6 +1679,13 @@ class TcpGangServer:
         if op == "set_role":
             s._do_set_role(int(req["rank"]), req["role"])
             return None
+        if op == "set_weights":
+            s._do_set_weights(int(req["rank"]), int(req["version"]),
+                              req.get("meta") or {})
+            return None
+        if op == "commit_weights":
+            return s._do_commit_weights(int(req["rank"]),
+                                        int(req["version"]))
         if op == "retire_replica":
             return s._do_retire_replica(int(req["rank"]))
         if op == "read_serving":
@@ -1758,9 +1892,10 @@ class TcpTransport(GangTransport):
     def _do_take_requests(self, replica, max_n):
         return self._call("take_requests", rank=replica, max_n=max_n)
 
-    def _do_post_result(self, replica, epoch, payload):
+    def _do_post_result(self, replica, epoch, payload, version):
         return bool(self._call("post_result", rank=replica,
-                               epoch=epoch, payload=payload))
+                               epoch=epoch, payload=payload,
+                               version=version))
 
     def _do_take_results(self, max_n):
         return self._call("take_results", max_n=max_n)
@@ -1770,6 +1905,14 @@ class TcpTransport(GangTransport):
 
     def _do_set_role(self, replica, role):
         self._call("set_role", rank=replica, role=role)
+
+    def _do_set_weights(self, replica, version, meta):
+        self._call("set_weights", rank=replica, version=version,
+                   meta=meta)
+
+    def _do_commit_weights(self, replica, version):
+        return bool(self._call("commit_weights", rank=replica,
+                               version=version))
 
     def _do_retire_replica(self, replica):
         return self._call("retire_replica", rank=replica)

@@ -79,6 +79,9 @@ _EVENT_LABELS = {
     "replica_evictions": "serving replicas evicted (dead/slow)",
     "drains": "serving replicas drained gracefully",
     "request_rejects": "serving requests rejected (overload)",
+    "weight_swaps": "replica weight hot-swaps committed",
+    "canary_promotions": "deploys promoted after clean canary",
+    "canary_rollbacks": "deploys rolled back (regression/burn)",
 }
 
 

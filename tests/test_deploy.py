@@ -1,0 +1,828 @@
+"""Train-to-serve continuous deployment (ISSUE 18): the verified
+reshard→requantize chain, the two-phase fenced weight hot-swap, the
+canary judge, and the chaos-proven auto-rollback.
+
+Fast half: ``load_serving_weights`` restores train-layout checkpoints
+(dp / zero1@8 / fsdp@8) onto the serving world bit-exactly with the
+per-leaf logical digests re-verified POST-requantize (a tampered
+restore and a corrupted checkpoint both fail loudly and quarantine),
+the worker's drain-then-commit swap seam versions every post, and the
+controller's promote / quality-rollback / SLO-burn-rollback /
+watcher-skips-corrupt paths.
+
+Tier-1 keystones: ``test_chaos_replica_killed_mid_swap_rolls_back``
+(the acceptance campaign — a fleet under sustained load, a deploy
+rolled mid-load, the canary replica killed mid-swap; the controller
+must time out the commit, roll back counted-and-ledgered, the fleet
+must heal by spare promotion, a follow-up deploy must promote on the
+healed fleet, and every admitted request completes exactly once inside
+the wall-clock cap) and the offline-observability test (serve_status /
+gang_status render the deployment state machine, trace_merge shows the
+``weight_swap`` instants).  The multi-deploy endurance variant rides
+behind ``slow``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.cli.deploy import (
+    checksum_token,
+    quality_probe,
+    versioned_step,
+    write_demo_checkpoint,
+)
+from distributed_machine_learning_tpu.runtime.deploy import (
+    DeployConfig,
+    DeployController,
+    load_serving_weights,
+    tree_digest,
+)
+from distributed_machine_learning_tpu.runtime.faults import (
+    FaultEvents,
+    corrupt_checkpoint_data,
+)
+from distributed_machine_learning_tpu.runtime.mesh import ShardSpec
+from distributed_machine_learning_tpu.runtime.serving import (
+    Overloaded,
+    ServingConfig,
+    ServingRouter,
+)
+from distributed_machine_learning_tpu.runtime.serving_worker import (
+    ServingWorkerConfig,
+    start_worker_thread,
+)
+from distributed_machine_learning_tpu.runtime.transport import (
+    FileTransport,
+    InProcHub,
+    InProcTransport,
+    TransportError,
+)
+from distributed_machine_learning_tpu.telemetry import Telemetry
+from distributed_machine_learning_tpu.train.checkpoint import (
+    CheckpointVerifyError,
+    latest_checkpoint,
+    save_checkpoint,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+CHAOS_BUDGET_S = 150.0
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# load_serving_weights: the reshard-to-serving verified chain
+# ---------------------------------------------------------------------------
+
+
+def _lm_state():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from distributed_machine_learning_tpu.train.adamw import AdamWConfig
+    from distributed_machine_learning_tpu.train.state import TrainState
+
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=1,
+                          n_heads=2)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return TrainState.create(params=params, rng=jax.random.PRNGKey(9),
+                             config=AdamWConfig())
+
+
+@pytest.fixture(scope="module")
+def lm_base():
+    return _lm_state()
+
+
+def _params_equal(a, b) -> bool:
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def _has_int8_leaf(tree) -> bool:
+    import jax
+
+    return any(np.asarray(leaf).dtype == np.int8
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def test_load_serving_weights_dp_checkpoint(tmp_path):
+    """dp save → serving load: params bit-exact, int8 requantize ran,
+    and the meta row is the transport-ready set_weights payload."""
+    path = write_demo_checkpoint(str(tmp_path), step=7)
+    events = FaultEvents()
+    out = load_serving_weights(path, events=events)
+    assert out["spec"].layout == "dp"
+    assert out["meta"]["step"] == 7
+    assert out["meta"]["layout"] == "dp"
+    assert out["meta"]["path"] == os.path.abspath(path)
+    assert out["meta"]["digest"] == tree_digest(out["quantized"])
+    assert len(out["meta"]["digest"]) == 64
+    assert _has_int8_leaf(out["quantized"])
+    assert events.ckpt_verify_failures == 0
+    # Same checkpoint, second load: identical weights identity.
+    again = load_serving_weights(path)
+    assert again["meta"]["digest"] == out["meta"]["digest"]
+    assert _params_equal(out["params"], again["params"])
+
+
+@pytest.mark.parametrize("layout", ["zero1", "fsdp"])
+def test_load_serving_weights_reshards_train_layout(tmp_path, mesh8,
+                                                    lm_base, layout):
+    """save@{zero1,fsdp} world 8 → serving world 1: the flat shards
+    fold back into the exact params tree the trainer held (bit-exact
+    vs the pre-shard leaves), requantized through the serving
+    quantizer with the manifest's logical digest re-verified after."""
+    from distributed_machine_learning_tpu.parallel.fsdp import (
+        shard_fsdp_state,
+    )
+    from distributed_machine_learning_tpu.parallel.zero1 import (
+        shard_zero1_state,
+    )
+
+    shard = shard_zero1_state if layout == "zero1" else shard_fsdp_state
+    state8, _, n_elems = shard(lm_base, mesh8)
+    spec8 = ShardSpec(layout, world=8, n_elems=n_elems)
+    path = save_checkpoint(tmp_path / "train", state8, shard_spec=spec8)
+    events = FaultEvents()
+    out = load_serving_weights(path, lm_base.params, events=events)
+    assert out["spec"].layout == layout
+    assert out["meta"]["layout"] == layout
+    assert _params_equal(out["params"], lm_base.params)
+    assert _has_int8_leaf(out["quantized"])
+    assert events.ckpt_verify_failures == 0
+    assert events.reshard_restores == 1
+
+
+def test_load_serving_weights_needs_template_for_flat_layouts(
+        tmp_path, mesh8, lm_base):
+    from distributed_machine_learning_tpu.parallel.zero1 import (
+        shard_zero1_state,
+    )
+
+    state8, _, n = shard_zero1_state(lm_base, mesh8)
+    path = save_checkpoint(tmp_path / "t", state8,
+                           shard_spec=ShardSpec("zero1", world=8,
+                                                n_elems=n))
+    with pytest.raises(ValueError, match="template_params"):
+        load_serving_weights(path)
+
+
+def test_cross_world_corruption_never_reaches_serving(tmp_path, mesh8,
+                                                      lm_base):
+    """A corrupted train-side checkpoint fails verification inside the
+    reshard, is quarantined + counted, and the watcher's next walk
+    skips it — no unverified bytes ever reach a replica."""
+    from distributed_machine_learning_tpu.parallel.zero1 import (
+        shard_zero1_state,
+    )
+
+    state8, _, n = shard_zero1_state(lm_base, mesh8)
+    path = save_checkpoint(tmp_path / "t", state8,
+                           shard_spec=ShardSpec("zero1", world=8,
+                                                n_elems=n))
+    corrupt_checkpoint_data(path)
+    events = FaultEvents()
+    with pytest.raises(CheckpointVerifyError):
+        load_serving_weights(path, lm_base.params, events=events)
+    assert events.ckpt_verify_failures >= 1
+    # Quarantined: the verified-chain walk skips the dir entirely.
+    assert latest_checkpoint(tmp_path / "t") is None
+
+
+def test_post_requantize_digest_catches_tampered_restore(
+        tmp_path, mesh8, lm_base, monkeypatch):
+    """The end-to-end chain: flip ONE element between the (passing)
+    restore and the quantizer, and the post-requantize digest check
+    against the manifest's logical leaf sha256 fails loudly, counted,
+    with the checkpoint quarantined."""
+    import jax.numpy as jnp
+
+    import distributed_machine_learning_tpu.runtime.deploy as deploy_mod
+    from distributed_machine_learning_tpu.parallel.zero1 import (
+        shard_zero1_state,
+    )
+
+    state8, _, n = shard_zero1_state(lm_base, mesh8)
+    path = save_checkpoint(tmp_path / "t", state8,
+                           shard_spec=ShardSpec("zero1", world=8,
+                                                n_elems=n))
+    real = deploy_mod.reshard_restore
+
+    def tampered(p, world=1, events=None):
+        state, spec = real(p, world=world, events=events)
+        vec = np.asarray(state.param_flat).copy()
+        vec[spec.n_elems // 2] += 1.0  # in-memory bit-flip post-restore
+        return state.replace(param_flat=jnp.asarray(vec)), spec
+
+    monkeypatch.setattr(deploy_mod, "reshard_restore", tampered)
+    events = FaultEvents()
+    with pytest.raises(CheckpointVerifyError, match="post-requantize"):
+        load_serving_weights(path, lm_base.params, events=events)
+    assert events.ckpt_verify_failures == 1
+    assert latest_checkpoint(tmp_path / "t") is None
+
+
+# ---------------------------------------------------------------------------
+# Fleet plumbing for the swap / canary / chaos campaigns
+# ---------------------------------------------------------------------------
+
+
+def _default_on_swap_for(rank):
+    def on_swap(version, rec):
+        return versioned_step(version)
+
+    return on_swap
+
+
+def _deploy_fleet(tmp_path, *, replicas, world, on_swap_for=None,
+                  telemetry_dir=None, replica_timeout_s=2.0,
+                  micro_batch=2, service_time=0.0, backend="inproc"):
+    """Router + workers over a dir-mirrored in-proc hub (or the file
+    backend, whose serving records the offline tools can read); every
+    worker carries the ISSUE 18 ``on_swap`` seam (default: rebuild the
+    version-tagged synthetic step)."""
+    gang = str(tmp_path / "gang")
+    if backend == "inproc":
+        hub = InProcHub(mirror_dir=gang)
+        make_tx = lambda: InProcTransport(hub)  # noqa: E731
+    else:
+        os.makedirs(gang, exist_ok=True)
+        make_tx = lambda: FileTransport(gang)  # noqa: E731
+    events = FaultEvents()
+    tels = []
+    router_tel = None
+    if telemetry_dir:
+        router_tel = Telemetry(telemetry_dir, instance="router",
+                               enabled=True)
+        tels.append(router_tel)
+    router = ServingRouter(
+        make_tx(),
+        ServingConfig(replicas=replicas, max_queue=64,
+                      micro_batch=micro_batch,
+                      replica_timeout_s=replica_timeout_s, poll_s=0.002),
+        events=events, telemetry=router_tel)
+    on_swap_for = on_swap_for or _default_on_swap_for
+    wcfg = ServingWorkerConfig(heartbeat_interval=0.02,
+                               micro_batch=micro_batch)
+    fleet = []
+    for rank in range(world):
+        stop = threading.Event()
+        tel = None
+        if telemetry_dir:
+            tel = Telemetry(telemetry_dir, instance=f"replica{rank}",
+                            enabled=True)
+            tels.append(tel)
+        t, out = start_worker_thread(
+            make_tx(), rank,
+            versioned_step(0, service_time), stop, wcfg,
+            on_swap=on_swap_for(rank), telemetry=tel)
+        fleet.append((rank, stop, t, out))
+    stop_router = threading.Event()
+    rt = threading.Thread(target=router.run, args=(stop_router,),
+                          name="deploy-router", daemon=True)
+    rt.start()
+    return {"make_tx": make_tx, "gang": gang, "events": events,
+            "router": router, "fleet": fleet, "tels": tels,
+            "stop_router": stop_router, "rt": rt}
+
+
+def _teardown_fleet(f):
+    verdict = f["router"].close()
+    f["stop_router"].set()
+    for _, stop, t, _ in f["fleet"]:
+        stop.set()
+        t.join(5.0)
+    f["rt"].join(5.0)
+    for tel in f["tels"]:
+        tel.close()
+    return verdict
+
+
+def _wait_live(router, n, deadline_s=30.0):
+    deadline = time.monotonic() + deadline_s
+    while True:
+        with router._lock:
+            live = len(router._replicas)
+        if live >= n:
+            return
+        assert time.monotonic() < deadline, "fleet never warmed up"
+        time.sleep(0.01)
+
+
+def _start_load(router, *, min_requests, done):
+    """Sustained synthetic load (the cli/deploy.py client shape):
+    traffic keeps flowing until ``done`` is set AND at least
+    ``min_requests`` were admitted — canary windows need completions.
+    Returns ``(thread, stop_event, counter)``."""
+    stop = threading.Event()
+    counter = {"n": 0}
+
+    def load():
+        rng = 12345
+        while not stop.is_set():
+            if done.is_set() and counter["n"] >= min_requests:
+                return
+            rng = (1103515245 * rng + 12345) % (1 << 31)
+            prompt = [1 + (rng >> s) % 13 for s in (3, 7, 11)][
+                :1 + rng % 3]
+            try:
+                router.submit(prompt)
+                counter["n"] += 1
+            except Overloaded:
+                time.sleep(0.002)
+
+    t = threading.Thread(target=load, name="deploy-load", daemon=True)
+    t.start()
+    return t, stop, counter
+
+
+def _controller(f, ckpt_dir, **over):
+    cfg = dict(checkpoint_dir=str(ckpt_dir), canary_replicas=1,
+               canary_every_n=2, canary_window=8,
+               commit_timeout_s=10.0, judge_timeout_s=30.0,
+               poll_s=0.005)
+    cfg.update(over)
+    return DeployController(
+        f["make_tx"](), f["router"], DeployConfig(**cfg),
+        events=f["events"], quality_fn=quality_probe)
+
+
+# ---------------------------------------------------------------------------
+# The worker's drain-then-commit swap seam
+# ---------------------------------------------------------------------------
+
+
+def test_worker_hot_swap_commits_and_versions_every_post(tmp_path):
+    """Transport-level swap against one live replica: ``set_weights``
+    stages (no fence — old work keeps completing), the worker drains,
+    calls ``on_swap`` with the staged record, commits, and every later
+    post carries the new version; its summary counts the swap."""
+    calls = []
+
+    def on_swap_for(rank):
+        def on_swap(version, rec):
+            calls.append((rank, version, rec))
+            return versioned_step(version)
+
+        return on_swap
+
+    f = _deploy_fleet(tmp_path, replicas=1, world=1,
+                      on_swap_for=on_swap_for)
+    router, tx = f["router"], f["make_tx"]()
+    try:
+        _wait_live(router, 1)
+        rid_old = router.submit([1, 2, 3])
+        assert router.wait_idle(30.0), router.audit()
+        tx.set_weights(0, 1, {"step": 5, "digest": "d" * 64})
+        deadline = time.monotonic() + 10.0
+        while True:
+            rec = tx.read_serving(0).get("weights") or {}
+            if int(rec.get("version", 0)) == 1:
+                assert rec.get("pending") is None
+                break
+            assert time.monotonic() < deadline, rec
+            time.sleep(0.005)
+        rid_new = router.submit([4, 5])
+        assert router.wait_idle(30.0), router.audit()
+        assert router.result(rid_old)["version"] == 0
+        new_rec = router.result(rid_new)
+        assert new_rec["version"] == 1
+        # The swapped step really serves: echo + checksum contract.
+        assert new_rec["result"] == [4, 5, checksum_token([4, 5])]
+    finally:
+        verdict = _teardown_fleet(f)
+    assert verdict["exactly_once"], verdict
+    assert len(calls) == 1
+    swap_rank, swap_version, swap_rec = calls[0]
+    assert swap_rank == 0 and swap_version == 1
+    assert swap_rec["pending"] == 1 and swap_rec["step"] == 5
+    (_, _, _, out), = f["fleet"]
+    assert out["swaps"] == 1 and out["weight_version"] == 1
+
+
+# ---------------------------------------------------------------------------
+# The deploy state machine: watcher → canary → promote / roll back
+# ---------------------------------------------------------------------------
+
+
+def test_watcher_deploys_promotes_and_skips_corrupt(tmp_path,
+                                                    monkeypatch):
+    """The full promote arc through the watcher: ``poll_once`` picks up
+    a fresh verified checkpoint, canaries it under live load, and
+    promotes the whole fleet.  Then both bad-checkpoint paths: on-disk
+    corruption is quarantined inside the ``latest_checkpoint`` chain
+    walk (the watcher falls back, counted, fleet untouched), a
+    load-time verify failure surfaces as ``deploy_verify_failed`` in
+    the ledger — and the next good step still deploys fine."""
+    import distributed_machine_learning_tpu.runtime.deploy as deploy_mod
+
+    ckpts = tmp_path / "ckpts"
+    f = _deploy_fleet(tmp_path, replicas=3, world=3)
+    router, events = f["router"], f["events"]
+    ctl = _controller(f, ckpts)
+    done = threading.Event()
+    lt, lstop, _ = _start_load(router, min_requests=60, done=done)
+    try:
+        _wait_live(router, 3)
+        assert ctl.poll_once() is None  # empty dir: nothing to deploy
+        write_demo_checkpoint(str(ckpts), step=100)
+        out = ctl.poll_once()
+        assert out["outcome"] == "promoted", out
+        assert out["step"] == 100
+        assert out["canary"]["count"] >= 8 and out["canary"]["bad"] == 0
+        assert ctl.state == "promoted"
+        assert ctl.deployed_version == 1
+        assert ctl.deployed_meta["step"] == 100
+        assert ctl.poll_once() is None  # same step: not redeployed
+        versions = router.audit()["weight_versions"]
+        assert set(versions.values()) == {1}, versions
+        assert events.weight_swaps == 3
+        assert events.canary_promotions == 1
+        assert events.canary_rollbacks == 0
+        assert [h["why"] for h in ctl.history] == [
+            "canary", "promote", "promote"]
+        # On-disk corruption: the verified-chain walk quarantines the
+        # step and falls back — nothing to deploy, fleet untouched.
+        bad = write_demo_checkpoint(str(ckpts), step=150)
+        corrupt_checkpoint_data(bad)
+        assert ctl.poll_once() is None
+        assert events.ckpt_verify_failures >= 1
+        assert set(router.audit()["weight_versions"].values()) == {1}
+        # A load-time verify failure (the post-requantize class): the
+        # watcher surfaces it as deploy_verify_failed, counted in the
+        # deploy row, and the fleet stays on the deployed version.
+        real_load = deploy_mod.load_serving_weights
+
+        def flaky(path, template_params=None, *, events=None):
+            if os.path.basename(path) == "step_200":
+                raise CheckpointVerifyError(
+                    "injected: post-requantize digest mismatch")
+            return real_load(path, template_params, events=events)
+
+        monkeypatch.setattr(deploy_mod, "load_serving_weights", flaky)
+        write_demo_checkpoint(str(ckpts), step=200)
+        out = ctl.poll_once()
+        assert out["outcome"] == "verify_failed" and out["step"] == 200
+        assert set(router.audit()["weight_versions"].values()) == {1}
+        # The chain recovers: the next good step deploys as v2.
+        write_demo_checkpoint(str(ckpts), step=300)
+        out = ctl.poll_once()
+        assert out["outcome"] == "promoted" and out["step"] == 300
+        assert set(router.audit()["weight_versions"].values()) == {2}
+        done.set()
+        lt.join(30.0)
+        assert router.wait_idle(60.0), router.audit()
+    finally:
+        done.set()
+        lstop.set()
+        verdict = _teardown_fleet(f)
+    assert verdict["exactly_once"], verdict
+    summary = ctl.summary()
+    assert summary["state"] == "promoted"
+    assert summary["deployed_version"] == 2
+    assert summary["swaps"] == 6
+    assert [d["outcome"] for d in summary["deploys"]] == [
+        "promoted", "promoted"]
+    # Health ledger carries the whole state machine for the tools.
+    kinds = [e.get("kind")
+             for e in FileTransport(f["gang"]).snapshot()["health"]]
+    assert kinds.count("deploy_canary") == 2
+    assert kinds.count("deploy_promote") == 2
+    assert kinds.count("deploy_verify_failed") == 1
+    assert kinds.count("weight_swap") == 6
+
+
+def test_canary_quality_regression_rolls_back(tmp_path):
+    """The injected-regression arc: v1's step mis-computes the checksum
+    token, the canary probe fails inside the window, and the controller
+    re-swaps the canary back to v0 — counted, ledgered, with zero
+    dropped requests and the fleet back on the prior version."""
+
+    def on_swap_for(rank):
+        def on_swap(version, rec):
+            return versioned_step(version, corrupt=version == 1)
+
+        return on_swap
+
+    ckpts = tmp_path / "ckpts"
+    f = _deploy_fleet(tmp_path, replicas=3, world=3,
+                      on_swap_for=on_swap_for)
+    router, events = f["router"], f["events"]
+    ctl = _controller(f, ckpts)
+    done = threading.Event()
+    lt, lstop, _ = _start_load(router, min_requests=60, done=done)
+    try:
+        _wait_live(router, 3)
+        write_demo_checkpoint(str(ckpts), step=100)
+        out = ctl.poll_once()
+        assert out["outcome"] == "rolled_back", out
+        assert "quality regression" in out["reason"]
+        assert out["to_version"] == 0 and out["unrecovered"] == []
+        assert ctl.state == "rolled_back"
+        assert ctl.deployed_version == 0  # never promoted
+        assert set(router.audit()["weight_versions"].values()) == {0}
+        assert events.canary_rollbacks == 1
+        assert events.canary_promotions == 0
+        assert events.weight_swaps == 2  # canary out + rollback home
+        assert [h["why"] for h in ctl.history] == ["canary", "rollback"]
+        done.set()
+        lt.join(30.0)
+        assert router.wait_idle(60.0), router.audit()
+    finally:
+        done.set()
+        lstop.set()
+        verdict = _teardown_fleet(f)
+    # Zero requests dropped across swap + rollback.
+    assert verdict["exactly_once"], verdict
+    assert verdict["admitted"] == verdict["completed"]
+    kinds = [e.get("kind")
+             for e in FileTransport(f["gang"]).snapshot()["health"]]
+    assert "deploy_rollback" in kinds
+
+
+def test_canary_slo_burn_rolls_back(tmp_path):
+    """The deploy-scoped SLO engine (telemetry/slo.py burn-rate rule)
+    judges the canary's outcomes alone: a correct-but-slow v1 burns a
+    tight latency objective and rolls back even though every probe
+    passed."""
+
+    def on_swap_for(rank):
+        def on_swap(version, rec):
+            # Correct answers, 20ms service: quality clean, SLO burns.
+            return versioned_step(version, service_time_s=0.02)
+
+        return on_swap
+
+    ckpts = tmp_path / "ckpts"
+    f = _deploy_fleet(tmp_path, replicas=2, world=2,
+                      on_swap_for=on_swap_for)
+    router, events = f["router"], f["events"]
+    ctl = _controller(f, ckpts, canary_window=6, slo=("p99<=1ms",))
+    done = threading.Event()
+    lt, lstop, _ = _start_load(router, min_requests=40, done=done)
+    try:
+        _wait_live(router, 2)
+        write_demo_checkpoint(str(ckpts), step=100)
+        out = ctl.poll_once()
+        assert out["outcome"] == "rolled_back", out
+        assert out["reason"].startswith("SLO burn on canary: p99<=1ms")
+        assert events.canary_rollbacks == 1
+        assert set(router.audit()["weight_versions"].values()) == {0}
+        done.set()
+        lt.join(30.0)
+        assert router.wait_idle(60.0), router.audit()
+    finally:
+        done.set()
+        lstop.set()
+        verdict = _teardown_fleet(f)
+    assert verdict["exactly_once"], verdict
+
+
+# ---------------------------------------------------------------------------
+# Offline observability: the tools render the deployment state machine
+# ---------------------------------------------------------------------------
+
+
+def test_deployment_renders_in_status_tools_and_trace(tmp_path):
+    """Satellites 2 + 4: after a promote-then-rollback run, (a)
+    serve_status shows per-replica weight versions, the swap history,
+    and the rollback reason; (b) gang_status's serving section renders
+    the same edges; (c) the merged Perfetto timeline carries the
+    ``weight_swap`` instants on the replica tracks."""
+
+    def on_swap_for(rank):
+        def on_swap(version, rec):
+            return versioned_step(version, corrupt=version == 2)
+
+        return on_swap
+
+    ckpts = tmp_path / "ckpts"
+    teldir = str(tmp_path / "telemetry")
+    # File backend: the tools read the REAL serving records (per-
+    # replica weight versions) off disk, not just the mirrored ledger.
+    f = _deploy_fleet(tmp_path, replicas=2, world=2, backend="file",
+                      on_swap_for=on_swap_for, telemetry_dir=teldir)
+    router = f["router"]
+    ctl = _controller(f, ckpts)
+    done = threading.Event()
+    lt, lstop, _ = _start_load(router, min_requests=40, done=done)
+    try:
+        _wait_live(router, 2)
+        write_demo_checkpoint(str(ckpts), step=100)
+        assert ctl.poll_once()["outcome"] == "promoted"
+        write_demo_checkpoint(str(ckpts), step=200)
+        out = ctl.poll_once()
+        assert out["outcome"] == "rolled_back", out
+        done.set()
+        lt.join(30.0)
+        assert router.wait_idle(60.0), router.audit()
+    finally:
+        done.set()
+        lstop.set()
+        verdict = _teardown_fleet(f)
+    assert verdict["exactly_once"], verdict
+
+    serve_status = _load_tool("serve_status")
+    status = serve_status.collect(f["gang"], teldir)
+    dep = status["deployment"]
+    assert dep["state"] == "rolled_back"
+    assert dep["promotions"] == 1 and dep["rollbacks"] == 1
+    assert len(dep["swaps"]) >= 3  # 2 promote swaps + canary + rollback
+    rendered = serve_status.render(status)
+    assert "Continuous deployment" in rendered
+    assert "weights v1" in rendered       # replicas back on v1
+    assert "swap: replica" in rendered
+    assert "rollback" in rendered and "quality regression" in rendered
+
+    gang_status = _load_tool("gang_status")
+    grendered = gang_status.render(gang_status.collect(f["gang"],
+                                                       teldir))
+    assert "weight_swap" in grendered or "swap" in grendered
+    assert "deploy_rollback" in grendered or "rollback" in grendered
+
+    trace_merge = _load_tool("trace_merge")
+    merged, counts = trace_merge.merge_traces(teldir)
+    swap_instants = [e for e in merged["traceEvents"]
+                     if e.get("name") == "weight_swap"]
+    # v1 on both replicas, v2 canary, rollback-to-v1: >= 4 instants,
+    # re-homed onto the serving pid block.
+    assert len(swap_instants) >= 4, json.dumps(counts)
+    assert all(e["pid"] >= trace_merge.SERVING_PID_BASE
+               for e in swap_instants)
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 chaos campaign: replica killed mid-swap
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faultinject
+def test_chaos_replica_killed_mid_swap_rolls_back(tmp_path):
+    """The ISSUE 18 acceptance campaign: 6 live replicas + 1 warm
+    spare under sustained load; a deploy rolls mid-load and the canary
+    replica DIES inside ``on_swap`` (staged, never committed).  The
+    controller must time out the commit and roll back — counted and
+    ledgered, never silent; the fleet must heal by spare promotion
+    (the dead rank's work requeued, exactly once); and a follow-up
+    deploy must promote cleanly on the healed fleet.  Wall-clock
+    capped."""
+    t_start = time.monotonic()
+    victim = {"rank": None}
+
+    def on_swap_for(rank):
+        def on_swap(version, rec):
+            if version == 1 and rank == victim["rank"]:
+                raise TransportError("injected: replica died mid-swap")
+            return versioned_step(version)
+
+        return on_swap
+
+    ckpts = tmp_path / "ckpts"
+    f = _deploy_fleet(tmp_path, replicas=6, world=7,
+                      on_swap_for=on_swap_for, replica_timeout_s=0.4,
+                      micro_batch=4)
+    router, events = f["router"], f["events"]
+    ctl = _controller(f, ckpts, commit_timeout_s=1.0,
+                      judge_timeout_s=20.0)
+    done = threading.Event()
+    lt, lstop, _ = _start_load(router, min_requests=300, done=done)
+    try:
+        _wait_live(router, 6)
+        deadline = time.monotonic() + 30.0
+        while router.completed < 30:
+            assert time.monotonic() < deadline, "fleet never warmed up"
+            time.sleep(0.01)
+        # The canary is the lowest live rank: aim the kill at it.
+        victim["rank"] = min(router.audit()["weight_versions"])
+        write_demo_checkpoint(str(ckpts), step=100)
+        out = ctl.poll_once()
+        assert out["outcome"] == "rolled_back", out
+        assert "failed to commit v1" in out["reason"]
+        assert out["unrecovered"] == []  # nothing committed to undo
+        assert events.canary_rollbacks == 1
+        assert events.weight_swaps == 0  # the stage never committed
+        # Heal: the dead canary stops beating, is evicted, the spare
+        # promotes, and the orphaned work re-dispatches.
+        deadline = time.monotonic() + 30.0
+        while events.replica_evictions < 1 or len(
+                router.audit()["weight_versions"]) < 6:
+            assert time.monotonic() < deadline, router.audit()
+            time.sleep(0.01)
+        live = router.audit()["weight_versions"]
+        assert victim["rank"] not in live
+        assert set(live.values()) == {0}  # everyone on the old version
+        # The healed fleet still deploys: the next step promotes.
+        write_demo_checkpoint(str(ckpts), step=200)
+        out = ctl.poll_once()
+        assert out["outcome"] == "promoted", out
+        assert set(router.audit()["weight_versions"].values()) == {2}
+        assert events.canary_promotions == 1
+        assert events.weight_swaps == 6
+        done.set()
+        lt.join(60.0)
+        assert router.wait_idle(60.0), router.audit()
+    finally:
+        done.set()
+        lstop.set()
+        verdict = _teardown_fleet(f)
+    elapsed = time.monotonic() - t_start
+    # Exactly-once across the kill, the rollback, and the redeploy.
+    assert verdict["exactly_once"], verdict
+    assert verdict["admitted"] == verdict["completed"] >= 300
+    assert verdict["unknown_results"] == 0
+    assert verdict["evictions"] >= 1
+    assert verdict["promotions"] >= 7  # 6 initial + the heal
+    kinds = [e.get("kind")
+             for e in FileTransport(f["gang"]).snapshot()["health"]]
+    assert "deploy_rollback" in kinds and "deploy_promote" in kinds
+    assert elapsed < CHAOS_BUDGET_S, (
+        f"deploy chaos campaign took {elapsed:.1f}s (cap "
+        f"{CHAOS_BUDGET_S:.0f}s)")
+
+
+@pytest.mark.slow
+@pytest.mark.faultinject
+def test_chaos_endurance_multi_deploy_with_kills(tmp_path):
+    """Endurance variant: 8 replicas + 2 spares, three deploys rolled
+    under continuous load — promote, injected quality rollback, then a
+    non-canary replica killed mid-canary-window before a final
+    promote.  Exactly-once throughout."""
+    t_start = time.monotonic()
+
+    def on_swap_for(rank):
+        def on_swap(version, rec):
+            return versioned_step(version, corrupt=version == 2)
+
+        return on_swap
+
+    ckpts = tmp_path / "ckpts"
+    f = _deploy_fleet(tmp_path, replicas=8, world=10,
+                      on_swap_for=on_swap_for, replica_timeout_s=0.4,
+                      micro_batch=4)
+    router, events = f["router"], f["events"]
+    ctl = _controller(f, ckpts, judge_timeout_s=30.0)
+    done = threading.Event()
+    lt, lstop, _ = _start_load(router, min_requests=600, done=done)
+    try:
+        _wait_live(router, 8)
+        write_demo_checkpoint(str(ckpts), step=100)
+        assert ctl.poll_once()["outcome"] == "promoted"
+        write_demo_checkpoint(str(ckpts), step=200)
+        out = ctl.poll_once()
+        assert out["outcome"] == "rolled_back"
+        assert "quality regression" in out["reason"]
+        assert set(router.audit()["weight_versions"].values()) == {1}
+        # Kill a non-canary replica, then deploy through the churn.
+        live = sorted(router.audit()["weight_versions"])
+        target = live[-1]
+        for rank, stop, _, _ in f["fleet"]:
+            if rank == target:
+                stop.set()
+        write_demo_checkpoint(str(ckpts), step=300)
+        out = ctl.poll_once()
+        # Promote unless the dying rank was caught mid-promote-swap;
+        # either way the outcome is explicit and counted.
+        assert out["outcome"] in ("promoted", "rolled_back"), out
+        deadline = time.monotonic() + 30.0
+        while len(router.audit()["weight_versions"]) < 8:
+            assert time.monotonic() < deadline, router.audit()
+            time.sleep(0.01)
+        done.set()
+        lt.join(60.0)
+        assert router.wait_idle(90.0), router.audit()
+    finally:
+        done.set()
+        lstop.set()
+        verdict = _teardown_fleet(f)
+    elapsed = time.monotonic() - t_start
+    assert verdict["exactly_once"], verdict
+    assert verdict["admitted"] == verdict["completed"] >= 600
+    assert events.canary_promotions >= 1
+    assert events.canary_rollbacks >= 1
+    assert elapsed < 2 * CHAOS_BUDGET_S, elapsed

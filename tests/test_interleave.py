@@ -205,6 +205,22 @@ def test_drain_promote_bug_is_rediscovered(tmp_path):
     assert "zombie" in trace and "hub:sepoch:gap" in trace
 
 
+def test_weight_swap_bug_is_rediscovered(tmp_path):
+    # ISSUE 18: the continuous-deployment hot-swap.  With the post
+    # fence's weights-version check hoisted outside the lock, an
+    # old-version compute's post parks in the TOCTOU window through
+    # commit_weights' version flip and lands AFTER the swap committed
+    # — a duplicate completion for a request the post-swap compute
+    # already answered.
+    f, repro = _gate(tmp_path, "weight_swap", "swap-unfenced")
+    assert "old-version post" in f.message
+    r1 = replay_file(repro)
+    r2 = replay_file(repro)
+    assert r1 == r2 and r1["reproduced"]
+    trace = format_trace(r1["trace"])
+    assert "zombie" in trace and "hub:swv:gap" in trace
+
+
 def test_mutations_restore_the_fixed_methods(tmp_path):
     orig_evict = TcpGangServer.__dict__["_evict_seen_locked"]
     orig_locked = InProcTransport.__dict__["_locked"]
@@ -228,7 +244,7 @@ def test_unknown_mutation_and_scenario_are_loud():
     with pytest.raises(ValueError, match="unknown scenario"):
         run_layer3(quick=True, scenarios=["no_such_protocol"])
     assert set(MUTATIONS) == {"dedup-evict", "epoch-unlocked",
-                              "result-unfenced"}
+                              "result-unfenced", "swap-unfenced"}
 
 
 # ---------------------------------------------------------------------------

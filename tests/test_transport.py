@@ -325,7 +325,8 @@ def test_serving_result_fence_retire_and_roles(backend):
     assert [r["rid"] for r in undelivered] == ["undelivered"]
     after = router.read_serving(0)
     assert after == {"role": "spare", "epoch": e0 + 1,
-                     "drain": False, "queued": 0}
+                     "drain": False, "queued": 0,
+                     "weights": {"version": 0, "pending": None}}
     # The retired epoch's late post bounces off the fence...
     assert worker.post_result(0, e0, {"rid": "late"}) is False
     assert router.take_results(8) == []
@@ -342,11 +343,44 @@ def test_serving_state_reaches_fleet_view_and_snapshot(backend):
     tx.set_drain(2, True)
     fleet = peer.read_serving()
     assert fleet["replicas"][1] == {"role": "live", "epoch": 0,
-                                    "drain": False, "queued": 1}
+                                    "drain": False, "queued": 1,
+                                    "weights": {"version": 0,
+                                                "pending": None}}
     assert fleet["replicas"][2]["drain"] is True
     assert fleet["results"] == 0
     snap = peer.snapshot()
     assert snap["serving"]["replicas"][1]["queued"] == 1
+
+
+def test_weight_swap_stage_commit_and_fence(backend):
+    """ISSUE 18: the weights channel on every backend.  Staging a
+    version does NOT move the fence (in-flight old-version work keeps
+    completing — the zero-dropped-requests half); the commit flips it
+    atomically, and from then on an old-version post is discarded."""
+    _, make = backend
+    deploy, worker = make(), make()
+    deploy.set_serving_role(3, "live")
+    e0 = deploy.read_serving(3)["epoch"]
+    deploy.set_weights(3, 1, {"step": 100, "digest": "abc"})
+    rec = deploy.read_serving(3)["weights"]
+    assert rec["version"] == 0 and rec["pending"] == 1
+    assert rec["step"] == 100 and rec["digest"] == "abc"
+    assert worker.post_result(3, e0, {"rid": "pre"}, version=0) is True
+    assert worker.commit_weights(3, 1) is True
+    rec = deploy.read_serving(3)["weights"]
+    assert rec["version"] == 1 and rec["pending"] is None
+    assert worker.post_result(3, e0, {"rid": "old"}, version=0) is False
+    assert worker.post_result(3, e0, {"rid": "new"}, version=1) is True
+    got = {r["rid"]: r for r in deploy.take_results(8)}
+    assert set(got) == {"pre", "new"}
+    assert got["new"]["version"] == 1
+    # Version-less posts (fleets with no deployment controller) are
+    # never version-fenced — the pre-ISSUE-18 contract is unchanged.
+    assert worker.post_result(3, e0, {"rid": "plain"}) is True
+    # The weights record survives a retire (the spare still HOLDS the
+    # weights it last served; re-promotion decides what to load).
+    deploy.retire_replica(3)
+    assert deploy.read_serving(3)["weights"]["version"] == 1
 
 
 def test_serving_state_is_wiped_with_the_gang(backend):

@@ -20,9 +20,10 @@ telemetry plane the workers stream (default ``<gang-dir>/telemetry``):
   (``telemetry/aggregator.py``): per-rank throughput, whole-run
   p95/max step-time skew, offline straggler verdicts;
 - the serving view (ISSUE 16): replica states (role / serving epoch /
-  drain latch / queue depth) from the transport snapshot, the router's
-  final SLO summary record, and the promotion / eviction / drain
-  history from the health ledger.
+  drain latch / queue depth / committed+staging weight version) from
+  the transport snapshot, the router's final SLO summary record, and
+  the promotion / eviction / drain / weight-swap / canary
+  promote-rollback history from the health ledger (ISSUE 18).
 
 Live mode (``--watch N``) re-renders every N seconds; everything
 tolerates the artifacts of a crash (torn lines, frozen beat files) —
@@ -182,7 +183,9 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
         if kind == "serving":
             serving_summary = e
         elif kind in ("serve_promote", "serve_evict", "serve_drain",
-                      "serve_demote"):
+                      "serve_demote", "weight_swap", "deploy_canary",
+                      "deploy_promote", "deploy_rollback",
+                      "deploy_verify_failed"):
             serving_history.append(e)
     out = {
         "gang_dir": gang_dir,
@@ -337,9 +340,14 @@ def render(status: dict) -> str:
                               key=lambda kv: int(kv[0])):
         state = ("draining" if rec.get("drain")
                  else rec.get("role", "?"))
+        w = rec.get("weights") or {}
+        wtxt = f", weights v{w.get('version', 0)}"
+        if w.get("pending") is not None:
+            wtxt += f" (staging v{w['pending']})"
         lines.append(f"  replica {rank_s}: {state}, epoch "
                      f"{rec.get('epoch', 0)}, "
-                     f"{rec.get('queued', 0)} queued request(s)")
+                     f"{rec.get('queued', 0)} queued request(s)"
+                     f"{wtxt}")
     for e in sv_hist:
         kind = e.get("kind")
         if kind == "serve_promote":
@@ -353,6 +361,25 @@ def render(status: dict) -> str:
         elif kind == "serve_drain":
             lines.append(f"  drain: replica {e.get('rank')} stopped "
                          "admitting, finishing in-flight")
+        elif kind == "weight_swap":
+            lines.append(f"  swap: replica {e.get('rank')} -> "
+                         f"v{e.get('version', '?')} "
+                         f"(step {e.get('step', '?')}, "
+                         f"{e.get('why', '?')})")
+        elif kind == "deploy_canary":
+            lines.append(f"  canary: v{e.get('version', '?')} on "
+                         f"replica(s) {e.get('ranks', '?')}, every "
+                         f"{e.get('every_n', '?')}th dispatch")
+        elif kind == "deploy_promote":
+            lines.append(f"  promote deploy: v{e.get('version', '?')} "
+                         "fleet-wide (clean canary window)")
+        elif kind == "deploy_rollback":
+            lines.append(f"  rollback: v{e.get('version', '?')} -> "
+                         f"v{e.get('to_version', '?')} — "
+                         f"{e.get('reason', '?')}")
+        elif kind == "deploy_verify_failed":
+            lines.append(f"  deploy blocked: step {e.get('step', '?')} "
+                         "failed verification before any bytes moved")
         else:  # serve_demote
             lines.append(f"  demote: replica {e.get('rank')} -> spare "
                          f"({e.get('why', '?')})")

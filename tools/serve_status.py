@@ -24,6 +24,12 @@ complete event timeline — the "why was THIS request slow" debugging
 workflow: every stage, who stamped it, and the rank-local delta since
 that actor's previous stamp.
 
+A fleet that took continuous deployments (ISSUE 18,
+``cli/deploy.py``) additionally renders each replica's committed /
+staging weight version and a "Continuous deployment" section: the
+reconstructed state machine (canary / promoted / rolled_back), the
+per-replica swap history, and every rollback with its reason.
+
 Usage:  python tools/serve_status.py <gang-dir> [--telemetry DIR]
                  [--slo SPEC ...] [--postmortem RID] [--json]
 """
@@ -71,12 +77,35 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
     health = snap["health"]
     summary = None
     requests = []
+    deploy_events = []
     for e in health:
         kind = e.get("kind")
         if kind == "serving":
             summary = e
         elif kind == "serve_request":
             requests.append(e)
+        elif kind in ("weight_swap", "deploy_canary", "deploy_promote",
+                      "deploy_rollback", "deploy_verify_failed"):
+            deploy_events.append(e)
+    # The deployment state machine (ISSUE 18), reconstructed from the
+    # ledger: the LAST state edge wins, swap history rides along.
+    dep_state = None
+    for e in deploy_events:
+        dep_state = {"deploy_canary": "canary",
+                     "deploy_promote": "promoted",
+                     "deploy_rollback": "rolled_back",
+                     "deploy_verify_failed": "verify_failed"}.get(
+            e.get("kind"), dep_state)
+    deployment = {
+        "state": dep_state,
+        "swaps": [e for e in deploy_events
+                  if e.get("kind") == "weight_swap"],
+        "promotions": sum(1 for e in deploy_events
+                          if e.get("kind") == "deploy_promote"),
+        "rollbacks": sum(1 for e in deploy_events
+                         if e.get("kind") == "deploy_rollback"),
+        "events": deploy_events,
+    }
     # Per-replica compute intervals out of the event stream — the same
     # ``computed``-delta feed the router's straggler judgement uses.
     compute: dict[int, list[float]] = {}
@@ -110,6 +139,7 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
         "gang_dir": gang_dir,
         "serving_state": snap.get("serving"),
         "summary": summary,
+        "deployment": deployment,
         "requests": requests,
         "replicas": replica_rows,
         "stages": stages,
@@ -189,9 +219,33 @@ def render(status: dict, slo_verdict: dict | None = None) -> str:
     for rank_s, rec in sorted((state.get("replicas") or {}).items(),
                               key=lambda kv: int(kv[0])):
         role = "draining" if rec.get("drain") else rec.get("role", "?")
+        w = rec.get("weights") or {}
+        wtxt = f", weights v{w.get('version', 0)}"
+        if w.get("pending") is not None:
+            wtxt += f" (staging v{w['pending']})"
         lines.append(f"  replica {rank_s}: {role}, epoch "
                      f"{rec.get('epoch', 0)}, "
-                     f"{rec.get('queued', 0)} queued request(s)")
+                     f"{rec.get('queued', 0)} queued request(s)"
+                     f"{wtxt}")
+    dep = status.get("deployment") or {}
+    if dep.get("events"):
+        lines.append("== Continuous deployment ==")
+        lines.append(
+            f"  state: {dep.get('state', '?')}, "
+            f"{len(dep.get('swaps') or ())} swap(s), "
+            f"{dep.get('promotions', 0)} promoted, "
+            f"{dep.get('rollbacks', 0)} rolled back")
+        for e in dep.get("swaps") or ():
+            lines.append(
+                f"  swap: replica {e.get('rank', '?')} -> "
+                f"v{e.get('version', '?')} "
+                f"(step {e.get('step', '?')}, {e.get('why', '?')})")
+        for e in dep.get("events") or ():
+            if e.get("kind") == "deploy_rollback":
+                lines.append(
+                    f"  rollback: v{e.get('version', '?')} -> "
+                    f"v{e.get('to_version', '?')}: "
+                    f"{e.get('reason', '?')}")
     stages = status.get("stages") or {}
     if stages:
         lines.append("== Per-stage latency ==")
