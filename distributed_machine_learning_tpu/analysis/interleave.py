@@ -16,16 +16,18 @@ module makes them testable deterministically:
   the quick configs (≤3 threads / ≤8 ops), with label-based
   partial-order pruning and a bounded-preemption filter for the larger
   ``full`` configs.
-- :data:`SCENARIOS` — eight bounded gang protocols (abort race, join
+- :data:`SCENARIOS` — nine bounded gang protocols (abort race, join
   duplicate delivery, ledger append storm, dedup-cache hit racing a
   slow in-flight apply, beat publish vs batched reads, epoch fence vs
   zombie thread, serving drain/promote handoff vs a retiring
   replica's late result, weight hot-swap commit vs an old-version
-  compute's late post), each with invariants checked after every
+  compute's late post, paged-KV admission racing decode appends and
+  retirement frees), each with invariants checked after every
   terminal schedule.
 - :data:`MUTATIONS` — the known-bug seeds (the pre-fix dedup eviction,
   the pre-fix epoch check outside the lock, the pre-fix serving
-  result fence, the pre-fix weight-swap version fence).  The
+  result fence, the pre-fix weight-swap version fence, the pre-fix
+  block-allocator capacity check outside the lock).  The
   mutation-test gate: with a seed applied, the explorer must
   rediscover the bug deterministically; on the fixed tree it must
   exit clean.
@@ -45,9 +47,11 @@ Stdlib-only by construction, like the rest of layer 1's import chain.
 from __future__ import annotations
 
 import contextlib
+import importlib.util
 import json
 import os
 import shutil
+import sys
 import tempfile
 import threading
 import time
@@ -63,6 +67,32 @@ from ..runtime.transport import (
     _read_jsonl_dicts,
 )
 from .findings import Finding
+
+
+def _load_kv_blocks():
+    """The block allocator under test WITHOUT importing the
+    ``inference`` package: its ``__init__`` pulls in jax, and this
+    module must stay importable under ``python -S`` (the dmlcheck
+    CLI).  ``kv_blocks.py`` itself is stdlib-only by construction, so
+    when the canonical module is already loaded (pytest runs) the
+    scenario — and the ``admit-unlocked`` seed — target the REAL
+    class; otherwise the file is loaded directly, bypassing the
+    package ``__init__``."""
+    mod = sys.modules.get(
+        "distributed_machine_learning_tpu.inference.kv_blocks")
+    if mod is not None:
+        return mod
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "inference", "kv_blocks.py")
+    spec = importlib.util.spec_from_file_location(
+        "dml_layer3_kv_blocks", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_kvb = _load_kv_blocks()
 
 LAYER3_RULES = {"DML301", "DML302"}
 
@@ -853,6 +883,70 @@ def _build_weight_swap() -> _Scenario:
                      check)
 
 
+def _build_continuous_batching() -> _Scenario:
+    """The paged-KV admission race (ISSUE 19): the router thread
+    admits sequences into the block pool while the engine thread
+    appends decode tokens and retires finished lanes.  Pool of 3
+    blocks (block_size 2); lane "c" is live holding one block;
+    admitters "a" and "b" each pledge 2 blocks — either alone fits
+    the 2-block headroom, both together overcommit it.  Invariants:
+    the allocator's accounting identities hold at every admit edge
+    and terminally (pledged never exceeds free — the reserve-on-admit
+    guarantee), every admitted sequence decodes its full budget at
+    contiguous slots, and every block returns to the pool.
+    ``MUTATIONS['admit-unlocked']`` hoists the capacity check out of
+    the critical section: two admitters park in the TOCTOU window,
+    both pass against the same headroom, and the pool overcommits.
+    """
+    alloc = _kvb.BlockAllocator(num_blocks=3, block_size=2)
+    alloc.admit("c", prompt_len=2, max_new=0)   # a live decode lane
+    outcome: dict = {}
+
+    def admitter(seq):
+        def run():
+            try:
+                alloc.admit(seq, prompt_len=2, max_new=2)
+            except _kvb.CacheExhausted:
+                outcome[seq] = "exhausted"
+                return
+            alloc.check_invariants()   # the admit edge must be sane
+            slots = [alloc.append(seq) for _ in range(2)]
+            alloc.free(seq)
+            outcome[seq] = slots
+        return run
+
+    def retire_c():
+        # Free-on-finish returning "c"'s block while admissions race.
+        alloc.free("c")
+
+    def check():
+        v = []
+        try:
+            alloc.check_invariants()
+        except AssertionError as e:
+            v.append(f"allocator invariant broken: {e}")
+        st = alloc.stats()
+        if st["sequences"] or st["free"] != alloc.num_blocks:
+            v.append("blocks leaked past retirement: "
+                     f"{st['free']}/{alloc.num_blocks} free, "
+                     f"{st['sequences']} live sequence(s)")
+        admitted = [s for s in ("a", "b")
+                    if isinstance(outcome.get(s), list)]
+        if not admitted:
+            v.append("admission control starved both admitters of a "
+                     f"2-block headroom: {outcome}")
+        for s in admitted:
+            if outcome[s] != [2, 3]:
+                v.append(f"sequence {s} decoded slots {outcome[s]} "
+                         "(want contiguous [2, 3] — the "
+                         "reserve-on-admit guarantee)")
+        return v
+
+    return _Scenario([("admit-a", admitter("a")),
+                      ("admit-b", admitter("b")),
+                      ("retire-c", retire_c)], check)
+
+
 # name -> {"quick": build, "full": build, "quick_max": int,
 #          "full_max": int, "invariant": str}
 SCENARIOS = {
@@ -913,6 +1007,15 @@ SCENARIOS = {
         "invariant": "an old-version compute's late post is fenced "
                      "at the swap commit and every request delivers "
                      "exactly once across the weight hot-swap",
+    },
+    "continuous_batching": {
+        "quick": _build_continuous_batching,
+        "full": _build_continuous_batching,
+        "quick_max": 6000, "full_max": 30000,
+        "invariant": "paged-KV admission check-and-bind is one "
+                     "critical section: the pool never overcommits "
+                     "and every admitted sequence decodes within its "
+                     "reservation",
     },
 }
 
@@ -994,6 +1097,37 @@ def _post_result_swap_unfenced(self, replica, epoch, payload,
     return True
 
 
+def _admit_unlocked(self, seq, prompt_len: int, max_new: int):
+    # The pre-fix BlockAllocator.admit: the capacity check reads the
+    # headroom OUTSIDE the critical section that binds the blocks,
+    # with an explicit schedule point in the TOCTOU window — two
+    # admitters park in the gap, both pass against the same headroom,
+    # and the pool overcommits (pledged > free), breaking the
+    # reserve-on-admit guarantee as an empty-pool pop mid-decode.
+    if prompt_len < 1:
+        raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+    if max_new < 0:
+        raise ValueError(f"max_new must be >= 0, got {max_new}")
+    _coord._sched_point("kvb:admit")
+    with self._lock:
+        if seq in self._tables:
+            raise ValueError(f"sequence {seq!r} already admitted")
+        avail = len(self._free) - self._pledged
+    need = _kvb.blocks_needed(prompt_len + max_new, self.block_size)
+    if need > avail:
+        raise _kvb.CacheExhausted(
+            f"need {need} blocks, {avail} available")
+    _coord._sched_point("kvb:admit:gap")
+    with self._lock:
+        now = _kvb.blocks_needed(prompt_len, self.block_size)
+        table = [self._free.pop() for _ in range(now)]
+        self._tables[seq] = table
+        self._lengths[seq] = prompt_len
+        self._reserved[seq] = need
+        self._pledged += need - now
+        return list(table)
+
+
 # name -> (class, attr, broken replacement)
 MUTATIONS = {
     "dedup-evict": (TcpGangServer, "_evict_seen_locked",
@@ -1004,6 +1138,7 @@ MUTATIONS = {
                         _post_result_unfenced),
     "swap-unfenced": (InProcTransport, "_do_post_result",
                       _post_result_swap_unfenced),
+    "admit-unlocked": (_kvb.BlockAllocator, "admit", _admit_unlocked),
 }
 
 

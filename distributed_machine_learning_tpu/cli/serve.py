@@ -34,7 +34,11 @@ requests, and demotes to spare with zero drops.
 The decode step is synthetic by default (echo + checksum token, with
 ``--service-time`` of simulated work) so the fleet story is testable
 without a model; ``inference/generate.py::make_serving_step`` is the
-production step-callable this slot takes.
+production step-callable this slot takes.  ``--engine`` (ISSUE 19)
+swaps every replica onto the continuous-batching engine
+(``inference/continuous.py``: paged KV cache, iteration-level
+scheduling) over a tiny real model, and hands the router the
+regime-aware scheduler whose lever/flips are reported at exit.
 
 Observability (ISSUE 17): ``--telemetry-dir`` gives every serving
 process its own instance-tagged stream (``registry.router.json`` with
@@ -75,6 +79,34 @@ def synthetic_step(service_time_s: float = 0.0):
     return step
 
 
+def _make_engine(micro_batch: int):
+    """A continuous-batching engine (ISSUE 19) over a tiny real model
+    — one per worker, since an engine is owned by a single thread.
+    Warmed before the worker starts heartbeating: XLA compilation
+    inside the first live ``step()`` would starve the beat channel
+    long enough to look like a dead replica."""
+    from distributed_machine_learning_tpu.inference.continuous import (
+        ContinuousEngine,
+        EngineConfig,
+    )
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from distributed_machine_learning_tpu.train.lm_step import (
+        init_lm_state,
+    )
+
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=2,
+                          n_heads=4, n_kv_heads=2)
+    engine = ContinuousEngine(
+        model, init_lm_state(model).params,
+        EngineConfig(max_lanes=micro_batch, block_size=4,
+                     num_blocks=32, max_len=16, max_new=8),
+    )
+    engine.warmup(prompt_lens=(1, 2, 3))
+    return engine
+
+
 def _parse_tx_chaos(spec: str):
     from distributed_machine_learning_tpu.runtime.faults import (
         TransportChaos,
@@ -113,11 +145,12 @@ def _run_worker(args) -> int:
     tx = make_transport("tcp", address=args.address, chaos=chaos)
     stop = threading.Event()
     tel = _instance_telemetry(args, f"replica{args.rank}")
+    engine = _make_engine(args.micro_batch) if args.engine else None
     try:
         summary = run_serving_worker(
             tx, args.rank, synthetic_step(args.service_time), stop,
             ServingWorkerConfig(micro_batch=args.micro_batch),
-            telemetry=tel)
+            telemetry=tel, engine=engine)
     finally:
         if tel is not None:
             tel.close()
@@ -178,6 +211,13 @@ def _run_fleet(args) -> int:
     worker_tels = [_instance_telemetry(args, f"replica{rank}")
                    for rank in range(world)]
 
+    scheduler = None
+    if args.engine:
+        from distributed_machine_learning_tpu.runtime.scheduler import (
+            RegimeScheduler,
+        )
+
+        scheduler = RegimeScheduler()
     events = FaultEvents()
     router = ServingRouter(
         make_tx(),
@@ -185,14 +225,15 @@ def _run_fleet(args) -> int:
                       max_queue=args.max_queue,
                       micro_batch=args.micro_batch,
                       replica_timeout_s=args.replica_timeout),
-        events=events, telemetry=router_tel, slo=slo)
+        events=events, telemetry=router_tel, slo=slo,
+        scheduler=scheduler)
     stop = threading.Event()
     wcfg = ServingWorkerConfig(micro_batch=args.micro_batch)
-    workers = [start_worker_thread(make_tx(), rank,
-                                   synthetic_step(args.service_time),
-                                   stop, wcfg,
-                                   telemetry=worker_tels[rank])
-               for rank in range(world)]
+    workers = [start_worker_thread(
+        make_tx(), rank, synthetic_step(args.service_time), stop, wcfg,
+        telemetry=worker_tels[rank],
+        engine=_make_engine(args.micro_batch) if args.engine else None)
+        for rank in range(world)]
     router_thread = threading.Thread(target=router.run, args=(stop,),
                                      name="serve-router", daemon=True)
     router_thread.start()
@@ -248,6 +289,9 @@ def _run_fleet(args) -> int:
         print(f"latency: p50 {lat['p50'] * 1e3:.1f} ms  "
               f"p95 {lat['p95'] * 1e3:.1f} ms  "
               f"p99 {lat['p99'] * 1e3:.1f} ms")
+    if scheduler is not None:
+        print(f"regime: {scheduler.lever} after "
+              f"{scheduler.flips} flip(s)")
     print(resilience_summary(events))
     rc = 0
     if slo is not None:
@@ -286,6 +330,12 @@ def main(argv=None) -> int:
                          "raise Overloaded")
     ap.add_argument("--micro-batch", dest="micro_batch", type=int,
                     default=4, help="requests per dispatch")
+    ap.add_argument("--engine", action="store_true",
+                    help="replicas run the continuous-batching engine "
+                         "(paged KV cache, per-sequence retirement, "
+                         "ISSUE 19) over a tiny real model instead of "
+                         "the synthetic batch step; the router gets "
+                         "the regime-aware scheduler")
     ap.add_argument("--service-time", dest="service_time", type=float,
                     default=0.0,
                     help="simulated decode seconds per micro-batch")

@@ -84,6 +84,7 @@ def make_generate_fn(
     top_k: int | None = None,
     quantize: str | None = None,
     top_p: float | None = None,
+    eos_id: int | None = None,
 ):
     """Build a jitted ``fn(params, prompt, rng) -> tokens``.
 
@@ -98,6 +99,15 @@ def make_generate_fn(
     converted by ``ops.quant.quantize_lm_params`` (the ``generate``
     wrapper converts for you) — decode is weight-bandwidth-bound, so
     halving the weight bytes is ~the step-time divisor (docs/PERF.md).
+
+    ``eos_id`` (ISSUE 19): with an EOS token set, decode runs as a
+    ``lax.while_loop`` that exits as soon as EVERY row has emitted
+    ``eos_id`` — a short batch stops paying ``max_new_tokens`` steps.
+    Rows that finish early emit ``eos_id`` for their remaining slots
+    (the output shape stays static), and their pre-EOS tokens are
+    token-for-token identical to the ``eos_id=None`` run — asserted in
+    ``tests/test_serving.py``.  ``eos_id=None`` keeps the original
+    fixed-length ``lax.scan`` program bit-for-bit.
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -106,10 +116,11 @@ def make_generate_fn(
     dm = model.clone(attn_impl="dense", decode=True, weight_quant=quantize)
     sample = partial(_sample, temperature=temperature, top_k=top_k,
                      top_p=top_p)
-    return jax.jit(partial(_generate_body, dm, sample, max_new_tokens))
+    return jax.jit(partial(_generate_body, dm, sample, max_new_tokens,
+                           eos_id))
 
 
-def _generate_body(dm, sample, max_new_tokens, params, prompt, rng):
+def _generate_body(dm, sample, max_new_tokens, eos_id, params, prompt, rng):
     """The traced generate program (prefill + decode scan) — shared by
     the single-device jit (:func:`make_generate_fn`) and the manual-TP
     shard_map wrap (:func:`make_tp_generate_fn`), so the two paths can
@@ -143,22 +154,60 @@ def _generate_body(dm, sample, max_new_tokens, params, prompt, rng):
     rng, r = jax.random.split(rng)
     tok = sample(logits[:, -1], r)  # first generated token
 
-    def body(carry, _):
-        cache, tok, rng = carry
+    if eos_id is None:
+        def body(carry, _):
+            cache, tok, rng = carry
+            logits, vars_ = dm.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                train=False, mutable=["cache"],
+            )
+            rng, r = jax.random.split(rng)
+            nxt = sample(logits[:, -1], r)
+            return (vars_["cache"], nxt, rng), tok
+
+        (_, last, _), toks = lax.scan(
+            body, (vars_["cache"], tok, rng), None,
+            length=max_new_tokens - 1,
+        )
+        # toks: [max_new-1, B] tokens 1..max_new-1; `last` is the final.
+        gen = jnp.concatenate([toks, last[None]], axis=0).swapaxes(0, 1)
+        return jnp.concatenate([prompt, gen], axis=1)
+
+    # EOS early-exit (ISSUE 19): a while_loop that stops the moment
+    # every row has finished.  Finished rows keep riding the batch
+    # (the program stays batch-static; their cache writes are masked
+    # into irrelevance by forcing their tokens to eos), but once ALL
+    # rows are done the remaining decode steps are never issued —
+    # that is the "finished sequences stop consuming decode steps"
+    # fix for the batch-static serving path.
+    eos = jnp.int32(eos_id)
+    done = tok == eos
+    buf = jnp.full((B, max_new_tokens), eos, jnp.int32)
+    buf = buf.at[:, 0].set(tok)
+
+    def cond(carry):
+        _, _, _, _, done, i = carry
+        return jnp.logical_and(i < max_new_tokens,
+                               jnp.logical_not(jnp.all(done)))
+
+    def body(carry):
+        cache, tok, rng, buf, done, i = carry
         logits, vars_ = dm.apply(
             {"params": params, "cache": cache}, tok[:, None],
             train=False, mutable=["cache"],
         )
         rng, r = jax.random.split(rng)
         nxt = sample(logits[:, -1], r)
-        return (vars_["cache"], nxt, rng), tok
+        nxt = jnp.where(done, eos, nxt)
+        done = jnp.logical_or(done, nxt == eos)
+        buf = buf.at[:, i].set(nxt)
+        return (vars_["cache"], nxt, rng, buf, done, i + 1)
 
-    (_, last, _), toks = lax.scan(
-        body, (vars_["cache"], tok, rng), None, length=max_new_tokens - 1
+    _, _, _, buf, _, _ = lax.while_loop(
+        cond, body,
+        (vars_["cache"], tok, rng, buf, done, jnp.int32(1)),
     )
-    # toks: [max_new-1, B] tokens 1..max_new-1; `last` is the final one.
-    gen = jnp.concatenate([toks, last[None]], axis=0).swapaxes(0, 1)
-    return jnp.concatenate([prompt, gen], axis=1)
+    return jnp.concatenate([prompt, buf], axis=1)
 
 
 def tp_local_decode_clone(model, mesh, model_axis: str,
@@ -226,6 +275,7 @@ def make_tp_generate_fn(
     quantize: str | None = None,
     model_axis: str = "model",
     top_p: float | None = None,
+    eos_id: int | None = None,
 ):
     """Tensor-parallel generation: ``fn(params, prompt, rng) -> tokens``.
 
@@ -259,7 +309,7 @@ def make_tp_generate_fn(
     local = tp_local_decode_clone(model, mesh, model_axis, quantize)
     sample = partial(_sample, temperature=temperature, top_k=top_k,
                      top_p=top_p)
-    body = partial(_generate_body, local, sample, max_new_tokens)
+    body = partial(_generate_body, local, sample, max_new_tokens, eos_id)
 
     jitted: dict = {}
 
@@ -288,6 +338,7 @@ def generate(
     rng=None,
     quantize: str | None = None,
     top_p: float | None = None,
+    eos_id: int | None = None,
 ):
     """One-shot convenience wrapper around :func:`make_generate_fn`.
 
@@ -296,7 +347,7 @@ def generate(
     the (full-precision) params with ``quantize_lm_params`` here.
     """
     fn = make_generate_fn(model, max_new_tokens, temperature, top_k,
-                          quantize=quantize, top_p=top_p)
+                          quantize=quantize, top_p=top_p, eos_id=eos_id)
     if quantize == "int8":
         from distributed_machine_learning_tpu.ops.quant import (
             quantize_lm_params,
@@ -317,6 +368,7 @@ def make_serving_step(
     quantize: str | None = None,
     top_p: float | None = None,
     rng=None,
+    eos_id: int | None = None,
 ):
     """The step-callable seam for the serving fleet (ISSUE 16): wrap
     the batch-static decode program as ``step(prompts) -> outputs``
@@ -330,9 +382,17 @@ def make_serving_step(
     router with a fixed ``micro_batch`` converges on a handful).  The
     RNG threads through calls so repeated sampling steps never reuse a
     key.
+
+    ``eos_id`` fixes the semantics drift this path had vs
+    ``generate``: without it every group decodes ``max_new_tokens``
+    unconditionally; with it a group's while_loop exits once all its
+    rows emit EOS and finished rows pad with ``eos_id`` (see
+    :func:`make_generate_fn`).  The group-level exit is the
+    batch-static ceiling — per-sequence retirement is what the
+    continuous engine (``inference/continuous.py``) adds.
     """
     fn = make_generate_fn(model, max_new_tokens, temperature, top_k,
-                          quantize=quantize, top_p=top_p)
+                          quantize=quantize, top_p=top_p, eos_id=eos_id)
     if quantize == "int8":
         from distributed_machine_learning_tpu.ops.quant import (
             quantize_lm_params,

@@ -1,0 +1,230 @@
+"""Block/paged KV-cache allocator for the continuous-batching engine.
+
+The batch-static serving path (``inference/generate.py``) sizes its KV
+cache ``batch x max_len`` — every request pays the worst case even
+when most sequences are short.  This module carves one shared cache
+budget into fixed-size **token blocks** (the vLLM PagedAttention idea)
+with a per-sequence **block table** mapping logical block index ->
+physical block id:
+
+* **reserve-on-admit**: admission reserves the sequence's worst case
+  (``ceil((prompt_len + max_new) / block_size)`` blocks) so an
+  admitted sequence can never fail mid-decode — no preemption path —
+  and rejects (:class:`CacheExhausted`) when the pledge would exceed
+  the physically free pool.  The caller queues and retries; that IS
+  the admission control.
+* **alloc-on-append**: physical blocks bind lazily — prefill blocks at
+  admission, one more each time decode crosses a block boundary — so
+  the *allocated* footprint tracks actual tokens, not the reservation.
+* **free-on-finish**: retiring a sequence returns its blocks (and its
+  unused pledge — an EOS early-exit frees what it never touched) to
+  the pool the same step, which is what lets the engine backfill the
+  slot immediately.
+
+Because reservations are worst-case but *lengths are ragged*, a mix
+whose total reserved tokens exceeds ``batch x max_len`` padding fits
+in the same budget whenever per-request ``prompt+max_new`` vary —
+asserted in ``tests/test_kv_blocks.py``.
+
+Thread-safety: the router thread admits while the engine thread
+appends/frees, so every public op is one critical section under a
+single lock, with a dmlcheck layer-3 schedule point before the acquire
+(the ``analysis/interleave.py`` ``continuous_batching`` scenario
+explores admit/retire/swap interleavings here; its seeded
+``admit-unlocked`` mutation re-creates the capacity check-then-act
+race this layout forbids).  Lock order: the allocator lock is a leaf —
+no transport/hub call is ever made while holding it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from distributed_machine_learning_tpu.runtime.coordinator import (
+    _sched_point,
+)
+
+
+class CacheExhausted(RuntimeError):
+    """Admission would overcommit the block pool — queue and retry."""
+
+
+def blocks_needed(tokens: int, block_size: int) -> int:
+    """Blocks covering ``tokens`` cache slots (ceil division)."""
+    return -(-tokens // block_size)
+
+
+class BlockAllocator:
+    """Fixed-pool block allocator with per-sequence block tables.
+
+    ``num_blocks`` physical blocks of ``block_size`` token slots each.
+    Sequences are any hashable id (the engine uses request rids).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._lock = threading.Lock()
+        # LIFO free stack: blocks freed by a retired sequence are the
+        # first reused — the warmest pages.
+        self._free: list[int] = list(range(num_blocks - 1, -1, -1))
+        self._tables: dict = {}    # seq -> [physical block id, ...]
+        self._lengths: dict = {}   # seq -> tokens written (cache slots)
+        self._reserved: dict = {}  # seq -> total blocks pledged
+        # Blocks pledged by reservations but not yet bound to a
+        # physical block (sum over seqs of reserved - len(table)).
+        self._pledged = 0
+
+    # -- queries (lock-free reads are fine for monitoring, but the
+    # values used for decisions must come from inside admit/append) ----
+
+    def free_blocks(self) -> int:
+        """Physically unbound blocks (includes pledged-not-yet-bound)."""
+        with self._lock:
+            return len(self._free)
+
+    def available_blocks(self) -> int:
+        """Blocks admission may still pledge: free minus outstanding
+        pledges.  This is the admission-control headroom."""
+        with self._lock:
+            return len(self._free) - self._pledged
+
+    def sequences(self) -> list:
+        with self._lock:
+            return list(self._tables)
+
+    def table(self, seq) -> list[int]:
+        with self._lock:
+            return list(self._tables[seq])
+
+    def length(self, seq) -> int:
+        with self._lock:
+            return self._lengths[seq]
+
+    # -- lifecycle ------------------------------------------------------
+
+    def admit(self, seq, prompt_len: int, max_new: int) -> list[int]:
+        """Admit one sequence: pledge its worst case, bind its prefill
+        blocks, return the (prefill) block table.  Raises
+        :class:`CacheExhausted` when the pledge exceeds free blocks and
+        ``ValueError`` on a duplicate/invalid sequence.  The capacity
+        check and the binding are ONE critical section — splitting them
+        is exactly the ``admit-unlocked`` layer-3 mutation."""
+        if prompt_len < 1:
+            raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+        if max_new < 0:
+            raise ValueError(f"max_new must be >= 0, got {max_new}")
+        _sched_point("kvb:admit")
+        with self._lock:
+            if seq in self._tables:
+                raise ValueError(f"sequence {seq!r} already admitted")
+            need = blocks_needed(prompt_len + max_new, self.block_size)
+            if need > len(self._free) - self._pledged:
+                raise CacheExhausted(
+                    f"need {need} blocks, "
+                    f"{len(self._free) - self._pledged} available "
+                    f"({len(self._free)} free, {self._pledged} pledged)"
+                )
+            now = blocks_needed(prompt_len, self.block_size)
+            table = [self._free.pop() for _ in range(now)]
+            self._tables[seq] = table
+            self._lengths[seq] = prompt_len
+            self._reserved[seq] = need
+            self._pledged += need - now
+            return list(table)
+
+    def append(self, seq) -> int:
+        """Claim the next cache slot for ``seq`` (the decode step is
+        about to write position ``length``): binds a fresh block from
+        the sequence's pledge at block boundaries.  Returns the slot's
+        absolute position.  Never raises for an admitted sequence
+        within its reservation — that is the reserve-on-admit
+        guarantee."""
+        _sched_point("kvb:append")
+        with self._lock:
+            pos = self._lengths[seq]
+            table = self._tables[seq]
+            bidx = pos // self.block_size
+            if bidx >= self._reserved[seq]:
+                raise ValueError(
+                    f"sequence {seq!r} exceeded its reservation "
+                    f"({self._reserved[seq]} blocks)"
+                )
+            if bidx == len(table):
+                table.append(self._free.pop())
+                self._pledged -= 1
+            self._lengths[seq] = pos + 1
+            return pos
+
+    def free(self, seq) -> list[int]:
+        """Retire ``seq``: return its bound blocks (and its unused
+        pledge) to the pool.  Returns the freed physical ids."""
+        _sched_point("kvb:free")
+        with self._lock:
+            table = self._tables.pop(seq)
+            self._lengths.pop(seq)
+            reserved = self._reserved.pop(seq)
+            self._pledged -= reserved - len(table)
+            self._free.extend(reversed(table))
+            return list(table)
+
+    # -- auditing -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Pool occupancy snapshot for telemetry gauges."""
+        with self._lock:
+            bound = self.num_blocks - len(self._free)
+            tokens = sum(self._lengths.values())
+            # Fragmentation: slots bound but unwritten (tail-of-block
+            # waste) — bounded by block_size - 1 per live sequence.
+            waste = bound * self.block_size - tokens
+            return {
+                "num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "free": len(self._free),
+                "pledged": self._pledged,
+                "available": len(self._free) - self._pledged,
+                "bound": bound,
+                "sequences": len(self._tables),
+                "tokens": tokens,
+                "waste_slots": waste,
+                "utilization": bound / self.num_blocks,
+            }
+
+    def check_invariants(self) -> None:
+        """Assert the accounting identities; raises AssertionError on
+        any violation.  Cheap enough that tests run it after every op;
+        the layer-3 scenario runs it after every explored schedule."""
+        with self._lock:
+            bound = [b for t in self._tables.values() for b in t]
+            assert len(bound) == len(set(bound)), (
+                "physical block double-booked across tables"
+            )
+            assert not set(bound) & set(self._free), (
+                "block simultaneously bound and free"
+            )
+            assert len(bound) + len(self._free) == self.num_blocks, (
+                f"block leak: {len(bound)} bound + {len(self._free)} "
+                f"free != {self.num_blocks}"
+            )
+            # The ISSUE invariant: sum of table entries == allocated.
+            assert len(bound) == self.num_blocks - len(self._free)
+            assert self._pledged == sum(
+                self._reserved[s] - len(self._tables[s])
+                for s in self._tables
+            ), "pledge accounting drifted"
+            assert 0 <= self._pledged <= len(self._free), (
+                f"pledged {self._pledged} outside [0, {len(self._free)}]"
+                " — admission overcommitted the pool"
+            )
+            for s, t in self._tables.items():
+                need = blocks_needed(self._lengths[s], self.block_size)
+                assert len(t) == max(need, 1), (
+                    f"sequence {s!r}: {len(t)} blocks bound, "
+                    f"{need} covered by length {self._lengths[s]}"
+                )
+                assert len(t) <= self._reserved[s]

@@ -272,3 +272,207 @@ def cached_flash_attention(
         interpret=_interpret(),
         **compiler_params,
     )(pos_arr, q, k_cache, v_cache, k_scale, v_scale)
+
+
+# ---------------------------------------------------------------------------
+# Paged (block-table) decode attention — ISSUE 19
+# ---------------------------------------------------------------------------
+# The continuous-batching engine (inference/continuous.py) keeps KV
+# residency in a SHARED physical pool of fixed-size blocks
+# ([num_blocks, Hkv, block_s, D], ops on it managed by
+# inference/kv_blocks.py) instead of a per-sequence [B, S, D] slab;
+# each in-flight lane w owns a block table mapping its logical block j
+# to a physical pool block.  The ragged entry point below is the
+# decode dispatch for that layout: one grid where every lane reads its
+# OWN frontier-clamped walk of the pool through the scalar-prefetched
+# table — the vLLM PagedAttention access pattern on the flash-decode
+# kernel above.  Per-lane reads stay O(position); lanes at different
+# lengths share one dispatch, which is what makes iteration-level
+# batching a single program instead of a per-length group loop.
+
+
+def _paged_kernel(
+    tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, block_s: int, n_rep: int, scale: float,
+):
+    si = pl.program_id(1)
+    pos = pos_ref[pl.program_id(0)]
+    frontier = pos // block_s
+
+    @pl.when(si == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _update(masked: bool):
+        n_kv = k_ref.shape[0]
+        D = k_ref.shape[2]
+        H = n_kv * n_rep
+        width = n_kv * block_s
+        k_all = k_ref[:].reshape(width, D)
+        v_all = v_ref[:].reshape(width, D)
+        q_all = q_ref[0, 0]  # [H, D]
+        s = jax.lax.dot_general(
+            q_all.astype(k_all.dtype), k_all, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (scale * LOG2E)
+        col_group = (
+            jax.lax.broadcasted_iota(jnp.int32, (H, width), 1) // block_s
+        )
+        row_group = (
+            jax.lax.broadcasted_iota(jnp.int32, (H, width), 0) // n_rep
+        )
+        valid = col_group == row_group
+        if masked:
+            slot = si * block_s + (
+                jax.lax.broadcasted_iota(jnp.int32, (H, width), 1) % block_s
+            )
+            valid = valid & (slot <= pos)
+        s = jnp.where(valid, s, NEG_INF)
+        m_new, l_new, acc_new = _online_update(
+            s, m_ref[:, 0], l_ref[:, 0], acc_ref[:, :], v_all, causal=True
+        )
+        acc_ref[:, :] = acc_new
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(si < frontier)
+    def _interior():
+        _update(False)
+
+    @pl.when(si == frontier)
+    def _boundary():
+        _update(True)
+
+    @pl.when(si == pl.num_programs(1) - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0, 0] = (acc_ref[:, :] / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention_reference(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+) -> jax.Array:
+    """XLA reference for :func:`paged_flash_attention` — the gather
+    formulation (pool rows indexed by the block table, then the same
+    masked fp32-softmax attention as ``_cached_attention``).  This is
+    also the CPU serving path: on hosts without a Pallas TPU backend
+    the engine dispatches here, and the kernel's interpret-mode parity
+    test pins the two together.
+
+    ``q``: [W, 1, H, D] — one in-flight decode token per lane;
+    ``k_pool``/``v_pool``: [num_blocks, Hkv, block_s, D];
+    ``block_tables``: [W, max_blocks] int32 physical ids (entries past
+    a lane's frontier must be in-range but are never attended);
+    ``positions``: [W] int32 — lane w's query slot; it attends cache
+    slots 0..positions[w] inclusive.  Returns [W, 1, H, D].
+    """
+    W, _, H, D = q.shape
+    Hkv, block_s = k_pool.shape[1], k_pool.shape[2]
+    n_rep = H // Hkv
+    mb = block_tables.shape[1]
+    S = mb * block_s
+
+    def lane(kv):  # [W, MB, Hkv, bs, D] -> [W, Hkv, MB*bs, D]
+        return kv.transpose(0, 2, 1, 3, 4).reshape(W, Hkv, S, D)
+
+    k = lane(k_pool[block_tables])
+    v = lane(v_pool[block_tables])
+    qg = q.reshape(W, Hkv, n_rep, D)
+    s = jnp.einsum(
+        "whrd,whsd->whrs", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) / (D ** 0.5)
+    slot = jnp.arange(S, dtype=jnp.int32)
+    mask = slot[None, :] <= positions[:, None].astype(jnp.int32)
+    s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("whrs,whsd->whrd", p, v.astype(jnp.float32))
+    return o.reshape(W, 1, H, D).astype(q.dtype)
+
+
+def paged_flash_qualifies(block_s: int) -> bool:
+    """TPU dispatch rule for the paged kernel: pool blocks are the
+    kernel's S tiles, so they must be 128-lane multiples on real
+    hardware; interpret mode (CPU tests) takes any size."""
+    return block_s % 128 == 0 or _interpret()
+
+
+def paged_flash_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+) -> jax.Array:
+    """Ragged block-table decode attention as one Pallas dispatch.
+
+    Same contract as :func:`paged_attention_reference`.  Grid
+    ``(W, max_blocks)`` with the block axis innermost (it carries the
+    online-softmax scratch); BOTH the block table and the per-lane
+    positions ride the scalar-prefetch channel, so the K/V index map
+    resolves ``table[w, min(j, frontier_w)]`` before the DMA — each
+    lane streams only its own O(position) bytes out of the shared
+    pool, regardless of how long its neighbors are.  bf16/f32 pools
+    only (the int8-pool variant would mirror the quant mode above)."""
+    W, Lq, H, D = q.shape
+    if Lq != 1:
+        raise ValueError(f"paged kernel is single-token (got Lq={Lq})")
+    Hkv, block_s = k_pool.shape[1], k_pool.shape[2]
+    n_rep = H // Hkv
+    mb = block_tables.shape[1]
+    if not _HAS_PLTPU:  # pragma: no cover
+        raise RuntimeError("pallas TPU support unavailable")
+    if not paged_flash_qualifies(block_s):
+        raise ValueError(
+            f"pool block_s={block_s} is not a 128 multiple; dispatch "
+            "paged_attention_reference instead"
+        )
+    tbl = jnp.asarray(block_tables, jnp.int32)
+    pos = jnp.asarray(positions, jnp.int32).reshape((W,))
+
+    kv_spec = pl.BlockSpec(
+        (None, Hkv, block_s, D),
+        lambda w, s, tbl, pos: (
+            tbl[w, jnp.minimum(s, pos[w] // block_s)], 0, 0, 0
+        ),
+    )
+    q_spec = pl.BlockSpec((1, 1, H, D), lambda w, s, tbl, pos: (w, 0, 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(W, mb),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((H, _LANES), jnp.float32),  # running max (log2)
+            pltpu.VMEM((H, _LANES), jnp.float32),  # running normalizer
+            pltpu.VMEM((H, D), jnp.float32),  # output accumulator
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel,
+        block_s=block_s,
+        n_rep=n_rep,
+        scale=1.0 / (D**0.5),
+    )
+    compiler_params = (
+        {}
+        if _interpret()
+        else {
+            "compiler_params": pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")
+            )
+        }
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((W, 1, H, D), q.dtype),
+        interpret=_interpret(),
+        **compiler_params,
+    )(tbl, pos, q, k_pool, v_pool)

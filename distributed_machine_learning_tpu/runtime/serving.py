@@ -90,10 +90,14 @@ from distributed_machine_learning_tpu.telemetry.registry import (
 # sat on a replica that died under it.  ``admitted``/``taken`` open
 # each actor's local chain (dt is None by construction: the prior
 # stamp crossed a process boundary) and ``fenced``/``dropped`` record
-# discards, so none of those carry durations.
+# discards, so none of those carry durations.  ``prefill``/``decode``
+# are the continuous-batching engine's split of the compute interval
+# (ISSUE 19): engine replicas stamp those two instead of ``computed``,
+# so per-request prefill/decode latency quantiles fall out of the same
+# serving_stage_latency_s family.
 _HISTOGRAM_STAGES = frozenset(
-    {"queued", "dispatched", "bound", "computed", "posted",
-     "completed", "requeued"})
+    {"queued", "dispatched", "bound", "prefill", "decode", "computed",
+     "posted", "completed", "requeued"})
 
 
 class Overloaded(RuntimeError):
@@ -145,11 +149,17 @@ class ServingRouter:
 
     def __init__(self, transport, config: ServingConfig | None = None,
                  events: FaultEvents | None = None, *,
-                 telemetry=None, slo=None):
+                 telemetry=None, slo=None, scheduler=None):
         self.tx = transport
         self.cfg = config or ServingConfig()
         self.events = events if events is not None else FaultEvents()
         self.slo = slo  # an SLOEngine fed one observe() per outcome
+        # Regime-aware dispatch (ISSUE 19): a RegimeScheduler observed
+        # once per pump with the FLEET-wide load (queue depth + total
+        # in-flight).  The chosen lever is stamped onto every dispatched
+        # request so each replica's engine follows one coherent regime
+        # instead of N local views drifting at the boundary.
+        self.scheduler = scheduler
         self._lock = threading.RLock()
         self._queue: collections.deque[str] = collections.deque()
         self._ledger: dict[str, dict] = {}
@@ -452,6 +462,15 @@ class ServingRouter:
         return min(ready)[1]
 
     def _dispatch_locked(self) -> None:
+        # One regime observation per pump — NOT per request: the
+        # scheduler's dwell counts observations, and a burst of N
+        # dispatches is one load sample, not N votes to flip.
+        lever = None
+        if self.scheduler is not None:
+            lever = self.scheduler.observe(
+                len(self._queue),
+                sum(len(rep.in_flight)
+                    for rep in self._replicas.values()))
         while self._queue:
             ready = [(len(rep.in_flight), rank)
                      for rank, rep in self._replicas.items()
@@ -485,12 +504,15 @@ class ServingRouter:
                 stamp_stage(entry, "dispatched", "router",
                             disp=entry["dispatches"], replica=rank)
                 rep.in_flight.add(rid)
-                self.tx.push_request(rank, {
+                payload = {
                     "rid": rid, "prompt": entry["prompt"],
                     "epoch": rep.epoch,
                     "dispatch": entry["dispatches"],
                     "events": entry["events"],
-                })
+                }
+                if lever is not None:
+                    payload["lever"] = lever
+                self.tx.push_request(rank, payload)
 
     def _grow_locked(self, now: float) -> None:
         live = sum(1 for rep in self._replicas.values()
@@ -569,8 +591,14 @@ class ServingRouter:
                     self._stage_latency(ev["stage"]).observe(dt)
             # The straggler feed (shared detector code path): the
             # ``computed`` deltas are per-replica compute intervals.
-            for rank, dur in serving_stage_samples(
-                    entry["events"], stage="computed").items():
+            # Engine replicas (ISSUE 19) stamp ``decode`` instead —
+            # the per-request decode interval is their service sample.
+            samples = serving_stage_samples(
+                entry["events"], stage="computed")
+            if not samples:
+                samples = serving_stage_samples(
+                    entry["events"], stage="decode")
+            for rank, dur in samples.items():
                 rep = self._replicas.get(rank)
                 if rep is not None:
                     rep.service_s = dur

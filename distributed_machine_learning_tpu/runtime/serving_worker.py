@@ -57,7 +57,8 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                        stop_event: threading.Event,
                        cfg: ServingWorkerConfig | None = None, *,
                        prefetch_fn=None, on_restore=None,
-                       on_swap=None, telemetry=None) -> dict:
+                       on_swap=None, telemetry=None,
+                       engine=None) -> dict:
     """Drive one replica until ``stop_event`` (a campaign's kill switch
     doubles as the worker's death) or the control plane severs.
 
@@ -87,6 +88,25 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
     the failure paths ``requeued`` (newer-epoch repush) / ``fenced``
     (zombie drop), so every exit closes the record.
 
+    ``engine`` (ISSUE 19): a
+    :class:`~..inference.continuous.ContinuousEngine` replaces the
+    batch-static ``step_fn`` with iteration-level scheduling.  Fence
+    triage is unchanged; kept requests are *submitted* to the engine
+    (which stamps ``prefill``/``decode`` instead of ``computed``),
+    each loop iteration advances it one decode step, and every
+    retirement posts immediately under the bound epoch — so requests
+    finish mid-micro-batch instead of waiting on the group.  Router
+    lever hints (``request["lever"]``, stamped by a regime-aware
+    dispatcher) are forwarded via ``note_lever``.  On a staged weight
+    version the worker pauses admission and keeps stepping until
+    ``engine.in_flight() == 0`` — the drain that guarantees no
+    sequence ever mixes weight versions — before ``on_swap`` (which
+    may return a *replacement engine*, or swap the existing engine's
+    params itself and return None) and the ``commit_weights`` fence.
+    On retirement the engine's queued/in-flight work is aborted
+    without posting: the router's retire already requeued those rids
+    for survivors, and a late post would fence anyway.
+
     Returns a summary dict (served counts, restores) for audits.
     """
     cfg = cfg or ServingWorkerConfig()
@@ -98,16 +118,54 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
     repushed = 0
     restores = 0
     swaps = 0
+    aborted = 0
     last_service: float | None = None
     bound_epoch: int | None = None
     bound_version: int | None = None
     prefetched = None
     last_announce = -1.0
     last_beat = -1.0
+    if engine is not None:
+        # The engine stamps stage events itself; rename its actor to
+        # this replica so each request's taken→bound→prefill→decode
+        # chain stays on one monotonic clock (and the router's
+        # straggler feed can attribute the decode samples to a rank).
+        engine._by = by
+
+    def _post_engine(done) -> None:
+        """Post one engine retirement batch under the bound epoch."""
+        nonlocal served, fenced, last_service
+        for d in done:
+            req = d.get("request") or {}
+            svc = d["prefill_s"] + d["decode_s"]
+            last_service = svc
+            ok = tx.post_result(rank, bound_epoch,
+                                carry_stage_context(req, {
+                                    "rid": d["rid"],
+                                    "output": d["tokens"],
+                                    "service_time_s": svc,
+                                    "lever": d["lever"],
+                                }), version=bound_version)
+            if tracer is not None:
+                t1 = time.perf_counter()
+                tracer.complete("request", t1 - d["e2e_s"], t1,
+                                rid=d["rid"], rank=rank,
+                                stage="posted" if ok else "fenced")
+            if ok:
+                served += 1
+            else:
+                fenced += 1
+
     try:
         while not stop_event.is_set():
             state = tx.read_serving(rank)
             if state["role"] != "live":
+                if engine is not None and bound_epoch is not None:
+                    # Retired with work still on the engine: the
+                    # router's retire_replica already requeued every
+                    # owned rid for survivors — drop ours without
+                    # posting (a post would fence anyway).
+                    aborted += len(engine.abort_all())
                 bound_epoch = None
                 bound_version = None
                 now = time.monotonic()
@@ -129,6 +187,10 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                 # before serving, and post every future result under
                 # THIS epoch — the fence that makes a late post after
                 # retirement a no-op instead of a duplicate.
+                if engine is not None and bound_epoch is not None:
+                    # Re-promoted without passing through spare: the
+                    # old epoch's requests were requeued at retirement.
+                    aborted += len(engine.abort_all())
                 bound_epoch = state["epoch"]
                 bound_version = None  # rebind to the committed record
                 restores += 1
@@ -138,6 +200,8 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
             wrec = state.get("weights") or {}
             if bound_version is None:
                 bound_version = int(wrec.get("version", 0) or 0)
+                if engine is not None and not engine.in_flight():
+                    engine.version = bound_version
             pending = wrec.get("pending")
             if pending is not None and int(pending) != bound_version:
                 # Hot-swap point (ISSUE 18): the deploy controller
@@ -150,7 +214,28 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                 # result fence, so an old-version zombie's late post
                 # can never complete a post-swap rid.
                 pending = int(pending)
-                if on_swap is not None:
+                if engine is not None:
+                    # Engine drain (ISSUE 19): sequences are mid-decode
+                    # at arbitrary frontiers, and swap_params refuses
+                    # while any are in flight — finish every one under
+                    # the OLD weights first, admission paused so queued
+                    # work waits for the new version.  This is the
+                    # step-boundary fence: no sequence ever mixes
+                    # weight versions mid-stream.
+                    engine.pause_admission()
+                    while (engine.in_flight()
+                           and not stop_event.is_set()):
+                        _post_engine(engine.step())
+                    if engine.in_flight():
+                        continue  # killed mid-drain; exit via loop top
+                    if on_swap is not None:
+                        new_engine = on_swap(pending, dict(wrec))
+                        if new_engine is not None:
+                            engine = new_engine
+                            engine._by = by
+                    engine.version = pending
+                    engine.resume_admission()
+                elif on_swap is not None:
                     new_step = on_swap(pending, dict(wrec))
                     if new_step is not None:
                         step_fn = new_step
@@ -171,7 +256,7 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                 })
                 last_beat = now
             reqs = tx.take_requests(rank, cfg.micro_batch)
-            if not reqs:
+            if not reqs and engine is None:
                 stop_event.wait(cfg.poll_s)
                 continue
             t_take = time.perf_counter()
@@ -219,6 +304,41 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
                     tx.push_request(rank, r)
                 repushed += len(newer)
             reqs = keep
+            if engine is not None:
+                for r in reqs:
+                    if isinstance(r.get("events"), list):
+                        # dt: taken -> bound, the fence-check interval.
+                        stamp_stage(r, "bound", by, epoch=bound_epoch)
+                    hint = r.get("lever")
+                    if hint is not None:
+                        try:
+                            engine.note_lever(hint)
+                        except ValueError:
+                            pass  # router speaks a newer lever dialect
+                    try:
+                        engine.submit(r.get("rid"), r.get("prompt"),
+                                      max_new=r.get("max_new"),
+                                      request=r)
+                    except (TypeError, ValueError) as e:
+                        # A request the engine can NEVER serve (empty,
+                        # or longer than max_len): answer it rather
+                        # than strand it in the router's in-flight set.
+                        tx.post_result(rank, bound_epoch,
+                                       carry_stage_context(r, {
+                                           "rid": r.get("rid"),
+                                           "output": None,
+                                           "error": str(e),
+                                       }), version=bound_version)
+                if newer and not reqs:
+                    continue  # rebind via read_serving first
+                if not engine.has_work():
+                    stop_event.wait(cfg.poll_s)
+                    continue
+                # One iteration: every in-flight sequence advances one
+                # token; retirements post immediately and their lanes
+                # backfill inside the same step.
+                _post_engine(engine.step())
+                continue
             if not reqs:
                 if newer:
                     continue  # rebind via read_serving first
@@ -259,7 +379,7 @@ def run_serving_worker(tx: GangTransport, rank: int, step_fn,
         pass  # severed from the control plane: retire quietly
     return {"rank": rank, "served": served, "fenced": fenced,
             "repushed": repushed, "restores": restores, "swaps": swaps,
-            "weight_version": bound_version}
+            "aborted": aborted, "weight_version": bound_version}
 
 
 def start_worker_thread(tx: GangTransport, rank: int, step_fn,

@@ -131,7 +131,12 @@ class TransportError(RuntimeError):
 # A serving request that carries an ``events`` list gets one stage
 # record appended at every hop of its journey: router-side (admitted,
 # queued, dispatched, requeued, dropped, completed) and worker-side
-# (taken, bound, computed, posted, fenced).  The record is
+# (taken, bound, computed, posted, fenced).  Replicas running the
+# continuous-batching engine (ISSUE 19) replace the single batch-wide
+# ``computed`` stamp with the per-request pair ``prefill`` (bound →
+# first token sampled) and ``decode`` (prefill → retirement), so the
+# stage histograms decompose a request's compute into its two
+# regimes instead of hiding both under one micro-batch interval.  The record is
 # ``{"stage", "by", "dt"}`` where ``dt`` is the seconds since the SAME
 # actor's previous stamp on this request, measured on that actor's own
 # monotonic clock — or None when the previous stamp came from another
@@ -147,7 +152,7 @@ class TransportError(RuntimeError):
 # that carry them.
 
 SERVING_STAGES = ("admitted", "queued", "dispatched", "taken", "bound",
-                  "computed", "posted", "completed",
+                  "prefill", "decode", "computed", "posted", "completed",
                   "requeued", "fenced", "dropped")
 
 # Terminal stages: after one of these, the actor that stamped it holds

@@ -1,0 +1,105 @@
+"""Serving-tier A/B bench (bench/serving_tier.py, ISSUE 19): the
+virtual-clock simulation of continuous batching vs the batch-static
+dispatch loop.
+
+The committed ``BENCH_r19_serving.json`` carries the r19 acceptance
+verdicts (engine beats batch-static on useful tokens/sec at the
+highest offered load AND on p95 e2e at the lowest); the fast tests
+here pin the file's shape and verdicts, the slow test re-runs a small
+sweep end to end under a wall-clock cap.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from distributed_machine_learning_tpu.bench.serving_tier import (
+    _quantiles,
+    acceptance,
+    make_workload,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BENCH_JSON = os.path.join(os.path.dirname(HERE),
+                          "BENCH_r19_serving.json")
+
+SWEEP_BUDGET_S = 300.0
+
+
+def test_workload_is_seeded_and_sorted():
+    a = make_workload(40, 16.0, seed=3)
+    b = make_workload(40, 16.0, seed=3)
+    assert a == b
+    assert a != make_workload(40, 16.0, seed=4)
+    times = [r["t_arr"] for r in a]
+    assert times == sorted(times) and times[0] > 0.0
+    # Same seed, different rate: identical request MIX (prompts and
+    # budgets), only the arrival spacing moves — what makes the
+    # per-rate rows comparable.
+    c = make_workload(40, 64.0, seed=3)
+    assert [(r["prompt"], r["max_new"]) for r in c] \
+        == [(r["prompt"], r["max_new"]) for r in a]
+    assert sum(r["t_arr"] for r in c) < sum(times)
+
+
+def test_quantiles_are_order_statistics():
+    q = _quantiles([0.1 * i for i in range(1, 101)])
+    assert q["p50_e2e_s"] == pytest.approx(5.0, abs=0.2)
+    assert q["p95_e2e_s"] == pytest.approx(9.5, abs=0.2)
+    assert q["max_e2e_s"] == pytest.approx(10.0)
+    assert _quantiles([])["p99_e2e_s"] == 0.0
+
+
+def test_committed_bench_rows_carry_the_r19_acceptance():
+    # The checked-in sweep must contain BOTH head-to-head rows the
+    # acceptance gate reads, and both verdicts must hold.
+    with open(BENCH_JSON) as f:
+        rows = json.load(f)
+    data = [r for r in rows if r["bench"] == "serving_tier"]
+    rates = sorted({r["rate_rps"] for r in data})
+    assert len(rates) >= 3, "offered-load sweep needs 3+ rates"
+    for rate in rates:
+        systems = {r["system"] for r in data if r["rate_rps"] == rate}
+        assert systems == {"batch_static", "engine"}
+    hi = [r for r in data if r["rate_rps"] == rates[-1]
+          and r["system"] == "engine"][0]
+    lo = [r for r in data if r["rate_rps"] == rates[0]
+          and r["system"] == "engine"][0]
+    assert hi["engine_wins_tokens_per_sec"] is True
+    assert lo["engine_wins_p95_e2e"] is True
+    verdict = [r for r in rows
+               if r["bench"] == "serving_tier_acceptance"][0]
+    assert verdict["engine_beats_tokens_per_sec_at_highest_load"]
+    assert verdict["engine_beats_p95_e2e_at_lowest_load"]
+    assert acceptance(data) == {
+        k: v for k, v in verdict.items()}
+
+
+@pytest.mark.slow
+def test_sweep_runs_end_to_end_and_engine_wins(tmp_path):
+    """A reduced sweep, real compute: the engine must win useful
+    tokens/sec at the saturating rate and p95 e2e at the light rate,
+    within the wall-clock budget."""
+    from distributed_machine_learning_tpu.bench.serving_tier import (
+        make_model,
+        run_sweep,
+    )
+
+    t0 = time.monotonic()
+    model, params = make_model(d_model=192, n_layers=4)
+    rows = run_sweep([6.0, 48.0], 40, seed=0, width=4,
+                     model=model, params=params)
+    elapsed = time.monotonic() - t0
+    assert elapsed < SWEEP_BUDGET_S, f"sweep took {elapsed:.0f}s"
+    assert len(rows) == 4
+    verdict = acceptance(rows)
+    assert verdict["engine_beats_tokens_per_sec_at_highest_load"], rows
+    assert verdict["engine_beats_p95_e2e_at_lowest_load"], rows
+    # The virtual clock conserves work: both systems served the same
+    # useful tokens, and every row's percentiles are ordered.
+    assert len({r["useful_tokens"] for r in rows}) == 1
+    for r in rows:
+        assert (r["p50_e2e_s"] <= r["p95_e2e_s"]
+                <= r["p99_e2e_s"] <= r["max_e2e_s"])

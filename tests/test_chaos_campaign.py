@@ -375,3 +375,130 @@ def test_tcp_gang_survives_partition_end_to_end(tmp_path):
     status = json.loads(res_status.stdout)
     assert status["transport"]["backend"] == "tcp"
     assert any(e.get("kind") == "restart" for e in status["health"])
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: continuous-batching replicas under the serving chaos rules
+# ---------------------------------------------------------------------------
+
+SERVING_ENGINE_BUDGET_S = 150.0
+
+
+@pytest.mark.faultinject
+def test_serving_engine_replica_kill_requeues_exactly_once(tmp_path):
+    """Kill a replica whose continuous-batching engine holds sequences
+    mid-decode.  The router's beat-staleness eviction must requeue
+    every rid the dead replica owned, the survivor plus the promoted
+    warm spare must re-serve them token-for-token (greedy decode: the
+    re-served answer is bit-identical to the reference), the audit
+    must stay exactly-once, and the whole recovery must land inside
+    the wall-clock cap.  The engine's prefill/decode stage split and
+    the requeue scar must both show in the router's stage quantiles."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.inference.continuous import (
+        ContinuousEngine,
+        EngineConfig,
+    )
+    from distributed_machine_learning_tpu.inference.generate import (
+        generate,
+    )
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from distributed_machine_learning_tpu.runtime.serving import (
+        ServingConfig,
+        ServingRouter,
+    )
+    from distributed_machine_learning_tpu.runtime.serving_worker import (
+        ServingWorkerConfig,
+        start_worker_thread,
+    )
+
+    MAX_NEW = 12
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=2,
+                          n_heads=4, n_kv_heads=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def make_engine():
+        eng = ContinuousEngine(model, params, EngineConfig(
+            max_lanes=2, block_size=4, num_blocks=32, max_len=16,
+            max_new=MAX_NEW, levers=("latency",)))
+        # Compile before heartbeating: XLA tracing inside the first
+        # live step reads as a stale beat at a 0.4s eviction timeout.
+        eng.warmup(prompt_lens=(3,))
+        return eng
+
+    hub = InProcHub(mirror_dir=os.path.join(str(tmp_path), "gang"))
+    make_tx = lambda: InProcTransport(hub)  # noqa: E731
+    router = ServingRouter(make_tx(), ServingConfig(
+        replicas=2, micro_batch=2, max_outstanding=6,
+        replica_timeout_s=0.4, poll_s=0.002))
+    wcfg = ServingWorkerConfig(heartbeat_interval=0.02, micro_batch=2)
+    fleet = []
+    for rank in range(3):           # 2 live + 1 warm spare
+        stop = threading.Event()
+        t, out = start_worker_thread(make_tx(), rank, None, stop, wcfg,
+                                     engine=make_engine())
+        fleet.append((rank, stop, t, out))
+    stop_router = threading.Event()
+    rt = threading.Thread(target=router.run, args=(stop_router,),
+                          name="engine-chaos-router", daemon=True)
+    rt.start()
+    start = time.monotonic()
+    try:
+        deadline = time.monotonic() + 60.0
+        while True:
+            with router._lock:
+                if len(router._replicas) >= 2:
+                    break
+            assert time.monotonic() < deadline, "fleet never warmed up"
+            time.sleep(0.01)
+        prompts = {}
+        for i in range(16):
+            p = [1 + i % 11, 2 + i % 7, 3]
+            prompts[router.submit(list(p))] = p
+        # Kill the first replica seen holding >= 2 in-flight rids —
+        # its engine is mid-decode on real sequences at that moment.
+        victim = None
+        while victim is None:
+            with router._lock:
+                for rank, rep in router._replicas.items():
+                    if len(rep.in_flight) >= 2:
+                        victim = rank
+                        break
+            assert time.monotonic() < deadline, "no replica loaded up"
+        fleet[victim][1].set()      # hard kill, mid-flight
+        assert router.wait_idle(90.0), router.audit()
+    finally:
+        verdict = router.close()
+        stop_router.set()
+        for _, stop, t, _ in fleet:
+            stop.set()
+            t.join(10.0)
+        rt.join(10.0)
+    elapsed = time.monotonic() - start
+    assert elapsed < SERVING_ENGINE_BUDGET_S, (
+        f"engine kill campaign took {elapsed:.1f}s")
+    assert verdict["exactly_once"], verdict
+    assert verdict["evictions"] >= 1, verdict
+    assert verdict["redispatches"] >= 1, verdict
+    # Every answer is the model's true decode — re-served rids
+    # included (requeue restarts from the prompt; greedy decode makes
+    # the second serving bit-identical).
+    for rid, p in prompts.items():
+        entry = router.result(rid)
+        assert entry is not None and entry["state"] == "done", rid
+        want = np.asarray(generate(
+            model, params, np.asarray([p], np.int32), MAX_NEW
+        ))[0].tolist()
+        assert entry["result"] == want, rid
+    # The engine's stage split reached the stage histograms, and at
+    # least one completion carries the requeue scar.
+    stages = verdict["stage_latency"]
+    assert "prefill" in stages and "decode" in stages, sorted(stages)
+    assert "requeued" in stages, sorted(stages)

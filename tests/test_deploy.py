@@ -826,3 +826,123 @@ def test_chaos_endurance_multi_deploy_with_kills(tmp_path):
     assert events.canary_promotions >= 1
     assert events.canary_rollbacks >= 1
     assert elapsed < 2 * CHAOS_BUDGET_S, elapsed
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: the engine-mode swap fence (hot swap during active decode)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_hot_swap_during_active_decode_no_mixing(tmp_path):
+    """A weight version staged while continuous-batching sequences are
+    mid-decode waits for the engine drain: zero requests dropped, and
+    every completion decodes token-for-token under exactly ONE weights
+    version — in-flight sequences finish under the old weights, every
+    post-commit request serves the new ones.  The engine-step-boundary
+    fence, proven through the worker's real swap path."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.inference.continuous import (
+        ContinuousEngine,
+        EngineConfig,
+    )
+    from distributed_machine_learning_tpu.inference.generate import (
+        generate,
+    )
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+
+    MAX_NEW = 8
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=2,
+                          n_heads=4, n_kv_heads=2)
+    params1 = model.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    params2 = model.init(jax.random.PRNGKey(7),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def ref(params, prompt):
+        return np.asarray(generate(
+            model, params, np.asarray([prompt], np.int32), MAX_NEW
+        ))[0].tolist()
+
+    engine = ContinuousEngine(model, params1, EngineConfig(
+        max_lanes=2, block_size=4, num_blocks=32, max_len=16,
+        max_new=MAX_NEW, levers=("latency",)))
+    # Compile BEFORE the replica starts heartbeating: XLA tracing
+    # inside the first live step would look like a stale beat.
+    engine.warmup(prompt_lens=(3,))
+    swap_calls = []
+
+    def on_swap(version, rec):
+        # The production shape: load the staged weights into the SAME
+        # engine.  swap_params would raise if the worker had not
+        # drained first — the fence under test.
+        swap_calls.append((version, engine.in_flight()))
+        engine.swap_params(params2, version=version)
+        return None
+
+    hub = InProcHub(mirror_dir=str(tmp_path / "gang"))
+    make_tx = lambda: InProcTransport(hub)  # noqa: E731
+    router = ServingRouter(
+        make_tx(), ServingConfig(replicas=1, micro_batch=4,
+                                 poll_s=0.002))
+    stop = threading.Event()
+    t, out = start_worker_thread(
+        make_tx(), 0, None, stop,
+        ServingWorkerConfig(heartbeat_interval=0.02, micro_batch=4),
+        on_swap=on_swap, engine=engine)
+    stop_router = threading.Event()
+    rt = threading.Thread(target=router.run, args=(stop_router,),
+                          name="engine-swap-router", daemon=True)
+    rt.start()
+    try:
+        _wait_live(router, 1)
+        prompts = {}
+        for i in range(6):
+            p = [1 + i, 2, 3]
+            prompts[router.submit(list(p))] = p
+        # Stage the new version the moment sequences are mid-decode.
+        deadline = time.monotonic() + 60.0
+        while engine.in_flight() == 0:
+            assert time.monotonic() < deadline, "engine never started"
+            time.sleep(0.002)
+        tx = make_tx()
+        tx.set_weights(0, 1, {"step": 5, "digest": "d" * 64})
+        while int((tx.read_serving(0).get("weights") or {})
+                  .get("version", 0) or 0) != 1:
+            assert time.monotonic() < deadline, "commit never landed"
+            time.sleep(0.005)
+        late = {}
+        for i in range(3):
+            p = [9 + i, 2, 3]
+            late[router.submit(list(p))] = p
+        assert router.wait_idle(60.0), router.audit()
+        seen_versions = set()
+        for rid, p in {**prompts, **late}.items():
+            entry = router.result(rid)
+            assert entry is not None and entry["state"] == "done"
+            v = entry["version"]
+            seen_versions.add(v)
+            want = ref(params1 if v == 0 else params2, p)
+            assert entry["result"] == want, (
+                f"{rid} mixed weight versions (posted v{v})")
+        for rid in late:
+            assert router.result(rid)["version"] == 1
+        # Both versions actually served: the drain finished the
+        # in-flight work under v0, the backlog + late work under v1.
+        assert seen_versions == {0, 1}
+    finally:
+        verdict = router.close()
+        stop_router.set()
+        stop.set()
+        t.join(10.0)
+        rt.join(10.0)
+    assert verdict["exactly_once"], verdict
+    assert [v for v, _ in swap_calls] == [1]
+    # The fence held: on_swap saw a fully drained engine.
+    assert swap_calls[0][1] == 0
+    assert out["swaps"] == 1 and out["aborted"] == 0
+    assert engine.in_flight() == 0 and engine.queued() == 0
+    engine.allocator.check_invariants()

@@ -221,6 +221,23 @@ def test_weight_swap_bug_is_rediscovered(tmp_path):
     assert "zombie" in trace and "hub:swv:gap" in trace
 
 
+def test_continuous_batching_bug_is_rediscovered(tmp_path):
+    # ISSUE 19: the paged-KV admission race.  With the allocator's
+    # capacity check hoisted outside the lock that binds the blocks,
+    # two admitters park in the TOCTOU window, both pass against the
+    # same headroom, and the pool overcommits — the reserve-on-admit
+    # guarantee breaks while decodes are in flight.
+    f, repro = _gate(tmp_path, "continuous_batching", "admit-unlocked")
+    r1 = replay_file(repro)
+    r2 = replay_file(repro)
+    assert r1 == r2 and r1["reproduced"]
+    assert any("overcommitted" in v or "pop from empty" in v
+               for v in r1["violations"]), r1["violations"]
+    # The minimized trace names the actual TOCTOU window.
+    trace = format_trace(r1["trace"])
+    assert "admit-" in trace and "kvb:admit:gap" in trace
+
+
 def test_mutations_restore_the_fixed_methods(tmp_path):
     orig_evict = TcpGangServer.__dict__["_evict_seen_locked"]
     orig_locked = InProcTransport.__dict__["_locked"]
@@ -244,7 +261,8 @@ def test_unknown_mutation_and_scenario_are_loud():
     with pytest.raises(ValueError, match="unknown scenario"):
         run_layer3(quick=True, scenarios=["no_such_protocol"])
     assert set(MUTATIONS) == {"dedup-evict", "epoch-unlocked",
-                              "result-unfenced", "swap-unfenced"}
+                              "result-unfenced", "swap-unfenced",
+                              "admit-unlocked"}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,178 @@
+"""RegimeScheduler (runtime/scheduler.py): dead-band + dwell
+hysteresis, telemetry wiring, config validation (ISSUE 19)."""
+
+import pytest
+
+from distributed_machine_learning_tpu.runtime.scheduler import (
+    LATENCY,
+    THROUGHPUT,
+    RegimeConfig,
+    RegimeScheduler,
+)
+from distributed_machine_learning_tpu.telemetry.registry import (
+    MetricsRegistry,
+)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="dead band"):
+        RegimeConfig(thin_width=4, wide_width=4)
+    with pytest.raises(ValueError, match="dwell"):
+        RegimeConfig(dwell_steps=0)
+    with pytest.raises(ValueError, match="thin_width"):
+        RegimeConfig(thin_width=-1)
+
+
+def test_flip_to_throughput_needs_dwell():
+    s = RegimeScheduler(RegimeConfig(thin_width=2, wide_width=6,
+                                     dwell_steps=3))
+    assert s.lever == LATENCY
+    # Two wide observations: below dwell, no flip.
+    assert s.observe(4, 3) == LATENCY
+    assert s.observe(4, 3) == LATENCY
+    # A single dip resets the streak.
+    assert s.observe(0, 1) == LATENCY
+    assert s.observe(4, 3) == LATENCY
+    assert s.observe(4, 3) == LATENCY
+    # Third consecutive wide observation commits the flip.
+    assert s.observe(4, 3) == THROUGHPUT
+    assert s.flips == 1
+
+
+def test_dead_band_blocks_boundary_thrash():
+    """Pressure oscillating strictly inside (thin, wide) never flips —
+    in either direction."""
+    s = RegimeScheduler(RegimeConfig(thin_width=2, wide_width=6,
+                                     dwell_steps=1))
+    for q, w in [(1, 2), (2, 3), (1, 3), (3, 2), (0, 3)] * 10:
+        assert s.observe(q, w) == LATENCY
+    assert s.flips == 0
+    # Enter throughput, then oscillate in the band again: stays there.
+    s.observe(6, 2)
+    assert s.lever == THROUGHPUT
+    for q, w in [(1, 2), (2, 3), (1, 3)] * 10:
+        assert s.observe(q, w) == THROUGHPUT
+    assert s.flips == 1
+    # Only a true thin reading flips back.
+    assert s.observe(0, 2) == LATENCY
+    assert s.flips == 2
+
+
+def test_round_trip_with_dwell():
+    s = RegimeScheduler(RegimeConfig(thin_width=1, wide_width=4,
+                                     dwell_steps=2))
+    s.observe(3, 2)
+    s.observe(3, 2)
+    assert s.lever == THROUGHPUT
+    s.observe(0, 1)
+    assert s.lever == THROUGHPUT          # dwell not met yet
+    s.observe(0, 0)
+    assert s.lever == LATENCY
+    assert s.flips == 2
+    snap = s.snapshot()
+    assert snap["lever"] == LATENCY and snap["flips"] == 2
+
+
+def test_telemetry_gauges_and_flip_counter():
+    reg = MetricsRegistry()
+    s = RegimeScheduler(RegimeConfig(thin_width=1, wide_width=3,
+                                     dwell_steps=1), registry=reg)
+    s.observe(2, 2)                        # pressure 4 -> throughput
+    snap = reg.snapshot()
+    gauges = {g["name"]: g["value"] for g in snap["gauges"]}
+    counters = {c["name"]: c["value"] for c in snap["counters"]}
+    assert gauges["serving_regime"] == 1.0
+    assert gauges["serving_pressure"] == 4.0
+    assert counters["serving_regime_flips"] == 1
+
+
+def test_router_stamps_fleet_regime_onto_engine_completions(tmp_path):
+    """Fleet wiring (ISSUE 19): a RegimeScheduler handed to the router
+    observes fleet-wide load (queue depth + total in-flight) once per
+    pump and stamps the chosen lever onto every dispatched request;
+    the replica's engine honors the hint, and each completion's stage
+    events record which lever served it.  A burst of 8 requests
+    against a 2-lane engine must push the fleet into the throughput
+    regime."""
+    import threading
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.inference.continuous import (
+        ContinuousEngine,
+        EngineConfig,
+    )
+    from distributed_machine_learning_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from distributed_machine_learning_tpu.runtime.serving import (
+        ServingConfig,
+        ServingRouter,
+    )
+    from distributed_machine_learning_tpu.runtime.serving_worker import (
+        ServingWorkerConfig,
+        start_worker_thread,
+    )
+    from distributed_machine_learning_tpu.runtime.transport import (
+        InProcHub,
+        InProcTransport,
+    )
+
+    model = TransformerLM(vocab_size=32, d_model=16, n_layers=2,
+                          n_heads=4, n_kv_heads=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = ContinuousEngine(model, params, EngineConfig(
+        max_lanes=2, block_size=4, num_blocks=32, max_len=16,
+        max_new=6, levers=(LATENCY, THROUGHPUT)))
+    engine.warmup(prompt_lens=(3,))
+
+    sched = RegimeScheduler(RegimeConfig(thin_width=0, wide_width=2,
+                                         dwell_steps=1))
+    hub = InProcHub(mirror_dir=str(tmp_path / "gang"))
+    make_tx = lambda: InProcTransport(hub)  # noqa: E731
+    router = ServingRouter(
+        make_tx(), ServingConfig(replicas=1, micro_batch=4,
+                                 poll_s=0.002), scheduler=sched)
+    stop = threading.Event()
+    t, _ = start_worker_thread(
+        make_tx(), 0, None, stop,
+        ServingWorkerConfig(heartbeat_interval=0.02, micro_batch=4),
+        engine=engine)
+    stop_router = threading.Event()
+    rt = threading.Thread(target=router.run, args=(stop_router,),
+                          name="regime-router", daemon=True)
+    rt.start()
+    try:
+        deadline = time.monotonic() + 60.0
+        while True:
+            with router._lock:
+                if router._replicas:
+                    break
+            assert time.monotonic() < deadline, "replica never joined"
+            time.sleep(0.01)
+        rids = [router.submit([1 + i % 11, 2, 3]) for i in range(8)]
+        assert router.wait_idle(60.0), router.audit()
+        levers = set()
+        for rid in rids:
+            entry = router.result(rid)
+            assert entry["state"] == "done"
+            evs = [ev for ev in entry["events"]
+                   if ev.get("stage") == "decode"]
+            assert evs, f"{rid} never stamped its decode stage"
+            levers.add(evs[-1]["lever"])
+        # The backlog (8 deep against 2 lanes) drove the fleet into
+        # the wide regime; the hint reached the engine's completions.
+        assert THROUGHPUT in levers, levers
+        assert levers <= {LATENCY, THROUGHPUT}
+        assert sched.flips >= 1
+        assert sched.lever in (LATENCY, THROUGHPUT)
+    finally:
+        verdict = router.close()
+        stop_router.set()
+        stop.set()
+        t.join(10.0)
+        rt.join(10.0)
+    assert verdict["exactly_once"], verdict
