@@ -1175,6 +1175,70 @@ def check_unclosed_serving_spans(ctx: FileContext) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# DML016 — the digital twin must never touch a real clock (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+# Real-clock readings AND real sleeps: the virtual-clock modules price
+# time arithmetically and advance a VirtualClock; any of these leaking
+# in couples the modeled trajectory to host scheduling.
+_REAL_CLOCK_CALLS = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
+    "time.clock_gettime", "time.sleep",
+    "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+})
+
+
+def _virtual_clock_scope(path: str) -> bool:
+    """The modeled-network modules: ``runtime/netmodel.py`` and any
+    sibling whose filename says it belongs to the twin (fixtures map
+    here via their virtual-path header)."""
+    return _in_package(path) and "netmodel" in path.rsplit("/", 1)[-1]
+
+
+@_rule(
+    "DML016",
+    "real clock or sleep inside a virtual-clock (digital twin) path",
+    "ISSUE 20: the pod-scale twin replays 512-rank gray-failure "
+    "campaigns deterministically because every duration is model "
+    "arithmetic over a VirtualClock; a single time.sleep or wall/"
+    "monotonic reading re-couples the trajectory to host scheduling, "
+    "and the 1-core CI host turns that into flaky campaigns and "
+    "false straggler flags.",
+    _virtual_clock_scope,
+)
+def check_virtual_clock_purity(ctx: FileContext) -> Iterator[Finding]:
+    """Any ``time.*`` clock/sleep or ``datetime.now``-family call in a
+    twin module — including the bare names when imported via
+    ``from time import sleep`` — is an error.  VirtualClock methods
+    (``now``/``advance``/``advance_to``) are attribute calls on model
+    state and do not match."""
+    # Map `from time import sleep as snooze` -> {"snooze": "time.sleep"}.
+    aliased: dict[str, str] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+                "time", "datetime"):
+            for alias in node.names:
+                dotted = f"{node.module}.{alias.name}"
+                if dotted in _REAL_CLOCK_CALLS:
+                    aliased[alias.asname or alias.name] = dotted
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        dotted = aliased.get(name, name)
+        if dotted in _REAL_CLOCK_CALLS:
+            yield ctx.finding(
+                "DML016", node,
+                f"{dotted}() inside a virtual-clock path — the twin "
+                "must stay pure arithmetic over VirtualClock; real "
+                "sleeps/clock reads make modeled campaigns depend on "
+                "host scheduling and break deterministic replay",
+            )
+
+
+# ---------------------------------------------------------------------------
 # Runner
 # ---------------------------------------------------------------------------
 

@@ -275,9 +275,10 @@ def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
 
     ``topology`` ("INNERxOUTER", round 11): compile the hierarchical
     plan instead — ``compress`` becomes the OUTER axis's codec (the CLI
-    mapping) and ``hd_max_bytes`` overrides the selector's
-    small-bucket threshold (0 pins every bucket to the ring plans, a
-    large value pins them to halving-doubling).
+    mapping) and ``hd_max_bytes`` caps the selector's halving-doubling
+    admissibility (``None`` lets the round-20 cost model decide, 0
+    pins every bucket to the ring plans, a large value admits
+    halving-doubling for every bucket it wins).
 
     ``codec_impl`` (round 13): compile the int8 codec as the fused
     Pallas kernels (``"pallas"``) instead of the XLA ops — the DML103
@@ -303,7 +304,6 @@ def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
     topo = None
     if topology is not None:
         from distributed_machine_learning_tpu.ops.topology import (
-            DEFAULT_HD_MAX_BYTES,
             Topology,
             parse_topology,
         )
@@ -316,9 +316,7 @@ def compile_ring_hlo(mesh, length: int, *, compress: str = "none",
             )
         topo = Topology(
             inner, outer, outer_scheme=compress, topk_frac=topk_frac,
-            codec_impl=codec_impl,
-            hd_max_bytes=(DEFAULT_HD_MAX_BYTES if hd_max_bytes is None
-                          else hd_max_bytes),
+            codec_impl=codec_impl, hd_max_bytes=hd_max_bytes,
         )
 
     def per_device(x):

@@ -45,7 +45,8 @@ def bench_ring_compress(worlds=(2, 4, 8), iters: int = 24,
                         model_name: str = "vggtest",
                         topk_frac: float = 0.125,
                         bucket_mb: int = 25,
-                        topologies=("flat",)) -> list[dict]:
+                        topologies=("flat",),
+                        modeled_network: bool = False) -> list[dict]:
     import jax
     import numpy as np
 
@@ -172,6 +173,38 @@ def bench_ring_compress(worlds=(2, 4, 8), iters: int = 24,
                         / max(abs(final_exact), 1e-30)
                     ),
                 }
+                if modeled_network:
+                    # The pod claim, priced instead of measured: seconds
+                    # one bucketed all-reduce costs under the calibrated
+                    # LinkModel (round 20) — the number the CPU rows
+                    # cannot show because their ppermute "wire" is a
+                    # memcpy.  Topology rows ride the selector's own
+                    # cost model; a flat ring on a multi-node pod is
+                    # topology-unaware, so every hop is priced at the
+                    # inter-node link (Topology._flat_axis).
+                    from distributed_machine_learning_tpu.ops.ring import (
+                        _bucket_bounds,
+                    )
+                    from distributed_machine_learning_tpu.ops.topology import (  # noqa: E501
+                        DEFAULT_LINK_MODEL,
+                        Topology,
+                        predict_all_reduce_time,
+                    )
+
+                    if topo is not None:
+                        modeled = predict_all_reduce_time(
+                            n_elems, topo, bucket_mb * 2**20)
+                    else:
+                        pod = Topology(
+                            inner=1, outer=world,
+                            outer_scheme=compress, topk_frac=topk_frac)
+                        modeled = sum(
+                            pod.predict_bucket_time(
+                                (b1 - b0) * 4, plan="flat",
+                                link=DEFAULT_LINK_MODEL)
+                            for b0, b1 in _bucket_bounds(
+                                n_elems, bucket_mb * 2**20, 4))
+                    row["modeled_pod_step_s"] = modeled
                 rows.append(row)
                 print(json.dumps(row))
     return rows
@@ -301,6 +334,12 @@ def main(argv=None) -> None:
                              "cancels) instead of the sweep; the "
                              "first --topology entry that is not "
                              "'flat' is the factorization under test")
+    parser.add_argument("--modeled-network", action="store_true",
+                        help="add a modeled_pod_step_s column: the "
+                             "calibrated LinkModel's predicted pod "
+                             "all-reduce seconds next to the measured "
+                             "CPU time (the digital-twin pricing, "
+                             "round 20)")
     parser.add_argument("--json", dest="json_out", default=None)
     args = parser.parse_args(argv)
     if args.selector_ab:
@@ -322,6 +361,7 @@ def main(argv=None) -> None:
             topk_frac=args.topk_frac,
             bucket_mb=args.bucket_mb,
             topologies=tuple(t.strip() for t in args.topology.split(",")),
+            modeled_network=args.modeled_network,
         )
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -208,7 +208,8 @@ def simulate_baseline(step, cap, workload, *, micro_batch: int):
 
 def run_sweep(rates, n_requests: int, seed: int = 0, *, width: int = 4,
               model=None, params=None, prompt_lens=PROMPT_LENS,
-              budgets=BUDGETS, num_blocks: int = 64):
+              budgets=BUDGETS, num_blocks: int = 64,
+              modeled_network: bool = False):
     """One row per (rate, system), rates ascending.  The engine rows
     carry the head-to-head verdicts the acceptance gate reads.  The
     same seed drives every rate, so the request mix (prompts, budgets)
@@ -243,6 +244,28 @@ def run_sweep(rates, n_requests: int, seed: int = 0, *, width: int = 4,
             }
             row.update({k: round(v, 4) for k, v in
                         _quantiles(list(res["e2e"].values())).items()})
+            if modeled_network:
+                # Router<->replica transit over the modeled inter-node
+                # link (round 20): one round trip per dispatch (the
+                # per-hop overhead both directions) plus the token
+                # payload — prompts out, completions back — priced at
+                # the calibrated outer bandwidth.  Reported NEXT TO the
+                # measured numbers, never folded into the simulation:
+                # the column is what a pod adds on top of the CPU
+                # compute the rows measured.
+                from distributed_machine_learning_tpu.ops.topology import (  # noqa: E501
+                    DEFAULT_LINK_MODEL,
+                )
+
+                link = DEFAULT_LINK_MODEL
+                payload = sum(
+                    (len(r["prompt"]) + r["max_new"]) * 4 for r in wl)
+                net_s = (res["dispatches"] * 2 * link.outer_overhead_s
+                         + 2 * payload / link.outer_bytes_per_s)
+                row["modeled_net_s"] = round(net_s, 6)
+                row["tokens_per_sec_modeled_pod"] = round(
+                    res["useful_tokens"]
+                    / (res["makespan_s"] + net_s), 1)
             rows.append(row)
             print(json.dumps(row), flush=True)
         erow, brow = rows[-1], rows[-2]
@@ -281,6 +304,11 @@ def main() -> None:
                    help="micro_batch == max_lanes")
     p.add_argument("--d-model", dest="d_model", default=320, type=int)
     p.add_argument("--n-layers", dest="n_layers", default=4, type=int)
+    p.add_argument("--modeled-network", action="store_true",
+                   help="add modeled_net_s / tokens_per_sec_modeled_pod "
+                        "columns: router<->replica transit priced on "
+                        "the calibrated inter-node LinkModel next to "
+                        "the measured CPU numbers (round 20)")
     p.add_argument("--out", default=None,
                    help="write the row list as JSON (BENCH idiom)")
     args = p.parse_args()
@@ -288,7 +316,8 @@ def main() -> None:
     model, params = make_model(d_model=args.d_model,
                                n_layers=args.n_layers)
     rows = run_sweep(rates, args.requests, args.seed, width=args.width,
-                     model=model, params=params)
+                     model=model, params=params,
+                     modeled_network=args.modeled_network)
     verdict = acceptance(rows)
     rows.append(verdict)
     print(json.dumps(verdict), flush=True)

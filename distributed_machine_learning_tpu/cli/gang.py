@@ -194,6 +194,21 @@ def main(argv=None) -> int:
                          "this launcher hosts the gang server and "
                          "workers connect with per-op timeouts, "
                          "retry+backoff, and idempotent delivery")
+    ap.add_argument("--net-model", dest="net_model", default=None,
+                    help="attach the digital-twin network model "
+                         "(runtime/netmodel.py) to the in-proc hub "
+                         "(inproc only): 'INNER[:COMPUTE_US"
+                         "[:STEP_MB]]' — inner-major nodes of INNER "
+                         "ranks, intra-node fast / inter-node slow; "
+                         "ranks report MODELED step times (virtual "
+                         "seconds, no real sleeps) while liveness "
+                         "stays on the real heartbeat clock, and the "
+                         "gray fault kinds (--faults "
+                         "'degrade_link@SRC-DST:STEP:K,"
+                         "flaky_link@SRC-DST:STEP:P,"
+                         "bw_collapse@NODE:STEP:K,"
+                         "restore_link@SRC-DST:STEP') mutate the "
+                         "model's links")
     ap.add_argument("--tx-chaos", dest="tx_chaos", default=None,
                     help="transport-level fault injection forwarded to "
                          "tcp workers (runtime/gang_worker.py): "
@@ -228,6 +243,9 @@ def main(argv=None) -> int:
         ap.error("--spares without a promotion path: spares can only "
                  "be promoted at a grow (--max-world) or replacement "
                  "(--straggler-policy replace) boundary")
+    if args.net_model and args.gang_transport != "inproc":
+        ap.error("--net-model is the in-proc hub's digital-twin seam; "
+                 "use --gang-transport inproc")
     if args.replace_after < 1:
         ap.error(f"--replace-after must be >= 1, got {args.replace_after}")
     if args.tx_chaos and args.gang_transport != "tcp":
@@ -388,6 +406,27 @@ def main(argv=None) -> int:
         )
 
         hub = InProcHub(mirror_dir=args.gang_dir)
+        if args.net_model:
+            # The digital-twin seam (round 20): workers report modeled
+            # step times, rank 0 advances the virtual clock, and gray
+            # faults mutate these links.
+            from distributed_machine_learning_tpu.runtime.netmodel import (  # noqa: E501
+                NetModel,
+            )
+
+            parts = args.net_model.split(":")
+            try:
+                nm_inner = int(parts[0])
+                nm_compute_us = (float(parts[1]) if len(parts) > 1
+                                 else 2000.0)
+                nm_step_mb = float(parts[2]) if len(parts) > 2 else 4.0
+                hub.netmodel = NetModel(
+                    args.workers, inner=nm_inner,
+                    compute_s=nm_compute_us / 1e6,
+                    step_bytes=int(nm_step_mb * 2**20))
+            except ValueError as e:
+                ap.error(f"bad --net-model spec {args.net_model!r} "
+                         f"(expected INNER[:COMPUTE_US[:STEP_MB]]): {e}")
         transport = InProcTransport(hub, events=events)
         cfg = InprocGangConfig(
             ckpt_dir=args.ckpt_dir, steps=args.steps,
@@ -395,7 +434,12 @@ def main(argv=None) -> int:
             scaling_rule=args.scaling_rule, base_world=args.workers,
             base_lr=args.base_lr, feature_dim=args.feature_dim,
             heartbeat_interval=min(args.heartbeat_interval, 0.1),
-            peer_timeout=min(args.peer_timeout, 5.0),
+            # Modeled pod gangs run hundreds of thread ranks on a few
+            # cores: startup alone can exceed the thread-campaign
+            # clamp, and their death detection is exit-code/model
+            # driven — honor the user's timeout there.
+            peer_timeout=(args.peer_timeout if args.net_model
+                          else min(args.peer_timeout, 5.0)),
             faults=args.faults,
         )
         worker_cmd, spare_cmd = inproc_worker_cmds(cfg, hub)

@@ -73,16 +73,59 @@ from distributed_machine_learning_tpu.ops.ring import (
     ring_all_reduce_flat,
 )
 
-#: Buckets at or under this many bytes take the halving-doubling
-#: latency path by default (the hop-count term dominates the wire term
-#: well above a typical small gradient bucket; 64 KiB is conservative).
-DEFAULT_HD_MAX_BYTES = 64 * 1024
-
 #: When a lossy codec was requested, halving-doubling (which is exact
 #: and would silently discard the codec) only takes buckets at or
 #: under this size — the regime where per-chunk codec metadata and
-#: encode compute rival the payload itself.
+#: encode compute rival the payload itself.  This is a FIDELITY bound,
+#: not a performance threshold: the cost model below decides perf, but
+#: silently rerouting a requested codec onto an exact plan is only
+#: defensible where the codec could not have paid for itself anyway.
 HD_LOSSY_MAX_BYTES = 4 * 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkModel:
+    """Per-axis link cost model (round 20): the digital twin's notion
+    of what one ``ppermute`` costs on a pod.
+
+    Wormhole/cut-through routing semantics: a permute at ring distance
+    ``d`` on an axis pays the axis's per-message **overhead once** (the
+    header cuts through intermediate switches without store-and-forward
+    buffering) but its **payload occupies d links** of that axis's ring
+    — the congestion/bandwidth term scales with distance while the
+    latency term does not.  That asymmetry is what gives every
+    topology×scheme cell a genuine flat/hier/hd crossover: hd spends
+    fewer serial overheads than hier but its long-distance exchanges
+    multiply bytes across links, so hd wins small buckets and hier wins
+    large ones (2x4 exact: the crossover sits at
+    ``8·outer_overhead_s·outer_bytes_per_s`` = 1 MiB-ish under the
+    defaults; 4x2 exact: ``4·inner_overhead_s·inner_bytes_per_s``).
+
+    Defaults are ICI-class intra-node links (~1 µs, 100 GB/s) and
+    DCN-class inter-node links (~5 µs, 25 GB/s) — the fast/slow axis
+    split the :class:`Topology` descriptor declares.  Calibration:
+    ``tests/test_netmodel.py`` pins the model's per-axis bytes to the
+    static ``topology_wire_bytes`` accounting (itself pinned to the
+    compiled HLO by DML103) and its plan ordering to the measured
+    ``BENCH_r11_hier.json`` cells.
+    """
+
+    inner_overhead_s: float = 1.0e-6
+    inner_bytes_per_s: float = 100.0e9
+    outer_overhead_s: float = 5.0e-6
+    outer_bytes_per_s: float = 25.0e9
+
+    def permute_time(self, axis: str, distance: int, nbytes: int) -> float:
+        """Modeled seconds for one permute: overhead once, bytes across
+        ``distance`` links of the axis ring."""
+        if axis == "inner":
+            return (self.inner_overhead_s
+                    + distance * nbytes / self.inner_bytes_per_s)
+        return (self.outer_overhead_s
+                + distance * nbytes / self.outer_bytes_per_s)
+
+
+DEFAULT_LINK_MODEL = LinkModel()
 
 _TOPOLOGY_RE = re.compile(r"^\s*(\d+)\s*[x×X]\s*(\d+)\s*$")
 
@@ -121,7 +164,11 @@ class Topology:
     — the CLI maps ``--ring-compress`` onto the OUTER axis (compress
     where the wire is expensive) and leaves the inner axis exact, but
     the descriptor supports compressing either or both.
-    ``hd_max_bytes``: the selector's small-bucket threshold.
+    ``hd_max_bytes`` (round 20): an OPTIONAL admissibility cap on the
+    halving-doubling plan — ``None`` (default) lets the cost model
+    decide, ``0`` disables hd entirely, and a positive value admits hd
+    only at or under that many bytes; the lossy fidelity bound
+    :data:`HD_LOSSY_MAX_BYTES` is applied on top in every case.
     ``codec_impl`` (round 13): the int8 codec implementation both axes
     resolve — ``"pallas"`` runs the fused in-register kernels
     (``ops/pallas/ring_codec.py``), bitwise-identical to ``"xla"``.
@@ -132,7 +179,7 @@ class Topology:
     inner_scheme: str = "none"
     outer_scheme: str = "none"
     topk_frac: float = 0.125
-    hd_max_bytes: int = DEFAULT_HD_MAX_BYTES
+    hd_max_bytes: int | None = None
     codec_impl: str = "xla"
 
     def __post_init__(self):
@@ -175,30 +222,116 @@ class Topology:
         bottleneck inter-node links (see ``classify_permute_pairs``)."""
         return "inner" if self.outer == 1 else "outer"
 
-    # -- selector -------------------------------------------------------
+    # -- selector (round 20: prediction-driven, no byte threshold) ------
 
-    def select(self, bucket_bytes: int) -> str:
+    def _hd_admissible(self, bucket_bytes: int) -> bool:
+        """Whether halving-doubling may even be CONSIDERED for this
+        bucket — correctness/fidelity gates, not performance (the cost
+        model owns performance): pairwise exchange needs a power-of-two
+        world; when a lossy codec was requested, hd (which is exact and
+        would silently discard it) is only admissible at or under
+        :data:`HD_LOSSY_MAX_BYTES`; an explicit ``hd_max_bytes`` caps
+        it further (``0`` disables hd outright)."""
+        if not (_is_pow2(self.world) and self.world >= 4):
+            return False
+        cap = self.hd_max_bytes
+        if self.inner_scheme != "none" or self.outer_scheme != "none":
+            cap = (HD_LOSSY_MAX_BYTES if cap is None
+                   else min(cap, HD_LOSSY_MAX_BYTES))
+        return cap is None or bucket_bytes <= cap
+
+    def plan_hops(
+        self, bucket_bytes: int, plan: str, itemsize: int = 4,
+    ) -> list[tuple[str, int, int]]:
+        """The serial hop schedule of one bucket under ``plan``: a list
+        of ``(axis, distance, payload_bytes)``, one entry per
+        ``ppermute`` on the program's critical path.
+
+        The per-axis payload accounting is EXACTLY
+        :func:`topology_wire_bytes` re-expressed hop-by-hop (asserted
+        in ``tests/test_netmodel.py``), so the cost model prices the
+        same bytes the HLO audit counts; ``distance`` is the axis-ring
+        distance the payload travels (1 for ring hops, ``2**s`` scaled
+        into node units for the hd exchanges — the congestion input of
+        :meth:`LinkModel.permute_time`).
+        """
+        n = self.world
+        blen = -(-bucket_bytes // itemsize)
+        hops: list[tuple[str, int, int]] = []
+        if n <= 1 or blen <= 0:
+            return hops
+        if plan == "flat":
+            chunk = -(-blen // n)
+            axis = self._flat_axis()
+            pb = self.axis_scheme(axis).payload_bytes(chunk, itemsize)
+            hops.extend([(axis, 1, pb)] * (2 * (n - 1)))
+        elif plan == "hd":
+            chunk = -(-blen // n)
+            for s in range(n.bit_length() - 1):
+                d = 1 << s
+                # An exchange at rank distance d stays inside a block
+                # when d < inner (power-of-two factors nest), else it
+                # jumps d/inner nodes — the same block arithmetic as
+                # classify_permute_pairs, with the distance kept.
+                axis, dist = (("inner", d) if d < self.inner
+                              else ("outer", d // self.inner))
+                pb = (n >> (s + 1)) * chunk * itemsize
+                hops.extend([(axis, dist, pb)] * 2)
+        elif plan == "hier":
+            chunk_i = -(-blen // self.inner)
+            chunk_o = -(-chunk_i // self.outer)
+            pb_i = self.axis_scheme("inner").payload_bytes(
+                chunk_i, itemsize)
+            pb_o = self.axis_scheme("outer").payload_bytes(
+                chunk_o, itemsize)
+            hops.extend([("inner", 1, pb_i)] * (2 * (self.inner - 1)))
+            hops.extend([("outer", 1, pb_o)] * (2 * (self.outer - 1)))
+        else:
+            raise ValueError(f"unknown plan {plan!r}")
+        return hops
+
+    def predict_bucket_time(
+        self,
+        bucket_bytes: int,
+        plan: str | None = None,
+        link: LinkModel | None = None,
+        itemsize: int = 4,
+    ) -> float:
+        """Modeled seconds for one bucket's all-reduce under ``plan``
+        (default: whatever :meth:`select` picks under the same link
+        model) — the sum of the hop schedule through the link model."""
+        link = link or DEFAULT_LINK_MODEL
+        if plan is None:
+            plan = self.select(bucket_bytes, link=link)
+        return sum(
+            link.permute_time(axis, dist, pb)
+            for axis, dist, pb in self.plan_hops(bucket_bytes, plan,
+                                                 itemsize)
+        )
+
+    def select(self, bucket_bytes: int,
+               link: LinkModel | None = None) -> str:
         """Pick the plan for one bucket: ``"flat"`` / ``"hier"`` /
-        ``"hd"``.
+        ``"hd"`` — by PREDICTED hop time under the link model (round
+        20), not a hard-coded byte threshold.
 
         - a degenerate axis (inner==1 or outer==1) means there is no
           hierarchy to exploit: the flat ring, with the live axis's
           scheme, for EVERY bucket size — bit-for-bit the round-7
           program, never a crash and never a silent reroute (the
           ``--ring-topology 1xN`` contract);
-        - small buckets on a power-of-two world go recursive
-          halving-doubling: same bytes, ``2·log2 N`` serial hops
-          instead of ``2·(N−1)`` — the latency-bound regime where hop
-          count, not bandwidth, is the cost.  The threshold is
-          ``hd_max_bytes`` when both axes are exact; when a lossy
-          codec was requested it tightens to
-          :data:`HD_LOSSY_MAX_BYTES` — halving-doubling is exact, and
-          silently discarding a requested codec is only defensible
-          where metadata/encode overhead rivals the payload (an exact
-          small bucket then contributes zero EF residual, which keeps
-          the residual contract intact);
-        - everything else goes hierarchical: reduce-scatter inner,
-          compressed ring outer, all-gather inner.
+        - otherwise every admissible plan is priced through
+          :meth:`plan_hops` × :class:`LinkModel` and the cheapest wins.
+          Under the default pod parameters that reproduces the old
+          policy's *shape* from first principles: hd (fewest serial
+          overheads) takes small buckets, hier (1/inner the inter-node
+          bytes) takes large ones, and the crossover now moves with
+          the topology and link speeds instead of sitting at a frozen
+          64 KiB.  hd admissibility (:meth:`_hd_admissible`) stays a
+          correctness/fidelity gate: power-of-two worlds only, lossy
+          codecs never silently discarded above
+          :data:`HD_LOSSY_MAX_BYTES`, ``hd_max_bytes=0`` still
+          disables the plan.  Ties go to ``hier`` (keeps the codec).
         """
         if self.world == 1 or self.inner == 1 or self.outer == 1:
             # Degenerate axis FIRST: the documented contract is that a
@@ -207,13 +340,17 @@ class Topology:
             # the association order (and could discard a codec) behind
             # the user's declared no-hierarchy topology.
             return "flat"
-        hd_cap = self.hd_max_bytes
-        if self.inner_scheme != "none" or self.outer_scheme != "none":
-            hd_cap = min(hd_cap, HD_LOSSY_MAX_BYTES)
-        if (bucket_bytes <= hd_cap and _is_pow2(self.world)
-                and self.world >= 4):
-            return "hd"
-        return "hier"
+        link = link or DEFAULT_LINK_MODEL
+        candidates = ["hier"]
+        if self._hd_admissible(bucket_bytes):
+            candidates.append("hd")
+        candidates.append("flat")
+        best, best_t = None, None
+        for plan in candidates:
+            t = self.predict_bucket_time(bucket_bytes, plan, link=link)
+            if best_t is None or t < best_t:
+                best, best_t = plan, t
+        return best
 
     # -- static permutation tables (one entry per physical rank; the
     #    disjoint sub-rings all move in a single ppermute) --------------
@@ -559,3 +696,25 @@ def topology_wire_bytes(
                 2 * (topo.outer - 1) * so.payload_bytes(chunk_o, itemsize)
             )
     return out
+
+
+def predict_all_reduce_time(
+    n_elems: int,
+    topo: Topology,
+    bucket_bytes: int,
+    link: LinkModel | None = None,
+    itemsize: int = 4,
+) -> float:
+    """Modeled seconds for one FULL bucketed all-reduce (round 20):
+    every bucket priced under the plan the selector picks for it,
+    summed — serial buckets, the conservative no-overlap estimate.
+    This is the ``--modeled-network`` column of the bench suite and the
+    collective term of ``runtime.netmodel.NetModel.step_time``."""
+    link = link or DEFAULT_LINK_MODEL
+    if n_elems <= 0 or topo.world <= 1:
+        return 0.0
+    total = 0.0
+    for start, stop in _bucket_bounds(n_elems, bucket_bytes, itemsize):
+        total += topo.predict_bucket_time(
+            (stop - start) * itemsize, link=link, itemsize=itemsize)
+    return total

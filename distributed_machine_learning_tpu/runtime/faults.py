@@ -88,6 +88,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 import threading
 import time
 
@@ -126,7 +127,27 @@ _KIND_ALIASES = {
     "recover_rank": "recover_rank",
     "stall_rank": "stall_rank",
     "corrupt_ckpt": "corrupt_ckpt",
+    # Gray network faults (round 20): the link stays up and the rank
+    # stays "alive" — only the modeled network degrades.  They act on
+    # the hub-scoped NetModel (``injector.netmodel``) and are latched
+    # GANG-WIDE in the ledger like recover_rank.
+    "degrade_link": "degrade_link",
+    "flaky_link": "flaky_link",
+    "bw_collapse": "bw_collapse",
+    "restore_link": "restore_link",
 }
+
+# The gray/link fault class: targets a LINK or NODE of the modeled
+# network, not a process — exactly one rank acts, the mutation lives on
+# the shared NetModel, and the ledger latch is gang-wide.
+_LINK_KINDS = ("degrade_link", "flaky_link", "restore_link")
+_GRAY_KINDS = _LINK_KINDS + ("bw_collapse",)
+
+# Kinds whose ledger latch is GANG-WIDE on replay: the acting process
+# is an assignment (rank 0, a link's src) that demotions/renumberings
+# can move between hosts — a per-rank latch would let the next holder
+# re-fire a fault that already happened.
+_GANG_WIDE_KINDS = ("recover_rank",) + _GRAY_KINDS
 
 
 class InjectedFault(RuntimeError):
@@ -187,6 +208,10 @@ class FaultEvents:
     weight_swaps: int = 0       # replica weight hot-swaps committed
     canary_promotions: int = 0  # deploys promoted fleet-wide (clean canary)
     canary_rollbacks: int = 0   # deploys rolled back (regression/SLO burn)
+    link_degradations: int = 0  # injected gray link slowdown (degrade_link)
+    link_flakes: int = 0        # injected lossy link (flaky_link)
+    bw_collapses: int = 0       # injected node bandwidth collapse
+    link_restorations: int = 0  # gray link state cleared (restore_link)
 
     def __setattr__(self, name: str, value) -> None:
         # Mirror every increment into the telemetry registry AS IT
@@ -227,7 +252,9 @@ class _Fault:
     kind: str
     at: int            # batch index (data faults) / save ordinal (*_ckpt)
     arg: str | None = None
-    rank: int | None = None  # target process (kill_rank / stall_rank only)
+    rank: int | None = None  # target process (rank-aimed) / link SRC (gray)
+    dst: int | None = None   # link DST (degrade/flaky/restore_link only)
+    node: int | None = None  # target node (bw_collapse only)
     fired: bool = False
     index: int = -1    # position in the spec (the ledger's stable key)
 
@@ -266,6 +293,12 @@ class FaultInjector:
         # for itself, so the fault is acted by whichever live process
         # currently holds rank 0.
         self.current_rank: int | None = None
+        # Gray-fault seam (round 20): the shared
+        # ``runtime/netmodel.py::NetModel`` the link fault class
+        # mutates.  Hub-scoped in the in-proc gang (a relaunch clears
+        # beats, not physics), None when no modeled network is attached
+        # — firing a link fault then is a spec error, raised loudly.
+        self.netmodel = None
 
     def _process_rank(self) -> int:
         if self.rank is not None:
@@ -298,13 +331,14 @@ class FaultInjector:
             if not (isinstance(i, int) and 0 <= i < len(self._faults)
                     and entry.get("kind") == self._faults[i].kind):
                 continue
-            # recover_rank latches GANG-WIDE: the acting process is
-            # "whoever currently holds rank 0", an assignment a grow or
-            # demotion can move between hosts — a per-rank latch would
-            # let the next holder re-fire a recovery that already
-            # happened.
+            # recover_rank and the gray/link class latch GANG-WIDE: the
+            # acting process is an assignment (rank 0, a link's src)
+            # that a grow or demotion can move between hosts — a
+            # per-rank latch would let the next holder re-fire a fault
+            # that already happened (for a link fault, re-degrading a
+            # link the campaign already consumed).
             if (entry.get("rank") == me
-                    or self._faults[i].kind == "recover_rank"):
+                    or self._faults[i].kind in _GANG_WIDE_KINDS):
                 self._faults[i].fired = True
         return self
 
@@ -327,6 +361,10 @@ class FaultInjector:
             # acting rank — for kill/lose/stall the two coincide, for
             # recover_rank they cannot (the target is the dead host).
             entry["target"] = f.rank
+        if f.dst is not None:
+            entry["dst"] = f.dst
+        if f.node is not None:
+            entry["node"] = f.node
         if self._ledger_transport is not None:
             self._ledger_transport.append_fault_entry(entry)
             return
@@ -341,7 +379,9 @@ class FaultInjector:
     def parse(cls, spec: str, seed: int = 0, horizon: int = 40,
               rank: int | None = None) -> "FaultInjector":
         """``"nan@2,raise@4,stall@7:2.5,kill_ckpt@1,kill_rank@1:7"`` →
-        injector.
+        injector.  Gray network faults (round 20):
+        ``"degrade_link@3-4:2:50,flaky_link@0-1:3:0.5,bw_collapse@1:4:8,
+        restore_link@3-4:6"``.
 
         ``?`` steps draw from ``default_rng(seed)`` in ``[1, horizon)``,
         in spec order — deterministic per (spec, seed).
@@ -410,6 +450,59 @@ class FaultInjector:
                 faults.append(
                     _Fault(kind=kind, at=at, arg=arg, rank=target)
                 )
+                continue
+            if kind in _GRAY_KINDS:
+                # Gray-network grammar (round 20):
+                #   degrade_link@SRC-DST:STEP:K   latency ×K
+                #   flaky_link@SRC-DST:STEP:P     loss prob → ×1/(1−P)
+                #   restore_link@SRC-DST:STEP     clear both
+                #   bw_collapse@NODE:STEP:K       node bandwidth ÷K
+                parts = [p.strip() for p in rest.split(":")]
+                want = 2 if kind == "restore_link" else 3
+                if len(parts) != want:
+                    raise ValueError(
+                        f"bad {kind} entry {entry!r}: expected "
+                        + (f"{kind}@src-dst:step" if want == 2 else
+                           (f"{kind}@node:step:k" if kind == "bw_collapse"
+                            else f"{kind}@src-dst:step:arg"))
+                    )
+                arg = parts[2] if want == 3 else None
+                if arg is not None:
+                    val = float(arg)  # validate at parse time
+                    if kind == "flaky_link" and not 0.0 <= val <= 0.99:
+                        raise ValueError(
+                            f"flaky_link probability must be in "
+                            f"[0, 0.99], got {arg!r} in {entry!r}")
+                    if kind != "flaky_link" and val < 1.0:
+                        raise ValueError(
+                            f"{kind} factor must be >= 1, got {arg!r} "
+                            f"in {entry!r}")
+                at = parse_at(parts[1], entry)
+                if kind == "bw_collapse":
+                    try:
+                        node = int(parts[0])
+                    except ValueError:
+                        raise ValueError(
+                            f"bad bw_collapse node {parts[0]!r} in "
+                            f"{entry!r}") from None
+                    if node < 0:
+                        raise ValueError(
+                            f"bw_collapse node must be >= 0, got {node}")
+                    faults.append(_Fault(kind=kind, at=at, arg=arg,
+                                         node=node))
+                    continue
+                m = re.fullmatch(r"(\d+)\s*-\s*(\d+)", parts[0])
+                if not m:
+                    raise ValueError(
+                        f"bad {kind} link {parts[0]!r} in {entry!r}: "
+                        "expected SRC-DST (two rank ids)")
+                src, dst = int(m.group(1)), int(m.group(2))
+                if src == dst:
+                    raise ValueError(
+                        f"{kind} link must join two distinct ranks, "
+                        f"got {src}-{dst}")
+                faults.append(_Fault(kind=kind, at=at, arg=arg,
+                                     rank=src, dst=dst))
                 continue
             at_s, _, arg = rest.partition(":")
             at = parse_at(at_s, entry)
@@ -485,6 +578,49 @@ class FaultInjector:
                         f"(join published) at batch {idx}",
                         flush=True,
                     )
+                elif f.kind in _GRAY_KINDS:
+                    # Gray network faults: exactly one rank mutates the
+                    # SHARED NetModel — the link's src for link faults
+                    # (a rank id that survives renumbering), whoever
+                    # currently holds rank 0 for the node-wide
+                    # bw_collapse (the recover_rank convention).  The
+                    # gang-wide ledger latch keeps a relaunched attempt
+                    # from re-degrading a consumed link.
+                    if f.kind == "bw_collapse":
+                        cur = (self.current_rank if self.current_rank
+                               is not None else self._process_rank())
+                        acting = cur == 0
+                    else:
+                        acting = self._process_rank() == f.rank
+                    if not acting:
+                        self._mark_fired(f, acted=False)
+                        continue
+                    nm = self.netmodel
+                    if nm is None:
+                        raise InjectedFault(
+                            f"{f.kind} fault at batch {idx} requires an "
+                            "attached modeled network "
+                            "(injector.netmodel is None — run under "
+                            "the digital twin)")
+                    val = float(f.arg) if f.arg is not None else None
+                    if f.kind == "degrade_link":
+                        nm.degrade_link(f.rank, f.dst, val)
+                        if events is not None:
+                            events.link_degradations += 1
+                    elif f.kind == "flaky_link":
+                        nm.flaky_link(f.rank, f.dst, val)
+                        if events is not None:
+                            events.link_flakes += 1
+                    elif f.kind == "bw_collapse":
+                        nm.bw_collapse(f.node, val)
+                        if events is not None:
+                            events.bw_collapses += 1
+                    else:
+                        nm.restore_link(f.rank, f.dst)
+                        if events is not None:
+                            events.link_restorations += 1
+                    self._mark_fired(f)
+                    self._publish_link_event(f, nm, idx)
                 elif f.kind in ("kill_rank", "lose_rank", "stall_rank"):
                     # Every rank latches the fault at its index; only the
                     # targeted rank acts — so a gang sharing one spec
@@ -538,6 +674,49 @@ class FaultInjector:
                     rank0_print(f"[faults] poisoning batch {idx} with NaN")
                     batch = _poison(batch)
             yield batch
+
+    def _publish_link_event(self, f: _Fault, nm, idx: int) -> None:
+        """Make a gray firing observable: a ``link_degraded`` /
+        ``link_restored`` health-ledger event carrying the link's
+        EFFECTIVE modeled parameters (what ``tools/gang_status.py``
+        renders) and a ``gang_link_degraded{src,dst}`` counter."""
+        if f.kind == "bw_collapse":
+            src = f.node * nm.inner
+            dst = (src + 1) % nm.world
+        else:
+            src, dst = f.rank, f.dst
+        event = ("link_restored" if f.kind == "restore_link"
+                 else "link_degraded")
+        source = (f"{f.kind}@{f.node}:{f.at}" if f.kind == "bw_collapse"
+                  else f"{f.kind}@{f.rank}-{f.dst}:{f.at}")
+        if f.arg is not None:
+            source += f":{f.arg}"
+        params = nm.link_params(src, dst)
+        tx = self._ledger_transport
+        if tx is not None and hasattr(tx, "append_health_event"):
+            tx.append_health_event(
+                event, src=src, dst=dst, axis=params["axis"],
+                latency_s=params["latency_s"],
+                bytes_per_s=params["bytes_per_s"],
+                latency_mult=params["latency_mult"],
+                flaky_p=params["flaky_p"], bw_div=params["bw_div"],
+                source=source, step=idx,
+            )
+        from distributed_machine_learning_tpu.telemetry import (
+            get_telemetry,
+        )
+
+        tel = get_telemetry()
+        if tel is not None:
+            if event == "link_degraded":
+                tel.registry.counter("gang_link_degraded", src=str(src),
+                                     dst=str(dst)).inc()
+            tel.tracer.instant(event, src=src, dst=dst, source=source)
+            tel.flush()
+        print(f"[faults] {source} fired at batch {idx}: link {src}→"
+              f"{dst} now latency {params['latency_s'] * 1e6:.1f}µs, "
+              f"bw {params['bytes_per_s'] / 1e9:.1f} GB/s, "
+              f"loss p={params['flaky_p']}", flush=True)
 
     # -- checkpoint faults ---------------------------------------------
     def mid_save_hook(self, events: FaultEvents | None = None):
@@ -595,8 +774,11 @@ class FaultInjector:
         """Every rank some fault targets (kill_rank/stall_rank) — lets a
         launcher reject targets outside the gang before spawning it
         (a mistyped rank would otherwise turn a chaos run into a
-        silently fault-free one)."""
-        return {f.rank for f in self._faults if f.rank is not None}
+        silently fault-free one).  Link faults contribute BOTH
+        endpoints — a gray link only exists between live ranks."""
+        out = {f.rank for f in self._faults if f.rank is not None}
+        out |= {f.dst for f in self._faults if f.dst is not None}
+        return out
 
     def has_kind(self, kind: str) -> bool:
         """Whether the spec contains any fault of ``kind`` (fired or
@@ -611,8 +793,14 @@ class FaultInjector:
         for f in self._faults:
             if f.fired:
                 continue
-            head = (f"{f.kind}@{f.rank}:{f.at}" if f.rank is not None
-                    else f"{f.kind}@{f.at}")
+            if f.dst is not None:
+                head = f"{f.kind}@{f.rank}-{f.dst}:{f.at}"
+            elif f.node is not None:
+                head = f"{f.kind}@{f.node}:{f.at}"
+            elif f.rank is not None:
+                head = f"{f.kind}@{f.rank}:{f.at}"
+            else:
+                head = f"{f.kind}@{f.at}"
             out.append(head + (f":{f.arg}" if f.arg else ""))
         return out
 
@@ -736,17 +924,30 @@ class TransportChaos:
     dead within ``peer_timeout_s``, and the rank itself self-aborts
     once the outage outlives the same timeout.
 
+    ``degrade_after`` (round 20): the GRAY counterpart of
+    ``partition_after`` — after N total operations the channel goes
+    slow-not-dead: every later send attempt carries
+    ``degrade_delay_s`` of latency but still delivers.  This is the
+    transport-level expression of ``degrade_link``: the member keeps
+    beating (no peer-death escalation), it just beats late — exactly
+    the failure the straggler detector, not the liveness machinery,
+    must catch.
+
     Thread-safe: one plan is shared by a member's worker and monitor
     threads."""
 
     def __init__(self, *, drop=(), duplicate=(), delay=(),
                  partition_after: int | None = None,
-                 delay_s: float = 0.05):
+                 delay_s: float = 0.05,
+                 degrade_after: int | None = None,
+                 degrade_delay_s: float = 0.05):
         self._drop = {(op, int(n)) for op, n in drop}
         self._dup = {(op, int(n)) for op, n in duplicate}
         self._delay = {(op, int(n)) for op, n in delay}
         self.partition_after = partition_after
         self.delay_s = float(delay_s)
+        self.degrade_after = degrade_after
+        self.degrade_delay_s = float(degrade_delay_s)
         self._counts: dict[str, int] = {}
         self._total = 0
         self._lock = threading.Lock()
@@ -767,19 +968,27 @@ class TransportChaos:
                     and self._total > self.partition_after):
                 self.fired.append(("partition", op, any_nth))
                 return ChaosAction(partitioned=True)
+            degraded = (self.degrade_after is not None
+                        and self._total > self.degrade_after)
             drop = self._matches(self._drop, op, nth, any_nth)
             dup = self._matches(self._dup, op, nth, any_nth)
             delay = self._matches(self._delay, op, nth, any_nth)
+            if degraded:
+                self.fired.append(("degrade", op, any_nth))
             if drop:
                 self.fired.append(("drop", op, nth))
             if dup:
                 self.fired.append(("duplicate", op, nth))
             if delay:
                 self.fired.append(("delay", op, nth))
-        if not (drop or dup or delay):
+        if not (drop or dup or delay or degraded):
             return _NO_ACTION
-        return ChaosAction(drop=drop, duplicate=dup,
-                           delay_s=self.delay_s if delay else 0.0)
+        delay_s = self.delay_s if delay else 0.0
+        if degraded:
+            # Gray state is PERSISTENT: every attempt from here on is
+            # slow — additive with a one-shot delay match.
+            delay_s += self.degrade_delay_s
+        return ChaosAction(drop=drop, duplicate=dup, delay_s=delay_s)
 
 
 def corrupt_checkpoint_data(path: str | os.PathLike, match: str | None = None,

@@ -186,10 +186,15 @@ def run_inproc_worker(cfg: InprocGangConfig, hub: InProcHub, rank: int,
         peer_timeout_s=cfg.peer_timeout, events=events,
         on_abort=lambda reason: None,  # thread mode: flag, never exit
     )
+    coord.modeled_time = hub.netmodel is not None
     if injector is not None:
         injector.current_rank = rank
         injector.exit_fn = _raise_worker_exit
         injector.sleep_fn = _interruptible(stop_event, coord)
+        # The digital-twin seam: gray link faults mutate the
+        # hub-scoped network model (None on non-twin campaigns — a
+        # gray fault firing without a model is a loud config error).
+        injector.netmodel = hub.netmodel
         injector.attach_ledger(tx)
     coord.start()
 
@@ -267,10 +272,33 @@ def run_inproc_worker(cfg: InprocGangConfig, hub: InProcHub, rank: int,
                 "ids": [ex_cursor + int(j) for j in local_ids],
                 "loss": loss,
             })
-            coord.observe_step(idx + 1, t_end - t_start, {
-                "barrier_wait_s": t_barrier - t_start,
-                "compute_s": t_end - t_barrier,
-            })
+            if hub.netmodel is not None:
+                # Digital twin: report the MODELED step time — compute
+                # plus this rank's ring send schedule over the modeled
+                # links — instead of the measured thread CPU time.  A
+                # gray-degraded rank's dt inflates while healthy ranks
+                # hold baseline, which is the straggler detector's
+                # input signal; rank 0 advances the gang's virtual
+                # clock (and the twin gauge) by the gang-wide step
+                # (the max over ranks is what a lock-step barrier
+                # costs, but per-rank reporting must stay per-rank so
+                # the detector can attribute the inflation).
+                dt = hub.netmodel.step_time(orig_rank)
+                coord.observe_step(idx + 1, dt, {
+                    "barrier_wait_s": 0.0,
+                    "compute_s": hub.netmodel.compute_s,
+                    "modeled_net_s": dt - hub.netmodel.compute_s,
+                })
+                if rank == 0:
+                    step_max = max(hub.netmodel.step_time(r)
+                                   for r in range(world))
+                    hub.netmodel.clock.advance(step_max)
+                    _set_twin_gauge(step_max)
+            else:
+                coord.observe_step(idx + 1, t_end - t_start, {
+                    "barrier_wait_s": t_barrier - t_start,
+                    "compute_s": t_end - t_barrier,
+                })
             if (idx + 1) % cfg.save_every == 0 or idx + 1 == cfg.steps:
                 save_step = idx + 1
                 with coord.suspend():
@@ -313,6 +341,17 @@ def run_inproc_worker(cfg: InprocGangConfig, hub: InProcHub, rank: int,
 
 def _raise_worker_exit(code: int) -> None:
     raise WorkerExit(code)
+
+
+def _set_twin_gauge(step_s: float) -> None:
+    """Publish the gang-wide modeled step time (the straggler-inclusive
+    max) as the ``modeled_step_time_s`` gauge — the twin's one-number
+    health readout on dashboards."""
+    from distributed_machine_learning_tpu.telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if tel is not None:
+        tel.registry.gauge("modeled_step_time_s").set(step_s)
 
 
 def _train_state(w: np.ndarray, step: int):

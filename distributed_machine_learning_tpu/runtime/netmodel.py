@@ -1,0 +1,216 @@
+"""Pod-scale digital twin: the modeled network under the in-proc gang
+(round 20).
+
+PR 12 proved 64–128 thread ranks run in seconds; what kept the in-proc
+transport from being a pod simulator was a *network model*.  This
+module is that model:
+
+- :class:`VirtualClock` — the twin's ONLY time source.  Campaign time
+  is virtual: a 512-rank gang whose modeled steps cost tens of
+  milliseconds each runs in wall-clock seconds because nothing here
+  ever sleeps or reads a real clock (``dmlcheck`` DML016 makes that a
+  static error in this file, not a convention).
+- :class:`NetModel` — per-link latency/bandwidth over the topology
+  descriptor's axes: ranks are inner-major (node ``o`` owns ranks
+  ``[o·inner, (o+1)·inner)``, exactly :class:`ops.topology.Topology`'s
+  convention), an intra-node link rides the fast ICI-class parameters
+  and an inter-node link the slow DCN-class ones
+  (:class:`ops.topology.LinkModel` — the SAME cost model that drives
+  ``Topology.select``, so the twin and the selector can never price
+  the wire differently).  Gray failures mutate the link table:
+
+  * ``degrade_link(src, dst, k)`` — latency ×k on one directed link;
+  * ``flaky_link(src, dst, p)`` — loss probability ``p`` modeled as
+    its DETERMINISTIC expected retransmission factor ``1/(1−p)``
+    (no RNG: the same campaign seed reproduces the same trajectory
+    bit-for-bit, the acceptance criterion);
+  * ``bw_collapse(node, k)`` — bandwidth ÷k on every link touching a
+    node;
+  * ``restore_link(src, dst)`` — clear both gray states on a link.
+
+- :meth:`NetModel.step_time` — the per-rank modeled training-step
+  seconds the in-proc worker reports through ``observe_step`` instead
+  of its measured CPU time: modeled compute plus this rank's send
+  schedule of the flat data-parallel ring — ``2·(world−1)`` chunks of
+  ``ceil(step_bytes/world)`` to the right neighbor, the identical
+  per-device accounting ``ops.ring.ring_wire_bytes`` pins (and DML103
+  asserts against compiled HLO).  A gray-degraded rank's modeled step
+  inflates while healthy ranks stay at baseline, which is precisely
+  the signature the straggler detector flags — the 512-rank campaigns
+  in ``tests/test_pod_twin.py`` close that loop end to end.
+
+The model state lives on the ``InProcHub`` (``hub.netmodel``), NOT on
+a transport or an attempt: a supervisor relaunch clears beats and
+aborts but a degraded physical link stays degraded — while the fault
+LEDGER (replayed by ``FaultInjector.attach_ledger``) guarantees the
+*injection* itself never re-fires on the relaunched attempt.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class VirtualClock:
+    """Monotone virtual seconds — the clock seam of the digital twin.
+
+    Contract: ``now()`` returns accumulated VIRTUAL seconds; the only
+    way time passes is an explicit ``advance``/``advance_to`` by the
+    simulation's owner (the gang's rank-0 step hook, a DES loop).  No
+    method reads a real clock or sleeps; campaigns therefore cost wall
+    time proportional to the *work simulated*, never the time modeled.
+    Thread-safe: thread ranks observe and advance it concurrently.
+    """
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    def advance(self, dt: float) -> float:
+        """Move time forward by ``dt >= 0``; returns the new now."""
+        if dt < 0:
+            raise ValueError(f"virtual time cannot rewind (dt={dt})")
+        with self._lock:
+            self._now += dt
+            return self._now
+
+    def advance_to(self, t: float) -> float:
+        """Monotone jump: ``now = max(now, t)``; returns the new now."""
+        with self._lock:
+            if t > self._now:
+                self._now = t
+            return self._now
+
+
+class NetModel:
+    """Per-link latency/bandwidth model over an inner×outer rank
+    grouping, with mutable gray-failure state.
+
+    ``world`` ranks in nodes of ``inner`` (inner-major).  ``link`` is
+    an :class:`ops.topology.LinkModel` (imported lazily so this module
+    stays stdlib-cheap for the tools layer); ``compute_s`` is the
+    modeled per-step compute, ``step_bytes`` the per-step gradient
+    payload of the data-parallel ring.  All mutation and reads are
+    lock-protected — thread ranks and the fault injector touch one
+    shared instance.
+    """
+
+    def __init__(self, world: int, inner: int = 1, *, link=None,
+                 compute_s: float = 0.005,
+                 step_bytes: int = 4 << 20,
+                 clock: VirtualClock | None = None):
+        if world < 1 or inner < 1 or world % inner:
+            raise ValueError(
+                f"world {world} must be a positive multiple of inner "
+                f"{inner}")
+        if link is None:
+            from distributed_machine_learning_tpu.ops.topology import (
+                DEFAULT_LINK_MODEL,
+            )
+            link = DEFAULT_LINK_MODEL
+        self.world = world
+        self.inner = inner
+        self.link = link
+        self.compute_s = float(compute_s)
+        self.step_bytes = int(step_bytes)
+        self.clock = clock if clock is not None else VirtualClock()
+        self._lock = threading.Lock()
+        self._latency_mult: dict[tuple[int, int], float] = {}
+        self._flaky_p: dict[tuple[int, int], float] = {}
+        self._bw_div: dict[int, float] = {}
+
+    # -- topology arithmetic -------------------------------------------
+
+    def node_of(self, rank: int) -> int:
+        return rank // self.inner
+
+    def link_axis(self, src: int, dst: int) -> str:
+        return ("inner" if self.node_of(src) == self.node_of(dst)
+                else "outer")
+
+    # -- gray-failure state (the fault kinds' mutation surface) --------
+
+    def degrade_link(self, src: int, dst: int, k: float) -> None:
+        if k < 1.0:
+            raise ValueError(f"latency multiplier must be >= 1, got {k}")
+        with self._lock:
+            self._latency_mult[(src, dst)] = float(k)
+
+    def flaky_link(self, src: int, dst: int, p: float) -> None:
+        if not 0.0 <= p <= 0.99:
+            raise ValueError(f"loss probability must be in [0, 0.99], "
+                             f"got {p}")
+        with self._lock:
+            self._flaky_p[(src, dst)] = float(p)
+
+    def bw_collapse(self, node: int, k: float) -> None:
+        if k < 1.0:
+            raise ValueError(f"bandwidth divisor must be >= 1, got {k}")
+        with self._lock:
+            self._bw_div[node] = float(k)
+
+    def restore_link(self, src: int, dst: int) -> None:
+        with self._lock:
+            self._latency_mult.pop((src, dst), None)
+            self._flaky_p.pop((src, dst), None)
+
+    def link_params(self, src: int, dst: int) -> dict:
+        """Effective parameters of one directed link — what the
+        ``link_degraded`` health event records and
+        ``tools/gang_status.py`` renders."""
+        axis = self.link_axis(src, dst)
+        base_lat = (self.link.inner_overhead_s if axis == "inner"
+                    else self.link.outer_overhead_s)
+        base_bw = (self.link.inner_bytes_per_s if axis == "inner"
+                   else self.link.outer_bytes_per_s)
+        with self._lock:
+            mult = self._latency_mult.get((src, dst), 1.0)
+            p = self._flaky_p.get((src, dst), 0.0)
+            div = max(self._bw_div.get(self.node_of(src), 1.0),
+                      self._bw_div.get(self.node_of(dst), 1.0))
+        return {
+            "src": src, "dst": dst, "axis": axis,
+            "latency_mult": mult, "flaky_p": p, "bw_div": div,
+            "latency_s": base_lat * mult,
+            "bytes_per_s": base_bw / div,
+        }
+
+    def degraded_links(self) -> list[dict]:
+        """Every link/node with non-baseline gray state, as
+        ``link_params`` rows (bw-collapsed nodes contribute their
+        outgoing ring link as the representative row)."""
+        with self._lock:
+            keys = set(self._latency_mult) | set(self._flaky_p)
+            nodes = list(self._bw_div)
+        for node in nodes:
+            src = node * self.inner
+            keys.add((src, (src + 1) % self.world))
+        return [self.link_params(s, d) for s, d in sorted(keys)]
+
+    # -- the cost queries ----------------------------------------------
+
+    def link_time(self, src: int, dst: int, nbytes: int) -> float:
+        """Modeled seconds to move ``nbytes`` over one directed link,
+        with every gray effect applied: latency ×mult, bandwidth ÷div,
+        and the whole transfer ×1/(1−p) expected retransmissions."""
+        p = self.link_params(src, dst)
+        once = p["latency_s"] + nbytes / p["bytes_per_s"]
+        return once / (1.0 - p["flaky_p"])
+
+    def step_time(self, rank: int) -> float:
+        """Modeled seconds of one training step as RANK experiences it:
+        compute plus the rank's send schedule of the flat data-parallel
+        ring — ``2·(world−1)`` hops of ``ceil(step_bytes/world)`` on
+        the (rank → rank+1) link.  Per-device accounting, so only the
+        ranks incident to a gray link inflate — the straggler
+        detector's input signal."""
+        if self.world == 1:
+            return self.compute_s
+        dst = (rank + 1) % self.world
+        chunk = -(-self.step_bytes // self.world)
+        return (self.compute_s
+                + 2 * (self.world - 1) * self.link_time(rank, dst, chunk))

@@ -1073,6 +1073,15 @@ class InProcHub:
         # fence post_result checks atomically with its append.
         self.serving_weights: dict[int, dict] = {}
         self._version = 0
+        # Digital-twin seam (ISSUE 20): when a campaign attaches a
+        # ``runtime.netmodel.NetModel`` here, in-proc workers report
+        # MODELED step times through ``observe_step`` and the fault
+        # injector's gray link kinds mutate it.  Hub-scoped on purpose:
+        # ``clear`` resets beats and aborts between attempts, but a
+        # degraded physical link stays degraded across a relaunch —
+        # the fault ledger (not the model) is what stops the
+        # *injection* from re-firing.
+        self.netmodel = None
 
     # -- the broadcast box (in-proc worker extension) --------------------
     # A tiny rank-0-broadcast channel the in-proc worker harness uses to
@@ -1173,6 +1182,28 @@ class InProcTransport(GangTransport):
     def _do_read_beats(self) -> dict[int, tuple]:
         with self._locked("hub:beats:r") as hub:
             return dict(hub.beats)
+
+    def barrier_ready(self, step: int, rank: int, world: int) -> bool:
+        """Copy-free lock-step barrier probe (the coordinator's
+        ``wait_for_peers`` fast path).  Semantically identical to
+        snapshotting the beat table and scanning — every peer must
+        have published ``step`` (or ``done``) — but one lock entry and
+        zero dict copies, which is the difference between a 512-rank
+        barrier costing ~2µs per poll and ~150µs: at pod scale the
+        generic path alone would saturate the single CI core."""
+        with self._locked("hub:barrier:r") as hub:
+            beats = hub.beats
+            for peer in range(world):
+                if peer == rank:
+                    continue
+                entry = beats.get(peer)
+                if entry is None or not isinstance(entry[1], dict):
+                    return False
+                payload = entry[1]
+                if (not payload.get("done")
+                        and int(payload.get("step", -1)) < step):
+                    return False
+            return True
 
     def _do_declare_abort(self, reason, by_rank, peer) -> bool:
         payload = {"reason": reason, "by_rank": by_rank,
@@ -1379,16 +1410,26 @@ class InProcTransport(GangTransport):
     # cadence: reads are dict lookups — poll tightly so barriers and
     # boundary detection turn around in milliseconds, which is the
     # whole point of the backend (64-128-rank campaigns in seconds).
+    # Above ~128 ranks the tight cadence itself becomes the bottleneck
+    # (512 threads × 2 ms polls is ~256k acquisitions/s on ONE hub
+    # lock), so the poll intervals stretch with world size — pod-scale
+    # twins trade per-op latency for lock headroom.
     def monitor_poll_s(self, heartbeat_interval_s, peer_timeout_s,
                        world) -> float:
-        return max(min(heartbeat_interval_s, peer_timeout_s / 4, 0.05),
+        base = max(min(heartbeat_interval_s, peer_timeout_s / 4, 0.05),
                    0.005)
+        return base * max(1.0, world / 128)
 
     def supervisor_poll_s(self, world: int) -> float:
-        return 0.02
+        return 0.02 * max(1.0, world / 256)
 
     def barrier_poll_s(self) -> float:
-        return 0.002
+        # No world argument on this hook, but the beat table holds one
+        # entry per live member — stretch by it so a 512-rank barrier
+        # (each poll copies the whole table) doesn't burn the single
+        # CI core on 256k lock acquisitions per second.  Unlocked
+        # len() is safe (GIL) and only tunes a poll interval.
+        return 0.002 * max(1.0, len(self.hub.beats) / 128)
 
 
 # ---------------------------------------------------------------------------

@@ -565,16 +565,24 @@ class HeartbeatSampler:
             metrics = p.get("metrics")
             stime = None
             phases = {}
+            modeled = False
             if isinstance(metrics, dict):
                 st = metrics.get("step_time_s")
                 if isinstance(st, (int, float)):
                     stime = float(st)
                 if isinstance(metrics.get("phases"), dict):
                     phases = metrics["phases"]
+                modeled = bool(metrics.get("modeled"))
             step = int(p.get("step", 0))
             done = bool(p.get("done"))
             suspended = bool(p.get("suspended"))
-            if stime is None or done or suspended:
+            if stime is None or done or suspended or modeled:
+                # ``modeled`` (the digital twin): step times are
+                # VIRTUAL seconds — inflating them by real-clock
+                # progress age would mix clocks and flag every rank a
+                # busy CI core descheduled.  Liveness still rides the
+                # real heartbeat (peer timeout), so an actually-dead
+                # rank is caught by the monitor, not this rule.
                 eff = stime
             elif min_step is not None and step <= min_step:
                 eff = max(stime, age)

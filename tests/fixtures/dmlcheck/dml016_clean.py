@@ -1,0 +1,30 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/netmodel_pacer.py
+"""DML016 clean case: the sanctioned twin idiom — every duration is
+model arithmetic, time only moves through the VirtualClock seam, and
+``clock.now()``/``advance``/``advance_to`` are attribute calls on model
+state (not real clocks), so the rule stays quiet."""
+import threading
+
+
+def modeled_step(nm, rank):
+    dt = nm.step_time(rank)               # pure arithmetic pricing
+    return dt
+
+
+def advance_gang(nm, world):
+    step_max = max(nm.step_time(r) for r in range(world))
+    nm.clock.advance(step_max)            # virtual time, not a sleep
+    return nm.clock.now()
+
+
+def degraded_window(nm, src, dst, k, until_s):
+    nm.degrade_link(src, dst, k)
+    nm.clock.advance_to(until_s)
+    nm.restore_link(src, dst)
+    return nm.clock.now()
+
+
+def guarded_mutation(nm, lock: threading.Lock, src, dst, k):
+    with lock:                            # locks are fine; clocks are not
+        nm.degrade_link(src, dst, k)
+    return nm.degraded_links()

@@ -1,0 +1,32 @@
+# dmlcheck-virtual-path: distributed_machine_learning_tpu/runtime/netmodel_pacer.py
+"""DML016 firing cases: real clocks and sleeps leaking into a
+virtual-clock (digital twin) module — each one re-couples the modeled
+trajectory to host scheduling and breaks deterministic replay."""
+import time
+from time import sleep as snooze
+from datetime import datetime
+
+
+def settle_link(nm, src, dst, nbytes):
+    time.sleep(0.05)                      # real sleep inside the twin
+    return nm.link_time(src, dst, nbytes)
+
+
+def stamp_modeled_step(nm, rank):
+    t0 = time.perf_counter()              # real clock read
+    dt = nm.step_time(rank)
+    nm.clock.advance(dt)
+    return t0, dt
+
+
+def paced_rounds(nm, rounds):
+    out = []
+    for _ in range(rounds):
+        snooze(0.01)                      # aliased `from time import sleep`
+        out.append(nm.clock.now())
+    return out
+
+
+def wall_stamp_row(row):
+    row["at"] = datetime.now().isoformat()   # wall clock in twin state
+    return row

@@ -254,6 +254,48 @@ def test_hd_wire_bytes_attribution(mesh8):
     assert got["count"] == 6
 
 
+def test_predicted_plan_bytes_match_hlo_audit(mesh8):
+    """Round-20 acceptance: ``Topology.select`` is PREDICTION-driven
+    (no ``hd_max_bytes`` override anywhere here), and the plan the cost
+    model picks prices exactly the bytes the compiled executable moves:
+    for 2x4/4x2 × {none,int8,topk}, the per-axis payloads of
+    ``plan_hops`` under the selected plan equal the per-axis bytes the
+    DML103 HLO walker reads off ``source_target_pairs`` — the link
+    model can never cost a different program than the one that runs."""
+    from distributed_machine_learning_tpu.ops.ring import (
+        ring_wire_bytes_by_axis,
+    )
+    from distributed_machine_learning_tpu.ops.topology import Topology
+
+    length, bb = 4096, 8192  # two 8 KiB buckets
+    for inner, outer in ((2, 4), (4, 2)):
+        for compress in ("none", "int8", "topk"):
+            topo = Topology(inner, outer, outer_scheme=compress)
+            plan = topo.select(bb)
+            # The cost model's regime split at this bucket size: exact
+            # 8 KiB buckets sit below both topologies' hd/hier
+            # crossovers (latency path); a requested codec forbids hd
+            # above the fidelity bound (hier keeps the codec).
+            assert plan == ("hd" if compress == "none" else "hier"), (
+                inner, outer, compress, plan)
+            priced = {"inner": 0, "outer": 0}
+            for axis, _dist, nbytes in topo.plan_hops(bb, plan):
+                priced[axis] += nbytes
+            priced = {k: 2 * v for k, v in priced.items()}  # two buckets
+            got = wire_bytes_from_hlo(
+                compile_ring_hlo(mesh8, length, compress=compress,
+                                 bucket_bytes=bb,
+                                 topology=f"{inner}x{outer}"),
+                inner=inner,
+            )
+            assert got["by_axis"] == priced, (
+                inner, outer, compress, plan, got["by_axis"], priced)
+            # And the static telemetry accounting dispatches through
+            # the SAME selector, so all three agree.
+            assert priced == ring_wire_bytes_by_axis(
+                length, 8, bucket_bytes=bb, topology=topo)
+
+
 def test_wire_bytes_ci_regression_int8_vs_exact(mesh8):
     """The fast CI gate (ISSUE 7 satellite): compile a real bucketed
     ring for the 8-device mesh, exact and int8, and assert the

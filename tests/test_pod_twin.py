@@ -1,0 +1,425 @@
+"""Pod-scale digital-twin chaos campaigns (ISSUE 20).
+
+The in-proc gang at 64-128 ranks (ISSUE 12) proved the control plane;
+what it could not exercise was *gray* failure — links that get slow,
+flaky, or starved without anyone dying, the failure mode straggler
+detection exists for.  With the modeled network
+(``runtime/netmodel.py``) attached to the hub, thread ranks report
+MODELED step times (virtual seconds over per-link latency/bandwidth)
+while liveness keeps riding the real heartbeat clock, so:
+
+- a **512-rank gang** with one gray-degraded link sees exactly that
+  link's source rank flagged by the straggler detector and swapped for
+  a warm spare under ``straggler_policy="replace"`` — world unchanged,
+  loss-continuous, exactly-once — and a hard ``kill_rank`` later in
+  the same run proves the fault LEDGER keeps the gray injection
+  exactly-once across a full gang relaunch;
+- a **1024-rank** beat-batching sanity run: one transport snapshot
+  returns all 1024 beats, the sampler feeds the detector pure modeled
+  times (no wall-clock age pollution — 1024 threads share one CI
+  core), and only the gray ranks flag;
+- the **serving fleet over the modeled network**: two replicas' links
+  degrade, the PR 6 detector evicts both, warm spares take their
+  slots, and the post-eviction p99 returns to the healthy baseline.
+
+Campaign wall-clock caps are asserted IN the tests (the ISSUE 12
+convention): a pod twin that stops finishing in tier-1 time must fail
+loudly, not eat the suite budget.
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import os
+import time
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.runtime.faults import (
+    FaultEvents,
+    FaultInjector,
+)
+from distributed_machine_learning_tpu.runtime.inproc_worker import (
+    InprocGangConfig,
+    inproc_worker_cmds,
+)
+from distributed_machine_learning_tpu.runtime.netmodel import NetModel
+from distributed_machine_learning_tpu.runtime.serving import (
+    ServingConfig,
+    ServingRouter,
+)
+from distributed_machine_learning_tpu.runtime.supervisor import (
+    gang_supervise,
+)
+from distributed_machine_learning_tpu.runtime.transport import (
+    InProcHub,
+    InProcTransport,
+)
+from distributed_machine_learning_tpu.telemetry.aggregator import (
+    HeartbeatSampler,
+    StragglerDetector,
+)
+
+from tests.test_chaos_campaign import (
+    _assert_exactly_once_chained,
+    _final_losses,
+    _gang_status_tool,
+)
+
+POD_512_BUDGET_S = 150.0
+POD_1024_BUDGET_S = 180.0
+
+
+# ---------------------------------------------------------------------------
+# 512-rank gray campaign: degrade -> flag -> replace, ledger-latched
+# across a later hard relaunch
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faultinject
+def test_pod_512_gray_link_flagged_and_replaced(tmp_path):
+    """The flagship twin campaign: 512 thread ranks over a modeled
+    64-node pod (inner=8).  ``degrade_link@100-101`` multiplies one
+    intra-node link's latency 200x at step 2; only rank 100's modeled
+    step inflates, the detector flags it within the replace
+    hysteresis, and the supervisor demotes it for a warm spare at a
+    planned boundary — world stays 512 throughout.  A ``kill_rank`` at
+    step 6 then forces a full coordinated relaunch, proving the gray
+    fault's ledger latch: the relaunched attempt replays the spec but
+    never re-fires the consumed link fault."""
+    world = 512
+    hub = InProcHub(mirror_dir=os.path.join(str(tmp_path), "gang"))
+    hub.netmodel = NetModel(world, inner=8, compute_s=0.002,
+                            step_bytes=4 << 20)
+    tx = InProcTransport(hub)
+    cfg = InprocGangConfig(
+        ckpt_dir=os.path.join(str(tmp_path), "ckpt"), steps=8,
+        save_every=4, global_batch=world, scaling_rule="pinned",
+        base_world=world, feature_dim=32, heartbeat_interval=0.05,
+        # 512 threads on one core need tens of seconds just to all
+        # start beating; the gray campaign's death detection is
+        # exit-code- and model-driven, not timeout-driven.
+        peer_timeout=60.0,
+        faults="degrade_link@100-101:2:200,kill_rank@7:6",
+    )
+    os.makedirs(cfg.ckpt_dir, exist_ok=True)
+    worker_cmd, spare_cmd = inproc_worker_cmds(cfg, hub)
+    events = FaultEvents()
+    start = time.monotonic()
+    codes = gang_supervise(
+        worker_cmd, world, None, ckpt_dirs=cfg.ckpt_dir, events=events,
+        spares=2, spare_cmd=spare_cmd, grace_s=3.0, transport=tx,
+        max_restarts=4, straggler_policy="replace", replace_after=2,
+        straggler_multiple=4.0, straggler_consecutive=3,
+    )
+    elapsed = time.monotonic() - start
+    assert elapsed < POD_512_BUDGET_S, (
+        f"512-rank twin campaign took {elapsed:.1f}s — the pod twin "
+        "stopped being tier-1 fast"
+    )
+    # World unchanged: every one of the 512 slots finished clean.
+    assert len(codes) == world and set(codes) == {0}
+    assert events.spare_demotions == 1
+    assert events.spare_promotions == 1
+    assert events.gang_restarts >= 1      # the kill_rank relaunch
+    assert events.gang_shrinks == 0 and events.gang_grows == 0
+
+    health = tx.read_health_events()
+    kinds = collections.Counter(e["kind"] for e in health)
+    assert kinds["replace"] == 1
+    # The demoted rank is exactly the gray link's source.
+    assert [e["rank"] for e in health if e["kind"] == "demote"] == [100]
+    stragglers = [e for e in health if e["kind"] == "straggler"]
+    assert stragglers and all(e["rank"] == 100 for e in stragglers), (
+        "a rank off the gray link was flagged — modeled attribution "
+        "leaked wall-clock time"
+    )
+    degraded = [e for e in health if e["kind"] == "link_degraded"]
+    assert len(degraded) == 1, (
+        "link_degraded recorded more than once — the gray fault "
+        "re-fired across the relaunch"
+    )
+    assert degraded[0]["src"] == 100 and degraded[0]["dst"] == 101
+    assert degraded[0]["latency_mult"] == 200.0
+    assert degraded[0]["axis"] == "inner"
+
+    # Ledger latch: one firing per fault, ever — including across the
+    # kill_rank relaunch that replayed the whole spec.
+    fired = collections.Counter(
+        e["kind"] for e in tx.read_fault_entries())
+    assert fired["degrade_link"] == 1 and fired["kill_rank"] == 1
+
+    # The model keeps the physics: the link is STILL degraded after
+    # the campaign (restore_link was never injected) and virtual time
+    # advanced without any real sleeps.
+    links = hub.netmodel.degraded_links()
+    assert [(r["src"], r["dst"]) for r in links] == [(100, 101)]
+    assert hub.netmodel.clock.now() > 0.0
+
+    # The ops view: tools/gang_status.py replays the mirrored health
+    # ledger into a degraded-link table — link, axis, effective
+    # modeled latency/bandwidth, and the fault spec that put it there.
+    tool = _gang_status_tool()
+    gang_dir = os.path.join(str(tmp_path), "gang")
+    status = tool.collect(gang_dir, os.path.join(gang_dir, "telemetry"))
+    assert [(e["src"], e["dst"]) for e in status["degraded_links"]] \
+        == [(100, 101)]
+    text = tool.render(status)
+    assert "Modeled network: degraded links" in text
+    assert "degrade_link@100-101:2:200" in text
+
+    # Exactly-once consumption chained across the replace AND the
+    # relaunch, at world 512 for every step.
+    rows = tx.read_consumed()
+    worlds = _assert_exactly_once_chained(rows, cfg.steps)
+    assert set(worlds.values()) == {world}
+
+    # Loss continuity: pinned rule, world unchanged => the replicated
+    # trajectory starts at the optimum (w=0) and settles onto the
+    # world-invariant stationary floor ``lr/(2-lr)·dim/B``.  Neither
+    # the replace boundary (step 4) nor the kill relaunch (step 6) may
+    # kick a step off that floor: every post-warmup loss stays inside
+    # a 4x band around the run's own median (chi-square noise at
+    # dim 32 is ~25% — a restart discontinuity would be a multiple).
+    losses = _final_losses(rows)
+    assert sorted(losses) == list(range(cfg.steps))
+    tail = [losses[s] for s in range(1, cfg.steps)]
+    med = sorted(tail)[len(tail) // 2]
+    for s in range(1, cfg.steps):
+        assert med / 4 < losses[s] < 4 * med, (
+            f"loss discontinuity at step {s}: {losses[s]} vs "
+            f"stationary median {med}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# 1024-rank heartbeat/beat-batching sanity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faultinject
+def test_pod_1024_beat_batching_and_modeled_attribution():
+    """1024 ranks' heartbeats through one hub: a 32-thread pool
+    publishes all beats (the batched-publisher shape a real pod's
+    per-host agents have), ONE transport snapshot returns all 1024,
+    and the sampler->detector chain flags exactly the two gray ranks —
+    from pure modeled times, with zero wall-clock age pollution even
+    though 1024 "ranks" share one CI core."""
+    world, inner = 1024, 8
+    start = time.monotonic()
+    nm = NetModel(world, inner=inner, compute_s=0.002,
+                  step_bytes=4 << 20)
+    nm.degrade_link(100, 101, 500.0)
+    nm.degrade_link(900, 901, 500.0)
+    hub = InProcHub()
+    tx = InProcTransport(hub)
+
+    def publish(block: int, seq: int, step: int) -> None:
+        btx = InProcTransport(hub)
+        for rank in range(block * 32, (block + 1) * 32):
+            btx.publish_beat(rank, {
+                "rank": rank, "seq": seq, "step": step, "beat_age": 0.0,
+                "suspended": False, "done": False, "time": time.time(),
+                "metrics": {"step_time_s": nm.step_time(rank),
+                            "steps_timed": 1, "phases": {},
+                            "modeled": True},
+            })
+
+    sampler = HeartbeatSampler()
+    detector = StragglerDetector(multiple=4.0, consecutive=3)
+    with concurrent.futures.ThreadPoolExecutor(32) as pool:
+        for seq in range(3):  # three observation rounds
+            list(pool.map(lambda b: publish(b, seq, seq + 1),
+                          range(world // 32)))
+            beats = tx.read_beat_payloads()
+            assert len(beats) == world  # one batched read, whole pod
+            samples = sampler.sample(None, beats=beats)
+            feed = {r: s.eff_step_time_s for r, s in samples.items()}
+            # Modeled attribution: the effective time IS the modeled
+            # time, bit-exact — never inflated by how long the busy CI
+            # core took to schedule the publisher threads.
+            for r, s in samples.items():
+                assert s.eff_step_time_s == nm.step_time(r)
+            detector.update(feed)
+    assert detector.flagged == {100, 900}
+    nm.clock.advance(max(nm.step_time(r) for r in range(world)))
+    assert nm.clock.now() > 0.0
+
+    # The pod-scale cadence and barrier seams: the poll interval
+    # stretches with the beat table and the copy-free barrier probe
+    # answers directly against the hub.
+    assert tx.barrier_poll_s() == pytest.approx(0.002 * world / 128)
+    assert tx.barrier_ready(1, 0, world)
+    tx.publish_beat(777, {"rank": 777, "seq": 99, "step": 0,
+                          "done": False})
+    assert not tx.barrier_ready(1, 0, world)
+
+    elapsed = time.monotonic() - start
+    assert elapsed < POD_1024_BUDGET_S, (
+        f"1024-rank sanity run took {elapsed:.1f}s"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Serving fleet over the modeled network
+# ---------------------------------------------------------------------------
+
+
+def _p99(values: list[float]) -> float:
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, int(0.99 * (len(ordered) - 1)))]
+
+
+@pytest.mark.faultinject
+def test_serving_fleet_gray_degrade_evicts_and_p99_recovers():
+    """Two replicas' modeled links degrade mid-load: their ``computed``
+    stage deltas (the detector feed since ISSUE 17) inflate 10x+, the
+    detector evicts both, warm spares take their slots, and the next
+    wave's p99 is back at the healthy baseline — the serving-tier
+    statement of the gray-failure loop, with every latency a modeled
+    number (no sleeps anywhere)."""
+    nm = NetModel(8, inner=1, compute_s=0.02, step_bytes=1 << 20)
+    hub = InProcHub()
+    tx = InProcTransport(hub)
+    events = FaultEvents()
+    router = ServingRouter(
+        InProcTransport(hub),
+        ServingConfig(replicas=6, replica_timeout_s=60.0),
+        events=events)
+    for rank in range(8):
+        tx.announce_join(rank, {"rank": rank, "spare": True,
+                                "kind": "serving", "time": time.time()})
+    router.pump()
+    assert sorted(router._replicas) == [0, 1, 2, 3, 4, 5]
+
+    def serve_wave(batches_per_replica: int) -> dict[int, list[float]]:
+        """Dispatch one wave — enough requests that EVERY replica
+        receives work (micro_batch per replica per batch round) — and
+        fabricate completions whose compute interval is each replica's
+        MODELED step time."""
+        latencies: dict[int, list[float]] = collections.defaultdict(list)
+        n = (batches_per_replica * len(router._replicas)
+             * router.cfg.micro_batch)
+        for _ in range(n):
+            router.submit([1, 2])
+        router.pump()
+        for rank in list(router._replicas):
+            for req in tx.take_requests(rank, 64):
+                dt = nm.step_time(rank)
+                req["events"].append({
+                    "stage": "computed", "by": f"replica{rank}",
+                    "dt": dt})
+                assert tx.post_result(rank, req["epoch"], {
+                    "rid": req["rid"], "output": req["prompt"],
+                    "events": req["events"]})
+                latencies[rank].append(dt)
+        return latencies
+
+    healthy = serve_wave(2)
+    router.pump()
+    base_p99 = _p99([v for vs in healthy.values() for v in vs])
+
+    # Gray-degrade the links under replicas 2 and 5 (their outgoing
+    # ring links): only those two replicas' modeled service inflates.
+    nm.degrade_link(2, 3, 5000.0)
+    nm.degrade_link(5, 6, 5000.0)
+    degraded = serve_wave(2)
+    for _ in range(5):  # collect + consecutive judgments
+        router.pump()
+    assert router.evictions == 2
+    assert events.replica_evictions == 2
+    assert 2 not in router._replicas and 5 not in router._replicas
+    assert 6 in router._replicas and 7 in router._replicas
+    assert tx.read_serving(2)["role"] == "spare"
+    assert max(degraded[2]) > 10.0 * base_p99  # the gray signal
+
+    # Post-eviction: the fleet's p99 is back at baseline — the
+    # degraded links still exist in the model, but nothing routes over
+    # them any more.
+    recovered = serve_wave(2)
+    router.pump()
+    rec_p99 = _p99([v for vs in recovered.values() for v in vs])
+    assert rec_p99 < 2.0 * base_p99, (
+        f"post-eviction p99 {rec_p99:.4f}s never recovered "
+        f"(healthy baseline {base_p99:.4f}s)"
+    )
+    evict = [e for e in tx.read_health_events()
+             if e.get("kind") == "serve_evict"]
+    assert sorted(e["rank"] for e in evict) == [2, 5]
+    assert all("straggler" in e["why"] for e in evict)
+
+
+# ---------------------------------------------------------------------------
+# Determinism and the ledger latch, unit form
+# ---------------------------------------------------------------------------
+
+
+def test_gray_trajectory_is_deterministic_per_seed(tmp_path):
+    """Same spec + same seed => the same firing steps and the same
+    final link state, run twice from scratch.  The flaky model is an
+    expected-value factor (no RNG) and randomized ``?`` steps derive
+    from the seed alone, so the whole trajectory is a pure function of
+    (spec, seed)."""
+    spec = "degrade_link@3-4:?:50,flaky_link@0-1:?:0.5,bw_collapse@1:?:8"
+
+    def run(seed: int):
+        inj = FaultInjector.from_flags(spec, seed=seed, horizon=8,
+                                       rank=0)
+        inj.current_rank = 0
+        nm = NetModel(8, inner=4, compute_s=0.001)
+        inj.netmodel = nm
+        fired_at: list[tuple[str, int]] = []
+        for f in inj._faults:
+            fired_at.append((f.kind, f.at))
+        list(inj.wrap_batches(range(8), FaultEvents()))
+        links = [(r["src"], r["dst"], r["latency_mult"], r["flaky_p"],
+                  r["bw_div"]) for r in nm.degraded_links()]
+        return fired_at, links
+
+    assert run(7) == run(7)
+    assert run(11) == run(11)
+
+
+def test_gray_fault_ledger_latches_across_injector_relaunch(tmp_path):
+    """The relaunch contract at unit scale: once a link fault's firing
+    is in the ledger, a FRESH injector parsing the same spec replays
+    it as consumed — the model is mutated exactly once, ever."""
+    from distributed_machine_learning_tpu.runtime.faults import (
+        FAULT_LEDGER_FILE,
+    )
+
+    ledger = os.path.join(str(tmp_path), FAULT_LEDGER_FILE)
+    nm = NetModel(8, inner=4, compute_s=0.001)
+    inj = FaultInjector.parse("degrade_link@3-4:2:50", rank=3)
+    inj.current_rank = 3
+    inj.netmodel = nm
+    inj.attach_ledger(ledger)
+    ev1 = FaultEvents()
+    list(inj.wrap_batches(range(6), ev1))
+    assert ev1.link_degradations == 1
+    assert nm.link_params(3, 4)["latency_mult"] == 50.0
+
+    # Relaunch: new injector, same spec, same ledger.  The fault reads
+    # as consumed; the (hub-scoped, still-degraded) model is not
+    # touched again.
+    nm.restore_link(3, 4)  # sentinel: a re-fire would re-degrade
+    fresh = FaultInjector.parse("degrade_link@3-4:2:50", rank=3)
+    fresh.current_rank = 3
+    fresh.netmodel = nm
+    fresh.attach_ledger(ledger)
+    assert fresh.pending() == []
+    ev2 = FaultEvents()
+    assert list(fresh.wrap_batches(range(6), ev2)) == list(range(6))
+    assert ev2.link_degradations == 0
+    assert nm.link_params(3, 4)["latency_mult"] == 1.0  # untouched
+
+    # And the latch is GANG-WIDE: any other rank's injector sees it
+    # consumed too (a link fault names its endpoints, not the local
+    # process).
+    other = FaultInjector.parse("degrade_link@3-4:2:50", rank=6)
+    other.current_rank = 6
+    other.netmodel = nm
+    other.attach_ledger(ledger)
+    assert other.pending() == []

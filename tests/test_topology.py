@@ -70,15 +70,49 @@ def test_topology_descriptor_validation():
 
 
 def test_selector_policy():
+    """Round 20: the selector is prediction-driven — plans are priced
+    through ``plan_hops`` × ``LinkModel`` and the cheapest wins, so the
+    hd/hier crossover MOVES with the topology instead of sitting at a
+    frozen 64 KiB."""
+    from distributed_machine_learning_tpu.ops.topology import (
+        DEFAULT_LINK_MODEL,
+        LinkModel,
+    )
+
     t = Topology(2, 4)  # exact both axes, world 8 (pow2)
+    assert t.hd_max_bytes is None          # no byte threshold anymore
     assert t.select(1024) == "hd"          # small bucket → latency path
-    assert t.select(t.hd_max_bytes) == "hd"
-    assert t.select(t.hd_max_bytes + 1) == "hier"
+    # Analytic 2x4 crossover: hd trades hier's two extra outer
+    # overheads for distance-multiplied outer bytes (B/4 extra), so hd
+    # wins exactly below 8 · outer_overhead · outer_bandwidth.
+    lm = DEFAULT_LINK_MODEL
+    xover = 8 * lm.outer_overhead_s * lm.outer_bytes_per_s
+    assert t.select(int(xover) - 4096) == "hd"
+    assert t.select(int(xover) + 4096) == "hier"
     assert t.select(25 * 2**20) == "hier"
-    # A requested codec is only discarded for TRULY tiny buckets.
+    assert (t.predict_bucket_time(25 * 2**20, "hier")
+            < t.predict_bucket_time(25 * 2**20, "hd"))
+    # 4x2 crossover is an INNER-axis property (the long hd exchange is
+    # intra-node there): 4 · inner_overhead · inner_bandwidth.
+    t42 = Topology(4, 2)
+    xover42 = 4 * lm.inner_overhead_s * lm.inner_bytes_per_s
+    assert t42.select(int(xover42) - 4096) == "hd"
+    assert t42.select(int(xover42) + 4096) == "hier"
+    # A custom link model moves the decision — no frozen constants.
+    slow_outer = LinkModel(outer_overhead_s=100e-6)
+    assert t.select(int(xover) + 4096, link=slow_outer) == "hd"
+    # Flat never beats hier on a real hierarchy (more serial outer
+    # overheads AND inner-times the outer bytes).
+    assert (t.predict_bucket_time(1 << 20, "hier")
+            < t.predict_bucket_time(1 << 20, "flat"))
+    # A requested codec is only discarded for TRULY tiny buckets — the
+    # fidelity bound survives the cost-model rewrite unchanged.
     tc = Topology(2, 4, outer_scheme="int8")
     assert tc.select(HD_LOSSY_MAX_BYTES) == "hd"
     assert tc.select(HD_LOSSY_MAX_BYTES + 1) == "hier"
+    # hd_max_bytes: 0 still disables hd; a value still caps it.
+    assert Topology(2, 4, hd_max_bytes=0).select(1024) == "hier"
+    assert Topology(2, 4, hd_max_bytes=512).select(1024) == "hier"
     # Degenerate axes: flat ring, never a crash.
     assert Topology(1, 8).select(25 * 2**20) == "flat"
     assert Topology(8, 1).select(25 * 2**20) == "flat"

@@ -187,6 +187,18 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
                       "deploy_promote", "deploy_rollback",
                       "deploy_verify_failed"):
             serving_history.append(e)
+    # The modeled-network view (ISSUE 20): replay the gray-link ledger
+    # (``link_degraded`` / ``link_restored``) in order — the surviving
+    # rows are the links the digital twin is CURRENTLY pricing
+    # off-baseline, each carrying its effective modeled parameters and
+    # the fault spec that put it there.
+    live_links: dict = {}
+    for e in health:
+        kind = e.get("kind")
+        if kind == "link_degraded":
+            live_links[(e.get("src"), e.get("dst"))] = e
+        elif kind == "link_restored":
+            live_links.pop((e.get("src"), e.get("dst")), None)
     out = {
         "gang_dir": gang_dir,
         "world": len(rank_rows),
@@ -198,6 +210,7 @@ def collect(gang_dir: str, telemetry_dir: str) -> dict:
         "pending_joins": pending_joins,
         "health": health,
         "faults_fired": snap["faults_fired"],
+        "degraded_links": list(live_links.values()),
         "transport": transport_health,
         "serving": serving_summary,
         "serving_history": serving_history,
@@ -311,6 +324,26 @@ def render(status: dict) -> str:
                and e.get("target") != e.get("rank") else "")
         lines.append(f"  fault fired: {e.get('kind')} rank "
                      f"{e.get('rank')} at {e.get('at')}{tgt}")
+
+    dl = status.get("degraded_links") or []
+    if dl:
+        lines.append("== Modeled network: degraded links ==")
+        lines.append(f"  {'link':>11}  {'axis':>5}  {'latency':>10}  "
+                     f"{'bandwidth':>10}  {'loss':>5}  fault")
+        for e in dl:
+            lat = (f"{e['latency_s'] * 1e6:.1f}µs"
+                   if isinstance(e.get("latency_s"), (int, float))
+                   else "-")
+            bw = (f"{e['bytes_per_s'] / 1e9:.1f}GB/s"
+                  if isinstance(e.get("bytes_per_s"), (int, float))
+                  else "-")
+            loss = (f"{e['flaky_p']:.2f}"
+                    if isinstance(e.get("flaky_p"), (int, float))
+                    and e["flaky_p"] else "-")
+            lines.append(
+                f"  {e.get('src', '?'):>4} -> {e.get('dst', '?'):>4}  "
+                f"{e.get('axis', '?'):>5}  {lat:>10}  {bw:>10}  "
+                f"{loss:>5}  {e.get('source', '?')}")
 
     sv = status.get("serving")
     sv_hist = status.get("serving_history") or []
